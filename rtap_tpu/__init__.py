@@ -1,0 +1,32 @@
+"""rtap_tpu — TPU-native real-time anomaly prediction for distributed systems.
+
+A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+`atambol/Real-time-anomaly-prediction-in-distributed-systems` (an HTM-based
+per-node-metric anomaly pipeline built on NuPIC — see SURVEY.md for the full
+reconstruction): RDSE encoding -> Spatial Pooler -> Temporal Memory -> raw
+anomaly score on device, rolling-Gaussian anomaly likelihood + alerting on
+host, vmapped/sharded over thousands of concurrent metric streams.
+
+Layout:
+    config      typed model/runtime configs with NAB-preset defaults
+    utils       deterministic hashing, RNG schedules, logging
+    data        synthetic cluster generator, NAB-format corpus IO, stream sources
+    nab         NAB scorer/sweeper/runner (public NAB scoring spec)
+    models      CPU oracle (numpy, the semantic spec) + HTMModel/AnomalyDetector factory
+    ops         TPU kernels: SP, TM, fused step (JAX + Pallas)
+    parallel    mesh/sharding over the ("streams",) axis, host<->device feed
+    service     stream registry, alerting, checkpointing
+"""
+
+__version__ = "0.1.0"
+
+from rtap_tpu.config import (  # noqa: F401
+    DateConfig,
+    LikelihoodConfig,
+    ModelConfig,
+    RDSEConfig,
+    SPConfig,
+    TMConfig,
+    cluster_preset,
+    nab_preset,
+)

@@ -1,0 +1,230 @@
+"""Typed configuration for the HTM anomaly pipeline.
+
+The reference (a NuPIC application — SURVEY.md L2/L3) configures models via
+nested `modelParams` dicts copied from NAB's tuned parameter JSONs
+(SURVEY.md §5 "Config / flag system"). We replace those with frozen
+dataclasses plus two blessed presets:
+
+- :func:`nab_preset` — NuPIC/NAB-scale model (2048 columns, 32 cells/col),
+  used for detection-quality runs on NAB-format corpora (benchmark configs
+  1-2 in BASELINE.md).
+- :func:`cluster_preset` — a small-footprint model for massive stream counts
+  (benchmark configs 3 and 5: 1k-100k concurrent streams on one chip), where
+  per-stream HBM budget is the binding constraint (SURVEY.md §7 hard part 4).
+
+All sizes are static so every kernel compiles to fixed shapes (XLA
+requirement); segment/synapse pools are bounded capacity by design, mirroring
+NuPIC's maxSegmentsPerCell / maxSynapsesPerSegment bounds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class RDSEConfig:
+    """Random Distributed Scalar Encoder (SURVEY.md C1).
+
+    Scalar -> sparse binary SDR. A value maps to bucket
+    ``b = round((value - offset) / resolution)``; bucket ``b`` activates bits
+    ``{hash(seed, b + k) % size : k in 0..active_bits-1}``. Adjacent buckets
+    share ``active_bits - 1`` hash keys, so SDR overlap decays linearly with
+    bucket distance — the defining RDSE property. Hash collisions within one
+    bucket are tolerated (the SDR then has active_bits-1 on bits), the same
+    deterministic-union approach used by the public htm.core RDSE; this keeps
+    the encoder table-free and device-computable.
+
+    ``offset`` is bound to the first value a stream sees (NuPIC behavior),
+    stored in per-stream state.
+    """
+
+    size: int = 400
+    active_bits: int = 21
+    resolution: float = 0.9
+    seed: int = 42
+
+
+@dataclass(frozen=True)
+class DateConfig:
+    """Date/time encoder (SURVEY.md C2): periodic time-of-day + weekend bits.
+
+    ``time_of_day_width`` bits win a contiguous (wrapping) run on a periodic
+    ring of ``time_of_day_size`` bits covering 24h. ``weekend_width`` bits are
+    all-on during Sat/Sun, all-off otherwise. Width 0 disables a field.
+    """
+
+    time_of_day_width: int = 21
+    time_of_day_size: int = 54  # ring size; NuPIC n = w * period/radius ~ 21*24/9.49
+    weekend_width: int = 0
+
+    @property
+    def size(self) -> int:
+        return (self.time_of_day_size if self.time_of_day_width else 0) + self.weekend_width
+
+
+@dataclass(frozen=True)
+class SPConfig:
+    """Spatial Pooler (SURVEY.md C3) — global inhibition variant.
+
+    Semantics follow the public NuPIC SpatialPooler (overlap = count of
+    connected synapses on active inputs; boost; global top-k inhibition;
+    Hebbian permanence learning), re-laid-out as dense per-column arrays:
+    a fixed potential mask [columns, input_size] and a dense permanence
+    matrix masked by it. Tie-breaks in the top-k are deterministic by lower
+    column index (score = overlap * columns + (columns-1-c)), identical in
+    the numpy oracle and the TPU kernel.
+    """
+
+    columns: int = 2048
+    potential_pct: float = 0.8
+    syn_perm_connected: float = 0.2
+    syn_perm_active_inc: float = 0.003
+    syn_perm_inactive_dec: float = 0.0005
+    stimulus_threshold: int = 0
+    num_active_columns: int = 40  # k winners (global inhibition)
+    boost_strength: float = 0.0
+    duty_cycle_period: int = 1000
+    min_pct_overlap_duty_cycle: float = 0.001
+    syn_perm_below_stimulus_inc: float = 0.01  # bump for starved columns
+    seed: int = 1956
+
+
+@dataclass(frozen=True)
+class TMConfig:
+    """Temporal Memory (SURVEY.md C4/C5) — vanilla TM with bounded dense pools.
+
+    NuPIC's pointer-graph `Connections` store becomes pre-allocated pools
+    (SURVEY.md §7 design stance): per cell, ``max_segments_per_cell`` segment
+    slots x ``max_synapses_per_segment`` synapse slots, each synapse a
+    (presynaptic cell id, permanence) pair; id < 0 marks an empty slot.
+    Segment allocation uses free slots first, then evicts the least recently
+    used segment (NuPIC's eviction rule). Winner-cell and best-segment
+    tie-breaks are deterministic by lowest index.
+    """
+
+    cells_per_column: int = 32
+    activation_threshold: int = 13
+    min_threshold: int = 10
+    initial_permanence: float = 0.21
+    connected_permanence: float = 0.5
+    permanence_increment: float = 0.1
+    permanence_decrement: float = 0.1
+    predicted_segment_decrement: float = 0.001
+    max_segments_per_cell: int = 16
+    max_synapses_per_segment: int = 32
+    new_synapse_count: int = 20
+    seed: int = 1960
+
+
+@dataclass(frozen=True)
+class LikelihoodConfig:
+    """Anomaly likelihood post-process (SURVEY.md C8) — stays on host.
+
+    Faithful to the public NuPIC `anomaly_likelihood.py`: keep a rolling
+    window of raw scores, periodically fit a Gaussian to the *moving-averaged*
+    scores, and report ``1 - Q((shortTermMean - mu)/sigma)``, log-scaled.
+
+    ``mode="window"`` keeps the exact rolling window (quality runs);
+    ``mode="streaming"`` replaces it with exponential moving moments so that
+    100k streams do not need a [streams, window] buffer on host
+    (SURVEY.md §7 hard part 5).
+    """
+
+    learning_period: int = 288
+    estimation_samples: int = 100
+    historic_window_size: int = 8640
+    reestimation_period: int = 100
+    averaging_window: int = 10
+    mode: str = "window"  # "window" | "streaming"
+    streaming_decay: float = 0.999  # EMA decay for streaming mode
+
+    @property
+    def probationary_period(self) -> int:
+        return self.learning_period + self.estimation_samples
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Bundle: one HTM anomaly model (per stream or per stream group)."""
+
+    rdse: RDSEConfig = field(default_factory=RDSEConfig)
+    date: DateConfig = field(default_factory=DateConfig)
+    sp: SPConfig = field(default_factory=SPConfig)
+    tm: TMConfig = field(default_factory=TMConfig)
+    likelihood: LikelihoodConfig = field(default_factory=LikelihoodConfig)
+    n_fields: int = 1  # multivariate: number of scalar fields fused into one SDR
+
+    @property
+    def input_size(self) -> int:
+        return self.rdse.size * self.n_fields + self.date.size
+
+    @property
+    def num_cells(self) -> int:
+        return self.sp.columns * self.tm.cells_per_column
+
+    # ---- serialization (JSON round-trip for config files) ----
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ModelConfig":
+        return cls(
+            rdse=RDSEConfig(**d.get("rdse", {})),
+            date=DateConfig(**d.get("date", {})),
+            sp=SPConfig(**d.get("sp", {})),
+            tm=TMConfig(**d.get("tm", {})),
+            likelihood=LikelihoodConfig(**d.get("likelihood", {})),
+            n_fields=d.get("n_fields", 1),
+        )
+
+    @classmethod
+    def from_json(cls, s: str) -> "ModelConfig":
+        return cls.from_dict(json.loads(s))
+
+
+def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
+    """NuPIC/NAB-scale model for detection-quality runs.
+
+    Mirrors the NAB Numenta-detector parameter family (SURVEY.md §5 key
+    defaults): RDSE n=400/w=21 with resolution (max-min)/130, SP 2048
+    columns / 40 winners, TM 32 cells per column. Segment pools are bounded
+    at 16x32 (vs NuPIC's loose 128-segment cap) — dense-pool capacity
+    actually reached by single-metric streams is far below the cap.
+    """
+    resolution = max(0.001, (max_val - min_val) / 130.0)
+    return ModelConfig(
+        rdse=RDSEConfig(size=400, active_bits=21, resolution=resolution),
+        date=DateConfig(time_of_day_width=21, time_of_day_size=54, weekend_width=0),
+        sp=SPConfig(columns=2048, num_active_columns=40),
+        tm=TMConfig(cells_per_column=32, max_segments_per_cell=16,
+                    max_synapses_per_segment=32),
+        likelihood=LikelihoodConfig(mode="window"),
+    )
+
+
+def cluster_preset() -> ModelConfig:
+    """Small-footprint model for 1k-100k concurrent streams on one chip.
+
+    Per-stream HBM budget dominates at 100k streams (16 GB HBM / 100k ~=
+    160 KB per stream — SURVEY.md §7 hard part 4). This preset's device
+    state is ~112 KB/stream in f32 (SP dense perms 256x139, TM pools
+    256x8x4x12), before bf16/int8 compression in the TPU backend.
+    """
+    return ModelConfig(
+        rdse=RDSEConfig(size=128, active_bits=11, resolution=0.5),
+        date=DateConfig(time_of_day_width=0, time_of_day_size=0, weekend_width=0),
+        sp=SPConfig(columns=256, potential_pct=0.8, num_active_columns=10,
+                    syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002),
+        tm=TMConfig(cells_per_column=8, activation_threshold=7, min_threshold=5,
+                    max_segments_per_cell=4, max_synapses_per_segment=12,
+                    new_synapse_count=8),
+        likelihood=LikelihoodConfig(mode="streaming", historic_window_size=512,
+                                    learning_period=100, estimation_samples=50),
+    )

@@ -1,0 +1,2 @@
+from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_stream  # noqa: F401
+from rtap_tpu.data.nab_corpus import NabFile, load_corpus, ensure_standin_corpus  # noqa: F401

@@ -1,0 +1,129 @@
+"""Synthetic cluster-metric generator with labeled fault injection.
+
+Replaces the reference's monitored cluster + fault-injection rig (SURVEY.md
+C17/C21 and §3.5): instead of stressing a live Kubernetes deployment with
+cpu-burn / tc-netem / node-kill, we synthesize per-node per-metric time
+series (diurnal sine + noise, metric-specific baselines) and inject labeled
+anomalies — spike, level shift, drift, stuck-at, dropout — recording ground
+-truth windows in NAB's `combined_windows.json` shape. Deterministic per
+(seed, stream id): the same corpus regenerates bit-identically anywhere.
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass, field, replace
+from typing import Sequence
+
+import numpy as np
+
+from rtap_tpu.utils.hashing import hash_u32_np
+
+ANOMALY_KINDS = ("spike", "level_shift", "drift", "stuck", "dropout")
+
+# Per-metric (baseline, diurnal amplitude, noise sigma, clip range)
+METRIC_PROFILES = {
+    "cpu": (35.0, 20.0, 3.0, (0.0, 100.0)),
+    "mem": (55.0, 10.0, 1.5, (0.0, 100.0)),
+    "net": (20.0, 15.0, 5.0, (0.0, None)),
+    "disk_io": (10.0, 6.0, 2.5, (0.0, None)),
+    "latency_ms": (12.0, 4.0, 2.0, (0.0, None)),
+}
+
+
+@dataclass(frozen=True)
+class SyntheticStreamConfig:
+    length: int = 4000
+    cadence_s: float = 1.0
+    metric: str = "cpu"
+    period_s: float = 86400.0  # diurnal
+    n_anomalies: int = 3
+    anomaly_magnitude: float = 4.0  # in units of noise sigma
+    start_unix: int = 1_700_000_000
+
+
+@dataclass
+class LabeledStream:
+    """One generated stream: values + ground-truth anomaly windows."""
+
+    stream_id: str
+    timestamps: np.ndarray  # int64 unix seconds, [T]
+    values: np.ndarray  # float32, [T]
+    windows: list[tuple[int, int]] = field(default_factory=list)  # unix-sec spans
+
+
+def _rng_for(seed: int, stream_id: str) -> np.random.Generator:
+    # zlib.crc32 is process-independent (unlike builtin hash with its salt),
+    # keeping the "regenerates bit-identically anywhere" contract.
+    sid_hash = int(hash_u32_np(np.uint32(zlib.crc32(stream_id.encode())), seed))
+    return np.random.Generator(np.random.Philox(key=(seed, sid_hash)))
+
+
+def generate_stream(
+    stream_id: str, cfg: SyntheticStreamConfig, seed: int = 0
+) -> LabeledStream:
+    """Generate one labeled stream.
+
+    The base signal is baseline + diurnal sine (phase hashed from stream id)
+    + Gaussian noise; `cfg.n_anomalies` injections are placed in the
+    post-probation region with jittered spacing, each a random kind from
+    ANOMALY_KINDS. Window labels span the injected interval plus a small
+    margin, mirroring how NAB windows surround each anomaly.
+    """
+    rng = _rng_for(seed, stream_id)
+    base, amp, sigma, clip = METRIC_PROFILES.get(cfg.metric, METRIC_PROFILES["cpu"])
+    t_idx = np.arange(cfg.length, dtype=np.float64)
+    t_unix = (cfg.start_unix + t_idx * cfg.cadence_s).astype(np.int64)
+    phase = rng.uniform(0, 2 * np.pi)
+    signal = (
+        base
+        + amp * np.sin(2 * np.pi * t_idx * cfg.cadence_s / cfg.period_s + phase)
+        + rng.normal(0.0, sigma, cfg.length)
+    )
+
+    windows: list[tuple[int, int]] = []
+    if cfg.n_anomalies > 0:
+        # keep injections clear of the likelihood probation region (~15%)
+        lo = int(cfg.length * 0.25)
+        centers = np.sort(rng.choice(np.arange(lo, cfg.length - 50), size=cfg.n_anomalies, replace=False))
+        for c in centers:
+            kind = ANOMALY_KINDS[rng.integers(len(ANOMALY_KINDS))]
+            dur = int(rng.integers(5, 40))
+            s, e = int(c), min(int(c) + dur, cfg.length - 1)
+            mag = cfg.anomaly_magnitude * sigma
+            if kind == "spike":
+                signal[s : s + max(1, dur // 4)] += mag * rng.choice([-1.0, 1.0])
+            elif kind == "level_shift":
+                signal[s:] += mag * rng.choice([-1.0, 1.0])
+            elif kind == "drift":
+                ramp = np.linspace(0.0, mag, e - s)
+                signal[s:e] += ramp
+                signal[e:] += mag
+            elif kind == "stuck":
+                signal[s:e] = signal[s]
+            elif kind == "dropout":
+                signal[s:e] = 0.0
+            margin = max(2, dur // 2)
+            windows.append((int(t_unix[max(0, s - margin)]), int(t_unix[min(cfg.length - 1, e + margin)])))
+
+    if clip[0] is not None:
+        signal = np.maximum(signal, clip[0])
+    if clip[1] is not None:
+        signal = np.minimum(signal, clip[1])
+    return LabeledStream(stream_id, t_unix, signal.astype(np.float32), windows)
+
+
+def generate_cluster(
+    n_nodes: int,
+    metrics: Sequence[str] = ("cpu", "mem", "net"),
+    cfg: SyntheticStreamConfig | None = None,
+    seed: int = 0,
+) -> list[LabeledStream]:
+    """`n_nodes * len(metrics)` labeled streams, ids `node{i:05d}.{metric}`."""
+    cfg = cfg or SyntheticStreamConfig()
+    out = []
+    for i in range(n_nodes):
+        for m in metrics:
+            scfg = replace(cfg, metric=m)
+            out.append(generate_stream(f"node{i:05d}.{m}", scfg, seed=seed))
+    return out

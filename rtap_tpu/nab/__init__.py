@@ -1,0 +1,8 @@
+from rtap_tpu.nab.scorer import (  # noqa: F401
+    PROFILES,
+    CostProfile,
+    optimize_threshold,
+    scaled_sigmoid,
+    score_corpus,
+    score_file,
+)

@@ -1,0 +1,172 @@
+"""NAB (Numenta Anomaly Benchmark) scorer — reimplemented from the public spec.
+
+The reference's headline quality metric is its NAB score (SURVEY.md §3.4,
+§6); NAB itself could not be vendored offline, so this module reimplements
+the published scoring algorithm (NAB paper "Evaluating Real-Time Anomaly
+Detection Algorithms" + the nab/sweeper.py semantics described in SURVEY.md
+C23):
+
+- Each labeled anomaly has a window; the FIRST detection inside a window
+  earns a true-positive credit weighted by a scaled sigmoid of its relative
+  position (early detection -> credit near +1, at window end -> 0). Later
+  detections inside the same window are ignored.
+- A detection outside any window is a false positive: negative credit, -1.0
+  if before any window, else a sigmoid decay based on distance from the
+  preceding window's right edge (capped at -1 beyond 3 window-widths).
+- A window with no detection is a false negative: costs fn_weight.
+- Rows within the probationary period (15% of min(T, 5000)) are ignored.
+- The corpus score uses ONE threshold optimized over the whole corpus, then
+  is normalized 100 * (raw - null) / (perfect - null), where null = no
+  detections and perfect = first-row-of-window detections with no FPs.
+
+Weights per the three published profiles (standard / reward_low_FP /
+reward_low_FN).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class CostProfile:
+    name: str
+    tp_weight: float
+    fp_weight: float
+    fn_weight: float
+
+
+PROFILES = {
+    "standard": CostProfile("standard", 1.0, 0.11, 1.0),
+    "reward_low_FP": CostProfile("reward_low_FP", 1.0, 0.22, 1.0),
+    "reward_low_FN": CostProfile("reward_low_FN", 1.0, 0.11, 2.0),
+}
+
+PROBATION_PERCENT = 0.15
+PROBATION_CAP = 5000
+
+
+def probation_rows(n_rows: int) -> int:
+    return int(PROBATION_PERCENT * min(n_rows, PROBATION_CAP))
+
+
+def scaled_sigmoid(rel_pos: np.ndarray | float) -> np.ndarray | float:
+    """NAB's scaled sigmoid: +0.9866 at window start (-1), 0 at window end (0),
+    decaying to -1 for positions after the window; flat -1 beyond rel_pos 3."""
+    rel = np.asarray(rel_pos, dtype=np.float64)
+    val = 2.0 / (1.0 + np.exp(5.0 * np.minimum(rel, 4.0))) - 1.0
+    val = np.where(rel > 3.0, -1.0, val)
+    return float(val) if np.isscalar(rel_pos) else val
+
+
+def _window_indices(
+    timestamps: np.ndarray, windows: list[tuple[int, int]]
+) -> list[tuple[int, int]]:
+    """Convert unix-second windows to [left_idx, right_idx] inclusive row spans."""
+    out = []
+    for a, b in windows:
+        idx = np.nonzero((timestamps >= a) & (timestamps <= b))[0]
+        if len(idx):
+            out.append((int(idx[0]), int(idx[-1])))
+    return out
+
+
+def score_file(
+    detections: np.ndarray,
+    timestamps: np.ndarray,
+    windows: list[tuple[int, int]],
+    profile: CostProfile,
+) -> float:
+    """Raw NAB score of one file given binary detections per row."""
+    spans = _window_indices(timestamps, windows)
+    return _score_spans(detections, spans, profile)
+
+
+def _score_spans(
+    detections: np.ndarray, spans: list[tuple[int, int]], profile: CostProfile
+) -> float:
+    """Raw score given precomputed window row-spans (hot path of the sweep)."""
+    n = len(detections)
+    prob = probation_rows(n)
+    det_idx = np.nonzero(detections)[0]
+    det_idx = det_idx[det_idx >= prob]
+
+    score = 0.0
+    credited: set[int] = set()
+    for i in det_idx:
+        in_window = False
+        for w_i, (l, r) in enumerate(spans):
+            if l <= i <= r:
+                in_window = True
+                if w_i not in credited:
+                    credited.add(w_i)
+                    width = max(r - l, 1)
+                    rel = (i - r) / width  # -1 at left edge, 0 at right edge
+                    score += profile.tp_weight * scaled_sigmoid(rel)
+                break
+        if not in_window:
+            # FP: sigmoid decay from preceding window's right edge; -1 before any
+            prev = [(l, r) for (l, r) in spans if r < i]
+            if prev:
+                l, r = prev[-1]
+                width = max(r - l, 1)
+                rel = (i - r) / width  # > 0
+                score += profile.fp_weight * scaled_sigmoid(rel)
+            else:
+                score += profile.fp_weight * -1.0
+    # FNs
+    score -= profile.fn_weight * (len(spans) - len(credited))
+    return score
+
+
+def _prepare(
+    per_file: list[tuple[np.ndarray, np.ndarray, list[tuple[int, int]]]],
+    profile: CostProfile,
+) -> tuple[list[tuple[np.ndarray, list[tuple[int, int]]]], float, float]:
+    """Precompute threshold-independent state: row spans + perfect/null totals."""
+    prepped, perfect, null = [], 0.0, 0.0
+    for scores, ts, windows in per_file:
+        spans = _window_indices(ts, windows)
+        prepped.append((scores, spans))
+        perfect += profile.tp_weight * scaled_sigmoid(-1.0) * len(spans)
+        null += -profile.fn_weight * len(spans)
+    return prepped, perfect, null
+
+
+def score_corpus(
+    per_file: list[tuple[np.ndarray, np.ndarray, list[tuple[int, int]]]],
+    threshold: float,
+    profile: CostProfile,
+) -> float:
+    """Normalized corpus score (0-100 scale; null=0, perfect=100) at a fixed
+    threshold. `per_file` entries are (anomaly_scores, timestamps, windows)."""
+    prepped, perfect, null = _prepare(per_file, profile)
+    if perfect == null:
+        return 0.0
+    raw = sum(_score_spans(s >= threshold, spans, profile) for s, spans in prepped)
+    return 100.0 * (raw - null) / (perfect - null)
+
+
+def optimize_threshold(
+    per_file: list[tuple[np.ndarray, np.ndarray, list[tuple[int, int]]]],
+    profile: CostProfile,
+    max_candidates: int = 200,
+) -> tuple[float, float]:
+    """Sweep candidate thresholds (quantiles of the pooled score distribution,
+    as in NAB's exhaustive sweeper) -> (best_threshold, best_normalized_score)."""
+    pooled = np.concatenate([s for s, _, _ in per_file]) if per_file else np.array([0.5])
+    qs = np.unique(np.quantile(pooled, np.linspace(0.0, 1.0, max_candidates)))
+    candidates = np.unique(np.concatenate([qs, [0.5, 0.9, 0.99, 1.0, 1.1]]))
+    prepped, perfect, null = _prepare(per_file, profile)
+    best_t, best_s = 1.1, -np.inf
+    for t in candidates:
+        if perfect == null:
+            s = 0.0
+        else:
+            raw = sum(_score_spans(sc >= t, spans, profile) for sc, spans in prepped)
+            s = 100.0 * (raw - null) / (perfect - null)
+        if s > best_s:
+            best_t, best_s = float(t), s
+    return best_t, best_s

@@ -1,0 +1,1 @@
+from rtap_tpu.utils.hashing import fmix32_np, hash_bits_np  # noqa: F401

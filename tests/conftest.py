@@ -1,0 +1,13 @@
+"""Test harness config: run all tests on CPU with 8 virtual devices.
+
+Real-TPU execution is exercised by bench.py and the driver's compile checks;
+tests validate semantics + sharding on the virtual CPU mesh (SURVEY.md §4
+item 6). Must run before anything imports jax.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla:
+    os.environ["XLA_FLAGS"] = (_xla + " --xla_force_host_platform_device_count=8").strip()

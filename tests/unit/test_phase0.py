@@ -1,0 +1,168 @@
+"""Phase-0 unit tests: hashing, synthetic data, NAB corpus IO, NAB scorer."""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.data.nab_corpus import NabFile, ensure_standin_corpus, load_corpus, write_corpus
+from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_cluster, generate_stream
+from rtap_tpu.nab.scorer import (
+    PROFILES,
+    optimize_threshold,
+    probation_rows,
+    scaled_sigmoid,
+    score_corpus,
+    score_file,
+)
+from rtap_tpu.utils.hashing import hash_bits_np, hash_u32_np
+
+
+class TestHashing:
+    def test_deterministic(self):
+        k = np.arange(1000)
+        a, b = hash_u32_np(k, 42), hash_u32_np(k, 42)
+        np.testing.assert_array_equal(a, b)
+
+    def test_seed_changes_output(self):
+        k = np.arange(1000)
+        assert (hash_u32_np(k, 1) != hash_u32_np(k, 2)).mean() > 0.99
+
+    def test_uniformity(self):
+        bits = hash_bits_np(np.arange(100_000), 7, 400)
+        counts = np.bincount(bits, minlength=400)
+        assert counts.min() > 150 and counts.max() < 350  # ~250 expected
+
+    def test_negative_keys_ok(self):
+        assert hash_bits_np(np.array([-5]), 3, 400)[0] >= 0
+
+
+class TestSynthetic:
+    def test_deterministic(self):
+        cfg = SyntheticStreamConfig(length=500)
+        a = generate_stream("node0.cpu", cfg, seed=3)
+        b = generate_stream("node0.cpu", cfg, seed=3)
+        np.testing.assert_array_equal(a.values, b.values)
+        assert a.windows == b.windows
+
+    def test_labels_cover_injections(self):
+        cfg = SyntheticStreamConfig(length=2000, n_anomalies=4)
+        s = generate_stream("node1.cpu", cfg, seed=5)
+        assert len(s.windows) == 4
+        for a, b in s.windows:
+            assert s.timestamps[0] <= a <= b <= s.timestamps[-1]
+
+    def test_cluster_shape(self):
+        streams = generate_cluster(3, ("cpu", "mem"), SyntheticStreamConfig(length=100))
+        assert len(streams) == 6
+        assert streams[0].stream_id == "node00000.cpu"
+
+    def test_cpu_clipped(self):
+        s = generate_stream("n.cpu", SyntheticStreamConfig(length=3000, metric="cpu"), 0)
+        assert s.values.min() >= 0.0 and s.values.max() <= 100.0
+
+
+class TestCorpusIO:
+    def test_round_trip(self, tmp_path):
+        s = generate_stream("x", SyntheticStreamConfig(length=300, cadence_s=300.0), 1)
+        nf = NabFile("cat/x.csv", s.timestamps, s.values, s.windows)
+        write_corpus(tmp_path, [nf])
+        loaded = load_corpus(tmp_path)
+        assert len(loaded) == 1
+        assert loaded[0].name == "cat/x.csv"
+        np.testing.assert_array_equal(loaded[0].timestamps, nf.timestamps)
+        np.testing.assert_allclose(loaded[0].values, nf.values, atol=1e-4)
+        assert loaded[0].windows == nf.windows
+
+    def test_standin_corpus(self, tmp_path):
+        root = ensure_standin_corpus(tmp_path / "nab")
+        files = load_corpus(root)
+        names = {f.name for f in files}
+        assert "realAWSCloudwatch/ec2_cpu_utilization_5f5533.csv" in names
+        assert all(len(f.windows) > 0 for f in files)
+        # regeneration is a no-op (cached on disk)
+        assert ensure_standin_corpus(tmp_path / "nab") == root
+
+    def test_subset_filter(self, tmp_path):
+        root = ensure_standin_corpus(tmp_path / "nab")
+        files = load_corpus(root, subset="realAWSCloudwatch")
+        assert all(f.name.startswith("realAWSCloudwatch") for f in files)
+        assert len(files) == 6
+
+
+def _mkfile(n=1000, windows=((400, 449), (700, 749))):
+    ts = np.arange(n, dtype=np.int64)
+    return ts, [(int(a), int(b)) for a, b in windows]
+
+
+class TestScorer:
+    def test_scaled_sigmoid_endpoints(self):
+        assert scaled_sigmoid(-1.0) == pytest.approx(0.98661, abs=1e-4)
+        assert scaled_sigmoid(0.0) == pytest.approx(0.0, abs=1e-9)
+        assert scaled_sigmoid(4.0) == -1.0
+        assert scaled_sigmoid(1.0) == pytest.approx(-0.98661, abs=1e-4)
+
+    def test_perfect_is_100_null_is_0(self):
+        ts, windows = _mkfile()
+        prof = PROFILES["standard"]
+        scores_perfect = np.zeros(1000)
+        scores_perfect[400] = scores_perfect[700] = 1.0  # window starts
+        scores_null = np.zeros(1000)
+        per_perfect = [(scores_perfect, ts, windows)]
+        per_null = [(scores_null, ts, windows)]
+        assert score_corpus(per_perfect, 0.5, prof) == pytest.approx(100.0)
+        assert score_corpus(per_null, 0.5, prof) == pytest.approx(0.0)
+
+    def test_late_detection_scores_less(self):
+        ts, windows = _mkfile()
+        prof = PROFILES["standard"]
+        early, late = np.zeros(1000), np.zeros(1000)
+        early[405], late[445] = 1.0, 1.0
+        s_early = score_file(early >= 0.5, ts, windows, prof)
+        s_late = score_file(late >= 0.5, ts, windows, prof)
+        assert s_early > s_late > -2.0  # both better than missing both windows
+
+    def test_fp_penalty(self):
+        ts, windows = _mkfile()
+        prof = PROFILES["standard"]
+        fp = np.zeros(1000)
+        fp[300] = 1.0  # outside any window, after probation
+        assert score_file(fp >= 0.5, ts, windows, prof) == pytest.approx(
+            -prof.fp_weight - 2 * prof.fn_weight
+        )
+
+    def test_second_detection_in_window_ignored(self):
+        ts, windows = _mkfile()
+        prof = PROFILES["standard"]
+        one, two = np.zeros(1000), np.zeros(1000)
+        one[410] = 1.0
+        two[410] = two[420] = 1.0
+        assert score_file(one >= 0.5, ts, windows, prof) == pytest.approx(
+            score_file(two >= 0.5, ts, windows, prof)
+        )
+
+    def test_probation_ignored(self):
+        ts, windows = _mkfile()
+        prof = PROFILES["standard"]
+        det = np.zeros(1000)
+        det[10] = 1.0  # inside probation (150 rows)
+        assert probation_rows(1000) == 150
+        assert score_file(det >= 0.5, ts, windows, prof) == pytest.approx(-2.0)
+
+    def test_optimize_threshold_finds_separator(self):
+        ts, windows = _mkfile()
+        prof = PROFILES["standard"]
+        rng = np.random.default_rng(0)
+        scores = rng.uniform(0, 0.3, 1000)
+        scores[405] = 0.95  # clear detection in window 1
+        scores[705] = 0.95  # window 2
+        t, s = optimize_threshold([(scores, ts, windows)], prof)
+        assert 0.3 < t <= 0.95
+        assert s > 90.0
+
+    def test_profiles_order(self):
+        # an FP hurts reward_low_FP more than standard
+        ts, windows = _mkfile()
+        det = np.zeros(1000)
+        det[300] = 1.0
+        s_std = score_file(det >= 0.5, ts, windows, PROFILES["standard"])
+        s_fp = score_file(det >= 0.5, ts, windows, PROFILES["reward_low_FP"])
+        assert s_fp < s_std
