@@ -189,6 +189,13 @@ class ModelConfig:
         return cls.from_dict(json.loads(s))
 
 
+def rdse_resolution(min_val: float, max_val: float, buckets: int = 130) -> float:
+    """NAB's encoder-resolution rule: the expected value range spans ~130
+    buckets (SURVEY.md §5 key defaults). Single source of truth — the preset
+    and the per-file rescale in nab/runner.py both use it."""
+    return max(0.001, (max_val - min_val) / float(buckets))
+
+
 def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
     """NuPIC/NAB-scale model for detection-quality runs.
 
@@ -198,7 +205,7 @@ def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
     at 16x32 (vs NuPIC's loose 128-segment cap) — dense-pool capacity
     actually reached by single-metric streams is far below the cap.
     """
-    resolution = max(0.001, (max_val - min_val) / 130.0)
+    resolution = rdse_resolution(min_val, max_val)
     return ModelConfig(
         rdse=RDSEConfig(size=400, active_bits=21, resolution=resolution),
         date=DateConfig(time_of_day_width=21, time_of_day_size=54, weekend_width=0),

@@ -109,9 +109,13 @@ def write_corpus(root: str | Path, files: list[NabFile]) -> None:
 def _standin_files(seed: int = 7) -> list[NabFile]:
     out = []
     for rel, metric, rows in STANDIN_FILES:
+        # noise_scale keeps the stand-in as smooth as real CloudWatch series:
+        # per-step noise must stay within ~1 encoder bucket (range/130) or the
+        # TM never converges and anomalies drown in baseline jitter
         cfg = SyntheticStreamConfig(
             length=rows, cadence_s=300.0, metric=metric, n_anomalies=3,
-            anomaly_magnitude=5.0,
+            anomaly_magnitude=8.0, noise_scale=0.35,
+            kinds=("spike", "level_shift", "dropout"),
         )
         ls: LabeledStream = generate_stream(rel, cfg, seed=seed)
         out.append(NabFile(rel, ls.timestamps, ls.values, ls.windows))

@@ -38,7 +38,12 @@ class SyntheticStreamConfig:
     metric: str = "cpu"
     period_s: float = 86400.0  # diurnal
     n_anomalies: int = 3
-    anomaly_magnitude: float = 4.0  # in units of noise sigma
+    anomaly_magnitude: float = 4.0  # in units of (scaled) noise sigma
+    noise_scale: float = 1.0  # multiplier on the metric's noise sigma
+    # which fault kinds to inject; "drift" and "stuck" are near-invisible to
+    # point-anomaly detectors by design (gradual / too-regular) — include them
+    # only when evaluating that hard class
+    kinds: tuple[str, ...] = ANOMALY_KINDS
     start_unix: int = 1_700_000_000
 
 
@@ -72,6 +77,7 @@ def generate_stream(
     """
     rng = _rng_for(seed, stream_id)
     base, amp, sigma, clip = METRIC_PROFILES.get(cfg.metric, METRIC_PROFILES["cpu"])
+    sigma = sigma * cfg.noise_scale
     t_idx = np.arange(cfg.length, dtype=np.float64)
     t_unix = (cfg.start_unix + t_idx * cfg.cadence_s).astype(np.int64)
     phase = rng.uniform(0, 2 * np.pi)
@@ -87,7 +93,7 @@ def generate_stream(
         lo = int(cfg.length * 0.25)
         centers = np.sort(rng.choice(np.arange(lo, cfg.length - 50), size=cfg.n_anomalies, replace=False))
         for c in centers:
-            kind = ANOMALY_KINDS[rng.integers(len(ANOMALY_KINDS))]
+            kind = cfg.kinds[rng.integers(len(cfg.kinds))]
             dur = int(rng.integers(5, 40))
             s, e = int(c), min(int(c) + dur, cfg.length - 1)
             mag = cfg.anomaly_magnitude * sigma

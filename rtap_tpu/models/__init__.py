@@ -1,0 +1,1 @@
+from rtap_tpu.models.htm_model import AnomalyDetector, HTMModel, ModelResult, create_model  # noqa: F401

@@ -1,0 +1,114 @@
+"""HTMModel / AnomalyDetector factory — the plugin boundary.
+
+This is the analog of the reference's `ModelFactory.create(modelParams)` ->
+`HTMPredictionModel.run(record)` -> `inferences["anomalyScore"]` surface
+(SURVEY.md C9, §3.1-3.2), which BASELINE.json designates as the plugin seam:
+the CPU path is the default backend and TPU is opt-in. `backend="cpu"` runs
+the numpy oracle in this process; `backend="tpu"` routes the SDR hot loop
+through the jitted device step (ops/), keeping likelihood on host.
+
+Single-stream convenience API; high-throughput multi-stream execution goes
+through service/registry.py stream groups instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig, nab_preset
+from rtap_tpu.models.oracle.encoders import encode_record
+from rtap_tpu.models.oracle.likelihood import AnomalyLikelihood
+from rtap_tpu.models.oracle.spatial_pooler import sp_compute
+from rtap_tpu.models.oracle.temporal_memory import TMOracle
+from rtap_tpu.models.state import init_state
+
+BACKENDS = ("cpu", "tpu")
+
+
+@dataclass
+class ModelResult:
+    """Per-record inference output (the reference's ModelResult.inferences)."""
+
+    raw_score: float  # 1 - |active ∩ predicted| / |active|
+    likelihood: float  # rolling-Gaussian tail probability complement
+    log_likelihood: float  # NuPIC log-scaled likelihood (the detection score)
+
+
+class HTMModel:
+    """One HTM anomaly model over one (possibly multivariate) metric stream."""
+
+    def __init__(self, cfg: ModelConfig, seed: int = 0, backend: str = "cpu"):
+        if backend not in BACKENDS:
+            raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
+        self.cfg = cfg
+        self.backend = backend
+        self.seed = seed
+        self.state = init_state(cfg, seed)
+        self.likelihood = AnomalyLikelihood(cfg.likelihood)
+        if backend == "cpu":
+            self._tm = TMOracle(self.state, cfg.tm)
+        else:
+            from rtap_tpu.ops.step import TpuStepRunner  # deferred: jax import
+
+            self._runner = TpuStepRunner(cfg, self.state)
+
+    def run(self, timestamp: int, value: float | np.ndarray, learn: bool = True) -> ModelResult:
+        """Process one record; returns scores. Mirrors model.run({...})."""
+        values = np.atleast_1d(np.asarray(value, np.float32))
+        # bind each field's offset at its first finite value (a leading NaN
+        # must not poison the stream's bucket arithmetic forever)
+        bind = ~self.state["enc_bound"] & np.isfinite(values)
+        if bind.any():
+            self.state["enc_offset"] = np.where(bind, values, self.state["enc_offset"]).astype(np.float32)
+            self.state["enc_bound"] = self.state["enc_bound"] | bind
+
+        if self.backend == "cpu":
+            sdr = encode_record(self.cfg, values, int(timestamp), self.state["enc_offset"])
+            active = sp_compute(self.state, sdr, self.cfg.sp, learn)
+            raw = self._tm.compute(active, learn)
+        else:
+            raw = self._runner.step(values, int(timestamp), learn)
+
+        lik, loglik = self.likelihood.update(float(raw))
+        return ModelResult(float(raw), lik, loglik)
+
+
+def create_model(
+    cfg: ModelConfig | None = None,
+    backend: str = "cpu",
+    seed: int = 0,
+    min_val: float = 0.0,
+    max_val: float = 100.0,
+) -> HTMModel:
+    """ModelFactory.create analog. With no explicit config, builds the NAB
+    preset sized to the stream's expected [min_val, max_val] range (NAB hands
+    detectors the per-file input range the same way)."""
+    return HTMModel(cfg or nab_preset(min_val, max_val), seed=seed, backend=backend)
+
+
+class AnomalyDetector:
+    """NAB-detector-shaped wrapper: feed records, get detection scores + alerts.
+
+    The reference's service layer thresholds log-likelihood to raise early
+    warnings (SURVEY.md C20, §3.3); `threshold` defaults to the NuPIC-common
+    0.5 on the log scale.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig | None = None,
+        backend: str = "cpu",
+        seed: int = 0,
+        min_val: float = 0.0,
+        max_val: float = 100.0,
+        threshold: float = 0.5,
+    ):
+        self.model = create_model(cfg, backend, seed, min_val, max_val)
+        self.threshold = threshold
+
+    def handle_record(self, timestamp: int, value: float | np.ndarray) -> tuple[float, bool]:
+        """-> (detection score in [0,1] (log-likelihood), alert?)."""
+        res = self.model.run(timestamp, value)
+        return res.log_likelihood, res.log_likelihood >= self.threshold

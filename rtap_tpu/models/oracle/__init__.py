@@ -1,0 +1,13 @@
+"""Pure-numpy CPU oracle — the semantic specification of the HTM pipeline.
+
+Mirrors the role of NuPIC's pure-Python algorithm twins, which exist to pin
+the C++ implementations via parity tests (SURVEY.md §1 L1->L0 note, §4 item
+2). Here the oracle is additionally the default production backend for small
+stream counts (the reference keeps CPU NuPIC as default, TPU opt-in — the
+north star in BASELINE.json).
+"""
+
+from rtap_tpu.models.oracle.encoders import encode_record  # noqa: F401
+from rtap_tpu.models.oracle.spatial_pooler import sp_compute  # noqa: F401
+from rtap_tpu.models.oracle.temporal_memory import TMOracle  # noqa: F401
+from rtap_tpu.models.oracle.likelihood import AnomalyLikelihood  # noqa: F401

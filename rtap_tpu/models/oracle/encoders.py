@@ -1,0 +1,76 @@
+"""Host-side encoders: RDSE + date (time-of-day / weekend) + multi-field.
+
+Semantics per SURVEY.md C1/C2 (NuPIC `random_distributed_scalar.py`,
+`date.py`, `multi.py`), redesigned table-free: RDSE bucket b activates bits
+{hash(seed, b+k) % n : k < w}, so adjacent buckets share w-1 hash keys and
+SDR overlap decays linearly with |Δbucket| — the defining RDSE property —
+with no host-side bucket map to grow or serialize. Identical arithmetic runs
+on-device in ops/encoders_tpu.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.config import DateConfig, ModelConfig, RDSEConfig
+from rtap_tpu.utils.hashing import hash_bits_np
+
+SECONDS_PER_DAY = 86400
+# Unix epoch (1970-01-01) was a Thursday; weekday = (days + 3) % 7 (Mon=0).
+_EPOCH_WEEKDAY_SHIFT = 3
+
+
+def rdse_bucket(value: float | np.ndarray, offset: float | np.ndarray, resolution: float) -> np.ndarray:
+    """Bucket index: round((value - offset) / resolution). NuPIC binds `offset`
+    to the first value a stream sees so buckets stay centered on the data."""
+    return np.round((np.asarray(value, np.float64) - offset) / resolution).astype(np.int64)
+
+
+def rdse_bits(cfg: RDSEConfig, bucket: int, field_index: int = 0) -> np.ndarray:
+    """Active bit indices for one bucket (may contain duplicates — tolerated,
+    see RDSEConfig docstring). Each field of a multivariate record gets its
+    own hash stream via the seed."""
+    keys = bucket + np.arange(cfg.active_bits, dtype=np.int64)
+    return hash_bits_np(keys, cfg.seed + 0x1000 * field_index, cfg.size)
+
+
+def time_of_day_bits(cfg: DateConfig, ts_unix: int) -> np.ndarray:
+    """Periodic encoder over the 24h ring: w contiguous (wrapping) bits
+    centered on the current time of day."""
+    frac = (ts_unix % SECONDS_PER_DAY) / SECONDS_PER_DAY
+    center = int(frac * cfg.time_of_day_size)
+    return (center + np.arange(cfg.time_of_day_width) - cfg.time_of_day_width // 2) % cfg.time_of_day_size
+
+
+def is_weekend(ts_unix: int) -> bool:
+    weekday = (ts_unix // SECONDS_PER_DAY + _EPOCH_WEEKDAY_SHIFT) % 7
+    return weekday >= 5
+
+
+def encode_record(
+    cfg: ModelConfig,
+    values: np.ndarray,
+    ts_unix: int,
+    enc_offset: np.ndarray,
+) -> np.ndarray:
+    """Encode one record (n_fields scalars + timestamp) -> bool[input_size].
+
+    Layout: [field0 RDSE | field1 RDSE | ... | time-of-day ring | weekend].
+    """
+    sdr = np.zeros(cfg.input_size, bool)
+    values = np.atleast_1d(np.asarray(values, np.float64))
+    if len(values) != cfg.n_fields:
+        raise ValueError(f"expected {cfg.n_fields} field value(s), got {len(values)}")
+    for f in range(cfg.n_fields):
+        if not np.isfinite(values[f]):
+            continue  # missing/garbled sample -> no bits for this field (NuPIC behavior)
+        b = int(rdse_bucket(values[f], float(enc_offset[f]), cfg.rdse.resolution))
+        sdr[f * cfg.rdse.size + rdse_bits(cfg.rdse, b, f)] = True
+    base = cfg.n_fields * cfg.rdse.size
+    if cfg.date.time_of_day_width:
+        sdr[base + time_of_day_bits(cfg.date, ts_unix)] = True
+        base += cfg.date.time_of_day_size
+    if cfg.date.weekend_width:
+        if is_weekend(ts_unix):
+            sdr[base : base + cfg.date.weekend_width] = True
+    return sdr

@@ -1,0 +1,100 @@
+"""Spatial Pooler — numpy oracle.
+
+Semantics per SURVEY.md C3 / §3.2 (NuPIC `spatial_pooler.py` +
+`SpatialPooler.cpp`): overlap = connected-synapse count on active inputs,
+boosting, global k-winner inhibition, Hebbian permanence learning, duty
+cycles with weak-column permanence bump.
+
+Deviations from NuPIC, deliberate and shared with the TPU kernel so both
+backends agree bit-for-bit:
+- top-k tie-break is deterministic by lower column index (NuPIC breaks ties
+  by internal ordering of its sort) — encoded as score = overlap*C + (C-1-c);
+- the weak-column bump (raisePermanenceToThreshold) applies every step via
+  duty-cycle comparison rather than NuPIC's every-50-step update period.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.config import SPConfig
+
+
+def sp_overlap(state: dict, input_sdr: np.ndarray, cfg: SPConfig) -> np.ndarray:
+    """Overlap per column: number of connected potential synapses whose
+    presynaptic input bit is active. Indexes the ~w active bits instead of
+    building the full [C, n_in] connected mask (O(C*w) vs O(C*n_in))."""
+    idx = np.nonzero(input_sdr)[0]
+    if len(idx) == 0:
+        return np.zeros(state["perm"].shape[0], np.int64)
+    cols = (state["perm"][:, idx] >= cfg.syn_perm_connected) & state["potential"][:, idx]
+    return cols.sum(1, dtype=np.int64)
+
+
+def sp_inhibit(overlap: np.ndarray, boost: np.ndarray, cfg: SPConfig) -> np.ndarray:
+    """Global k-winner inhibition -> bool[C] active columns.
+
+    Winners are the top `num_active_columns` by boosted overlap with
+    deterministic low-index tie-break; columns below stimulus_threshold
+    (on raw overlap) never win.
+    """
+    C = overlap.shape[0]
+    if cfg.boost_strength > 0.0:
+        # Quantize boosted overlap to 1/256 so the low-index tie-break term
+        # can never override a real (>= 1/256) difference, and so the score is
+        # exact integer arithmetic — identical on CPU oracle and TPU kernel.
+        q = np.round((overlap * boost).astype(np.float32) * 256.0).astype(np.int64)
+        score = q * C + (C - 1 - np.arange(C))
+    else:
+        score = overlap.astype(np.int64) * C + (C - 1 - np.arange(C))
+    k = cfg.num_active_columns
+    winners = np.argsort(score)[::-1][:k]
+    active = np.zeros(C, bool)
+    active[winners] = True
+    active &= overlap >= cfg.stimulus_threshold
+    return active
+
+
+def sp_learn(
+    state: dict, input_sdr: np.ndarray, overlap: np.ndarray, active: np.ndarray, cfg: SPConfig
+) -> None:
+    """Hebbian update on winners + duty cycles + boost + weak-column bump.
+
+    `overlap` is this step's pre-learning overlap (duty cycles measure what
+    the column saw, not what it would see after the update). Mutates `state`
+    in place (the oracle is imperative; the TPU kernel is the functional twin).
+    """
+    perm, potential = state["perm"], state["potential"]
+    inc_mask = active[:, None] & potential & input_sdr[None, :]
+    dec_mask = active[:, None] & potential & ~input_sdr[None, :]
+    perm += cfg.syn_perm_active_inc * inc_mask
+    perm -= cfg.syn_perm_inactive_dec * dec_mask
+    np.clip(perm, 0.0, 1.0, out=perm)
+
+    it = int(state["sp_iter"]) + 1
+    state["sp_iter"] = np.int32(it)
+    period = min(cfg.duty_cycle_period, it)
+    overlap_now = (overlap > 0).astype(np.float32)
+    state["overlap_duty"] = (state["overlap_duty"] * (period - 1) + overlap_now) / period
+    state["active_duty"] = (state["active_duty"] * (period - 1) + active) / period
+
+    if cfg.boost_strength > 0.0:
+        target = cfg.num_active_columns / perm.shape[0]
+        state["boost"] = np.exp((target - state["active_duty"]) * cfg.boost_strength).astype(np.float32)
+
+    # Bump starved columns: below min_pct of the max overlap duty cycle ->
+    # raise all potential permanences (keeps dead columns recoverable).
+    min_duty = cfg.min_pct_overlap_duty_cycle * state["overlap_duty"].max()
+    weak = state["overlap_duty"] < min_duty
+    if weak.any():
+        perm += cfg.syn_perm_below_stimulus_inc * (weak[:, None] & potential)
+        np.clip(perm, 0.0, 1.0, out=perm)
+
+
+def sp_compute(state: dict, input_sdr: np.ndarray, cfg: SPConfig, learn: bool = True) -> np.ndarray:
+    """One SP step -> bool[C] active columns. Mutates state if learn."""
+    overlap = sp_overlap(state, input_sdr, cfg)
+    active = sp_inhibit(overlap, state["boost"], cfg)
+    if learn:
+        sp_learn(state, input_sdr, overlap, active, cfg)
+    return active

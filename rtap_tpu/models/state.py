@@ -1,0 +1,78 @@
+"""Model state initialization — shared by the CPU oracle and the TPU kernels.
+
+The reference's state lives in C++ object graphs (SpatialPooler members,
+Connections' segment/synapse lists — SURVEY.md C3/C5). Here all state is a
+flat dict of fixed-shape numpy arrays, initialized once on host; the TPU
+backend `device_put`s the very same arrays. Using one init for both backends
+makes oracle-vs-TPU parity exact (SURVEY.md §4 item 2).
+
+Layout (single stream; stream groups add a leading G axis):
+
+SP state:
+    potential   bool [C, n_in]   fixed potential pool mask
+    perm        f32  [C, n_in]   permanences (0 outside potential)
+    boost       f32  [C]         boost factors (1.0 when boost_strength == 0)
+    overlap_duty f32 [C]         overlap duty cycles
+    active_duty f32  [C]         activation duty cycles
+    sp_iter     i32  []          records seen
+
+TM state (dense bounded pools; C cols x K cells x S segments x M synapses):
+    presyn      i32 [C,K,S,M]    presynaptic flat cell id, -1 = empty slot
+    syn_perm    f32 [C,K,S,M]    synapse permanences (0 in empty slots)
+    seg_last    i32 [C,K,S]      last-used iteration, -1 = segment free (LRU key)
+    active_seg  bool [C,K,S]     segments active at end of previous step
+    matching_seg bool [C,K,S]    segments matching at end of previous step
+    seg_pot     i32 [C,K,S]      active-potential synapse count at prev step
+    prev_active bool [C,K]       active cells at previous step
+    prev_winner bool [C,K]       winner cells at previous step
+    tm_iter     i32  []
+
+Encoder state:
+    enc_offset  f32 [n_fields]   RDSE offset, bound to first seen value
+    enc_bound   bool []          whether offset has been bound
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+
+
+def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
+    """Build the full per-stream state dict (see module docstring for layout)."""
+    rng = np.random.Generator(np.random.Philox(key=(seed, 0xC0FFEE)))
+    C, n_in = cfg.sp.columns, cfg.input_size
+    K, S, M = cfg.tm.cells_per_column, cfg.tm.max_segments_per_cell, cfg.tm.max_synapses_per_segment
+
+    potential = rng.random((C, n_in)) < cfg.sp.potential_pct
+    # Permanences seeded around the connected threshold so ~half the potential
+    # pool starts connected (NuPIC's init strategy, SURVEY.md C3).
+    perm = np.where(
+        potential,
+        np.clip(cfg.sp.syn_perm_connected + (rng.random((C, n_in)) - 0.5) * 0.1, 0.0, 1.0),
+        0.0,
+    ).astype(np.float32)
+
+    return {
+        # SP
+        "potential": potential,
+        "perm": perm,
+        "boost": np.ones(C, np.float32),
+        "overlap_duty": np.zeros(C, np.float32),
+        "active_duty": np.zeros(C, np.float32),
+        "sp_iter": np.int32(0),
+        # TM
+        "presyn": np.full((C, K, S, M), -1, np.int32),
+        "syn_perm": np.zeros((C, K, S, M), np.float32),
+        "seg_last": np.full((C, K, S), -1, np.int32),
+        "active_seg": np.zeros((C, K, S), bool),
+        "matching_seg": np.zeros((C, K, S), bool),
+        "seg_pot": np.zeros((C, K, S), np.int32),
+        "prev_active": np.zeros((C, K), bool),
+        "prev_winner": np.zeros((C, K), bool),
+        "tm_iter": np.int32(0),
+        # encoder (offset binds per field at the first *finite* value seen)
+        "enc_offset": np.zeros(cfg.n_fields, np.float32),
+        "enc_bound": np.zeros(cfg.n_fields, bool),
+    }

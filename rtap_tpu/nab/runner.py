@@ -1,0 +1,73 @@
+"""NAB corpus runner: detector over every file -> optimized corpus scores.
+
+Analog of NAB's `run.py --detect --score --normalize` (SURVEY.md §3.4): one
+fresh detector per corpus file (sized to that file's value range, as NAB
+does), raw detection scores collected per row, then a single corpus-wide
+threshold sweep per cost profile. The reference parallelizes with one
+process per file (multiprocessing, SURVEY.md §2.3); we expose the same
+option for the CPU backend, while the TPU backend instead batches files
+into one vmapped stream group (service/registry.py).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+from dataclasses import dataclass
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig, nab_preset, rdse_resolution
+from rtap_tpu.data.nab_corpus import NabFile
+from rtap_tpu.models.htm_model import AnomalyDetector
+from rtap_tpu.nab.scorer import PROFILES, optimize_threshold
+
+
+@dataclass
+class NabRunResult:
+    scores: dict[str, tuple[float, float]]  # profile -> (best_threshold, score)
+    per_file: list[tuple[np.ndarray, np.ndarray, list[tuple[int, int]]]]
+
+
+def _file_range_config(nf: NabFile, base_cfg: ModelConfig | None) -> ModelConfig:
+    lo, hi = float(nf.values.min()), float(nf.values.max())
+    if base_cfg is None:
+        return nab_preset(lo, hi)
+    # rescale only the encoder resolution to this file's range, NAB-style
+    import dataclasses
+
+    res = rdse_resolution(lo, hi)
+    return dataclasses.replace(base_cfg, rdse=dataclasses.replace(base_cfg.rdse, resolution=res))
+
+
+def detect_file(
+    nf: NabFile, cfg: ModelConfig | None = None, backend: str = "cpu", seed: int = 0
+) -> np.ndarray:
+    """Run one detector over one file -> detection scores (log-likelihood)."""
+    det = AnomalyDetector(_file_range_config(nf, cfg), backend=backend, seed=seed)
+    out = np.zeros(len(nf.values), np.float64)
+    for i, (t, v) in enumerate(zip(nf.timestamps, nf.values)):
+        out[i], _ = det.handle_record(int(t), float(v))
+    return out
+
+
+def _detect_star(args):
+    return detect_file(*args)
+
+
+def run_corpus(
+    files: list[NabFile],
+    cfg: ModelConfig | None = None,
+    backend: str = "cpu",
+    seed: int = 0,
+    processes: int = 1,
+    profiles: tuple[str, ...] = ("standard", "reward_low_FP", "reward_low_FN"),
+) -> NabRunResult:
+    """Detect + score + normalize over a corpus (NAB run.py analog)."""
+    if processes > 1 and backend == "cpu":
+        with mp.get_context("spawn").Pool(processes) as pool:
+            scores = pool.map(_detect_star, [(nf, cfg, backend, seed) for nf in files])
+    else:
+        scores = [detect_file(nf, cfg, backend, seed) for nf in files]
+    per_file = [(s, nf.timestamps, nf.windows) for s, nf in zip(scores, files)]
+    results = {p: optimize_threshold(per_file, PROFILES[p]) for p in profiles}
+    return NabRunResult(results, per_file)

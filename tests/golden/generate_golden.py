@@ -1,0 +1,63 @@
+"""Regenerate the frozen golden outputs (SURVEY.md §4 item 4).
+
+Run manually after a *deliberate* semantic change:
+    python tests/golden/generate_golden.py
+The paired test regenerates the same deterministic inputs and asserts
+bit-identical raw scores and log-likelihoods against the frozen file.
+"""
+
+from pathlib import Path
+
+import numpy as np
+
+GOLDEN_PATH = Path(__file__).parent / "golden_config1.npz"
+ROWS = 400
+
+
+def golden_config():
+    from rtap_tpu.config import (
+        DateConfig,
+        LikelihoodConfig,
+        ModelConfig,
+        RDSEConfig,
+        SPConfig,
+        TMConfig,
+    )
+
+    # mid-size model: small enough to run in seconds, big enough to exercise
+    # every code path (date bits, boosting off, pools, punishment)
+    return ModelConfig(
+        rdse=RDSEConfig(size=200, active_bits=11, resolution=0.9),
+        date=DateConfig(time_of_day_width=11, time_of_day_size=32),
+        sp=SPConfig(columns=512, num_active_columns=20),
+        tm=TMConfig(cells_per_column=8, activation_threshold=9, min_threshold=6,
+                    max_segments_per_cell=8, max_synapses_per_segment=16,
+                    new_synapse_count=12),
+        likelihood=LikelihoodConfig(learning_period=60, estimation_samples=30,
+                                    reestimation_period=20, averaging_window=5),
+    )
+
+
+def run(tmp_root):
+    from rtap_tpu.data.nab_corpus import ensure_standin_corpus, load_corpus
+    from rtap_tpu.models import AnomalyDetector
+
+    root = ensure_standin_corpus(tmp_root)
+    files = load_corpus(root)
+    nf = next(f for f in files if "5f5533" in f.name)
+    det = AnomalyDetector(golden_config(), seed=0)
+    raw = np.zeros(ROWS)
+    loglik = np.zeros(ROWS)
+    for i in range(ROWS):
+        res = det.model.run(int(nf.timestamps[i]), float(nf.values[i]))
+        raw[i], loglik[i] = res.raw_score, res.log_likelihood
+    return raw, loglik
+
+
+if __name__ == "__main__":
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        raw, loglik = run(Path(td) / "nab")
+    np.savez(GOLDEN_PATH, raw=raw, loglik=loglik)
+    print(f"wrote {GOLDEN_PATH}: raw mean={raw.mean():.4f} loglik mean={loglik.mean():.4f}")

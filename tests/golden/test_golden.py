@@ -1,0 +1,15 @@
+"""Golden regression: frozen per-row raw/log-likelihood sequences for the
+config-1 stream (SURVEY.md §4 item 4). Any semantic drift in encoder, SP,
+TM, or likelihood shows up here as a bit-level diff."""
+
+import numpy as np
+
+from tests.golden.generate_golden import GOLDEN_PATH, run
+
+
+def test_golden_config1(tmp_path):
+    assert GOLDEN_PATH.exists(), "run python tests/golden/generate_golden.py"
+    golden = np.load(GOLDEN_PATH)
+    raw, loglik = run(tmp_path / "nab")
+    np.testing.assert_array_equal(raw, golden["raw"])
+    np.testing.assert_allclose(loglik, golden["loglik"], atol=1e-12)

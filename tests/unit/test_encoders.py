@@ -1,0 +1,96 @@
+"""Encoder semantics (SURVEY.md C1/C2): RDSE overlap properties, date fields."""
+
+import numpy as np
+
+from rtap_tpu.config import DateConfig, ModelConfig, RDSEConfig
+from rtap_tpu.models.oracle.encoders import (
+    encode_record,
+    is_weekend,
+    rdse_bits,
+    rdse_bucket,
+    time_of_day_bits,
+)
+
+CFG = RDSEConfig(size=400, active_bits=21, resolution=1.0, seed=3)
+
+
+def _sdr(bucket):
+    s = np.zeros(CFG.size, bool)
+    s[rdse_bits(CFG, bucket)] = True
+    return s
+
+
+class TestRDSE:
+    def test_deterministic(self):
+        np.testing.assert_array_equal(rdse_bits(CFG, 7), rdse_bits(CFG, 7))
+
+    def test_active_count_near_w(self):
+        # hash collisions may merge a couple of bits, never more than a few
+        for b in range(-50, 50, 7):
+            n = _sdr(b).sum()
+            assert CFG.active_bits - 3 <= n <= CFG.active_bits
+
+    def test_neighbor_overlap_decays_linearly(self):
+        base = _sdr(100)
+        overlaps = [(k, int((base & _sdr(100 + k)).sum())) for k in range(0, 25, 4)]
+        vals = [o for _, o in overlaps]
+        assert vals[0] >= CFG.active_bits - 3  # self
+        assert all(a >= b - 2 for a, b in zip(vals, vals[1:]))  # decreasing-ish
+        assert vals[-1] <= 4  # distance 24 > w: near-zero overlap
+
+    def test_far_buckets_nearly_disjoint(self):
+        assert int((_sdr(0) & _sdr(1000)).sum()) <= 4
+
+    def test_bucket_arithmetic(self):
+        assert rdse_bucket(10.0, 10.0, 0.5) == 0
+        assert rdse_bucket(11.0, 10.0, 0.5) == 2
+        assert rdse_bucket(9.74, 10.0, 0.5) == -1
+
+    def test_field_seeds_differ(self):
+        a = rdse_bits(CFG, 5, field_index=0)
+        b = rdse_bits(CFG, 5, field_index=1)
+        assert not np.array_equal(np.sort(a), np.sort(b))
+
+
+class TestDate:
+    DCFG = DateConfig(time_of_day_width=5, time_of_day_size=48, weekend_width=3)
+
+    def test_time_of_day_wraps(self):
+        bits = time_of_day_bits(self.DCFG, 0)  # midnight -> centered at 0, wraps
+        assert set(bits) == {46, 47, 0, 1, 2}
+
+    def test_noon_center(self):
+        bits = time_of_day_bits(self.DCFG, 12 * 3600)
+        assert set(bits) == {22, 23, 24, 25, 26}
+
+    def test_weekend(self):
+        assert not is_weekend(0)  # 1970-01-01 Thursday
+        assert is_weekend(2 * 86400)  # Saturday
+        assert is_weekend(3 * 86400)  # Sunday
+        assert not is_weekend(4 * 86400)  # Monday
+
+
+class TestMultiField:
+    def test_layout(self):
+        cfg = ModelConfig(
+            rdse=RDSEConfig(size=100, active_bits=5, resolution=1.0),
+            date=DateConfig(time_of_day_width=3, time_of_day_size=24, weekend_width=2),
+            n_fields=2,
+        )
+        sdr = encode_record(cfg, np.array([5.0, 7.0]), 2 * 86400, np.zeros(2, np.float32))
+        assert sdr.shape == (cfg.input_size,)
+        assert sdr[:100].sum() >= 4  # field 0 block
+        assert sdr[100:200].sum() >= 4  # field 1 block
+        assert sdr[200:224].sum() == 3  # time-of-day ring
+        assert sdr[224:226].all()  # weekend (Saturday)
+
+    def test_fields_independent(self):
+        cfg = ModelConfig(
+            rdse=RDSEConfig(size=100, active_bits=5, resolution=1.0),
+            date=DateConfig(time_of_day_width=0, time_of_day_size=0),
+            n_fields=2,
+        )
+        a = encode_record(cfg, np.array([5.0, 7.0]), 0, np.zeros(2, np.float32))
+        b = encode_record(cfg, np.array([5.0, 50.0]), 0, np.zeros(2, np.float32))
+        np.testing.assert_array_equal(a[:100], b[:100])  # field 0 unchanged
+        assert (a[100:200] != b[100:200]).any()

@@ -1,0 +1,136 @@
+"""AnomalyLikelihood semantics + HTMModel/AnomalyDetector API surface."""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import (
+    DateConfig,
+    LikelihoodConfig,
+    ModelConfig,
+    RDSEConfig,
+    SPConfig,
+    TMConfig,
+    cluster_preset,
+    nab_preset,
+)
+from rtap_tpu.models import AnomalyDetector, HTMModel, create_model
+from rtap_tpu.models.oracle.likelihood import AnomalyLikelihood, log_likelihood, tail_probability
+
+
+class TestLikelihood:
+    CFG = LikelihoodConfig(learning_period=20, estimation_samples=10,
+                           historic_window_size=200, reestimation_period=10,
+                           averaging_window=5)
+
+    def test_probation_returns_half(self):
+        al = AnomalyLikelihood(self.CFG)
+        for _ in range(self.CFG.probationary_period - 1):
+            lik, _ = al.update(0.3)
+            assert lik == 0.5
+
+    def test_spike_after_stable_history_is_anomalous(self):
+        al = AnomalyLikelihood(self.CFG)
+        rng = np.random.default_rng(0)
+        for _ in range(100):
+            al.update(float(rng.uniform(0.0, 0.2)))
+        liks = [al.update(1.0)[0] for _ in range(5)]
+        assert max(liks) > 0.999
+
+    def test_stable_scores_not_anomalous(self):
+        al = AnomalyLikelihood(self.CFG)
+        rng = np.random.default_rng(1)
+        liks = [al.update(float(rng.uniform(0.0, 0.2)))[0] for _ in range(200)]
+        assert max(liks[50:]) < 0.999
+
+    def test_streaming_mode_tracks_window_mode(self):
+        import dataclasses
+
+        rng = np.random.default_rng(2)
+        scores = rng.uniform(0.0, 0.3, 300).tolist() + [1.0] * 3
+        a = AnomalyLikelihood(self.CFG)
+        b = AnomalyLikelihood(dataclasses.replace(self.CFG, mode="streaming", streaming_decay=0.99))
+        la = [a.update(s)[0] for s in scores]
+        lb = [b.update(s)[0] for s in scores]
+        # both flag the spike hard
+        assert la[-1] > 0.99 and lb[-1] > 0.99
+
+    def test_log_likelihood_scale(self):
+        assert log_likelihood(0.5) == pytest.approx(0.0301, abs=1e-3)
+        assert log_likelihood(1.0) == pytest.approx(1.0, abs=1e-4)
+        assert log_likelihood(0.0) == pytest.approx(0.0, abs=1e-9)
+
+    def test_tail_probability(self):
+        assert tail_probability(0.0) == pytest.approx(0.5)
+        assert tail_probability(3.0) == pytest.approx(0.00135, abs=1e-4)
+
+
+def small_cfg():
+    return ModelConfig(
+        rdse=RDSEConfig(size=64, active_bits=7, resolution=1.0),
+        date=DateConfig(time_of_day_width=0, time_of_day_size=0),
+        sp=SPConfig(columns=64, num_active_columns=4),
+        tm=TMConfig(cells_per_column=4, activation_threshold=3, min_threshold=2,
+                    max_segments_per_cell=4, max_synapses_per_segment=8,
+                    new_synapse_count=4),
+        likelihood=LikelihoodConfig(learning_period=20, estimation_samples=10,
+                                    reestimation_period=10, averaging_window=5),
+    )
+
+
+class TestHTMModel:
+    def test_run_returns_result(self):
+        m = HTMModel(small_cfg(), seed=1)
+        r = m.run(1000, 5.0)
+        assert r.raw_score == 1.0  # first record always fully novel
+        assert 0.0 <= r.likelihood <= 1.0
+
+    def test_offset_binds_to_first_value(self):
+        m = HTMModel(small_cfg())
+        m.run(0, 42.5)
+        assert m.state["enc_offset"][0] == pytest.approx(42.5)
+        assert m.state["enc_bound"].all()
+
+    def test_leading_nan_does_not_poison_offset(self):
+        m = HTMModel(small_cfg())
+        m.run(0, float("nan"))
+        assert not m.state["enc_bound"].any()
+        m.run(1, 42.5)
+        assert m.state["enc_offset"][0] == pytest.approx(42.5)
+        r = m.run(2, 42.5)
+        assert np.isfinite(r.raw_score)
+
+    def test_periodic_signal_becomes_predictable(self):
+        m = HTMModel(small_cfg(), seed=2)
+        raws = [m.run(t, float(10 + 5 * (t % 4))).raw_score for t in range(200)]
+        assert np.mean(raws[:8]) > 0.8
+        assert np.mean(raws[-40:]) < 0.1
+
+    def test_invalid_backend_raises(self):
+        with pytest.raises(ValueError, match="backend"):
+            HTMModel(small_cfg(), backend="gpu")
+
+    def test_create_model_default_is_nab_preset(self):
+        m = create_model(min_val=0, max_val=130)
+        assert m.cfg.sp.columns == 2048
+        assert m.cfg.rdse.resolution == pytest.approx(1.0)
+
+    def test_presets_valid(self):
+        for cfg in (nab_preset(), cluster_preset()):
+            assert cfg.input_size > 0
+            assert cfg.sp.num_active_columns < cfg.sp.columns
+
+
+class TestAnomalyDetector:
+    def test_alert_on_pattern_break(self):
+        det = AnomalyDetector(small_cfg(), seed=3, threshold=0.35)
+        alerts = []
+        for t in range(300):
+            v = 10.0 + 5 * (t % 4)
+            if 250 <= t < 260:
+                # erratic injected anomaly; a *constant* anomalous level would
+                # be learned as the new normal within a few steps (HTM design)
+                v = 60.0 + 17.0 * (t % 3)
+            score, alert = det.handle_record(t, v)
+            alerts.append(alert)
+        assert not any(alerts[100:250])
+        assert any(alerts[250:270])

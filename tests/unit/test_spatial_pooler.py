@@ -1,0 +1,148 @@
+"""SP semantics on tiny handcrafted configurations (SURVEY.md §4 item 1)."""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import ModelConfig, RDSEConfig, DateConfig, SPConfig
+from rtap_tpu.models.oracle.spatial_pooler import sp_compute, sp_inhibit, sp_learn, sp_overlap
+from rtap_tpu.models.state import init_state
+
+
+def tiny_state(C=10, n=20, **sp_kw):
+    cfg = ModelConfig(
+        rdse=RDSEConfig(size=n, active_bits=5, resolution=1.0),
+        date=DateConfig(time_of_day_width=0, time_of_day_size=0),
+        sp=SPConfig(columns=C, num_active_columns=3, **sp_kw),
+    )
+    return init_state(cfg, seed=7), cfg.sp
+
+
+class TestOverlap:
+    def test_exact_counts_handcrafted(self):
+        state, cfg = tiny_state()
+        # handcraft: column 0 connected to inputs {0,1,2}, column 1 to {2,3}
+        state["potential"][:] = False
+        state["perm"][:] = 0.0
+        state["potential"][0, [0, 1, 2]] = True
+        state["perm"][0, [0, 1, 2]] = cfg.syn_perm_connected
+        state["potential"][1, [2, 3]] = True
+        state["perm"][1, [2, 3]] = cfg.syn_perm_connected
+        inp = np.zeros(20, bool)
+        inp[[0, 2, 3]] = True
+        ov = sp_overlap(state, inp, cfg)
+        assert ov[0] == 2 and ov[1] == 2 and ov[2:].sum() == 0
+
+    def test_disconnected_synapse_ignored(self):
+        state, cfg = tiny_state()
+        state["potential"][:] = False
+        state["perm"][:] = 0.0
+        state["potential"][0, [0, 1]] = True
+        state["perm"][0, 0] = cfg.syn_perm_connected - 0.01  # below threshold
+        state["perm"][0, 1] = cfg.syn_perm_connected
+        inp = np.ones(20, bool)
+        assert sp_overlap(state, inp, cfg)[0] == 1
+
+
+class TestInhibition:
+    def test_topk_and_low_index_tiebreak(self):
+        cfg = SPConfig(columns=6, num_active_columns=2)
+        overlap = np.array([3, 5, 5, 5, 1, 0])
+        active = sp_inhibit(overlap, np.ones(6, np.float32), cfg)
+        # three tie at 5 -> lowest indices 1,2 win
+        np.testing.assert_array_equal(np.nonzero(active)[0], [1, 2])
+
+    def test_stimulus_threshold(self):
+        cfg = SPConfig(columns=4, num_active_columns=3, stimulus_threshold=2)
+        overlap = np.array([5, 1, 0, 3])
+        active = sp_inhibit(overlap, np.ones(4, np.float32), cfg)
+        np.testing.assert_array_equal(np.nonzero(active)[0], [0, 3])  # 1 below threshold
+
+    def test_boost_changes_winners(self):
+        cfg = SPConfig(columns=4, num_active_columns=1, boost_strength=2.0)
+        overlap = np.array([4, 5, 0, 0])
+        boost = np.array([2.0, 1.0, 1.0, 1.0], np.float32)
+        active = sp_inhibit(overlap, boost, cfg)
+        np.testing.assert_array_equal(np.nonzero(active)[0], [0])  # 8 > 5 boosted
+
+    def test_boost_small_margin_beats_index_tiebreak(self):
+        # regression: a real boosted-overlap gap (>= 1/256) must beat the
+        # low-index tie-break no matter how the indices fall
+        cfg = SPConfig(columns=2048, num_active_columns=1, boost_strength=1.0)
+        overlap = np.zeros(2048, np.int64)
+        overlap[100], overlap[1800] = 5, 5
+        boost = np.ones(2048, np.float32)
+        boost[100], boost[1800] = 1.04, 1.06  # 5.2 vs 5.3 boosted
+        active = sp_inhibit(overlap, boost, cfg)
+        np.testing.assert_array_equal(np.nonzero(active)[0], [1800])
+
+
+class TestLearning:
+    def test_hebbian_deltas_exact(self):
+        state, cfg = tiny_state()
+        state["potential"][:] = True
+        state["perm"][:] = 0.3
+        inp = np.zeros(20, bool)
+        inp[:10] = True
+        active = np.zeros(10, bool)
+        active[0] = True
+        overlap = sp_overlap(state, inp, cfg)
+        sp_learn(state, inp, overlap, active, cfg)
+        np.testing.assert_allclose(state["perm"][0, :10], 0.3 + cfg.syn_perm_active_inc, atol=1e-6)
+        np.testing.assert_allclose(state["perm"][0, 10:], 0.3 - cfg.syn_perm_inactive_dec, atol=1e-6)
+        # non-winner column untouched
+        np.testing.assert_allclose(state["perm"][1], 0.3, atol=1e-6)
+
+    def test_clip_bounds(self):
+        state, cfg = tiny_state()
+        state["potential"][:] = True
+        state["perm"][:] = 0.9999
+        inp = np.ones(20, bool)
+        active = np.ones(10, bool)
+        sp_learn(state, inp, sp_overlap(state, inp, cfg), active, cfg)
+        assert state["perm"].max() <= 1.0
+
+    def test_duty_cycles_update(self):
+        state, cfg = tiny_state()
+        inp = np.ones(20, bool)
+        active = sp_compute(state, inp, cfg, learn=True)
+        assert state["sp_iter"] == 1
+        np.testing.assert_allclose(state["active_duty"], active.astype(float))
+
+    def test_weak_column_bump(self):
+        state, cfg = tiny_state()
+        # column 0 has no connected synapses and never overlaps -> bumped
+        state["perm"][0][state["potential"][0]] = 0.0
+        before = state["perm"][0].copy()
+        inp = np.ones(20, bool)
+        for _ in range(3):
+            sp_compute(state, inp, cfg, learn=True)
+        grown = state["perm"][0][state["potential"][0]] > before[state["potential"][0]]
+        assert grown.all()
+
+
+class TestStability:
+    def test_repeated_input_stable_winners(self):
+        state, cfg = tiny_state(C=64, n=40)
+        rng = np.random.default_rng(0)
+        inp = rng.random(40) < 0.3
+        first = sp_compute(state, inp, cfg, learn=True)
+        for _ in range(20):
+            last = sp_compute(state, inp, cfg, learn=True)
+        np.testing.assert_array_equal(first, last)
+
+    def test_learn_false_does_not_mutate(self):
+        state, cfg = tiny_state()
+        snap = {k: np.copy(v) for k, v in state.items()}
+        sp_compute(state, np.ones(20, bool), cfg, learn=False)
+        for k in snap:
+            np.testing.assert_array_equal(state[k], snap[k], err_msg=k)
+
+    def test_determinism_across_runs(self):
+        outs = []
+        for _ in range(2):
+            state, cfg = tiny_state(C=32, n=30)
+            rng = np.random.default_rng(5)
+            seq = [rng.random(30) < 0.25 for _ in range(10)]
+            outs.append([sp_compute(state, s, cfg, learn=True) for s in seq])
+        for a, b in zip(*outs):
+            np.testing.assert_array_equal(a, b)

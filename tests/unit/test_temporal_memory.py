@@ -1,0 +1,202 @@
+"""TM semantics: fixed column sequences, exact predictive sets, bursting,
+segment growth/punishment/eviction (SURVEY.md §4 item 1 — the A-B-C-D vs
+A-B-C-E pattern tests)."""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import ModelConfig, RDSEConfig, DateConfig, SPConfig, TMConfig
+from rtap_tpu.models.oracle.temporal_memory import TMOracle
+from rtap_tpu.models.state import init_state
+
+
+def make_tm(C=16, K=4, S=4, M=8, **kw):
+    tm_kw = dict(
+        cells_per_column=K,
+        activation_threshold=2,
+        min_threshold=1,
+        initial_permanence=0.55,  # connected at birth -> predicts after 1 rep
+        connected_permanence=0.5,
+        permanence_increment=0.1,
+        permanence_decrement=0.05,
+        predicted_segment_decrement=0.01,
+        max_segments_per_cell=S,
+        max_synapses_per_segment=M,
+        new_synapse_count=4,
+    )
+    tm_kw.update(kw)
+    cfg = ModelConfig(
+        rdse=RDSEConfig(size=16, active_bits=2),
+        date=DateConfig(time_of_day_width=0, time_of_day_size=0),
+        sp=SPConfig(columns=C, num_active_columns=2),
+        tm=TMConfig(**tm_kw),
+    )
+    state = init_state(cfg, seed=0)
+    return TMOracle(state, cfg.tm), state
+
+
+def cols(C, *idx):
+    a = np.zeros(C, bool)
+    a[list(idx)] = True
+    return a
+
+
+class TestBasics:
+    def test_first_input_bursts_full_anomaly(self):
+        tm, state = make_tm()
+        raw = tm.compute(cols(16, 0, 1))
+        assert raw == 1.0
+        assert state["prev_active"][0].all() and state["prev_active"][1].all()  # burst
+        assert state["prev_active"][2:].sum() == 0
+
+    def test_burst_winner_is_fewest_segments_lowest_index(self):
+        tm, state = make_tm()
+        tm.compute(cols(16, 0))
+        # no prior winners -> no segment grown, winner = cell 0 (all tie at 0 segs)
+        assert state["prev_winner"][0, 0] and state["prev_winner"][0, 1:].sum() == 0
+
+    def test_empty_input_zero_anomaly(self):
+        tm, state = make_tm()
+        assert tm.compute(np.zeros(16, bool)) == 0.0
+
+
+class TestSequenceLearning:
+    def test_abcd_predicts_after_one_rep(self):
+        # initial_permanence 0.55 > connected 0.5: one presentation suffices
+        tm, state = make_tm()
+        seq = [cols(16, 0, 1), cols(16, 2, 3), cols(16, 4, 5), cols(16, 6, 7)]
+        first = [tm.compute(a) for a in seq]
+        assert first == [1.0, 1.0, 1.0, 1.0]
+        second = [tm.compute(a) for a in seq]
+        # B, C, D now predicted (A after D also learned once wrapped)
+        assert second[1] == 0.0 and second[2] == 0.0 and second[3] == 0.0
+
+    def test_abce_novel_element_full_anomaly(self):
+        tm, state = make_tm()
+        seq = [cols(16, 0, 1), cols(16, 2, 3), cols(16, 4, 5), cols(16, 6, 7)]
+        for _ in range(3):
+            for a in seq:
+                tm.compute(a)
+        out = [
+            tm.compute(cols(16, 0, 1), learn=False),
+            tm.compute(cols(16, 2, 3), learn=False),
+            tm.compute(cols(16, 4, 5), learn=False),
+            tm.compute(cols(16, 10, 11), learn=False),  # E
+        ]
+        assert out[1] == 0.0 and out[2] == 0.0
+        assert out[3] == 1.0
+
+    def test_predicted_cells_exact(self):
+        # single-column steps -> only one prev-winner to connect to, so the
+        # activation threshold must be 1 for the segment to ever fire
+        tm, state = make_tm(activation_threshold=1)
+        tm.compute(cols(16, 0))
+        tm.compute(cols(16, 1))  # grows segment on (1, winner) to col-0 cells
+        tm.compute(cols(16, 0))  # A again
+        pred = state["active_seg"].any(-1)
+        assert pred[1].sum() == 1  # exactly the winner cell of column 1 predicted
+        assert pred[[0] + list(range(2, 16))].sum() == 0
+
+    def test_half_predicted_half_anomaly(self):
+        tm, state = make_tm(activation_threshold=1)
+        tm.compute(cols(16, 0))
+        tm.compute(cols(16, 1))
+        tm.compute(cols(16, 0))
+        # column 1 predicted; present columns {1, 9} -> half predicted
+        raw = tm.compute(cols(16, 1, 9))
+        assert raw == pytest.approx(0.5)
+
+
+class TestGrowthBounds:
+    def test_synapse_slots_bounded(self):
+        tm, state = make_tm(M=4, new_synapse_count=16)
+        for i in range(6):
+            tm.compute(cols(16, i % 8, (i + 1) % 8))
+        assert (state["presyn"] >= 0).sum(-1).max() <= 4
+
+    def test_segment_slots_bounded_with_lru_eviction(self):
+        tm, state = make_tm(S=2, K=1)  # 1 cell/col, 2 segments max
+        # many distinct transitions into column 0 force segment churn
+        for i in range(1, 12):
+            tm.compute(cols(16, i % 15 + 1))
+            tm.compute(cols(16, 0))
+        assert (state["seg_last"][0, 0] >= 0).sum() <= 2
+
+    def test_no_growth_without_prev_winners(self):
+        tm, state = make_tm()
+        tm.compute(cols(16, 3))
+        assert (state["presyn"] >= 0).sum() == 0  # nothing to connect to
+
+
+class TestPunishment:
+    def test_predicted_inactive_column_decremented(self):
+        tm, state = make_tm()
+        tm.compute(cols(16, 0))
+        tm.compute(cols(16, 1))
+        tm.compute(cols(16, 0))  # column 1 now predicted
+        seg_idx = np.nonzero(state["matching_seg"])
+        perm_before = state["syn_perm"][seg_idx].copy()
+        tm.compute(cols(16, 9))  # prediction fails
+        perm_after = state["syn_perm"][seg_idx]
+        assert (perm_after <= perm_before).all() and (perm_after < perm_before).any()
+
+    def test_no_punishment_when_disabled(self):
+        tm, state = make_tm(predicted_segment_decrement=0.0)
+        tm.compute(cols(16, 0))
+        tm.compute(cols(16, 1))
+        tm.compute(cols(16, 0))
+        before = state["syn_perm"].copy()
+        tm.compute(cols(16, 9), learn=True)
+        # segment perms may only have changed via death, not punishment
+        assert (state["syn_perm"] >= before - 1e-9).all()
+
+
+class TestDeathAndDeterminism:
+    def test_synapse_death_at_zero_perm(self):
+        tm, state = make_tm(initial_permanence=0.04, permanence_decrement=0.05,
+                            predicted_segment_decrement=0.0, min_threshold=1,
+                            activation_threshold=1, connected_permanence=0.03)
+        tm.compute(cols(16, 0))
+        tm.compute(cols(16, 1))  # segment born at 0.04, connected
+        tm.compute(cols(16, 2))
+        tm.compute(cols(16, 1))  # matching seg reinforced? presyn (col2 cells) inactive... decrement to 0 -> death
+        # eventually no synapse may carry negative permanence
+        assert (state["syn_perm"] >= 0).all()
+        dead_slots = state["presyn"] < 0
+        assert (state["syn_perm"][dead_slots] == 0).all()
+
+    def test_learn_false_pure(self):
+        tm, state = make_tm()
+        tm.compute(cols(16, 0))
+        tm.compute(cols(16, 1))
+        snap = {k: np.copy(v) for k, v in state.items()}
+        tm2 = TMOracle(state, tm.cfg)
+        tm2.compute(cols(16, 5), learn=False)
+        for k in ("presyn", "syn_perm", "seg_last"):
+            np.testing.assert_array_equal(state[k], snap[k], err_msg=k)
+
+    def test_learn_false_does_not_stamp_lru(self):
+        # regression: inference steps that *activate* segments must not
+        # refresh their LRU stamps (would perturb eviction once learning resumes)
+        tm, state = make_tm(activation_threshold=1)
+        tm.compute(cols(16, 0))
+        tm.compute(cols(16, 1))  # segment grown on col 1
+        snap_last = state["seg_last"].copy()
+        tm.compute(cols(16, 0), learn=False)  # col-1 segment becomes active
+        assert state["active_seg"].any()  # precondition: a segment did activate
+        np.testing.assert_array_equal(state["seg_last"], snap_last)
+
+    def test_determinism(self):
+        outs = []
+        for _ in range(2):
+            tm, state = make_tm()
+            rng = np.random.default_rng(4)
+            raws = []
+            for _ in range(30):
+                active = np.zeros(16, bool)
+                active[rng.choice(16, 2, replace=False)] = True
+                raws.append(tm.compute(active))
+            outs.append((raws, state["presyn"].copy(), state["syn_perm"].copy()))
+        assert outs[0][0] == outs[1][0]
+        np.testing.assert_array_equal(outs[0][1], outs[1][1])
+        np.testing.assert_array_equal(outs[0][2], outs[1][2])
