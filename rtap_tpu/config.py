@@ -118,6 +118,13 @@ class TMConfig:
     max_synapses_per_segment: int = 32
     new_synapse_count: int = 20
     seed: int = 1960
+    # Static-shape capacities for the device kernel's compact learning pass
+    # (SURVEY.md §7 hard part 1): at most `learn_cap` segments learn per step
+    # (>= active columns; predicted columns can contribute several) and at most
+    # `winner_cap` winner cells existed at t-1. Overflow is counted in
+    # state["tm_overflow"]; tests assert it stays zero at these sizes.
+    learn_cap: int = 128
+    winner_cap: int = 192
 
 
 @dataclass(frozen=True)
@@ -231,7 +238,7 @@ def cluster_preset() -> ModelConfig:
                     syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002),
         tm=TMConfig(cells_per_column=8, activation_threshold=7, min_threshold=5,
                     max_segments_per_cell=4, max_synapses_per_segment=12,
-                    new_synapse_count=8),
+                    new_synapse_count=8, learn_cap=32, winner_cap=48),
         likelihood=LikelihoodConfig(mode="streaming", historic_window_size=512,
                                     learning_period=100, estimation_samples=50),
     )

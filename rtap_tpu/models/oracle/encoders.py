@@ -22,8 +22,15 @@ _EPOCH_WEEKDAY_SHIFT = 3
 
 def rdse_bucket(value: float | np.ndarray, offset: float | np.ndarray, resolution: float) -> np.ndarray:
     """Bucket index: round((value - offset) / resolution). NuPIC binds `offset`
-    to the first value a stream sees so buckets stay centered on the data."""
-    return np.round((np.asarray(value, np.float64) - offset) / resolution).astype(np.int64)
+    to the first value a stream sees so buckets stay centered on the data.
+
+    Computed in float32 end-to-end: the device kernels have no f64 (JAX x64
+    stays off on TPU), and host/device bucket arithmetic must be bit-identical
+    for oracle-vs-TPU parity (SURVEY.md §4 item 2)."""
+    v = np.asarray(value, np.float32)
+    off = np.asarray(offset, np.float32)
+    res = np.float32(resolution)
+    return np.round((v - off) / res).astype(np.int64)
 
 
 def rdse_bits(cfg: RDSEConfig, bucket: int, field_index: int = 0) -> np.ndarray:
@@ -37,8 +44,9 @@ def rdse_bits(cfg: RDSEConfig, bucket: int, field_index: int = 0) -> np.ndarray:
 def time_of_day_bits(cfg: DateConfig, ts_unix: int) -> np.ndarray:
     """Periodic encoder over the 24h ring: w contiguous (wrapping) bits
     centered on the current time of day."""
-    frac = (ts_unix % SECONDS_PER_DAY) / SECONDS_PER_DAY
-    center = int(frac * cfg.time_of_day_size)
+    # Pure integer math (floor((s/86400) * size)) so host and device agree
+    # exactly; float forms can differ by 1 ulp at bucket boundaries.
+    center = (ts_unix % SECONDS_PER_DAY) * cfg.time_of_day_size // SECONDS_PER_DAY
     return (center + np.arange(cfg.time_of_day_width) - cfg.time_of_day_width // 2) % cfg.time_of_day_size
 
 

@@ -41,8 +41,10 @@ def sp_inhibit(overlap: np.ndarray, boost: np.ndarray, cfg: SPConfig) -> np.ndar
     C = overlap.shape[0]
     if cfg.boost_strength > 0.0:
         # Quantize boosted overlap to 1/256 so the low-index tie-break term
-        # can never override a real (>= 1/256) difference, and so the score is
-        # exact integer arithmetic — identical on CPU oracle and TPU kernel.
+        # can never override a real (>= 1/256) difference. Note this makes
+        # host/device winner parity overwhelmingly likely but not guaranteed:
+        # a 1-ulp exp() difference can still flip q on an exact .5 boundary.
+        # The NAB preset runs boost_strength=0, where parity is exact.
         q = np.round((overlap * boost).astype(np.float32) * 256.0).astype(np.int64)
         score = q * C + (C - 1 - np.arange(C))
     else:
@@ -67,16 +69,25 @@ def sp_learn(
     perm, potential = state["perm"], state["potential"]
     inc_mask = active[:, None] & potential & input_sdr[None, :]
     dec_mask = active[:, None] & potential & ~input_sdr[None, :]
-    perm += cfg.syn_perm_active_inc * inc_mask
-    perm -= cfg.syn_perm_inactive_dec * dec_mask
+    # f32 constants: python float * bool-mask would promote to f64 and
+    # double-round on the in-place store, drifting 1 ulp from the device f32
+    # chain (see temporal_memory._reinforce_and_grow).
+    perm += np.float32(cfg.syn_perm_active_inc) * inc_mask
+    perm -= np.float32(cfg.syn_perm_inactive_dec) * dec_mask
     np.clip(perm, 0.0, 1.0, out=perm)
 
     it = int(state["sp_iter"]) + 1
     state["sp_iter"] = np.int32(it)
-    period = min(cfg.duty_cycle_period, it)
+    period = np.float32(min(cfg.duty_cycle_period, it))
     overlap_now = (overlap > 0).astype(np.float32)
-    state["overlap_duty"] = (state["overlap_duty"] * (period - 1) + overlap_now) / period
-    state["active_duty"] = (state["active_duty"] * (period - 1) + active) / period
+    # Moving average in incremental form d += (x-d)/p, not (d*(p-1)+x)/p: the
+    # latter's multiply-add gets FMA-contracted by XLA on device (1-ulp drift
+    # vs numpy, observed); sub/div/add has no contractable pattern, so host
+    # and device stay bit-identical.
+    state["overlap_duty"] = state["overlap_duty"] + (overlap_now - state["overlap_duty"]) / period
+    state["active_duty"] = state["active_duty"] + (
+        active.astype(np.float32) - state["active_duty"]
+    ) / period
 
     if cfg.boost_strength > 0.0:
         target = cfg.num_active_columns / perm.shape[0]
@@ -87,7 +98,7 @@ def sp_learn(
     min_duty = cfg.min_pct_overlap_duty_cycle * state["overlap_duty"].max()
     weak = state["overlap_duty"] < min_duty
     if weak.any():
-        perm += cfg.syn_perm_below_stimulus_inc * (weak[:, None] & potential)
+        perm += np.float32(cfg.syn_perm_below_stimulus_inc) * (weak[:, None] & potential)
         np.clip(perm, 0.0, 1.0, out=perm)
 
 

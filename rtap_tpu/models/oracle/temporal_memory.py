@@ -70,10 +70,13 @@ def _reinforce_and_grow(
     presyn = state["presyn"][c, k, s]
     exists = presyn >= 0
     act = exists & prev_active_flat[np.clip(presyn, 0, None)]
+    # f32 constants: a python float * bool-array promotes to f64 in numpy and
+    # the f64-compute-then-f32-store double-rounds, diverging 1 ulp from the
+    # device's pure-f32 chain (observed). All perm arithmetic stays f32.
     state["syn_perm"][c, k, s] = np.clip(
         state["syn_perm"][c, k, s]
-        + cfg.permanence_increment * act
-        - cfg.permanence_decrement * (exists & ~act),
+        + np.float32(cfg.permanence_increment) * act
+        - np.float32(cfg.permanence_decrement) * (exists & ~act),
         0.0,
         1.0,
     )
@@ -165,7 +168,8 @@ class TMOracle:
                 presyn = state["presyn"][idx]
                 act = (presyn >= 0) & prev_active_flat[np.clip(presyn, 0, None)]
                 state["syn_perm"][idx] = np.maximum(
-                    state["syn_perm"][idx] - cfg.predicted_segment_decrement * act, 0.0
+                    state["syn_perm"][idx] - np.float32(cfg.predicted_segment_decrement) * act,
+                    np.float32(0.0),
                 )
 
         if learn:

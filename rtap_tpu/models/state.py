@@ -72,6 +72,8 @@ def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
         "prev_active": np.zeros((C, K), bool),
         "prev_winner": np.zeros((C, K), bool),
         "tm_iter": np.int32(0),
+        "tm_overflow": np.int32(0),  # device-kernel capacity overflow counter
+
         # encoder (offset binds per field at the first *finite* value seen)
         "enc_offset": np.zeros(cfg.n_fields, np.float32),
         "enc_bound": np.zeros(cfg.n_fields, bool),

@@ -1,0 +1,9 @@
+"""Device (TPU) kernels — the XLA-compiled analog of the reference's C++ core.
+
+The reference's hot loop lives in nupic.core C++ (SpatialPooler.cpp,
+Cells4.cpp/TemporalMemory.cpp, Connections.cpp — SURVEY.md §1 L0). Here the
+same semantics are pure JAX functions over fixed-shape pytrees, jitted and
+vmapped over stream groups (SURVEY.md §7 design stance). Every kernel has a
+numpy oracle twin in models/oracle/ and bit-exact parity tests in
+tests/parity/.
+"""

@@ -1,0 +1,72 @@
+"""Device-side record encoder: RDSE + date bits, table-free, vmappable.
+
+Twin of models/oracle/encoders.py (SURVEY.md C1/C2). The RDSE is a pure hash
+function (bucket b -> bits {hash(seed, b+k) % n}), so encoding runs on device
+with no host-side bucket table: one record is (values[F] f32, ts i32) and the
+output is a bool[input_size] SDR built by scatter. All arithmetic is f32/int32
+and bit-identical to the host oracle (tests/parity/test_encoder_parity.py).
+
+NaN/inf field values contribute no bits (NuPIC missing-sample behavior),
+implemented branch-free via out-of-bounds scatter indices with mode="drop".
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from rtap_tpu.config import ModelConfig
+from rtap_tpu.ops.hashing_tpu import hash_bits
+
+SECONDS_PER_DAY = 86400
+_EPOCH_WEEKDAY_SHIFT = 3  # 1970-01-01 was a Thursday; weekday = (days+3) % 7
+
+
+def encode_device(
+    cfg: ModelConfig,
+    values: jnp.ndarray,  # [F] f32
+    ts_unix: jnp.ndarray,  # scalar i32
+    enc_offset: jnp.ndarray,  # [F] f32
+) -> jnp.ndarray:
+    """Encode one record -> bool[input_size]. Layout matches the oracle:
+    [field0 RDSE | field1 RDSE | ... | time-of-day ring | weekend]."""
+    F, R, w = cfg.n_fields, cfg.rdse.size, cfg.rdse.active_bits
+    n_in = cfg.input_size
+
+    finite = jnp.isfinite(values)
+    v = jnp.where(finite, values, jnp.float32(0.0))
+    bucket = jnp.round((v - enc_offset) / jnp.float32(cfg.rdse.resolution)).astype(jnp.int32)
+    keys = bucket[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [F, w]
+    # per-field hash stream: seed + 0x1000 * field (same keying as the oracle)
+    seeds = jnp.uint32(cfg.rdse.seed) + jnp.uint32(0x1000) * jnp.arange(F, dtype=jnp.uint32)
+    bits = hash_bits(keys, seeds[:, None], R)  # [F, w]
+    idx = bits + (jnp.arange(F, dtype=jnp.int32) * R)[:, None]
+    idx = jnp.where(finite[:, None], idx, n_in)  # missing field -> dropped scatter
+
+    sdr = jnp.zeros(n_in, bool).at[idx.reshape(-1)].set(True, mode="drop")
+
+    base = F * R
+    if cfg.date.time_of_day_width:
+        # integer floor((s/86400) * ring_size); identical to the oracle
+        center = (ts_unix % SECONDS_PER_DAY) * cfg.date.time_of_day_size // SECONDS_PER_DAY
+        tod = (
+            center
+            + jnp.arange(cfg.date.time_of_day_width, dtype=jnp.int32)
+            - cfg.date.time_of_day_width // 2
+        ) % cfg.date.time_of_day_size
+        sdr = sdr.at[base + tod].set(True)
+        base += cfg.date.time_of_day_size
+    if cfg.date.weekend_width:
+        weekend = ((ts_unix // SECONDS_PER_DAY + _EPOCH_WEEKDAY_SHIFT) % 7) >= 5
+        widx = jnp.where(weekend, base + jnp.arange(cfg.date.weekend_width, dtype=jnp.int32), n_in)
+        sdr = sdr.at[widx].set(True, mode="drop")
+    return sdr
+
+
+def bind_offsets(
+    values: jnp.ndarray, enc_offset: jnp.ndarray, enc_bound: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Bind each field's RDSE offset at its first finite value (NuPIC binds
+    buckets to the first sample; a leading NaN must not poison the stream).
+    Returns (new_offset, new_bound); pure, runs inside the fused step."""
+    bind = ~enc_bound & jnp.isfinite(values)
+    return jnp.where(bind, values, enc_offset), enc_bound | bind

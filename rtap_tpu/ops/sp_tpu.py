@@ -1,0 +1,99 @@
+"""Spatial Pooler — device kernel (functional twin of oracle/spatial_pooler.py).
+
+The reference's SP hot loop is SpatialPooler.cpp's sparse matvec + inhibition
+(SURVEY.md C3, §3.2). TPU-native layout: the connected-synapse mask is a dense
+bool [C, n_in]; overlap is a 0/1 matmul that XLA tiles onto the MXU (counts
+< 2^24, so f32 accumulation is exact); inhibition is `lax.top_k` over an
+integer score that encodes the low-index tie-break, making winner selection
+bit-identical to the oracle's argsort.
+
+State dict keys/layout are shared with the oracle (models/state.py); this
+module never mutates — it returns the updated SP slice of the state dict.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from rtap_tpu.config import SPConfig
+
+
+def sp_overlap(perm: jnp.ndarray, potential: jnp.ndarray, sdr: jnp.ndarray, cfg: SPConfig) -> jnp.ndarray:
+    """Overlap per column = |connected potential synapses ∩ active inputs|.
+    0/1 f32 matmul -> MXU; exact integer counts."""
+    connected = ((perm >= cfg.syn_perm_connected) & potential).astype(jnp.float32)
+    return jnp.dot(connected, sdr.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+
+
+def sp_inhibit(overlap: jnp.ndarray, boost: jnp.ndarray, cfg: SPConfig) -> jnp.ndarray:
+    """Global k-winner inhibition -> bool[C]. Score = overlap*C + (C-1-c)
+    (quantized to 1/256 under boosting) is unique per column, so top_k has no
+    ties and matches the oracle's descending argsort exactly when
+    boost_strength == 0 (the NAB preset). Under boosting, a 1-ulp host/device
+    exp() difference on an exact .5 rounding boundary of q can still flip a
+    winner — statistically negligible, and tolerated by the boost parity test.
+    """
+    C = overlap.shape[0]
+    col_rev = (C - 1 - jnp.arange(C, dtype=jnp.int32))
+    if cfg.boost_strength > 0.0:
+        q = jnp.round(overlap.astype(jnp.float32) * boost * 256.0).astype(jnp.int32)
+        score = q * C + col_rev
+    else:
+        score = overlap * C + col_rev
+    _, winners = jax.lax.top_k(score, cfg.num_active_columns)
+    active = jnp.zeros(C, bool).at[winners].set(True)
+    return active & (overlap >= cfg.stimulus_threshold)
+
+
+def sp_learn(
+    state: dict, sdr: jnp.ndarray, overlap: jnp.ndarray, active: jnp.ndarray, cfg: SPConfig
+) -> dict:
+    """Hebbian update on winners + duty cycles + boost + weak-column bump.
+    Same op order as the oracle (hebbian -> clip -> duty -> boost -> bump ->
+    clip); inc/dec masks are disjoint so the fused expression is bit-equal to
+    the oracle's sequential += / -=."""
+    perm, potential = state["perm"], state["potential"]
+    inc_mask = active[:, None] & potential & sdr[None, :]
+    dec_mask = active[:, None] & potential & ~sdr[None, :]
+    perm = perm + cfg.syn_perm_active_inc * inc_mask - cfg.syn_perm_inactive_dec * dec_mask
+    perm = jnp.clip(perm, 0.0, 1.0)
+
+    it = state["sp_iter"] + 1
+    period = jnp.minimum(cfg.duty_cycle_period, it).astype(jnp.float32)
+    overlap_now = (overlap > 0).astype(jnp.float32)
+    # d += (x-d)/p form (not (d*(p-1)+x)/p): sub/div/add has no multiply-add
+    # for XLA to FMA-contract, keeping device duty bit-identical to the numpy
+    # oracle (an optimization_barrier does NOT stop the contraction; observed).
+    overlap_duty = state["overlap_duty"] + (overlap_now - state["overlap_duty"]) / period
+    active_duty = state["active_duty"] + (active.astype(jnp.float32) - state["active_duty"]) / period
+
+    boost = state["boost"]
+    if cfg.boost_strength > 0.0:
+        target = cfg.num_active_columns / perm.shape[0]
+        boost = jnp.exp((target - active_duty) * cfg.boost_strength).astype(jnp.float32)
+
+    min_duty = cfg.min_pct_overlap_duty_cycle * overlap_duty.max()
+    weak = overlap_duty < min_duty
+    perm = jnp.clip(perm + cfg.syn_perm_below_stimulus_inc * (weak[:, None] & potential), 0.0, 1.0)
+
+    return {
+        **state,
+        "perm": perm,
+        "boost": boost,
+        "overlap_duty": overlap_duty,
+        "active_duty": active_duty,
+        "sp_iter": it.astype(jnp.int32),
+    }
+
+
+@partial(jax.jit, static_argnames=("cfg", "learn"))
+def sp_step(state: dict, sdr: jnp.ndarray, cfg: SPConfig, learn: bool = True):
+    """One SP step -> (new_state, bool[C] active columns). Pure."""
+    overlap = sp_overlap(state["perm"], state["potential"], sdr, cfg)
+    active = sp_inhibit(overlap, state["boost"], cfg)
+    if learn:
+        state = sp_learn(state, sdr, overlap, active, cfg)
+    return state, active

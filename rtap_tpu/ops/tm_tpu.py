@@ -1,0 +1,279 @@
+"""Temporal Memory — device kernel (functional twin of oracle/temporal_memory.py).
+
+The reference's TM is Cells4.cpp/TemporalMemory.cpp over the Connections
+pointer graph (SURVEY.md C4/C5). TPU-native re-design (SURVEY.md §7 hard part
+1): fixed-capacity dense pools [C, K, S, M] of (presyn id, permanence), and a
+step composed of
+
+  1. column categorization (predicted / burst-matching / burst-new) — dense,
+  2. burst-new segment allocation (first-free slot else LRU-evict) — scatter,
+  3. a *compact learning pass*: the <= learn_cap segments that learn this step
+     are gathered to a [L, M] workspace, reinforced, grown toward previous
+     winner cells (membership test + rank-select + weakest-synapse eviction,
+     all static-shape), and scattered back,
+  4. dense punishment of matching segments in non-active columns,
+  5. dense synapse/segment death,
+  6. dense dendrite activity (gather presyn -> segment popcounts) for t+1.
+
+Tie-breaks are lowest-index everywhere, matching the oracle exactly; parity
+is bit-for-bit (tests/parity/test_tm_parity.py).
+
+Capacity bounds (learn_cap learning segments, winner_cap previous winners per
+step) are static-shape requirements of XLA; overflow beyond the bounds is
+counted in state["tm_overflow"] so tests can assert it never fires at the
+configured sizes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from rtap_tpu.config import TMConfig
+
+INF = jnp.float32(jnp.inf)
+
+
+def _segment_learning_mask(
+    cfg: TMConfig,
+    active_cols: jnp.ndarray,  # bool [C]
+    active_seg: jnp.ndarray,  # bool [C, K, S] (prev step)
+    matching_seg: jnp.ndarray,  # bool [C, K, S] (prev step)
+    seg_pot: jnp.ndarray,  # i32 [C, K, S] (prev step)
+    seg_last: jnp.ndarray,  # i32 [C, K, S]
+    have_winners: jnp.ndarray,  # bool scalar (any prev winner cells)
+):
+    """Categorize columns and pick the per-column learning segments.
+
+    Returns (predicted_cols, learn_mask, alloc [C,3] (col, cell, slot) for
+    burst-new allocations with col==C when inactive, winner_cells_extra
+    [C, K] winner contributions from burst columns).
+    """
+    C, K, S = active_seg.shape
+    prev_predictive = active_seg.any(-1)  # [C, K]
+    predicted_cols = prev_predictive.any(-1)  # [C]
+
+    burst = active_cols & ~predicted_cols
+    col_matching = matching_seg.any((-2, -1))  # [C]
+    burst_match = burst & col_matching
+    burst_new = burst & ~col_matching & have_winners
+
+    # (a) predicted columns: every active segment of every predicted cell learns
+    mask_pred = active_cols[:, None, None] & active_seg
+
+    # (b) burst-matching: best matching segment (max seg_pot, lowest flat index)
+    pot = jnp.where(matching_seg, seg_pot, -1).reshape(C, K * S)
+    best_flat = jnp.argmax(pot, axis=-1)  # first max — same as np.argmax
+    bm_k, bm_s = best_flat // S, best_flat % S
+    bm_mask = (
+        jnp.zeros((C, K, S), bool)
+        .at[jnp.arange(C), bm_k, bm_s]
+        .set(burst_match)
+    )
+
+    # (c) burst-new: cell with fewest segments; first free slot else LRU slot
+    seg_counts = (seg_last >= 0).sum(-1)  # [C, K]
+    bn_k = jnp.argmin(seg_counts, axis=-1)  # first min — matches oracle
+    row_last = seg_last[jnp.arange(C), bn_k]  # [C, S]
+    any_free = (row_last < 0).any(-1)
+    first_free = jnp.argmax(row_last < 0, axis=-1)
+    lru = jnp.argmin(row_last, axis=-1)
+    bn_s = jnp.where(any_free, first_free, lru)
+
+    # burst-column winner cells, one-hot (no scatter: a False write from one
+    # branch must never clobber a True from the other)
+    kk = jnp.arange(K, dtype=jnp.int32)[None, :]
+    winner_extra = (burst_match[:, None] & (kk == bm_k[:, None])) | (
+        (burst & ~col_matching)[:, None] & (kk == bn_k[:, None])  # winner even when no alloc
+    )
+
+    alloc_col = jnp.where(burst_new, jnp.arange(C), C)  # C == dropped
+    return predicted_cols, mask_pred | bm_mask, (alloc_col, bn_k, bn_s), winner_extra, burst
+
+
+def _grow_compact(
+    cfg: TMConfig,
+    presyn_l: jnp.ndarray,  # i32 [L, M] (post-reinforce)
+    perm_l: jnp.ndarray,  # f32 [L, M]
+    n_grow: jnp.ndarray,  # i32 [L]
+    winner_ids: jnp.ndarray,  # i32 [W] ascending, padded with N
+    n_cells: int,
+):
+    """Oracle _grow_synapses, vectorized: per segment, add the first
+    min(n_grow, #eligible) winner cells (ascending id, not already
+    presynaptic), evicting weakest synapses when free slots run short."""
+    L, M = presyn_l.shape
+    W = winner_ids.shape[0]
+    G = cfg.new_synapse_count  # max grown per segment per step
+
+    valid_w = winner_ids < n_cells
+    # membership: winner already presynaptic on this segment?  [L, W]
+    already = (presyn_l[:, None, :] == winner_ids[None, :, None]).any(-1)
+    eligible = valid_w[None, :] & ~already
+    rank = jnp.cumsum(eligible, axis=1)  # 1-based among eligible
+    chosen = eligible & (rank <= n_grow[:, None])
+    n_new = chosen.sum(-1).astype(jnp.int32)  # [L]
+
+    # extract chosen winner positions ascending -> [L, G]
+    wpos = jnp.where(chosen, jnp.arange(W, dtype=jnp.int32), W)
+    wpos = jax.lax.sort(wpos, dimension=1)[:, :G]
+    new_ids = jnp.where(wpos < W, winner_ids[jnp.clip(wpos, 0, W - 1)], n_cells)  # [L]
+
+    # evict weakest occupied synapses if short of free slots (stable by slot)
+    occupied = presyn_l >= 0
+    n_free = M - occupied.sum(-1)
+    short = n_new - n_free  # [L]
+    key = jnp.where(occupied, perm_l, INF)
+    ranks = jnp.argsort(jnp.argsort(key, axis=-1, stable=True), axis=-1, stable=True)
+    evict = occupied & (ranks < short[:, None])
+    presyn_l = jnp.where(evict, -1, presyn_l)
+    perm_l = jnp.where(evict, 0.0, perm_l)
+
+    # fill free slots ascending with new ids ascending
+    free = presyn_l < 0
+    frank = jnp.cumsum(free, axis=-1) - 1  # 0-based among free slots
+    assign = free & (frank < n_new[:, None])
+    fill = new_ids[jnp.arange(L)[:, None], jnp.clip(frank, 0, G - 1)]
+    presyn_l = jnp.where(assign, fill, presyn_l)
+    perm_l = jnp.where(assign, jnp.float32(cfg.initial_permanence), perm_l)
+    return presyn_l, perm_l
+
+
+@partial(jax.jit, static_argnames=("cfg", "learn"))
+def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = True):
+    """One TM step -> (new_state, raw anomaly score f32). Pure.
+
+    `state` uses the models/state.py TM layout plus "tm_overflow" (i32
+    overflow counter, device-only observability).
+    """
+    C, K, S, M = state["presyn"].shape
+    N = C * K
+    L, W = cfg.learn_cap, cfg.winner_cap
+
+    presyn = state["presyn"]
+    syn_perm = state["syn_perm"]
+    seg_last = state["seg_last"]
+    it = state["tm_iter"] + 1
+
+    prev_predictive = state["active_seg"].any(-1)  # [C, K]
+    prev_pred_cols = prev_predictive.any(-1)
+    n_active = active_cols.sum()
+    raw = jnp.where(
+        n_active > 0,
+        1.0 - (active_cols & prev_pred_cols).sum() / jnp.maximum(n_active, 1).astype(jnp.float32),
+        0.0,
+    )
+
+    prev_active_flat = state["prev_active"].reshape(-1)  # bool [N]
+    prev_winner_flat = state["prev_winner"].reshape(-1)
+    n_winners = prev_winner_flat.sum()
+    have_winners = n_winners > 0
+
+    predicted_cols, learn_mask, alloc, winner_extra, burst = _segment_learning_mask(
+        cfg, active_cols, state["active_seg"], state["matching_seg"], state["seg_pot"],
+        seg_last, have_winners,
+    )
+
+    # cell activation / winner selection (pure function of prev state)
+    active_cells = jnp.where(
+        (active_cols & predicted_cols)[:, None], prev_predictive, False
+    ) | (burst[:, None] & jnp.ones((C, K), bool))
+    winner_cells = (
+        jnp.where((active_cols & predicted_cols)[:, None], prev_predictive, False)
+        | winner_extra
+    )
+
+    if learn:
+        alloc_col, bn_k, bn_s = alloc
+
+        # --- burst-new allocation: clear slot (evict if LRU) + stamp ---
+        presyn = presyn.at[alloc_col, bn_k, bn_s].set(-1, mode="drop")
+        syn_perm = syn_perm.at[alloc_col, bn_k, bn_s].set(0.0, mode="drop")
+        seg_pot0 = state["seg_pot"].at[alloc_col, bn_k, bn_s].set(0, mode="drop")
+        seg_last = seg_last.at[alloc_col, bn_k, bn_s].set(it, mode="drop")
+        alloc_mask = (
+            jnp.zeros((C, K, S), bool).at[alloc_col, bn_k, bn_s].set(True, mode="drop")
+        )
+        lm = learn_mask | alloc_mask
+        overflow = (lm.sum() > L) | (n_winners > W)
+
+        # --- compact gather of learning segments ---
+        idx = jnp.nonzero(lm.reshape(-1), size=L, fill_value=C * K * S)[0]
+        valid_l = idx < C * K * S
+        safe = jnp.clip(idx, 0, C * K * S - 1)
+        presyn_l = presyn.reshape(-1, M)[safe]
+        perm_l = syn_perm.reshape(-1, M)[safe]
+        pot_l = seg_pot0.reshape(-1)[safe]
+
+        # reinforce: +inc on synapses to prev-active cells, -dec on the rest
+        exists = presyn_l >= 0
+        act = exists & prev_active_flat[jnp.clip(presyn_l, 0, N - 1)]
+        perm_l = jnp.clip(
+            perm_l
+            + cfg.permanence_increment * act
+            - cfg.permanence_decrement * (exists & ~act),
+            0.0,
+            1.0,
+        )
+
+        # grow toward previous winner cells (ascending id)
+        winner_ids = jnp.nonzero(prev_winner_flat, size=W, fill_value=N)[0].astype(jnp.int32)
+        n_grow = (cfg.new_synapse_count - pot_l).astype(jnp.int32)
+        grown_presyn, grown_perm = _grow_compact(cfg, presyn_l, perm_l, n_grow, winner_ids, N)
+        grow_ok = have_winners & valid_l
+        presyn_l = jnp.where(grow_ok[:, None], grown_presyn, presyn_l)
+        perm_l = jnp.where(grow_ok[:, None], grown_perm, perm_l)
+
+        # scatter back (invalid rows dropped via OOB index)
+        presyn = presyn.reshape(-1, M).at[idx].set(presyn_l, mode="drop").reshape(C, K, S, M)
+        syn_perm = syn_perm.reshape(-1, M).at[idx].set(perm_l, mode="drop").reshape(C, K, S, M)
+        seg_last = seg_last.reshape(-1).at[idx].set(it, mode="drop").reshape(C, K, S)
+
+        # --- punish matching segments in columns that did not activate ---
+        if cfg.predicted_segment_decrement > 0.0:
+            pmask = state["matching_seg"] & ~active_cols[:, None, None]
+            pact = (presyn >= 0) & prev_active_flat[jnp.clip(presyn, 0, N - 1)]
+            syn_perm = jnp.where(
+                pmask[..., None] & pact,
+                jnp.maximum(syn_perm - cfg.predicted_segment_decrement, 0.0),
+                syn_perm,
+            )
+
+        # --- synapse death at permanence <= 0, then empty-segment death ---
+        dead = (presyn >= 0) & (syn_perm <= 0.0)
+        presyn = jnp.where(dead, -1, presyn)
+        nsyn = (presyn >= 0).sum(-1)
+        seg_last = jnp.where((seg_last >= 0) & (nsyn == 0), -1, seg_last)
+
+        tm_overflow = state["tm_overflow"] + overflow.astype(jnp.int32)
+    else:
+        tm_overflow = state["tm_overflow"]
+
+    # --- dendrite activity for t+1 over existing segments ---
+    exists_seg = seg_last >= 0
+    syn_act = (presyn >= 0) & active_cells.reshape(-1)[jnp.clip(presyn, 0, N - 1)]
+    conn_count = (syn_act & (syn_perm >= cfg.connected_permanence)).sum(-1)
+    pot_count = syn_act.sum(-1)
+    active_seg = exists_seg & (conn_count >= cfg.activation_threshold)
+    matching_seg = exists_seg & (pot_count >= cfg.min_threshold)
+    seg_pot = jnp.where(exists_seg, pot_count, 0).astype(jnp.int32)
+    if learn:
+        # LRU stamp for active segments (NuPIC stamps under learn only)
+        seg_last = jnp.where(active_seg, it, seg_last)
+
+    new_state = {
+        **state,
+        "presyn": presyn,
+        "syn_perm": syn_perm,
+        "seg_last": seg_last,
+        "active_seg": active_seg,
+        "matching_seg": matching_seg,
+        "seg_pot": seg_pot,
+        "prev_active": active_cells,
+        "prev_winner": winner_cells,
+        "tm_iter": it.astype(jnp.int32),  # oracle increments under inference too
+        "tm_overflow": tm_overflow,
+    }
+    return new_state, raw

@@ -2,12 +2,22 @@
 
 Real-TPU execution is exercised by bench.py and the driver's compile checks;
 tests validate semantics + sharding on the virtual CPU mesh (SURVEY.md §4
-item 6). Must run before anything imports jax.
+item 6).
+
+This environment pre-imports jax and forces JAX_PLATFORMS=axon (the TPU
+tunnel) via a sitecustomize .pth before any conftest runs, so mutating
+os.environ here is too late for the platform choice — the env default is
+latched into jax.config at interpreter start. `jax.config.update` still works
+any time before first backend use, and XLA_FLAGS is read at backend init, so
+the virtual device count can be set here.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _xla = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _xla:
     os.environ["XLA_FLAGS"] = (_xla + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

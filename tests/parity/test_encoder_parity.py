@@ -1,0 +1,61 @@
+"""Oracle-vs-device parity for hashing + record encoding (SURVEY.md §4 item 2).
+
+The RDSE/date encoder must be bit-identical across host numpy and jitted JAX:
+every downstream parity test depends on both backends seeing the same SDR.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rtap_tpu.config import DateConfig, ModelConfig, RDSEConfig
+from rtap_tpu.models.oracle.encoders import encode_record
+from rtap_tpu.ops.encoders_tpu import bind_offsets, encode_device
+from rtap_tpu.ops.hashing_tpu import hash_bits, hash_u32
+from rtap_tpu.utils.hashing import hash_bits_np, hash_u32_np
+
+
+def test_hash_u32_parity():
+    keys = np.arange(-500, 500, dtype=np.int64)
+    for seed in (0, 42, 0xDEADBEEF):
+        np_h = hash_u32_np(keys, seed)
+        dev_h = np.asarray(jax.jit(lambda k: hash_u32(k, seed))(jnp.asarray(keys, jnp.int32)))
+        np.testing.assert_array_equal(np_h, dev_h)
+
+
+def test_hash_bits_parity():
+    keys = np.arange(-200, 200, dtype=np.int64)
+    np_b = hash_bits_np(keys, 7, 400)
+    dev_b = np.asarray(jax.jit(lambda k: hash_bits(k, 7, 400))(jnp.asarray(keys, jnp.int32)))
+    np.testing.assert_array_equal(np_b, dev_b)
+
+
+@pytest.mark.parametrize("n_fields", [1, 3])
+def test_encode_parity(n_fields):
+    cfg = ModelConfig(
+        rdse=RDSEConfig(size=100, active_bits=7, resolution=0.5),
+        date=DateConfig(time_of_day_width=5, time_of_day_size=13, weekend_width=3),
+        n_fields=n_fields,
+    )
+    rng = np.random.default_rng(0)
+    offsets = rng.normal(size=n_fields).astype(np.float32)
+    enc_dev = jax.jit(lambda v, t, o: encode_device(cfg, v, t, o))
+    for i in range(50):
+        values = (rng.normal(size=n_fields) * 10).astype(np.float32)
+        if i % 7 == 0:
+            values[rng.integers(n_fields)] = np.nan  # missing sample
+        ts = int(rng.integers(0, 2_000_000_000))
+        host = encode_record(cfg, values, ts, offsets)
+        dev = np.asarray(enc_dev(jnp.asarray(values), jnp.int32(ts), jnp.asarray(offsets)))
+        np.testing.assert_array_equal(host, dev, err_msg=f"record {i} ts={ts}")
+
+
+def test_bind_offsets_matches_host_rule():
+    values = jnp.asarray([np.nan, 2.5, 7.0], jnp.float32)
+    off = jnp.zeros(3, jnp.float32)
+    bound = jnp.asarray([False, False, True])
+    new_off, new_bound = jax.jit(bind_offsets)(values, off, bound)
+    # field0: NaN -> stays unbound; field1: binds to 2.5; field2: already bound
+    np.testing.assert_array_equal(np.asarray(new_bound), [False, True, True])
+    np.testing.assert_allclose(np.asarray(new_off), [0.0, 2.5, 0.0])
