@@ -25,6 +25,15 @@ from dataclasses import dataclass, field
 from typing import Any
 
 
+# RDSE bucket indices are clamped to this magnitude on BOTH backends before
+# integer conversion. The device kernel runs int32 (no x64 on TPU); without a
+# shared clamp, a wild value (overflowed counter, sensor garbage) >= 2^31
+# buckets from the offset would wrap on device but not on host, silently and
+# permanently diverging the SDR stream. 2^30 is exactly representable in f32
+# and leaves headroom for the +active_bits hash-key offsets.
+RDSE_BUCKET_CLAMP = 1 << 30
+
+
 @dataclass(frozen=True)
 class RDSEConfig:
     """Random Distributed Scalar Encoder (SURVEY.md C1).

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from rtap_tpu.config import DateConfig, ModelConfig, RDSEConfig
+from rtap_tpu.config import RDSE_BUCKET_CLAMP, DateConfig, ModelConfig, RDSEConfig
 from rtap_tpu.utils.hashing import hash_bits_np
 
 SECONDS_PER_DAY = 86400
@@ -30,7 +30,11 @@ def rdse_bucket(value: float | np.ndarray, offset: float | np.ndarray, resolutio
     v = np.asarray(value, np.float32)
     off = np.asarray(offset, np.float32)
     res = np.float32(resolution)
-    return np.round((v - off) / res).astype(np.int64)
+    # f32 divide may overflow to inf for wild values; that's fine — inf clamps
+    # to the bound, same as on device (which warns for nothing).
+    with np.errstate(over="ignore"):
+        b = np.clip(np.round((v - off) / res), -RDSE_BUCKET_CLAMP, RDSE_BUCKET_CLAMP)
+    return b.astype(np.int64)
 
 
 def rdse_bits(cfg: RDSEConfig, bucket: int, field_index: int = 0) -> np.ndarray:

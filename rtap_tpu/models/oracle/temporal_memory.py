@@ -116,8 +116,12 @@ class TMOracle:
         prev_pred_cols = prev_predictive.any(-1)  # [C]
 
         n_active = int(active_cols.sum())
+        # f32 arithmetic: the device step emits raw as f32, and the score is
+        # part of the cross-backend parity contract — round the same way here.
         raw_anomaly = (
-            1.0 - float((active_cols & prev_pred_cols).sum()) / n_active if n_active else 0.0
+            float(np.float32(1.0) - np.float32((active_cols & prev_pred_cols).sum()) / np.float32(n_active))
+            if n_active
+            else 0.0
         )
 
         active_cells = np.zeros((C, K), bool)

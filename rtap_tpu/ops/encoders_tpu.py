@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from rtap_tpu.config import ModelConfig
+from rtap_tpu.config import RDSE_BUCKET_CLAMP, ModelConfig
 from rtap_tpu.ops.hashing_tpu import hash_bits
 
 SECONDS_PER_DAY = 86400
@@ -34,7 +34,11 @@ def encode_device(
 
     finite = jnp.isfinite(values)
     v = jnp.where(finite, values, jnp.float32(0.0))
-    bucket = jnp.round((v - enc_offset) / jnp.float32(cfg.rdse.resolution)).astype(jnp.int32)
+    bucket = jnp.clip(
+        jnp.round((v - enc_offset) / jnp.float32(cfg.rdse.resolution)),
+        -RDSE_BUCKET_CLAMP,
+        RDSE_BUCKET_CLAMP,
+    ).astype(jnp.int32)
     keys = bucket[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [F, w]
     # per-field hash stream: seed + 0x1000 * field (same keying as the oracle)
     seeds = jnp.uint32(cfg.rdse.seed) + jnp.uint32(0x1000) * jnp.arange(F, dtype=jnp.uint32)

@@ -1,0 +1,94 @@
+"""Fused per-record device step: encode -> SP -> TM -> raw anomaly score.
+
+This is the TPU-native analog of the reference's per-record hot path
+(SURVEY.md §3.2: `model.run` -> encoders -> SpatialPooler.cpp ->
+Cells4/TemporalMemory.cpp -> raw score), collapsed into ONE jitted XLA
+program so a record costs a single device dispatch. The host boundary is
+exactly the one BASELINE.json prescribes: values/timestamps in, raw scores
+out; anomaly likelihood stays on host (models/oracle/likelihood.py,
+service/likelihood_batch.py).
+
+Three entry points:
+
+- :func:`fused_step` — single stream, used by `HTMModel(backend="tpu")`.
+- :func:`group_step` — vmapped over a leading stream-group axis G: one
+  dispatch scores G streams in lockstep (SURVEY.md §2.3 "DP over streams").
+- :class:`TpuStepRunner` — stateful convenience wrapper holding device state.
+
+All three are bit-identical to the CPU oracle per step
+(tests/parity/test_e2e_parity.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+from rtap_tpu.ops.encoders_tpu import bind_offsets, encode_device
+from rtap_tpu.ops.sp_tpu import sp_step
+from rtap_tpu.ops.tm_tpu import tm_step
+
+
+def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
+    """One fused record step -> (new_state, raw f32). Pure/traceable.
+
+    `values` is [n_fields] f32 (NaN = missing sample), `ts_unix` scalar i32.
+    """
+    enc_offset, enc_bound = bind_offsets(values, state["enc_offset"], state["enc_bound"])
+    state = {**state, "enc_offset": enc_offset, "enc_bound": enc_bound}
+    sdr = encode_device(cfg, values, ts_unix, enc_offset)
+    state, active = sp_step(state, sdr, cfg.sp, learn)
+    state, raw = tm_step(state, active, cfg.tm, learn)
+    return state, raw
+
+
+@partial(jax.jit, static_argnames=("cfg", "learn"))
+def fused_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
+    """Single-stream fused step (see :func:`step_impl`)."""
+    return step_impl(state, values, ts_unix, cfg, learn)
+
+
+@partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
+def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
+    """Stream-group fused step: every state leaf carries a leading G axis;
+    `values` is [G, n_fields] f32, `ts_unix` [G] i32 -> (state, raw [G] f32).
+
+    State buffers are donated: at 100k streams the TM pools dominate HBM and
+    the update must happen in place (SURVEY.md §7 hard part 4).
+    """
+    return jax.vmap(lambda s, v, t: step_impl(s, v, t, cfg, learn))(state, values, ts_unix)
+
+
+def replicate_state(state: dict, group_size: int) -> dict:
+    """Tile a single-stream state dict into a [G, ...] group state (host side).
+
+    Every stream starts from the same deterministic init (models/state.py);
+    per-stream divergence comes entirely from the data, mirroring the
+    reference's one-independent-model-per-stream registry (SURVEY.md C19).
+    """
+    return {
+        k: np.broadcast_to(np.asarray(v)[None, ...], (group_size, *np.shape(v))).copy()
+        for k, v in state.items()
+    }
+
+
+class TpuStepRunner:
+    """Holds one stream's device state and steps it record by record.
+
+    Used by `HTMModel(backend="tpu")` — the single-stream convenience path.
+    High-throughput multi-stream execution goes through service/registry.py
+    stream groups and :func:`group_step` instead.
+    """
+
+    def __init__(self, cfg: ModelConfig, state: dict):
+        self.cfg = cfg
+        self.state = jax.device_put(state)
+
+    def step(self, values: np.ndarray, ts_unix: int, learn: bool = True) -> float:
+        v = jnp.asarray(np.atleast_1d(values), jnp.float32)
+        self.state, raw = fused_step(self.state, v, jnp.int32(ts_unix), self.cfg, learn)
+        return float(raw)

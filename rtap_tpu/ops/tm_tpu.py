@@ -177,9 +177,10 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     )
 
     # cell activation / winner selection (pure function of prev state)
-    active_cells = jnp.where(
-        (active_cols & predicted_cols)[:, None], prev_predictive, False
-    ) | (burst[:, None] & jnp.ones((C, K), bool))
+    active_cells = (
+        jnp.where((active_cols & predicted_cols)[:, None], prev_predictive, False)
+        | burst[:, None]
+    )
     winner_cells = (
         jnp.where((active_cols & predicted_cols)[:, None], prev_predictive, False)
         | winner_extra

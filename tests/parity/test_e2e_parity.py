@@ -1,0 +1,103 @@
+"""End-to-end parity: CPU oracle vs fused TPU step (SURVEY.md §4 item 2).
+
+The upstream pattern this replicates is NuPIC's
+spatial_pooler_compatibility_test.py — run the Python and C++ implementations
+side by side with identical seeds and assert identical state. Here the pair is
+(numpy oracle pipeline) vs (single fused jitted device program), and parity
+must hold through the full encode -> SP -> TM -> raw-score composition, not
+just per kernel.
+"""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import ModelConfig, RDSEConfig, DateConfig, SPConfig, TMConfig, cluster_preset
+from rtap_tpu.models.htm_model import HTMModel
+
+N_RECORDS = 400
+
+
+def small_cfg(n_fields: int = 1) -> ModelConfig:
+    # Small enough to run 400 steps fast on the CPU test backend, big enough
+    # to exercise bursting, segment growth, LRU eviction, and date bits.
+    return ModelConfig(
+        rdse=RDSEConfig(size=128, active_bits=11, resolution=0.7),
+        date=DateConfig(time_of_day_width=7, time_of_day_size=18, weekend_width=3),
+        sp=SPConfig(columns=256, num_active_columns=10),
+        tm=TMConfig(cells_per_column=8, activation_threshold=6, min_threshold=4,
+                    max_segments_per_cell=4, max_synapses_per_segment=16,
+                    new_synapse_count=8, learn_cap=48, winner_cap=64),
+        n_fields=n_fields,
+    )
+
+
+def make_values(n, n_fields, seed=7):
+    rng = np.random.Generator(np.random.Philox(key=(seed, 1)))
+    t = np.arange(n)[:, None]
+    base = 50 + 20 * np.sin(2 * np.pi * t / 60.0 + np.arange(n_fields)[None, :])
+    vals = (base + rng.normal(0, 2.0, (n, n_fields))).astype(np.float32)
+    vals[n // 2, :] += 40.0  # a spike so raw scores actually move
+    vals[10, 0] = np.nan  # missing sample path
+    return vals
+
+
+@pytest.mark.parametrize("n_fields", [1, 3])
+def test_e2e_raw_score_parity(n_fields):
+    cfg = small_cfg(n_fields)
+    cpu = HTMModel(cfg, seed=3, backend="cpu")
+    tpu = HTMModel(cfg, seed=3, backend="tpu")
+    vals = make_values(N_RECORDS, n_fields)
+    ts0 = 1_700_000_000
+    for i in range(N_RECORDS):
+        v = vals[i] if n_fields > 1 else float(vals[i, 0])
+        r_cpu = cpu.run(ts0 + 300 * i, v)
+        r_tpu = tpu.run(ts0 + 300 * i, v)
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+        assert r_cpu.log_likelihood == pytest.approx(r_tpu.log_likelihood, rel=1e-9), f"step {i}"
+
+
+def test_e2e_state_parity_exact():
+    """After N steps, the full device state matches the oracle bit-for-bit."""
+    import jax
+
+    cfg = small_cfg()
+    cpu = HTMModel(cfg, seed=11, backend="cpu")
+    tpu = HTMModel(cfg, seed=11, backend="tpu")
+    vals = make_values(200, 1)
+    for i in range(200):
+        cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+    dev = jax.device_get(tpu._runner.state)
+    for k in ("perm", "boost", "overlap_duty", "active_duty", "presyn", "syn_perm",
+              "seg_last", "active_seg", "matching_seg", "seg_pot", "prev_active",
+              "prev_winner", "enc_offset"):
+        np.testing.assert_array_equal(np.asarray(dev[k]), np.asarray(cpu.state[k]), err_msg=k)
+    assert int(dev["tm_overflow"]) == 0
+
+
+def test_group_step_matches_single():
+    """group_step over G streams == G independent single-stream runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from rtap_tpu.models.state import init_state
+    from rtap_tpu.ops.step import fused_step, group_step, replicate_state
+
+    cfg = cluster_preset()
+    G, n = 4, 150
+    base = init_state(cfg, seed=5)
+    gstate = jax.device_put(replicate_state(base, G))
+    singles = [jax.device_put(init_state(cfg, seed=5)) for _ in range(G)]
+
+    rng = np.random.Generator(np.random.Philox(key=(9, 9)))
+    vals = (30 + 10 * rng.random((n, G))).astype(np.float32)
+    vals[60, 2] += 50.0
+
+    for i in range(n):
+        ts = np.full(G, 1_700_000_000 + i, np.int32)
+        gstate, graw = group_step(gstate, jnp.asarray(vals[i][:, None]), jnp.asarray(ts), cfg)
+        for g in range(G):
+            singles[g], raw = fused_step(
+                singles[g], jnp.asarray(vals[i, g : g + 1]), jnp.int32(ts[g]), cfg
+            )
+            assert float(raw) == float(graw[g]), f"step {i} stream {g}"

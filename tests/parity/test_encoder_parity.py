@@ -51,6 +51,23 @@ def test_encode_parity(n_fields):
         np.testing.assert_array_equal(host, dev, err_msg=f"record {i} ts={ts}")
 
 
+def test_encode_parity_extreme_values():
+    """Wild finite values (overflowed counters, sensor garbage) must encode
+    identically on both backends: the shared RDSE_BUCKET_CLAMP keeps the
+    device's int32 bucket from wrapping where the host's int64 would not."""
+    cfg = ModelConfig(
+        rdse=RDSEConfig(size=100, active_bits=7, resolution=0.5),
+        date=DateConfig(time_of_day_width=0, time_of_day_size=0, weekend_width=0),
+    )
+    offsets = np.zeros(1, np.float32)
+    enc_dev = jax.jit(lambda v, t, o: encode_device(cfg, v, t, o))
+    for x in (3e9, -3e9, 1e12, 1e30, -1e30, 3.4e38):
+        values = np.asarray([x], np.float32)
+        host = encode_record(cfg, values, 0, offsets)
+        dev = np.asarray(enc_dev(jnp.asarray(values), jnp.int32(0), jnp.asarray(offsets)))
+        np.testing.assert_array_equal(host, dev, err_msg=f"value {x}")
+
+
 def test_bind_offsets_matches_host_rule():
     values = jnp.asarray([np.nan, 2.5, 7.0], jnp.float32)
     off = jnp.zeros(3, jnp.float32)
