@@ -57,14 +57,16 @@ class HTMModel:
     def run(self, timestamp: int, value: float | np.ndarray, learn: bool = True) -> ModelResult:
         """Process one record; returns scores. Mirrors model.run({...})."""
         values = np.atleast_1d(np.asarray(value, np.float32))
-        # bind each field's offset at its first finite value (a leading NaN
-        # must not poison the stream's bucket arithmetic forever)
-        bind = ~self.state["enc_bound"] & np.isfinite(values)
-        if bind.any():
-            self.state["enc_offset"] = np.where(bind, values, self.state["enc_offset"]).astype(np.float32)
-            self.state["enc_bound"] = self.state["enc_bound"] | bind
 
         if self.backend == "cpu":
+            # bind each field's offset at its first finite value (a leading NaN
+            # must not poison the stream's bucket arithmetic forever); the tpu
+            # path performs the same bind on device (ops/encoders_tpu.bind_offsets)
+            # against its own state copy.
+            bind = ~self.state["enc_bound"] & np.isfinite(values)
+            if bind.any():
+                self.state["enc_offset"] = np.where(bind, values, self.state["enc_offset"]).astype(np.float32)
+                self.state["enc_bound"] = self.state["enc_bound"] | bind
             sdr = encode_record(self.cfg, values, int(timestamp), self.state["enc_offset"])
             active = sp_compute(self.state, sdr, self.cfg.sp, learn)
             raw = self._tm.compute(active, learn)

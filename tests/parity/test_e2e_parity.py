@@ -8,6 +8,7 @@ must hold through the full encode -> SP -> TM -> raw-score composition, not
 just per kernel.
 """
 
+import jax as _jax
 import numpy as np
 import pytest
 
@@ -15,6 +16,15 @@ from rtap_tpu.config import ModelConfig, RDSEConfig, DateConfig, SPConfig, TMCon
 from rtap_tpu.models.htm_model import HTMModel
 
 N_RECORDS = 400
+
+# Bit-exactness holds only when both backends run the same arithmetic: real
+# TPU f32 division rounds 1 ulp differently from host numpy (verify SKILL.md
+# gotcha). conftest.py forces the CPU platform under pytest; this guard keeps
+# the exact assertions honest if the file is ever run outside that harness.
+exact_only = pytest.mark.skipif(
+    _jax.devices()[0].platform != "cpu",
+    reason="bit-exact parity is asserted on the CPU test backend only",
+)
 
 
 def small_cfg(n_fields: int = 1) -> ModelConfig:
@@ -41,6 +51,7 @@ def make_values(n, n_fields, seed=7):
     return vals
 
 
+@exact_only
 @pytest.mark.parametrize("n_fields", [1, 3])
 def test_e2e_raw_score_parity(n_fields):
     cfg = small_cfg(n_fields)
@@ -56,6 +67,7 @@ def test_e2e_raw_score_parity(n_fields):
         assert r_cpu.log_likelihood == pytest.approx(r_tpu.log_likelihood, rel=1e-9), f"step {i}"
 
 
+@exact_only
 def test_e2e_state_parity_exact():
     """After N steps, the full device state matches the oracle bit-for-bit."""
     import jax
@@ -75,6 +87,7 @@ def test_e2e_state_parity_exact():
     assert int(dev["tm_overflow"]) == 0
 
 
+@exact_only
 def test_group_step_matches_single():
     """group_step over G streams == G independent single-stream runs."""
     import jax
