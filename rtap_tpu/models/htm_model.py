@@ -27,6 +27,31 @@ from rtap_tpu.models.state import init_state
 BACKENDS = ("cpu", "tpu")
 
 
+def oracle_record_step(
+    cfg: ModelConfig,
+    state: dict,
+    tm: TMOracle,
+    values: np.ndarray,
+    ts_unix: int,
+    learn: bool = True,
+) -> float:
+    """One oracle record through bind -> encode -> SP -> TM -> raw score.
+
+    The single source of the CPU per-record composition, shared by
+    HTMModel.run and the service layer's CPU stream groups; the device twin
+    is ops/step.step_impl.
+    """
+    bind = ~state["enc_bound"] & np.isfinite(values)
+    if bind.any():
+        # bind each field's offset at its first finite value (a leading NaN
+        # must not poison the stream's bucket arithmetic forever)
+        state["enc_offset"] = np.where(bind, values, state["enc_offset"]).astype(np.float32)
+        state["enc_bound"] = state["enc_bound"] | bind
+    sdr = encode_record(cfg, values, int(ts_unix), state["enc_offset"])
+    active = sp_compute(state, sdr, cfg.sp, learn)
+    return tm.compute(active, learn)
+
+
 @dataclass
 class ModelResult:
     """Per-record inference output (the reference's ModelResult.inferences)."""
@@ -59,18 +84,10 @@ class HTMModel:
         values = np.atleast_1d(np.asarray(value, np.float32))
 
         if self.backend == "cpu":
-            # bind each field's offset at its first finite value (a leading NaN
-            # must not poison the stream's bucket arithmetic forever); the tpu
-            # path performs the same bind on device (ops/encoders_tpu.bind_offsets)
-            # against its own state copy.
-            bind = ~self.state["enc_bound"] & np.isfinite(values)
-            if bind.any():
-                self.state["enc_offset"] = np.where(bind, values, self.state["enc_offset"]).astype(np.float32)
-                self.state["enc_bound"] = self.state["enc_bound"] | bind
-            sdr = encode_record(self.cfg, values, int(timestamp), self.state["enc_offset"])
-            active = sp_compute(self.state, sdr, self.cfg.sp, learn)
-            raw = self._tm.compute(active, learn)
+            raw = oracle_record_step(self.cfg, self.state, self._tm, values, int(timestamp), learn)
         else:
+            # the tpu path performs the offset bind on device
+            # (ops/encoders_tpu.bind_offsets) against its own state copy
             raw = self._runner.step(values, int(timestamp), learn)
 
         lik, loglik = self.likelihood.update(float(raw))

@@ -63,6 +63,25 @@ def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     return jax.vmap(lambda s, v, t: step_impl(s, v, t, cfg, learn))(state, values, ts_unix)
 
 
+@partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
+def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
+    """Multi-tick stream-group step: scan :func:`group_step`'s body over a
+    leading time axis so T ticks cost ONE device dispatch.
+
+    `values` is [T, G, n_fields] f32, `ts_unix` [T, G] i32 ->
+    (state, raw [T, G] f32). This is the replay/bench fast path (SURVEY.md §7
+    hard part 3: amortize per-tick dispatch latency by batching ticks when
+    replaying faster than real time); the live 1s-cadence service uses
+    :func:`group_step` per tick instead.
+    """
+
+    def body(s, inp):
+        v, t = inp
+        return jax.vmap(lambda ss, vv, tt: step_impl(ss, vv, tt, cfg, learn))(s, v, t)
+
+    return jax.lax.scan(body, state, (values, ts_unix))
+
+
 def replicate_state(state: dict, group_size: int) -> dict:
     """Tile a single-stream state dict into a [G, ...] group state (host side).
 

@@ -1,0 +1,20 @@
+"""Service layer: stream-group registry, batched likelihood, alerting, loops.
+
+The TPU-native analog of the reference's anomaly service (SURVEY.md L3,
+§3.3): where the reference lazily creates one NuPIC model per node-metric
+stream and loops over them in Python, this layer packs streams into
+fixed-size groups that share one vmapped XLA program, keeps the
+anomaly-likelihood post-process vectorized on host, and emits JSONL alerts.
+"""
+
+from rtap_tpu.service.alerts import AlertWriter, ThroughputCounter
+from rtap_tpu.service.likelihood_batch import BatchAnomalyLikelihood
+from rtap_tpu.service.registry import StreamGroup, StreamGroupRegistry
+
+__all__ = [
+    "AlertWriter",
+    "BatchAnomalyLikelihood",
+    "StreamGroup",
+    "StreamGroupRegistry",
+    "ThroughputCounter",
+]

@@ -1,0 +1,88 @@
+"""Alert emission + throughput accounting (host side).
+
+The reference thresholds anomaly log-likelihood and pushes alerts to a
+dashboard (SURVEY.md C20/C22, §3.3). v1 keeps the design but emits JSONL —
+one object per alert — plus periodic throughput stats implementing the
+north-star counter "anomaly-scored metrics/sec/chip" (SURVEY.md §5
+"Metrics / logging").
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import IO
+
+import numpy as np
+
+
+class AlertWriter:
+    """JSONL alert sink. One line per (stream, tick) whose score crosses the
+    threshold; `None` path writes nowhere but still counts."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._fh: IO[str] | None = open(path, "a") if path else None
+        self.count = 0
+
+    def emit_batch(
+        self,
+        stream_ids: list[str],
+        ts: np.ndarray,
+        values: np.ndarray,
+        raw: np.ndarray,
+        log_likelihood: np.ndarray,
+        alerts: np.ndarray,
+    ) -> int:
+        """Write one JSONL line per alerting stream; returns alert count."""
+        idx = np.nonzero(alerts)[0]
+        self.count += idx.size
+        if self._fh is not None and idx.size:
+            ts = np.broadcast_to(np.asarray(ts), alerts.shape)
+            for g in idx:
+                self._fh.write(
+                    json.dumps(
+                        {
+                            "stream": stream_ids[g],
+                            "ts": int(ts[g]),
+                            "value": float(np.asarray(values)[g]) if np.ndim(values) == 1 else [float(x) for x in np.asarray(values)[g]],
+                            "raw_score": float(raw[g]),
+                            "log_likelihood": float(log_likelihood[g]),
+                        }
+                    )
+                    + "\n"
+                )
+            self._fh.flush()
+        return int(idx.size)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+@dataclass
+class ThroughputCounter:
+    """Counts scored metrics against wall clock -> metrics/sec/chip."""
+
+    start: float = field(default_factory=time.perf_counter)
+    scored: int = 0
+
+    def add(self, n: int) -> None:
+        self.scored += int(n)
+
+    @property
+    def elapsed(self) -> float:
+        return max(time.perf_counter() - self.start, 1e-9)
+
+    @property
+    def metrics_per_sec(self) -> float:
+        return self.scored / self.elapsed
+
+    def stats(self) -> dict:
+        return {
+            "scored": self.scored,
+            "elapsed_s": round(self.elapsed, 3),
+            "metrics_per_sec": round(self.metrics_per_sec, 1),
+        }

@@ -1,0 +1,160 @@
+"""Vectorized anomaly likelihood over a stream group (host side).
+
+Semantically identical to the per-stream oracle
+(models/oracle/likelihood.py, itself faithful to NuPIC's
+anomaly_likelihood.py — SURVEY.md C8) but runs all G streams of a group in
+lockstep with numpy array ops, so the host post-process stays negligible next
+to the device step even at 100k streams (SURVEY.md §7 hard part 5).
+
+Lockstep is the group invariant: every stream in a group receives a score
+every tick, so the record count, ring-buffer cursor, and refit schedule are
+scalars shared by the whole batch. Floating-point note: batch reductions
+(np.sum/np.mean along an axis) may round differently from the oracle's
+sequential Python sums by ~1 ulp; parity tests use rel tolerances, not
+bit-equality.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from rtap_tpu.config import LikelihoodConfig
+
+# NuPIC's log-scale constant: log(1.0000000001 - x) / log(1e-10)
+_LOG_DENOM = np.log(1e-10)
+
+
+# numpy ships no erfc ufunc and scipy is unavailable here; a frompyfunc over
+# math.erfc is one ufunc call per tick over [G] — negligible next to the ring
+# updates, and bit-identical to the oracle's math.erfc per element.
+_erfc = np.frompyfunc(math.erfc, 1, 1)
+_SQRT2 = math.sqrt(2.0)
+
+
+def tail_probability_np(z: np.ndarray) -> np.ndarray:
+    """Gaussian upper-tail Q(z) = 0.5*erfc(z/sqrt(2)), elementwise."""
+    return 0.5 * _erfc(z / _SQRT2).astype(np.float64)
+
+
+def log_likelihood_np(lik: np.ndarray) -> np.ndarray:
+    return np.log(1.0000000001 - lik) / _LOG_DENOM
+
+
+class BatchAnomalyLikelihood:
+    """Likelihood state for G lockstep streams.
+
+    `update(raw [G]) -> (likelihood [G], log_likelihood [G])`.
+    """
+
+    def __init__(self, cfg: LikelihoodConfig, group_size: int):
+        self.cfg = cfg
+        self.G = int(group_size)
+        self.records = 0
+        # short moving-average ring [G, w]
+        self.recent = np.zeros((self.G, cfg.averaging_window), np.float64)
+        self.mean = np.zeros(self.G, np.float64)
+        self.std = np.ones(self.G, np.float64)
+        self.have_distribution = False
+        if cfg.mode == "streaming":
+            self._s0 = np.zeros(self.G, np.float64)
+            self._s1 = np.zeros(self.G, np.float64)
+            self._s2 = np.zeros(self.G, np.float64)
+            self.scores = None
+        else:
+            # historic window ring [G, W]; cursor/fill shared (lockstep)
+            self.scores = np.zeros((self.G, cfg.historic_window_size), np.float64)
+
+    # ---- checkpointing ----
+    def state_dict(self) -> dict[str, np.ndarray]:
+        d = {
+            "records": np.int64(self.records),
+            "recent": self.recent,
+            "mean": self.mean,
+            "std": self.std,
+            "have_distribution": np.bool_(self.have_distribution),
+        }
+        if self.scores is not None:
+            d["scores"] = self.scores
+        else:
+            d.update(s0=self._s0, s1=self._s1, s2=self._s2)
+        return d
+
+    def load_state_dict(self, d: dict[str, np.ndarray]) -> None:
+        self.records = int(d["records"])
+        self.recent = np.asarray(d["recent"], np.float64)
+        self.mean = np.asarray(d["mean"], np.float64)
+        self.std = np.asarray(d["std"], np.float64)
+        self.have_distribution = bool(d["have_distribution"])
+        if self.scores is not None:
+            self.scores = np.asarray(d["scores"], np.float64)
+        else:
+            self._s0, self._s1, self._s2 = (
+                np.asarray(d["s0"], np.float64),
+                np.asarray(d["s1"], np.float64),
+                np.asarray(d["s2"], np.float64),
+            )
+
+    # ---- the per-tick update ----
+    def _refit_window(self) -> None:
+        n = min(self.records, self.cfg.historic_window_size)
+        # ring -> chronological [G, n]
+        cur = self.records % self.cfg.historic_window_size
+        if self.records <= self.cfg.historic_window_size:
+            scores = self.scores[:, :n]
+        else:
+            scores = np.concatenate([self.scores[:, cur:], self.scores[:, :cur]], axis=1)
+        # skip records from the model's learning period (oracle._refit_window)
+        still_buffered = max(0, self.cfg.learning_period - (self.records - n))
+        if still_buffered:
+            scores = scores[:, still_buffered:]
+        if scores.shape[1] < 2:
+            return
+        w = self.cfg.averaging_window
+        if scores.shape[1] >= w:
+            # moving average over trailing window (the oracle's convolve
+            # "valid" mode), via cumulative sums
+            csum = np.cumsum(np.pad(scores * (1.0 / w), ((0, 0), (1, 0))), axis=1)
+            averaged = csum[:, w:] - csum[:, :-w]
+        else:
+            averaged = scores
+        self.mean = averaged.mean(axis=1)
+        self.std = np.maximum(averaged.std(axis=1), 1e-6)
+        self.have_distribution = True
+
+    def _update_streaming(self, avg: np.ndarray) -> None:
+        d = self.cfg.streaming_decay
+        self._s0 = d * self._s0 + 1.0
+        self._s1 = d * self._s1 + avg
+        self._s2 = d * self._s2 + avg * avg
+        self.mean = self._s1 / self._s0
+        var = np.maximum(self._s2 / self._s0 - self.mean**2, 0.0)
+        self.std = np.maximum(np.sqrt(var), 1e-6)
+        self.have_distribution = self.records >= self.cfg.probationary_period
+
+    def update(self, raw: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Feed one tick of raw scores [G] -> (likelihood [G], log_lik [G])."""
+        raw = np.asarray(raw, np.float64)
+        w = self.cfg.averaging_window
+        self.recent[:, self.records % w] = raw
+        self.records += 1
+        n_recent = min(self.records, w)
+        if self.records < w:
+            avg = self.recent[:, :n_recent].sum(axis=1) / n_recent
+        else:
+            avg = self.recent.sum(axis=1) / w
+
+        if self.cfg.mode == "streaming":
+            self._update_streaming(avg)
+        else:
+            self.scores[:, (self.records - 1) % self.cfg.historic_window_size] = raw
+            if self.records % self.cfg.reestimation_period == 0 or not self.have_distribution:
+                if self.records >= self.cfg.probationary_period:
+                    self._refit_window()
+
+        if self.records < self.cfg.probationary_period or not self.have_distribution:
+            half = np.full(self.G, 0.5)
+            return half, log_likelihood_np(half)
+        lik = 1.0 - tail_probability_np((avg - self.mean) / self.std)
+        return lik, log_likelihood_np(lik)

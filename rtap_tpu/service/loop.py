@@ -1,0 +1,135 @@
+"""Replay and live service loops — the reference's §3.3 tick loop, batched.
+
+`replay_streams` drives a set of equal-length streams through stream groups
+as fast as the chip allows (chunked scan dispatches); `live_loop` paces
+ticks to a real cadence, polling a callable source each tick — the analog of
+the reference's collector.poll() -> per-stream model.run() service loop.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+from rtap_tpu.data.synthetic import LabeledStream
+from rtap_tpu.service.alerts import AlertWriter, ThroughputCounter
+from rtap_tpu.service.registry import StreamGroup, StreamGroupRegistry
+
+
+@dataclass
+class ReplayResult:
+    stream_ids: list[str]
+    timestamps: np.ndarray  # [T] int64 (shared clock)
+    raw: np.ndarray  # [T, N] f32
+    log_likelihood: np.ndarray  # [T, N] f64
+    alerts: np.ndarray  # [T, N] bool
+    throughput: dict = field(default_factory=dict)
+
+
+def replay_streams(
+    streams: Sequence[LabeledStream],
+    cfg: ModelConfig,
+    backend: str = "tpu",
+    group_size: int | None = None,
+    chunk_ticks: int = 64,
+    threshold: float = 0.5,
+    alert_path: str | None = None,
+    learn: bool = True,
+) -> ReplayResult:
+    """Replay equal-length streams through grouped models at full speed.
+
+    All streams must share a clock (same length; timestamps of stream 0 are
+    used for the result). Groups are sized `group_size` (default: all streams
+    in one group) and each chunk of `chunk_ticks` ticks costs one device
+    dispatch per group.
+    """
+    del learn  # reserved: inference-only replay is a later optimization
+    n = len(streams)
+    T = len(streams[0].values)
+    for s in streams:
+        if len(s.values) != T:
+            raise ValueError("replay_streams requires equal-length streams")
+    group_size = group_size or n
+    ids = [s.stream_id for s in streams]
+
+    reg = StreamGroupRegistry(cfg, group_size=group_size, backend=backend, threshold=threshold)
+    for sid in ids:
+        reg.add_stream(sid)
+    reg.finalize()
+
+    values = np.stack([s.values for s in streams], axis=1)  # [T, N]
+    ts = np.stack([s.timestamps for s in streams], axis=1).astype(np.int64)  # [T, N]
+
+    raw = np.empty((T, n), np.float32)
+    loglik = np.empty((T, n), np.float64)
+    alerts = np.zeros((T, n), bool)
+    writer = AlertWriter(alert_path)
+    counter = ThroughputCounter()
+
+    # streams were added in order, so group i owns the contiguous slice
+    # ids[i*group_size : i*group_size + n_live], at slots 0..n_live-1
+    for gi, grp in enumerate(reg.groups):
+        lo = gi * group_size
+        live = grp.n_live
+        sids = ids[lo : lo + live]
+        # pad slots replay the first live stream's data; their scores are dropped
+        gv = np.repeat(values[:, lo : lo + 1], grp.G, axis=1)
+        gt = np.repeat(ts[:, lo : lo + 1], grp.G, axis=1)
+        gv[:, :live] = values[:, lo : lo + live]
+        gt[:, :live] = ts[:, lo : lo + live]
+
+        for t0 in range(0, T, chunk_ticks):
+            t1 = min(t0 + chunk_ticks, T)
+            r, ll, al = grp.run_chunk(gv[t0:t1], gt[t0:t1])
+            raw[t0:t1, lo : lo + live] = r[:, :live]
+            loglik[t0:t1, lo : lo + live] = ll[:, :live]
+            alerts[t0:t1, lo : lo + live] = al[:, :live]
+            counter.add((t1 - t0) * live)
+            for i in range(t0, t1):
+                writer.emit_batch(sids, gt[i, :live], gv[i, :live],
+                                  r[i - t0, :live], ll[i - t0, :live], al[i - t0, :live])
+    writer.close()
+
+    return ReplayResult(
+        stream_ids=ids,
+        timestamps=streams[0].timestamps,
+        raw=raw,
+        log_likelihood=loglik,
+        alerts=alerts,
+        throughput={**counter.stats(), "alerts": writer.count},
+    )
+
+
+def live_loop(
+    source: Callable[[int], tuple[np.ndarray, int]],
+    group: StreamGroup,
+    n_ticks: int,
+    cadence_s: float = 1.0,
+    alert_path: str | None = None,
+) -> dict:
+    """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
+    score the group, emit alerts; sleep off any time left in the cadence
+    budget. Returns throughput stats including missed-deadline count — the
+    real-time health signal for the 1s-cadence north star."""
+    writer = AlertWriter(alert_path)
+    counter = ThroughputCounter()
+    missed = 0
+    for k in range(n_ticks):
+        t_start = time.perf_counter()
+        values, ts = source(k)
+        res = group.tick(values, ts)
+        writer.emit_batch(group.stream_ids, np.full(group.G, ts), values, res.raw,
+                          res.log_likelihood, res.alerts)
+        counter.add(group.G)
+        budget = cadence_s - (time.perf_counter() - t_start)
+        if budget < 0:
+            missed += 1
+        elif k + 1 < n_ticks:
+            time.sleep(budget)
+    writer.close()
+    return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
+            "ticks": n_ticks, "cadence_s": cadence_s}

@@ -1,0 +1,189 @@
+"""Stream-group registry: many metric streams, few compiled programs.
+
+The reference's stream manager lazily creates one NuPIC model per node-metric
+stream and steps each in Python (SURVEY.md C19, §3.3). On TPU that shape is
+wrong — thousands of tiny independent programs waste the chip. Here streams
+are packed into fixed-capacity groups; all streams of a group share ONE
+jitted vmapped step (ops/step.group_step), so a tick costs one device
+dispatch per group and XLA compiles once per (config, group size).
+
+`backend="cpu"` keeps the reference's default behavior (per-stream numpy
+oracle models, no device) with the same API, preserving the plugin boundary:
+CPU default, TPU opt-in per group (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+from rtap_tpu.service.likelihood_batch import BatchAnomalyLikelihood
+
+
+@dataclass
+class TickResult:
+    """Scores for one tick of one group, index-aligned with group.stream_ids."""
+
+    raw: np.ndarray  # [G] f32
+    likelihood: np.ndarray  # [G] f64
+    log_likelihood: np.ndarray  # [G] f64
+    alerts: np.ndarray  # [G] bool
+
+
+class StreamGroup:
+    """G lockstep streams sharing one compiled device step (or one oracle loop)."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        stream_ids: list[str],
+        seed: int = 0,
+        backend: str = "tpu",
+        threshold: float = 0.5,
+    ):
+        self.cfg = cfg
+        self.stream_ids = list(stream_ids)
+        self.G = len(self.stream_ids)
+        self.backend = backend
+        self.threshold = threshold
+        self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
+        self.ticks = 0
+        if backend == "tpu":
+            import jax
+
+            from rtap_tpu.models.state import init_state
+            from rtap_tpu.ops.step import replicate_state
+
+            self.state = jax.device_put(replicate_state(init_state(cfg, seed), self.G))
+        else:
+            from rtap_tpu.models.oracle.temporal_memory import TMOracle
+            from rtap_tpu.models.state import init_state
+
+            self._states = [init_state(cfg, seed) for _ in range(self.G)]
+            self._tms = [TMOracle(s, cfg.tm) for s in self._states]
+
+    def _raw_cpu(self, values: np.ndarray, ts: np.ndarray) -> np.ndarray:
+        from rtap_tpu.models.htm_model import oracle_record_step
+
+        raw = np.empty(self.G, np.float32)
+        for g in range(self.G):
+            raw[g] = oracle_record_step(
+                self.cfg, self._states[g], self._tms[g], values[g], int(ts[g])
+            )
+        return raw
+
+    def tick(self, values: np.ndarray, ts: np.ndarray | int) -> TickResult:
+        """Score one tick. `values` [G] or [G, n_fields]; `ts` scalar or [G]."""
+        values = np.asarray(values, np.float32)
+        if values.ndim == 1:
+            values = values[:, None]
+        ts = np.broadcast_to(np.asarray(ts, np.int32), (self.G,))
+        if self.backend == "tpu":
+            import jax.numpy as jnp
+
+            from rtap_tpu.ops.step import group_step
+
+            self.state, raw = group_step(self.state, jnp.asarray(values), jnp.asarray(ts), self.cfg)
+            raw = np.asarray(raw)
+        else:
+            raw = self._raw_cpu(values, ts)
+        self.ticks += 1
+        lik, loglik = self.likelihood.update(raw)
+        return TickResult(raw, lik, loglik, loglik >= self.threshold)
+
+    def run_chunk(self, values: np.ndarray, ts: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Replay T ticks in one device dispatch (TPU backend only).
+
+        `values` [T, G] or [T, G, n_fields], `ts` [T, G] ->
+        (raw [T, G], log_likelihood [T, G], alerts [T, G]).
+        """
+        values = np.asarray(values, np.float32)
+        if values.ndim == 2:
+            values = values[..., None]
+        T = values.shape[0]
+        if self.backend == "tpu":
+            import jax.numpy as jnp
+
+            from rtap_tpu.ops.step import chunk_step
+
+            self.state, raw = chunk_step(
+                self.state, jnp.asarray(values), jnp.asarray(ts, jnp.int32), self.cfg
+            )
+            raw = np.asarray(raw)
+        else:
+            raw = np.stack([self._raw_cpu(values[i], np.asarray(ts[i])) for i in range(T)])
+        self.ticks += T
+        loglik = np.empty((T, self.G))
+        for i in range(T):
+            _, loglik[i] = self.likelihood.update(raw[i])
+        return raw, loglik, loglik >= self.threshold
+
+
+@dataclass
+class _Slot:
+    group: StreamGroup
+    index: int
+
+
+class StreamGroupRegistry:
+    """Lazy stream_id -> (group, slot) assignment, the C19 analog.
+
+    Streams are assigned to the open group until it reaches `group_size`,
+    then a new group opens. All groups share one ModelConfig so XLA compiles
+    the step once per group size (sizes are padded to `group_size` at
+    creation; short groups waste slots, not compilations).
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        group_size: int = 1024,
+        backend: str = "tpu",
+        seed: int = 0,
+        threshold: float = 0.5,
+    ):
+        self.cfg = cfg
+        self.group_size = int(group_size)
+        self.backend = backend
+        self.seed = seed
+        self.threshold = threshold
+        self.groups: list[StreamGroup] = []
+        self._slots: dict[str, _Slot] = {}
+        self._pending: list[str] = []
+
+    def add_stream(self, stream_id: str) -> None:
+        if stream_id in self._slots or stream_id in self._pending:
+            raise KeyError(f"duplicate stream id {stream_id!r}")
+        self._pending.append(stream_id)
+        if len(self._pending) == self.group_size:
+            self._seal()
+
+    def _seal(self) -> None:
+        if not self._pending:
+            return
+        ids = self._pending
+        # pad to the fixed group size so every group compiles to one program
+        padded = ids + [f"__pad{i}" for i in range(self.group_size - len(ids))]
+        grp = StreamGroup(
+            self.cfg, padded, seed=self.seed + len(self.groups),
+            backend=self.backend, threshold=self.threshold,
+        )
+        grp.n_live = len(ids)
+        for i, sid in enumerate(ids):
+            self._slots[sid] = _Slot(grp, i)
+        self.groups.append(grp)
+        self._pending = []
+
+    def finalize(self) -> None:
+        """Seal the last partially-filled group (call once ingestion is known)."""
+        self._seal()
+
+    def lookup(self, stream_id: str) -> tuple[StreamGroup, int]:
+        s = self._slots[stream_id]
+        return s.group, s.index
+
+    @property
+    def n_streams(self) -> int:
+        return len(self._slots) + len(self._pending)
