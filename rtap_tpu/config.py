@@ -130,10 +130,13 @@ class TMConfig:
     # Static-shape capacities for the device kernel's compact learning pass
     # (SURVEY.md §7 hard part 1): at most `learn_cap` segments learn per step
     # (>= active columns; predicted columns can contribute several) and at most
-    # `winner_cap` winner cells existed at t-1. Overflow is counted in
-    # state["tm_overflow"]; tests assert it stays zero at these sizes.
+    # `winner_cap` winner cells existed at t-1. `active_cap` bounds the active
+    # -cell id list the kernel's membership tests compare against (>= k winner
+    # columns x cells_per_column, the bursting worst case). Overflow is counted
+    # in state["tm_overflow"]; tests assert it stays zero at these sizes.
     learn_cap: int = 128
     winner_cap: int = 192
+    active_cap: int = 512
 
 
 @dataclass(frozen=True)
@@ -227,7 +230,7 @@ def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
         date=DateConfig(time_of_day_width=21, time_of_day_size=54, weekend_width=0),
         sp=SPConfig(columns=2048, num_active_columns=40),
         tm=TMConfig(cells_per_column=32, max_segments_per_cell=16,
-                    max_synapses_per_segment=32),
+                    max_synapses_per_segment=32, active_cap=1280),
         likelihood=LikelihoodConfig(mode="window"),
     )
 
@@ -247,7 +250,8 @@ def cluster_preset() -> ModelConfig:
                     syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002),
         tm=TMConfig(cells_per_column=8, activation_threshold=7, min_threshold=5,
                     max_segments_per_cell=4, max_synapses_per_segment=12,
-                    new_synapse_count=8, learn_cap=32, winner_cap=48),
+                    new_synapse_count=8, learn_cap=32, winner_cap=48,
+                    active_cap=80),
         likelihood=LikelihoodConfig(mode="streaming", historic_window_size=512,
                                     learning_period=100, estimation_samples=50),
     )

@@ -44,7 +44,7 @@ def sp_inhibit(overlap: jnp.ndarray, boost: jnp.ndarray, cfg: SPConfig) -> jnp.n
     else:
         score = overlap * C + col_rev
     _, winners = jax.lax.top_k(score, cfg.num_active_columns)
-    active = jnp.zeros(C, bool).at[winners].set(True)
+    active = jnp.zeros(C, bool).at[winners].set(True, unique_indices=True)
     return active & (overlap >= cfg.stimulus_threshold)
 
 

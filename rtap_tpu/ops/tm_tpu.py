@@ -36,6 +36,64 @@ from rtap_tpu.config import TMConfig
 INF = jnp.float32(jnp.inf)
 
 
+# Strategy switch for ops whose natural formulation (gather / nonzero)
+# serializes on the TPU scalar core: None = per-backend default (TPU-friendly
+# reformulations on TPU, plain gather/nonzero elsewhere); tests flip it to
+# cover both code paths on the CPU platform. Both paths are bit-identical.
+FORCE_TPU_PATHS: bool | None = None
+
+# Above this many [R, L] match elements (16M f32 = 64 MiB per stream) the
+# one-hot write-back matmul costs more memory than it saves time; use the
+# plain scatter instead (see the write-back branch in tm_step).
+_MATCH_WRITEBACK_MAX = 16 * 1024 * 1024
+
+
+def _tpu_paths() -> bool:
+    if FORCE_TPU_PATHS is not None:
+        return FORCE_TPU_PATHS
+    return jax.default_backend() == "tpu"
+
+
+def _compact_ids(mask: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Indices of the first `size` True entries of `mask` [n], ascending,
+    filled with n -> i32 [size].
+
+    Equivalent to jnp.nonzero(mask, size=size, fill_value=n)[0], but on TPU
+    nonzero's cumsum+pack runs on the scalar core (~16 ms/tick across the four
+    call sites at G=128 — profiled); top_k of (n - index) is the vector-unit
+    formulation: descending top_k of distinct values = ascending indices.
+    """
+    n = mask.shape[0]
+    if not _tpu_paths():
+        return jnp.nonzero(mask, size=size, fill_value=n)[0].astype(jnp.int32)
+    k = min(size, n)  # top_k rejects k > n; a cap larger than the domain
+    iota = jnp.arange(n, dtype=jnp.int32)
+    top = jax.lax.top_k(jnp.where(mask, n - iota, 0), k)[0]
+    ids = jnp.where(top > 0, n - top, n).astype(jnp.int32)
+    if k < size:
+        ids = jnp.concatenate([ids, jnp.full(size - k, n, jnp.int32)])
+    return ids
+
+
+def _presyn_active(presyn: jnp.ndarray, flat: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Is each synapse's presynaptic cell active? -> bool, presyn's shape.
+
+    `presyn` [..., M] i32 (-1 = empty); `flat` bool [N] dense activity;
+    `ids` [A] i32 the same activity as a compact ascending id list (fill N).
+
+    Two bit-identical implementations: on TPU, compare-any membership against
+    `ids` — XLA lowers `flat[presyn]` gathers to a serialized scalar-core loop
+    (~135 ms/tick at G=128, C=256 — profiled; it was the framework
+    bottleneck), while eq+any is pure VPU work. On CPU the gather is the fast
+    path (membership costs M*A compares per synapse). Empty slots (-1) and id
+    fills (N) never match / are masked.
+    """
+    if _tpu_paths():
+        return (presyn[..., None] == ids).any(-1)
+    N = flat.shape[0]
+    return (presyn >= 0) & flat[jnp.clip(presyn, 0, N - 1)]
+
+
 def _segment_learning_mask(
     cfg: TMConfig,
     active_cols: jnp.ndarray,  # bool [C]
@@ -76,7 +134,10 @@ def _segment_learning_mask(
     # (c) burst-new: cell with fewest segments; first free slot else LRU slot
     seg_counts = (seg_last >= 0).sum(-1)  # [C, K]
     bn_k = jnp.argmin(seg_counts, axis=-1)  # first min — matches oracle
-    row_last = seg_last[jnp.arange(C), bn_k]  # [C, S]
+    # one-hot select of row bn_k (a [C] gather serializes on TPU); exactly one
+    # k matches per column, so the sum passes values (incl. -1) through.
+    sel_k = jnp.arange(K, dtype=jnp.int32)[None, :] == bn_k[:, None]  # [C, K]
+    row_last = jnp.where(sel_k[:, :, None], seg_last, 0).sum(1)  # [C, S]
     any_free = (row_last < 0).any(-1)
     first_free = jnp.argmax(row_last < 0, axis=-1)
     lru = jnp.argmin(row_last, axis=-1)
@@ -186,22 +247,29 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         | winner_extra
     )
 
+    A = cfg.active_cap
+    prev_ids = _compact_ids(prev_active_flat, A)
+
     if learn:
         alloc_col, bn_k, bn_s = alloc
 
         # --- burst-new allocation: clear slot (evict if LRU) + stamp ---
-        presyn = presyn.at[alloc_col, bn_k, bn_s].set(-1, mode="drop")
-        syn_perm = syn_perm.at[alloc_col, bn_k, bn_s].set(0.0, mode="drop")
-        seg_pot0 = state["seg_pot"].at[alloc_col, bn_k, bn_s].set(0, mode="drop")
-        seg_last = seg_last.at[alloc_col, bn_k, bn_s].set(it, mode="drop")
-        alloc_mask = (
-            jnp.zeros((C, K, S), bool).at[alloc_col, bn_k, bn_s].set(True, mode="drop")
-        )
+        # Dense one-hot writes, not scatters: XLA's TPU scatter on the [C,K,S,M]
+        # pools serializes and drags transposed-layout copies along (~23 ms/tick
+        # each at G=1024 — profiled).
+        burst_new = alloc_col < C  # [C]
+        sel_k_a = jnp.arange(K, dtype=bn_k.dtype)[None, :] == bn_k[:, None]  # [C, K]
+        sel_s_a = jnp.arange(S, dtype=bn_s.dtype)[None, :] == bn_s[:, None]  # [C, S]
+        alloc_mask = burst_new[:, None, None] & sel_k_a[:, :, None] & sel_s_a[:, None, :]
+        presyn = jnp.where(alloc_mask[..., None], -1, presyn)
+        syn_perm = jnp.where(alloc_mask[..., None], jnp.float32(0), syn_perm)
+        seg_pot0 = jnp.where(alloc_mask, 0, state["seg_pot"])
+        seg_last = jnp.where(alloc_mask, it, seg_last)
         lm = learn_mask | alloc_mask
-        overflow = (lm.sum() > L) | (n_winners > W)
+        overflow = (lm.sum() > L) | (n_winners > W) | (prev_active_flat.sum() > A)
 
         # --- compact gather of learning segments ---
-        idx = jnp.nonzero(lm.reshape(-1), size=L, fill_value=C * K * S)[0]
+        idx = _compact_ids(lm.reshape(-1), L)
         valid_l = idx < C * K * S
         safe = jnp.clip(idx, 0, C * K * S - 1)
         presyn_l = presyn.reshape(-1, M)[safe]
@@ -210,7 +278,7 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
 
         # reinforce: +inc on synapses to prev-active cells, -dec on the rest
         exists = presyn_l >= 0
-        act = exists & prev_active_flat[jnp.clip(presyn_l, 0, N - 1)]
+        act = _presyn_active(presyn_l, prev_active_flat, prev_ids)
         perm_l = jnp.clip(
             perm_l
             + cfg.permanence_increment * act
@@ -220,22 +288,48 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         )
 
         # grow toward previous winner cells (ascending id)
-        winner_ids = jnp.nonzero(prev_winner_flat, size=W, fill_value=N)[0].astype(jnp.int32)
+        winner_ids = _compact_ids(prev_winner_flat, W)
         n_grow = (cfg.new_synapse_count - pot_l).astype(jnp.int32)
         grown_presyn, grown_perm = _grow_compact(cfg, presyn_l, perm_l, n_grow, winner_ids, N)
         grow_ok = have_winners & valid_l
         presyn_l = jnp.where(grow_ok[:, None], grown_presyn, presyn_l)
         perm_l = jnp.where(grow_ok[:, None], grown_perm, perm_l)
 
-        # scatter back (invalid rows dropped via OOB index)
-        presyn = presyn.reshape(-1, M).at[idx].set(presyn_l, mode="drop").reshape(C, K, S, M)
-        syn_perm = syn_perm.reshape(-1, M).at[idx].set(perm_l, mode="drop").reshape(C, K, S, M)
-        seg_last = seg_last.reshape(-1).at[idx].set(it, mode="drop").reshape(C, K, S)
+        if not _tpu_paths() or (C * K * S) * L > _MATCH_WRITEBACK_MAX:
+            # Plain row scatter. On CPU it is the fast path. On TPU it
+            # serializes per update row, but at large-model sizes (NAB preset:
+            # R = 1M, L = 128) the scatter is only ~L rows while the match
+            # matrix below would be R*L f32 = 512 MiB per stream — the scatter
+            # wins. idx is ascending with OOB fills; applied rows are unique.
+            hint = dict(mode="drop", unique_indices=True, indices_are_sorted=True)
+            presyn = presyn.reshape(-1, M).at[idx].set(presyn_l, **hint).reshape(C, K, S, M)
+            syn_perm = syn_perm.reshape(-1, M).at[idx].set(perm_l, **hint).reshape(C, K, S, M)
+            seg_last = seg_last.reshape(-1).at[idx].set(it, **hint).reshape(C, K, S)
+        else:
+            # Write-back as a one-hot matmul (MXU): XLA's TPU scatter
+            # serializes per update (~170 ms/tick at stream-group sizes) and
+            # row gathers / select-reduces drag transposed-layout pool copies
+            # along (~60 ms each — profiled). idx is unique, so inverting the
+            # scatter is an [R, L] equality match; each output row has at most
+            # one 1.0, so values pass through exactly (1.0*x accumulated with
+            # 0.0s in f32; presyn ids < 2^24).
+            rows = jnp.arange(C * K * S, dtype=idx.dtype)
+            match = rows[:, None] == idx[None, :]  # [R, L]
+            hit = match.any(-1)
+            match_f = match.astype(jnp.float32)
+            scat_presyn = jnp.round(
+                jax.lax.dot(match_f, presyn_l.astype(jnp.float32),
+                            precision=jax.lax.Precision.HIGHEST)
+            ).astype(jnp.int32)
+            scat_perm = jax.lax.dot(match_f, perm_l, precision=jax.lax.Precision.HIGHEST)
+            presyn = jnp.where(hit[:, None], scat_presyn, presyn.reshape(-1, M)).reshape(C, K, S, M)
+            syn_perm = jnp.where(hit[:, None], scat_perm, syn_perm.reshape(-1, M)).reshape(C, K, S, M)
+            seg_last = jnp.where(hit, it, seg_last.reshape(-1)).reshape(C, K, S)
 
         # --- punish matching segments in columns that did not activate ---
         if cfg.predicted_segment_decrement > 0.0:
             pmask = state["matching_seg"] & ~active_cols[:, None, None]
-            pact = (presyn >= 0) & prev_active_flat[jnp.clip(presyn, 0, N - 1)]
+            pact = _presyn_active(presyn, prev_active_flat, prev_ids)
             syn_perm = jnp.where(
                 pmask[..., None] & pact,
                 jnp.maximum(syn_perm - cfg.predicted_segment_decrement, 0.0),
@@ -248,13 +342,19 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         nsyn = (presyn >= 0).sum(-1)
         seg_last = jnp.where((seg_last >= 0) & (nsyn == 0), -1, seg_last)
 
-        tm_overflow = state["tm_overflow"] + overflow.astype(jnp.int32)
+        overflow_learn = overflow
     else:
-        tm_overflow = state["tm_overflow"]
+        overflow_learn = jnp.bool_(False)
 
     # --- dendrite activity for t+1 over existing segments ---
     exists_seg = seg_last >= 0
-    syn_act = (presyn >= 0) & active_cells.reshape(-1)[jnp.clip(presyn, 0, N - 1)]
+    active_flat = active_cells.reshape(-1)
+    act_ids = _compact_ids(active_flat, A)
+    # the act_ids truncation applies under inference too — count it always
+    tm_overflow = state["tm_overflow"] + (
+        overflow_learn | (active_flat.sum() > A)
+    ).astype(jnp.int32)
+    syn_act = _presyn_active(presyn, active_flat, act_ids)
     conn_count = (syn_act & (syn_perm >= cfg.connected_permanence)).sum(-1)
     pot_count = syn_act.sum(-1)
     active_seg = exists_seg & (conn_count >= cfg.activation_threshold)
