@@ -1,0 +1,81 @@
+"""Headline benchmark: anomaly-scored metrics/sec on one chip.
+
+Measures the full per-record pipeline at steady state — fused device step
+(encode -> SP -> TM -> raw score, chunked scan dispatches) plus the host-side
+batched anomaly likelihood — over a synthetic cluster workload on the
+cluster preset (BASELINE.md config 3/5 shape). Baseline is the north-star
+target of 100k concurrent 1s-cadence streams scored on a single chip
+(BASELINE.json), so vs_baseline = value / 100_000.
+
+Prints exactly ONE JSON line on stdout; progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_bench(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> float:
+    import jax
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.service.registry import StreamGroup
+
+    cfg = cluster_preset()
+    ids = [f"bench{i:06d}" for i in range(group_size)]
+    grp = StreamGroup(cfg, ids, backend="tpu")
+
+    rng = np.random.Generator(np.random.Philox(key=(2026, 7)))
+    t_idx = np.arange(chunk_ticks)[:, None]
+    base = 35.0 + 20.0 * np.sin(2 * np.pi * (t_idx + rng.integers(0, 86400, group_size)[None, :]) / 86400.0)
+    vals = (base + rng.normal(0, 3.0, (chunk_ticks, group_size))).astype(np.float32)
+    ts = (1_700_000_000 + t_idx + np.zeros((1, group_size))).astype(np.int64)
+
+    # warmup: compile + one chunk of real stepping
+    t0 = time.perf_counter()
+    grp.run_chunk(vals, ts)
+    log(f"warmup (compile + first chunk): {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    for i in range(measure_chunks):
+        grp.run_chunk(vals, ts + (i + 1) * chunk_ticks)
+    dt = time.perf_counter() - t0
+    scored = measure_chunks * chunk_ticks * group_size
+    return scored / dt
+
+
+def main() -> None:
+    target = 100_000.0  # metrics/sec/chip north star (BASELINE.json)
+    attempts = [(2048, 64), (1024, 64), (256, 32), (64, 16)]
+    value = None
+    for group_size, chunk_ticks in attempts:
+        try:
+            log(f"bench attempt: G={group_size}, T={chunk_ticks}")
+            value = run_bench(group_size, chunk_ticks)
+            break
+        except Exception as e:  # OOM / compile failure on small hosts: retry smaller
+            log(f"G={group_size} failed: {type(e).__name__}: {str(e)[:200]}")
+    if value is None:
+        raise SystemExit("all bench configurations failed")
+    print(
+        json.dumps(
+            {
+                "metric": "anomaly_scored_metrics_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "metrics/s",
+                "vs_baseline": round(value / target, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ All three are bit-identical to the CPU oracle per step
 
 from __future__ import annotations
 
+import functools as _functools
 from functools import partial
 
 import jax
@@ -63,6 +64,18 @@ def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     return jax.vmap(lambda s, v, t: step_impl(s, v, t, cfg, learn))(state, values, ts_unix)
 
 
+def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
+    """Shared hot-loop body: scan the vmapped fused step over the time axis.
+    Used identically by the single-device and shard_map entry points, so the
+    two can never diverge semantically."""
+
+    def body(s, inp):
+        v, t = inp
+        return jax.vmap(lambda ss, vv, tt: step_impl(ss, vv, tt, cfg, learn))(s, v, t)
+
+    return jax.lax.scan(body, state, (values, ts_unix))
+
+
 @partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
 def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
     """Multi-tick stream-group step: scan :func:`group_step`'s body over a
@@ -74,12 +87,43 @@ def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     replaying faster than real time); the live 1s-cadence service uses
     :func:`group_step` per tick instead.
     """
+    return _scan_chunk(state, values, ts_unix, cfg, learn)
 
-    def body(s, inp):
-        v, t = inp
-        return jax.vmap(lambda ss, vv, tt: step_impl(ss, vv, tt, cfg, learn))(s, v, t)
 
-    return jax.lax.scan(body, state, (values, ts_unix))
+@_functools.lru_cache(maxsize=None)
+def _sharded_chunk_fn(cfg: ModelConfig, mesh, learn: bool, state_ranks: tuple):
+    """Build (and cache) the jitted shard_map program for one (config, mesh)."""
+    from jax.sharding import PartitionSpec as P
+
+    state_specs = {k: P("streams", *([None] * (r - 1))) for k, r in state_ranks}
+
+    @partial(jax.jit, donate_argnums=(0,))
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(state_specs, P(None, "streams", None), P(None, "streams")),
+        out_specs=(state_specs, P(None, "streams")),
+    )
+    def run(state, values, ts_unix):
+        return _scan_chunk(state, values, ts_unix, cfg, learn)
+
+    return run
+
+
+def sharded_chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray,
+                       cfg: ModelConfig, mesh, learn: bool = True):
+    """:func:`chunk_step` under explicit SPMD (`jax.shard_map`) over the
+    1-D ("streams",) mesh.
+
+    Streams are independent, so each device steps its own shard with zero
+    collectives — guaranteed by construction here, whereas plain jit +
+    sharded inputs lets the partitioner all-gather around ops it won't
+    partition (observed: the [G, C] TopK in SP inhibition gets its batch
+    gathered to every chip). tests/scale/test_sharded.py pins the compiled
+    program collective-free.
+    """
+    state_ranks = tuple(sorted((k, max(np.ndim(v), 1)) for k, v in state.items()))
+    return _sharded_chunk_fn(cfg, mesh, learn, state_ranks)(state, values, ts_unix)
 
 
 def replicate_state(state: dict, group_size: int) -> dict:

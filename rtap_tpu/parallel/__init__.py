@@ -1,0 +1,19 @@
+"""Cross-chip parallelism: stream sharding over the device mesh.
+
+The reference's only parallelism is share-nothing per-stream processes
+(SURVEY.md §2.3); the TPU-native analog is data parallelism over a 1-D
+`("streams",)` mesh — streams never communicate, so the hot loop is
+collective-free by design and scales linearly over ICI. TP/PP/EP/CP and
+sequence parallelism are deliberately absent: HTM is a recurrent
+O(1)-state-per-step algorithm with no attention and no sequence-length
+scaling problem (SURVEY.md §5 "Long-context").
+"""
+
+from rtap_tpu.parallel.sharding import (
+    init_distributed,
+    make_stream_mesh,
+    shard_state,
+    stream_sharding,
+)
+
+__all__ = ["init_distributed", "make_stream_mesh", "shard_state", "stream_sharding"]

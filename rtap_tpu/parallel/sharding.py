@@ -1,0 +1,77 @@
+"""Stream-axis sharding utilities (SURVEY.md §2.4).
+
+A stream group's state pytree carries the group axis G as the leading
+dimension of every leaf; sharding that axis over a 1-D `("streams",)` mesh
+splits the group across chips with zero collectives in the hot loop (each
+chip steps its own stream shard; XLA inserts no cross-chip communication
+because no op mixes streams). Host code gathers only the [G] raw-score
+vector per tick.
+
+Multi-host (DCN) replay uses `init_distributed()` (a thin
+`jax.distributed.initialize` wrapper) before mesh construction, after which
+`jax.devices()` spans all hosts and the same sharding code applies.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def init_distributed(coordinator: str | None = None, num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Initialize multi-host JAX (DCN) when launched as one process per host.
+
+    No-op when running single-process (the common case and every test); args
+    default to the JAX_* / cloud-TPU environment autodetection.
+    """
+    import jax
+
+    if num_processes in (None, 1) and coordinator is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_stream_mesh(n_devices: int | None = None):
+    """1-D device mesh over the stream axis: Mesh([d0..dn], ("streams",))."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(f"need {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), axis_names=("streams",))
+
+
+def stream_sharding(mesh, ndim: int, axis: int = 0):
+    """NamedSharding that splits the stream axis (default: leading) over the
+    mesh and replicates every other axis."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = [None] * ndim
+    spec[axis] = "streams"
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def shard_state(state: dict, mesh) -> dict:
+    """device_put every leaf of a group state pytree with its leading axis
+    sharded over the mesh. Group size must be divisible by the mesh size
+    (the registry pads groups to a fixed size, so pick group_size as a
+    multiple of the chip count)."""
+    import jax
+
+    n = mesh.devices.size
+    for k, v in state.items():
+        if np.shape(v) and np.shape(v)[0] % n:
+            raise ValueError(
+                f"state leaf {k!r} group axis {np.shape(v)[0]} not divisible by mesh size {n}"
+            )
+    return {
+        k: jax.device_put(v, stream_sharding(mesh, max(np.ndim(v), 1)))
+        for k, v in state.items()
+    }

@@ -1,0 +1,73 @@
+"""Checkpoint / resume for stream groups (SURVEY.md §5 "Checkpoint/resume").
+
+The reference saves model state via NuPIC's Cap'n Proto serialization
+(`model.save()` / `ModelFactory.loadFromCheckpoint`), and the anomaly
+-likelihood history must ride along or likelihoods reset. Here a checkpoint
+is the group's full resume state: the device state pytree (fetched to host),
+the batched-likelihood state, stream ids, tick count, and the model config —
+written atomically per group with orbax. A resumed group continues
+bit-identically to an uninterrupted run (tests/unit/test_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+from rtap_tpu.service.registry import StreamGroup
+
+
+def save_group(grp: StreamGroup, path: str | Path) -> None:
+    """Write one group's resume state to `path` (a directory, per group)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    if grp.backend == "tpu":
+        model_state = {k: np.asarray(v) for k, v in jax.device_get(grp.state).items()}
+        tree = {"model": model_state}
+    else:
+        tree = {"model": {f"s{g}": grp._states[g] for g in range(grp.G)}}
+    tree["likelihood"] = grp.likelihood.state_dict()
+
+    meta = {
+        "backend": grp.backend,
+        "stream_ids": grp.stream_ids,
+        "ticks": grp.ticks,
+        "threshold": grp.threshold,
+        "n_live": getattr(grp, "n_live", grp.G),
+        "config": grp.cfg.to_dict(),
+    }
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path / "state", tree, force=True)
+    # meta written AFTER the tree: its presence marks the checkpoint complete
+    (path / "meta.json").write_text(json.dumps(meta))
+
+
+def load_group(path: str | Path) -> StreamGroup:
+    """Rebuild a StreamGroup from `path`; scoring continues bit-identically."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    meta = json.loads((path / "meta.json").read_text())
+    cfg = ModelConfig.from_dict(meta["config"])
+    grp = StreamGroup(
+        cfg, meta["stream_ids"], backend=meta["backend"], threshold=meta["threshold"]
+    )
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(path / "state")
+    if grp.backend == "tpu":
+        grp.state = jax.device_put(tree["model"])
+    else:
+        for g in range(grp.G):
+            saved = tree["model"][f"s{g}"]
+            for k in grp._states[g]:
+                grp._states[g][k] = np.asarray(saved[k])
+    grp.likelihood.load_state_dict(tree["likelihood"])
+    grp.ticks = int(meta["ticks"])
+    grp.n_live = int(meta["n_live"])
+    return grp

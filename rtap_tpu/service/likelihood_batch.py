@@ -69,11 +69,13 @@ class BatchAnomalyLikelihood:
     # ---- checkpointing ----
     def state_dict(self) -> dict[str, np.ndarray]:
         d = {
-            "records": np.int64(self.records),
+            # 0-d arrays, not numpy scalars: orbax has no TypeHandler for the
+            # scalar types (np.bool_/np.int64)
+            "records": np.asarray(self.records, np.int64),
             "recent": self.recent,
             "mean": self.mean,
             "std": self.std,
-            "have_distribution": np.bool_(self.have_distribution),
+            "have_distribution": np.asarray(self.have_distribution),
         }
         if self.scores is not None:
             d["scores"] = self.scores

@@ -42,12 +42,14 @@ class StreamGroup:
         seed: int = 0,
         backend: str = "tpu",
         threshold: float = 0.5,
+        mesh=None,
     ):
         self.cfg = cfg
         self.stream_ids = list(stream_ids)
         self.G = len(self.stream_ids)
         self.backend = backend
         self.threshold = threshold
+        self.mesh = mesh
         self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
         self.ticks = 0
         if backend == "tpu":
@@ -56,7 +58,13 @@ class StreamGroup:
             from rtap_tpu.models.state import init_state
             from rtap_tpu.ops.step import replicate_state
 
-            self.state = jax.device_put(replicate_state(init_state(cfg, seed), self.G))
+            host_state = replicate_state(init_state(cfg, seed), self.G)
+            if mesh is not None:
+                from rtap_tpu.parallel.sharding import shard_state
+
+                self.state = shard_state(host_state, mesh)
+            else:
+                self.state = jax.device_put(host_state)
         else:
             from rtap_tpu.models.oracle.temporal_memory import TMOracle
             from rtap_tpu.models.state import init_state
@@ -74,6 +82,20 @@ class StreamGroup:
             )
         return raw
 
+    def _put(self, x: np.ndarray, axis: int = 0):
+        """Host array -> device, sharded on the stream axis when meshed.
+
+        For chunked arrays [T, G, ...] the stream axis is 1; sharding is
+        expressed on that axis (the leading time axis is replicated)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return jnp.asarray(x)
+        from rtap_tpu.parallel.sharding import stream_sharding
+
+        return jax.device_put(np.asarray(x), stream_sharding(self.mesh, np.ndim(x), axis))
+
     def tick(self, values: np.ndarray, ts: np.ndarray | int) -> TickResult:
         """Score one tick. `values` [G] or [G, n_fields]; `ts` scalar or [G]."""
         values = np.asarray(values, np.float32)
@@ -81,12 +103,21 @@ class StreamGroup:
             values = values[:, None]
         ts = np.broadcast_to(np.asarray(ts, np.int32), (self.G,))
         if self.backend == "tpu":
-            import jax.numpy as jnp
+            if self.mesh is not None:
+                from rtap_tpu.ops.step import sharded_chunk_step
 
-            from rtap_tpu.ops.step import group_step
+                self.state, raw = sharded_chunk_step(
+                    self.state, self._put(values[None], axis=1),
+                    self._put(ts[None].astype(np.int32), axis=1), self.cfg, self.mesh,
+                )
+                raw = np.asarray(raw)[0]
+            else:
+                from rtap_tpu.ops.step import group_step
 
-            self.state, raw = group_step(self.state, jnp.asarray(values), jnp.asarray(ts), self.cfg)
-            raw = np.asarray(raw)
+                self.state, raw = group_step(
+                    self.state, self._put(values), self._put(ts.astype(np.int32)), self.cfg
+                )
+                raw = np.asarray(raw)
         else:
             raw = self._raw_cpu(values, ts)
         self.ticks += 1
@@ -104,13 +135,19 @@ class StreamGroup:
             values = values[..., None]
         T = values.shape[0]
         if self.backend == "tpu":
-            import jax.numpy as jnp
+            if self.mesh is not None:
+                from rtap_tpu.ops.step import sharded_chunk_step
 
-            from rtap_tpu.ops.step import chunk_step
+                self.state, raw = sharded_chunk_step(
+                    self.state, self._put(values, axis=1),
+                    self._put(ts.astype(np.int32), axis=1), self.cfg, self.mesh,
+                )
+            else:
+                from rtap_tpu.ops.step import chunk_step
 
-            self.state, raw = chunk_step(
-                self.state, jnp.asarray(values), jnp.asarray(ts, jnp.int32), self.cfg
-            )
+                self.state, raw = chunk_step(
+                    self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1), self.cfg
+                )
             raw = np.asarray(raw)
         else:
             raw = np.stack([self._raw_cpu(values[i], np.asarray(ts[i])) for i in range(T)])
@@ -143,12 +180,14 @@ class StreamGroupRegistry:
         backend: str = "tpu",
         seed: int = 0,
         threshold: float = 0.5,
+        mesh=None,
     ):
         self.cfg = cfg
         self.group_size = int(group_size)
         self.backend = backend
         self.seed = seed
         self.threshold = threshold
+        self.mesh = mesh
         self.groups: list[StreamGroup] = []
         self._slots: dict[str, _Slot] = {}
         self._pending: list[str] = []
@@ -168,7 +207,7 @@ class StreamGroupRegistry:
         padded = ids + [f"__pad{i}" for i in range(self.group_size - len(ids))]
         grp = StreamGroup(
             self.cfg, padded, seed=self.seed + len(self.groups),
-            backend=self.backend, threshold=self.threshold,
+            backend=self.backend, threshold=self.threshold, mesh=self.mesh,
         )
         grp.n_live = len(ids)
         for i, sid in enumerate(ids):

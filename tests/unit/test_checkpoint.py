@@ -1,0 +1,52 @@
+"""Checkpoint round-trip: save mid-stream, resume, outputs stay bit-identical
+to the uninterrupted run (SURVEY.md §4 item 3 — the serialization test
+pattern NuPIC uses for its Cap'n Proto save/resume)."""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import cluster_preset
+from rtap_tpu.service.checkpoint import load_group, save_group
+from rtap_tpu.service.registry import StreamGroup
+
+
+def _vals(n, g, seed):
+    rng = np.random.Generator(np.random.Philox(key=(seed, 11)))
+    v = (40 + 8 * rng.random((n, g))).astype(np.float32)
+    v[int(n * 0.7), :] += 50
+    return v
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu"])
+def test_group_checkpoint_roundtrip(backend, tmp_path):
+    cfg = cluster_preset()
+    ids = [f"s{i}" for i in range(3)]
+    n, cut = 160, 80
+    vals = _vals(n, 3, seed=1)
+
+    ref = StreamGroup(cfg, ids, backend=backend)
+    for i in range(cut):
+        ref.tick(vals[i], 1_700_000_000 + i)
+    save_group(ref, tmp_path / "grp0")
+
+    resumed = load_group(tmp_path / "grp0")
+    assert resumed.stream_ids == ids and resumed.ticks == cut
+    for i in range(cut, n):
+        r_ref = ref.tick(vals[i], 1_700_000_000 + i)
+        r_res = resumed.tick(vals[i], 1_700_000_000 + i)
+        np.testing.assert_array_equal(r_ref.raw, r_res.raw, err_msg=f"tick {i}")
+        np.testing.assert_array_equal(
+            r_ref.log_likelihood, r_res.log_likelihood, err_msg=f"tick {i}"
+        )
+        np.testing.assert_array_equal(r_ref.alerts, r_res.alerts)
+
+
+def test_checkpoint_preserves_config_and_threshold(tmp_path):
+    cfg = cluster_preset()
+    grp = StreamGroup(cfg, ["a", "b"], backend="cpu", threshold=0.37)
+    grp.tick(np.array([1.0, 2.0], np.float32), 1_700_000_000)
+    save_group(grp, tmp_path / "g")
+    back = load_group(tmp_path / "g")
+    assert back.threshold == 0.37
+    assert back.cfg == cfg
+    assert back.backend == "cpu"
