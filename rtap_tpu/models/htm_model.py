@@ -47,7 +47,7 @@ def oracle_record_step(
         # must not poison the stream's bucket arithmetic forever)
         state["enc_offset"] = np.where(bind, values, state["enc_offset"]).astype(np.float32)
         state["enc_bound"] = state["enc_bound"] | bind
-    sdr = encode_record(cfg, values, int(ts_unix), state["enc_offset"])
+    sdr = encode_record(cfg, values, int(ts_unix), state["enc_offset"], state["enc_resolution"])
     active = sp_compute(state, sdr, cfg.sp, learn)
     return tm.compute(active, learn)
 

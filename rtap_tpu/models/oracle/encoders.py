@@ -64,6 +64,7 @@ def encode_record(
     values: np.ndarray,
     ts_unix: int,
     enc_offset: np.ndarray,
+    enc_resolution: np.ndarray | None = None,
 ) -> np.ndarray:
     """Encode one record (n_fields scalars + timestamp) -> bool[input_size].
 
@@ -76,7 +77,8 @@ def encode_record(
     for f in range(cfg.n_fields):
         if not np.isfinite(values[f]):
             continue  # missing/garbled sample -> no bits for this field (NuPIC behavior)
-        b = int(rdse_bucket(values[f], float(enc_offset[f]), cfg.rdse.resolution))
+        res = cfg.rdse.resolution if enc_resolution is None else float(enc_resolution[f])
+        b = int(rdse_bucket(values[f], float(enc_offset[f]), res))
         sdr[f * cfg.rdse.size + rdse_bits(cfg.rdse, b, f)] = True
     base = cfg.n_fields * cfg.rdse.size
     if cfg.date.time_of_day_width:

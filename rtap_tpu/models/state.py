@@ -30,6 +30,9 @@ TM state (dense bounded pools; C cols x K cells x S segments x M synapses):
 Encoder state:
     enc_offset  f32 [n_fields]   RDSE offset, bound to first seen value
     enc_bound   bool []          whether offset has been bound
+    enc_resolution f32 [n_fields] RDSE resolution (runtime, so one compiled
+                                 program serves streams with different value
+                                 ranges, e.g. a batched NAB corpus run)
 """
 
 from __future__ import annotations
@@ -77,4 +80,5 @@ def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
         # encoder (offset binds per field at the first *finite* value seen)
         "enc_offset": np.zeros(cfg.n_fields, np.float32),
         "enc_bound": np.zeros(cfg.n_fields, bool),
+        "enc_resolution": np.full(cfg.n_fields, cfg.rdse.resolution, np.float32),
     }

@@ -26,6 +26,7 @@ def encode_device(
     values: jnp.ndarray,  # [F] f32
     ts_unix: jnp.ndarray,  # scalar i32
     enc_offset: jnp.ndarray,  # [F] f32
+    enc_resolution: jnp.ndarray,  # [F] f32 (runtime, per stream)
 ) -> jnp.ndarray:
     """Encode one record -> bool[input_size]. Layout matches the oracle:
     [field0 RDSE | field1 RDSE | ... | time-of-day ring | weekend]."""
@@ -35,7 +36,7 @@ def encode_device(
     finite = jnp.isfinite(values)
     v = jnp.where(finite, values, jnp.float32(0.0))
     bucket = jnp.clip(
-        jnp.round((v - enc_offset) / jnp.float32(cfg.rdse.resolution)),
+        jnp.round((v - enc_offset) / enc_resolution.astype(jnp.float32)),
         -RDSE_BUCKET_CLAMP,
         RDSE_BUCKET_CLAMP,
     ).astype(jnp.int32)

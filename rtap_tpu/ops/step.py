@@ -41,7 +41,7 @@ def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Model
     """
     enc_offset, enc_bound = bind_offsets(values, state["enc_offset"], state["enc_bound"])
     state = {**state, "enc_offset": enc_offset, "enc_bound": enc_bound}
-    sdr = encode_device(cfg, values, ts_unix, enc_offset)
+    sdr = encode_device(cfg, values, ts_unix, enc_offset, state["enc_resolution"])
     state, active = sp_step(state, sdr, cfg.sp, learn)
     state, raw = tm_step(state, active, cfg.tm, learn)
     return state, raw
