@@ -1,6 +1,6 @@
 """rtap_tpu — TPU-native real-time anomaly prediction for distributed systems.
 
-A ground-up JAX/XLA/Pallas rebuild of the capabilities of
+A ground-up JAX/XLA rebuild of the capabilities of
 `atambol/Real-time-anomaly-prediction-in-distributed-systems` (an HTM-based
 per-node-metric anomaly pipeline built on NuPIC — see SURVEY.md for the full
 reconstruction): RDSE encoding -> Spatial Pooler -> Temporal Memory -> raw
@@ -13,7 +13,7 @@ Layout:
     data        synthetic cluster generator, NAB-format corpus IO, stream sources
     nab         NAB scorer/sweeper/runner (public NAB scoring spec)
     models      CPU oracle (numpy, the semantic spec) + HTMModel/AnomalyDetector factory
-    ops         TPU kernels: SP, TM, fused step (JAX + Pallas)
+    ops         TPU kernels: SP, TM, fused step (XLA-compiled JAX)
     parallel    mesh/sharding over the ("streams",) axis, host<->device feed
     service     stream registry, alerting, checkpointing
 """

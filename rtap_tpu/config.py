@@ -136,7 +136,7 @@ class TMConfig:
     # in state["tm_overflow"]; tests assert it stays zero at these sizes.
     learn_cap: int = 128
     winner_cap: int = 192
-    active_cap: int = 512
+    active_cap: int = 1280  # >= num_active_columns * cells_per_column (validated in ModelConfig)
 
 
 @dataclass(frozen=True)
@@ -177,6 +177,18 @@ class ModelConfig:
     likelihood: LikelihoodConfig = field(default_factory=LikelihoodConfig)
     n_fields: int = 1  # multivariate: number of scalar fields fused into one SDR
 
+    def __post_init__(self) -> None:
+        # The bursting worst case activates num_active_columns * cells_per_column
+        # cells in one step; a smaller active_cap would silently truncate the
+        # compact active-cell list and corrupt dendrite counts (the tm_overflow
+        # counter is the only symptom). Fail loudly at construction instead.
+        worst = self.sp.num_active_columns * self.tm.cells_per_column
+        if self.tm.active_cap < worst:
+            raise ValueError(
+                f"TMConfig.active_cap={self.tm.active_cap} is below the bursting "
+                f"worst case num_active_columns*cells_per_column={worst}; raise it"
+            )
+
     @property
     def input_size(self) -> int:
         return self.rdse.size * self.n_fields + self.date.size
@@ -194,11 +206,27 @@ class ModelConfig:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ModelConfig":
+        sp = SPConfig(**d.get("sp", {}))
+        tm = TMConfig(**d.get("tm", {}))
+        # Migration for serialized configs predating the active_cap validation
+        # (old default 512 < the bursting worst case): clamp up with a warning
+        # rather than making the stored checkpoint unloadable. active_cap is a
+        # transient kernel-workspace bound, not part of the saved state shapes,
+        # so raising it on resume is semantics-preserving.
+        worst = sp.num_active_columns * tm.cells_per_column
+        if tm.active_cap < worst:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "stored TMConfig.active_cap=%d below bursting worst case %d; clamping up",
+                tm.active_cap, worst,
+            )
+            tm = dataclasses.replace(tm, active_cap=worst)
         return cls(
             rdse=RDSEConfig(**d.get("rdse", {})),
             date=DateConfig(**d.get("date", {})),
-            sp=SPConfig(**d.get("sp", {})),
-            tm=TMConfig(**d.get("tm", {})),
+            sp=sp,
+            tm=tm,
             likelihood=LikelihoodConfig(**d.get("likelihood", {})),
             n_fields=d.get("n_fields", 1),
         )

@@ -77,7 +77,10 @@ def encode_record(
     for f in range(cfg.n_fields):
         if not np.isfinite(values[f]):
             continue  # missing/garbled sample -> no bits for this field (NuPIC behavior)
-        res = cfg.rdse.resolution if enc_resolution is None else float(enc_resolution[f])
+        # Always round the resolution through f32: the state-carried array is
+        # f32, and the two entry points (explicit array vs config default)
+        # must agree on bucket assignment at boundaries.
+        res = float(np.float32(cfg.rdse.resolution)) if enc_resolution is None else float(enc_resolution[f])
         b = int(rdse_bucket(values[f], float(enc_offset[f]), res))
         sdr[f * cfg.rdse.size + rdse_bits(cfg.rdse, b, f)] = True
     base = cfg.n_fields * cfg.rdse.size

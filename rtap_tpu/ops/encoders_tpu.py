@@ -26,12 +26,17 @@ def encode_device(
     values: jnp.ndarray,  # [F] f32
     ts_unix: jnp.ndarray,  # scalar i32
     enc_offset: jnp.ndarray,  # [F] f32
-    enc_resolution: jnp.ndarray,  # [F] f32 (runtime, per stream)
+    enc_resolution: jnp.ndarray | None = None,  # [F] f32 (runtime, per stream)
 ) -> jnp.ndarray:
     """Encode one record -> bool[input_size]. Layout matches the oracle:
-    [field0 RDSE | field1 RDSE | ... | time-of-day ring | weekend]."""
+    [field0 RDSE | field1 RDSE | ... | time-of-day ring | weekend].
+
+    `enc_resolution` defaults to the config's static resolution (rounded
+    through f32, exactly like the state-carried per-stream array)."""
     F, R, w = cfg.n_fields, cfg.rdse.size, cfg.rdse.active_bits
     n_in = cfg.input_size
+    if enc_resolution is None:
+        enc_resolution = jnp.full(F, jnp.float32(cfg.rdse.resolution))
 
     finite = jnp.isfinite(values)
     v = jnp.where(finite, values, jnp.float32(0.0))

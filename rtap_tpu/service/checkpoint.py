@@ -12,6 +12,8 @@ bit-identically to an uninterrupted run (tests/unit/test_checkpoint.py).
 from __future__ import annotations
 
 import json
+import shutil
+import uuid
 from pathlib import Path
 
 import numpy as np
@@ -21,7 +23,13 @@ from rtap_tpu.service.registry import StreamGroup
 
 
 def save_group(grp: StreamGroup, path: str | Path) -> None:
-    """Write one group's resume state to `path` (a directory, per group)."""
+    """Write one group's resume state to `path` (a directory, per group).
+
+    Atomic on overwrite: the tree + meta are written to a fresh temp sibling
+    directory and swapped in with renames, so a crash mid-save can never leave
+    a directory that has meta.json (the completeness marker) but a partially
+    rewritten state tree.
+    """
     import jax
     import orbax.checkpoint as ocp
 
@@ -39,29 +47,74 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
         "ticks": grp.ticks,
         "threshold": grp.threshold,
         "n_live": getattr(grp, "n_live", grp.G),
+        "sharded": grp.mesh is not None,
         "config": grp.cfg.to_dict(),
     }
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path / "state", tree, force=True)
-    # meta written AFTER the tree: its presence marks the checkpoint complete
-    (path / "meta.json").write_text(json.dumps(meta))
+    # sweep residue from prior interrupted saves of this checkpoint
+    for stale in path.parent.glob(f".{path.name}.tmp-*"):
+        shutil.rmtree(stale, ignore_errors=True)
+    for stale in path.parent.glob(f".{path.name}.old-*"):
+        shutil.rmtree(stale, ignore_errors=True)
+    tmp = path.parent / f".{path.name}.tmp-{uuid.uuid4().hex[:8]}"
+    swapped = False
+    try:
+        tmp.mkdir(parents=True)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(tmp / "state", tree, force=True)
+        # meta written AFTER the tree: its presence marks the checkpoint complete
+        (tmp / "meta.json").write_text(json.dumps(meta))
+        if path.exists():
+            old = path.parent / f".{path.name}.old-{uuid.uuid4().hex[:8]}"
+            path.rename(old)
+            try:
+                tmp.rename(path)
+                swapped = True
+            except BaseException:
+                old.rename(path)  # roll the previous checkpoint back in place
+                raise
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            tmp.rename(path)
+            swapped = True
+    finally:
+        if not swapped:
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
-def load_group(path: str | Path) -> StreamGroup:
-    """Rebuild a StreamGroup from `path`; scoring continues bit-identically."""
+def load_group(path: str | Path, mesh=None) -> StreamGroup:
+    """Rebuild a StreamGroup from `path`; scoring continues bit-identically.
+
+    A group checkpointed while sharded over a mesh records that fact; pass
+    `mesh` to re-shard on resume. Resuming a sharded checkpoint without a mesh
+    downgrades to single-device and logs a warning (the state itself is
+    topology-independent — only placement changes).
+    """
     import jax
     import orbax.checkpoint as ocp
 
     path = Path(path).absolute()
     meta = json.loads((path / "meta.json").read_text())
     cfg = ModelConfig.from_dict(meta["config"])
+    if meta.get("sharded") and mesh is None:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "checkpoint %s was saved sharded over a mesh; resuming single-device "
+            "(pass mesh= to load_group to restore the sharded topology)", path
+        )
     grp = StreamGroup(
-        cfg, meta["stream_ids"], backend=meta["backend"], threshold=meta["threshold"]
+        cfg, meta["stream_ids"], backend=meta["backend"], threshold=meta["threshold"],
+        mesh=mesh,
     )
     with ocp.PyTreeCheckpointer() as ckptr:
         tree = ckptr.restore(path / "state")
     if grp.backend == "tpu":
-        grp.state = jax.device_put(tree["model"])
+        if mesh is not None:
+            from rtap_tpu.parallel.sharding import shard_state
+
+            grp.state = shard_state(tree["model"], mesh)
+        else:
+            grp.state = jax.device_put(tree["model"])
     else:
         for g in range(grp.G):
             saved = tree["model"][f"s{g}"]

@@ -47,7 +47,6 @@ def replay_streams(
     in one group) and each chunk of `chunk_ticks` ticks costs one device
     dispatch per group.
     """
-    del learn  # reserved: inference-only replay is a later optimization
     n = len(streams)
     T = len(streams[0].values)
     for s in streams:
@@ -84,7 +83,7 @@ def replay_streams(
 
         for t0 in range(0, T, chunk_ticks):
             t1 = min(t0 + chunk_ticks, T)
-            r, ll, al = grp.run_chunk(gv[t0:t1], gt[t0:t1])
+            r, ll, al = grp.run_chunk(gv[t0:t1], gt[t0:t1], learn=learn)
             raw[t0:t1, lo : lo + live] = r[:, :live]
             loglik[t0:t1, lo : lo + live] = ll[:, :live]
             alerts[t0:t1, lo : lo + live] = al[:, :live]
@@ -118,13 +117,14 @@ def live_loop(
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
     missed = 0
+    live = getattr(group, "n_live", group.G)  # never emit for registry pad slots
     for k in range(n_ticks):
         t_start = time.perf_counter()
         values, ts = source(k)
         res = group.tick(values, ts)
-        writer.emit_batch(group.stream_ids, np.full(group.G, ts), values, res.raw,
-                          res.log_likelihood, res.alerts)
-        counter.add(group.G)
+        writer.emit_batch(group.stream_ids[:live], np.full(live, ts), values[:live],
+                          res.raw[:live], res.log_likelihood[:live], res.alerts[:live])
+        counter.add(live)
         budget = cadence_s - (time.perf_counter() - t_start)
         if budget < 0:
             missed += 1

@@ -72,13 +72,13 @@ class StreamGroup:
             self._states = [init_state(cfg, seed) for _ in range(self.G)]
             self._tms = [TMOracle(s, cfg.tm) for s in self._states]
 
-    def _raw_cpu(self, values: np.ndarray, ts: np.ndarray) -> np.ndarray:
+    def _raw_cpu(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> np.ndarray:
         from rtap_tpu.models.htm_model import oracle_record_step
 
         raw = np.empty(self.G, np.float32)
         for g in range(self.G):
             raw[g] = oracle_record_step(
-                self.cfg, self._states[g], self._tms[g], values[g], int(ts[g])
+                self.cfg, self._states[g], self._tms[g], values[g], int(ts[g]), learn
             )
         return raw
 
@@ -96,7 +96,7 @@ class StreamGroup:
 
         return jax.device_put(np.asarray(x), stream_sharding(self.mesh, np.ndim(x), axis))
 
-    def tick(self, values: np.ndarray, ts: np.ndarray | int) -> TickResult:
+    def tick(self, values: np.ndarray, ts: np.ndarray | int, learn: bool = True) -> TickResult:
         """Score one tick. `values` [G] or [G, n_fields]; `ts` scalar or [G]."""
         values = np.asarray(values, np.float32)
         if values.ndim == 1:
@@ -109,22 +109,24 @@ class StreamGroup:
                 self.state, raw = sharded_chunk_step(
                     self.state, self._put(values[None], axis=1),
                     self._put(ts[None].astype(np.int32), axis=1), self.cfg, self.mesh,
+                    learn=learn,
                 )
                 raw = np.asarray(raw)[0]
             else:
                 from rtap_tpu.ops.step import group_step
 
                 self.state, raw = group_step(
-                    self.state, self._put(values), self._put(ts.astype(np.int32)), self.cfg
+                    self.state, self._put(values), self._put(ts.astype(np.int32)), self.cfg,
+                    learn=learn,
                 )
                 raw = np.asarray(raw)
         else:
-            raw = self._raw_cpu(values, ts)
+            raw = self._raw_cpu(values, ts, learn)
         self.ticks += 1
         lik, loglik = self.likelihood.update(raw)
         return TickResult(raw, lik, loglik, loglik >= self.threshold)
 
-    def run_chunk(self, values: np.ndarray, ts: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def run_chunk(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Replay T ticks in one device dispatch (TPU backend only).
 
         `values` [T, G] or [T, G, n_fields], `ts` [T, G] ->
@@ -141,16 +143,18 @@ class StreamGroup:
                 self.state, raw = sharded_chunk_step(
                     self.state, self._put(values, axis=1),
                     self._put(ts.astype(np.int32), axis=1), self.cfg, self.mesh,
+                    learn=learn,
                 )
             else:
                 from rtap_tpu.ops.step import chunk_step
 
                 self.state, raw = chunk_step(
-                    self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1), self.cfg
+                    self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1),
+                    self.cfg, learn=learn,
                 )
             raw = np.asarray(raw)
         else:
-            raw = np.stack([self._raw_cpu(values[i], np.asarray(ts[i])) for i in range(T)])
+            raw = np.stack([self._raw_cpu(values[i], np.asarray(ts[i]), learn) for i in range(T)])
         self.ticks += T
         loglik = np.empty((T, self.G))
         for i in range(T):

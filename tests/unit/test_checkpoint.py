@@ -50,3 +50,27 @@ def test_checkpoint_preserves_config_and_threshold(tmp_path):
     assert back.threshold == 0.37
     assert back.cfg == cfg
     assert back.backend == "cpu"
+
+
+def test_checkpoint_overwrite_atomic(tmp_path):
+    """Re-saving to an existing path swaps directories whole: the new state is
+    readable and no temp/old residue remains."""
+    cfg = cluster_preset()
+    grp = StreamGroup(cfg, ["a", "b"], backend="cpu")
+    grp.tick(np.array([1.0, 2.0], np.float32), 1_700_000_000)
+    save_group(grp, tmp_path / "g")
+    grp.tick(np.array([3.0, 4.0], np.float32), 1_700_000_001)
+    save_group(grp, tmp_path / "g")  # overwrite
+    back = load_group(tmp_path / "g")
+    assert back.ticks == 2
+    residue = [p.name for p in tmp_path.iterdir() if p.name != "g"]
+    assert residue == [], residue
+
+
+def test_config_validation_rejects_small_active_cap():
+    from rtap_tpu.config import ModelConfig, SPConfig, TMConfig
+
+    with pytest.raises(ValueError, match="active_cap"):
+        ModelConfig(sp=SPConfig(num_active_columns=40),
+                    tm=TMConfig(cells_per_column=32, active_cap=100))
+    ModelConfig()  # defaults must validate

@@ -134,3 +134,47 @@ def test_live_loop_paced():
     stats = live_loop(source, grp, n_ticks=10, cadence_s=0.02)
     assert stats["scored"] == 40 and stats["ticks"] == 10
     assert stats["missed_deadlines"] <= 3  # first tick compiles; allow jitter
+
+
+def test_learn_false_freezes_state():
+    """Inference-only stepping must not mutate learned state on either backend."""
+    import jax
+
+    cfg = cluster_preset()
+    ids = [f"s{i}" for i in range(3)]
+    rng = np.random.Generator(np.random.Philox(key=(9, 4)))
+    warm = (30 + 5 * rng.random((40, 3))).astype(np.float32)
+    probe = (30 + 5 * rng.random((10, 3))).astype(np.float32)
+    ts0 = 1_700_000_000
+
+    for backend in ("tpu", "cpu"):
+        grp = StreamGroup(cfg, ids, backend=backend)
+        for i in range(40):
+            grp.tick(warm[i], ts0 + i)
+        if backend == "tpu":
+            before = {k: np.asarray(v) for k, v in jax.device_get(grp.state).items()}
+        else:
+            before = [{k: np.copy(v) for k, v in s.items()} for s in grp._states]
+        for i in range(10):
+            grp.tick(probe[i], ts0 + 40 + i, learn=False)
+        # learned state identical; only the recurrent activity /iter slots move
+        frozen = ("perm", "syn_perm", "presyn", "boost", "overlap_duty",
+                  "active_duty", "seg_last", "sp_iter")
+        if backend == "tpu":
+            after = {k: np.asarray(v) for k, v in jax.device_get(grp.state).items()}
+            for k in frozen:
+                np.testing.assert_array_equal(before[k], after[k], err_msg=f"{backend}:{k}")
+        else:
+            for g in range(3):
+                for k in frozen:
+                    np.testing.assert_array_equal(
+                        before[g][k], grp._states[g][k], err_msg=f"{backend}:{k}"
+                    )
+
+
+def test_replay_learn_false_runs():
+    scfg = SyntheticStreamConfig(length=120, cadence_s=1.0, n_anomalies=0)
+    streams = generate_cluster(2, metrics=("cpu",), cfg=scfg, seed=6)
+    cfg = cluster_preset()
+    res = replay_streams(streams, cfg, backend="tpu", chunk_ticks=40, learn=False)
+    assert res.raw.shape == (120, 2) and np.isfinite(res.raw).all()
