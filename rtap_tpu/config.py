@@ -127,16 +127,19 @@ class TMConfig:
     max_synapses_per_segment: int = 32
     new_synapse_count: int = 20
     seed: int = 1960
-    # Static-shape capacities for the device kernel's compact learning pass
-    # (SURVEY.md §7 hard part 1): at most `learn_cap` segments learn per step
-    # (>= active columns; predicted columns can contribute several) and at most
-    # `winner_cap` winner cells existed at t-1. `active_cap` bounds the active
-    # -cell id list the kernel's membership tests compare against (>= k winner
-    # columns x cells_per_column, the bursting worst case). Overflow is counted
-    # in state["tm_overflow"]; tests assert it stays zero at these sizes.
+    # Static-shape capacities for the device kernel's column-compact learning
+    # pass (SURVEY.md §7 hard part 1): at most `learn_cap` segments learn per
+    # step (>= active columns; predicted columns can contribute several).
+    # Overflow is counted in state["tm_overflow"]; tests assert it stays zero
+    # at the configured sizes.
     learn_cap: int = 128
-    winner_cap: int = 192
-    active_cap: int = 1280  # >= num_active_columns * cells_per_column (validated in ModelConfig)
+    # Max simultaneously-active columns per step (>= SPConfig.num_active_columns,
+    # validated in ModelConfig). The device kernel's membership tests and its
+    # learning workspace are column-compact: active cells can only live in
+    # active columns, so comparing against <= col_cap column ids + a packed
+    # K-bit per-column cell mask replaces comparing against a flat active-cell
+    # id list (8-32x fewer VPU ops at preset sizes).
+    col_cap: int = 40
 
 
 @dataclass(frozen=True)
@@ -178,15 +181,25 @@ class ModelConfig:
     n_fields: int = 1  # multivariate: number of scalar fields fused into one SDR
 
     def __post_init__(self) -> None:
-        # The bursting worst case activates num_active_columns * cells_per_column
-        # cells in one step; a smaller active_cap would silently truncate the
-        # compact active-cell list and corrupt dendrite counts (the tm_overflow
-        # counter is the only symptom). Fail loudly at construction instead.
-        worst = self.sp.num_active_columns * self.tm.cells_per_column
-        if self.tm.active_cap < worst:
+        # A col_cap below the SP winner count would silently truncate the
+        # kernel's column-compact active set and corrupt dendrite counts (the
+        # tm_overflow counter is the only symptom). Fail loudly at construction.
+        if self.tm.col_cap < self.sp.num_active_columns:
             raise ValueError(
-                f"TMConfig.active_cap={self.tm.active_cap} is below the bursting "
-                f"worst case num_active_columns*cells_per_column={worst}; raise it"
+                f"TMConfig.col_cap={self.tm.col_cap} is below "
+                f"SPConfig.num_active_columns={self.sp.num_active_columns}; raise it"
+            )
+        if self.tm.cells_per_column > 32:
+            raise ValueError(
+                "cells_per_column > 32 is unsupported: the device kernel packs a "
+                "column's cell activity into one int32 bit mask"
+            )
+        if self.sp.columns * self.tm.cells_per_column >= 1 << 24:
+            # The kernel round-trips presynaptic cell ids through f32 one-hot
+            # matmuls; ids >= 2^24 would lose bits silently.
+            raise ValueError(
+                "columns * cells_per_column must stay below 2^24 (cell ids are "
+                "routed through f32 matmuls in the device kernel)"
             )
 
     @property
@@ -206,28 +219,33 @@ class ModelConfig:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ModelConfig":
-        sp = SPConfig(**d.get("sp", {}))
-        tm = TMConfig(**d.get("tm", {}))
-        # Migration for serialized configs predating the active_cap validation
-        # (old default 512 < the bursting worst case): clamp up with a warning
-        # rather than making the stored checkpoint unloadable. active_cap is a
-        # transient kernel-workspace bound, not part of the saved state shapes,
-        # so raising it on resume is semantics-preserving.
-        worst = sp.num_active_columns * tm.cells_per_column
-        if tm.active_cap < worst:
+        def known(cfg_cls, sub: dict) -> dict:
+            # Serialized configs may carry fields from other framework
+            # versions (e.g. the retired active_cap/winner_cap capacity
+            # bounds): accept and drop them so old checkpoints stay loadable.
+            names = {f.name for f in dataclasses.fields(cfg_cls)}
+            return {k: v for k, v in sub.items() if k in names}
+
+        sp = SPConfig(**known(SPConfig, d.get("sp", {})))
+        tm = TMConfig(**known(TMConfig, d.get("tm", {})))
+        # Migration: configs serialized before col_cap existed default to 40;
+        # clamp up to the SP winner count (col_cap is a transient kernel
+        # workspace bound, not part of saved state shapes, so raising it on
+        # resume is semantics-preserving) rather than failing validation.
+        if tm.col_cap < sp.num_active_columns:
             import logging
 
             logging.getLogger(__name__).warning(
-                "stored TMConfig.active_cap=%d below bursting worst case %d; clamping up",
-                tm.active_cap, worst,
+                "stored TMConfig.col_cap=%d below num_active_columns=%d; clamping up",
+                tm.col_cap, sp.num_active_columns,
             )
-            tm = dataclasses.replace(tm, active_cap=worst)
+            tm = dataclasses.replace(tm, col_cap=sp.num_active_columns)
         return cls(
-            rdse=RDSEConfig(**d.get("rdse", {})),
-            date=DateConfig(**d.get("date", {})),
+            rdse=RDSEConfig(**known(RDSEConfig, d.get("rdse", {}))),
+            date=DateConfig(**known(DateConfig, d.get("date", {}))),
             sp=sp,
             tm=tm,
-            likelihood=LikelihoodConfig(**d.get("likelihood", {})),
+            likelihood=LikelihoodConfig(**known(LikelihoodConfig, d.get("likelihood", {}))),
             n_fields=d.get("n_fields", 1),
         )
 
@@ -258,7 +276,7 @@ def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
         date=DateConfig(time_of_day_width=21, time_of_day_size=54, weekend_width=0),
         sp=SPConfig(columns=2048, num_active_columns=40),
         tm=TMConfig(cells_per_column=32, max_segments_per_cell=16,
-                    max_synapses_per_segment=32, active_cap=1280),
+                    max_synapses_per_segment=32, col_cap=40),
         likelihood=LikelihoodConfig(mode="window"),
     )
 
@@ -278,8 +296,7 @@ def cluster_preset() -> ModelConfig:
                     syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002),
         tm=TMConfig(cells_per_column=8, activation_threshold=7, min_threshold=5,
                     max_segments_per_cell=4, max_synapses_per_segment=12,
-                    new_synapse_count=8, learn_cap=32, winner_cap=48,
-                    active_cap=80),
+                    new_synapse_count=8, learn_cap=32, col_cap=10),
         likelihood=LikelihoodConfig(mode="streaming", historic_window_size=512,
                                     learning_period=100, estimation_samples=50),
     )

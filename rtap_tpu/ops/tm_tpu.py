@@ -3,22 +3,32 @@
 The reference's TM is Cells4.cpp/TemporalMemory.cpp over the Connections
 pointer graph (SURVEY.md C4/C5). TPU-native re-design (SURVEY.md §7 hard part
 1): fixed-capacity dense pools [C, K, S, M] of (presyn id, permanence), and a
-step composed of
+step built around two column-compact structures (profiled on v5e: the flat
+formulations in the first design cost 144 ms/tick at G=2048; these bring the
+same semantics down by an order of magnitude):
 
-  1. column categorization (predicted / burst-matching / burst-new) — dense,
-  2. burst-new segment allocation (first-free slot else LRU-evict) — scatter,
-  3. a *compact learning pass*: the <= learn_cap segments that learn this step
-     are gathered to a [L, M] workspace, reinforced, grown toward previous
-     winner cells (membership test + rank-select + weakest-synapse eviction,
-     all static-shape), and scattered back,
-  4. dense punishment of matching segments in non-active columns,
-  5. dense synapse/segment death,
-  6. dense dendrite activity (gather presyn -> segment popcounts) for t+1.
+1. **Packed-column membership.** "Is this synapse's presynaptic cell active?"
+   Active cells can only live in active columns (<= col_cap of them, = SP's
+   k winners), so the active set is (column ids [Ac], per-column K-bit cell
+   masks [Ac]) instead of a flat cell-id list. Membership is an
+   [..., Ac] compare + mask-select + bit probe — 8-32x fewer VPU ops than the
+   flat cell-id compare at preset sizes, and no serialized gather.
+2. **Column-compact learning workspace.** Every learning segment lives in an
+   active column, so the learning pass gathers the <= col_cap active columns
+   into a [Ac, K, S, M] workspace with one-hot MXU matmuls (XLA's TPU scatter
+   and row-gather on the full pool serialize — profiled in round 1), does the
+   compact reinforce/grow pass there (selecting <= learn_cap segments with a
+   cheap top_k over Ac*K*S instead of C*K*S), and scatters the workspace back
+   with the transposed one-hot matmul + column mask.
 
-Tie-breaks are lowest-index everywhere, matching the oracle exactly; parity
-is bit-for-bit (tests/parity/test_tm_parity.py).
+Step outline: dense column categorization (predicted / burst-matching /
+burst-new) -> workspace learning (alloc, reinforce, grow toward previous
+winner cells with weakest-synapse eviction) -> dense punishment of matching
+segments in non-active columns -> synapse/segment death -> dendrite activity
+for t+1. Tie-breaks are lowest-index everywhere, matching the oracle exactly;
+parity is bit-for-bit (tests/parity/test_tm_parity.py).
 
-Capacity bounds (learn_cap learning segments, winner_cap previous winners per
+Capacity bounds (col_cap active columns, learn_cap learning segments per
 step) are static-shape requirements of XLA; overflow beyond the bounds is
 counted in state["tm_overflow"] so tests can assert it never fires at the
 configured sizes.
@@ -34,18 +44,14 @@ import jax.numpy as jnp
 from rtap_tpu.config import TMConfig
 
 INF = jnp.float32(jnp.inf)
+_HI = jax.lax.Precision.HIGHEST
 
 
-# Strategy switch for ops whose natural formulation (gather / nonzero)
-# serializes on the TPU scalar core: None = per-backend default (TPU-friendly
-# reformulations on TPU, plain gather/nonzero elsewhere); tests flip it to
-# cover both code paths on the CPU platform. Both paths are bit-identical.
+# Strategy switch for _compact_ids, whose natural formulation (nonzero)
+# serializes on the TPU scalar core: None = per-backend default (top_k
+# reformulation on TPU, nonzero elsewhere); tests flip it to cover both code
+# paths on the CPU platform. Both paths are bit-identical.
 FORCE_TPU_PATHS: bool | None = None
-
-# Above this many [R, L] match elements (16M f32 = 64 MiB per stream) the
-# one-hot write-back matmul costs more memory than it saves time; use the
-# plain scatter instead (see the write-back branch in tm_step).
-_MATCH_WRITEBACK_MAX = 16 * 1024 * 1024
 
 
 def _tpu_paths() -> bool:
@@ -59,9 +65,9 @@ def _compact_ids(mask: jnp.ndarray, size: int) -> jnp.ndarray:
     filled with n -> i32 [size].
 
     Equivalent to jnp.nonzero(mask, size=size, fill_value=n)[0], but on TPU
-    nonzero's cumsum+pack runs on the scalar core (~16 ms/tick across the four
-    call sites at G=128 — profiled); top_k of (n - index) is the vector-unit
-    formulation: descending top_k of distinct values = ascending indices.
+    nonzero's cumsum+pack runs on the scalar core (profiled in round 1);
+    top_k of (n - index) is the vector-unit formulation: descending top_k of
+    distinct values = ascending indices.
     """
     n = mask.shape[0]
     if not _tpu_paths():
@@ -75,23 +81,45 @@ def _compact_ids(mask: jnp.ndarray, size: int) -> jnp.ndarray:
     return ids
 
 
-def _presyn_active(presyn: jnp.ndarray, flat: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
-    """Is each synapse's presynaptic cell active? -> bool, presyn's shape.
+def _pack_active(cells_ck: jnp.ndarray, Ac: int):
+    """Column-compact representation of a [C, K] cell set (K <= 32).
 
-    `presyn` [..., M] i32 (-1 = empty); `flat` bool [N] dense activity;
-    `ids` [A] i32 the same activity as a compact ascending id list (fill N).
-
-    Two bit-identical implementations: on TPU, compare-any membership against
-    `ids` — XLA lowers `flat[presyn]` gathers to a serialized scalar-core loop
-    (~135 ms/tick at G=128, C=256 — profiled; it was the framework
-    bottleneck), while eq+any is pure VPU work. On CPU the gather is the fast
-    path (membership costs M*A compares per synapse). Empty slots (-1) and id
-    fills (N) never match / are masked.
+    Returns (col_ids [Ac] i32 ascending with C fills, col_masks [Ac] i32
+    K-bit packed per-column cell masks, n_cols i32 total occupied columns —
+    n_cols > Ac means the compact form is truncated, counted as overflow).
     """
-    if _tpu_paths():
-        return (presyn[..., None] == ids).any(-1)
-    N = flat.shape[0]
-    return (presyn >= 0) & flat[jnp.clip(presyn, 0, N - 1)]
+    C, K = cells_ck.shape
+    col_any = cells_ck.any(-1)
+    col_ids = _compact_ids(col_any, Ac)
+    packed = (cells_ck.astype(jnp.int32) << jnp.arange(K, dtype=jnp.int32)).sum(-1)  # [C]
+    hit = col_ids[:, None] == jnp.arange(C, dtype=jnp.int32)  # [Ac, C]
+    col_masks = jnp.where(hit, packed[None, :], 0).sum(-1)
+    return col_ids, col_masks, col_any.sum()
+
+
+def _presyn_active_packed(
+    presyn: jnp.ndarray, col_ids: jnp.ndarray, col_masks: jnp.ndarray, K: int
+) -> jnp.ndarray:
+    """Is each synapse's presynaptic cell in the packed active set? -> bool,
+    presyn's shape. `presyn` [..., M] i32 (-1 = empty, never matches)."""
+    c_pre = presyn // K  # -1 -> -1 (floor), never equals a valid col id
+    k_pre = presyn % K  # python modulo: -1 -> K-1, masked by presyn >= 0
+    msk = jnp.where(c_pre[..., None] == col_ids, col_masks, 0).sum(-1)
+    return (presyn >= 0) & (((msk >> k_pre) & 1) > 0)
+
+
+def _winner_id_list(winner_ck: jnp.ndarray, Ac: int) -> jnp.ndarray:
+    """Flat cell-id list of winner cells, ascending where valid, invalid
+    entries = N -> i32 [Ac*K]. Winner cells live in <= Ac columns (they are a
+    subset of that step's active columns), so a column-compact construction
+    avoids a [N]-wide top_k."""
+    C, K = winner_ck.shape
+    N = C * K
+    col_ids = _compact_ids(winner_ck.any(-1), Ac)  # [Ac]
+    hit = col_ids[:, None] == jnp.arange(C, dtype=jnp.int32)  # [Ac, C]
+    rows = (hit[:, :, None] & winner_ck[None, :, :]).any(1)  # [Ac, K]
+    ids = col_ids[:, None] * K + jnp.arange(K, dtype=jnp.int32)[None, :]
+    return jnp.where(rows & (col_ids[:, None] < C), ids, N).reshape(-1)
 
 
 def _segment_learning_mask(
@@ -159,7 +187,7 @@ def _grow_compact(
     presyn_l: jnp.ndarray,  # i32 [L, M] (post-reinforce)
     perm_l: jnp.ndarray,  # f32 [L, M]
     n_grow: jnp.ndarray,  # i32 [L]
-    winner_ids: jnp.ndarray,  # i32 [W] ascending, padded with N
+    winner_ids: jnp.ndarray,  # i32 [W] ascending where valid, fills = N
     n_cells: int,
 ):
     """Oracle _grow_synapses, vectorized: per segment, add the first
@@ -202,6 +230,21 @@ def _grow_compact(
     return presyn_l, perm_l
 
 
+def _gather_rows_f32(x: jnp.ndarray, oh: jnp.ndarray) -> jnp.ndarray:
+    """One-hot row gather as an MXU matmul: oh [R, C] f32 0/1 one-hot rows,
+    x [C, F] f32 -> [R, F]. At most one 1.0 per output row, so values pass
+    through exactly under HIGHEST precision (full-f32 passes)."""
+    return jax.lax.dot(oh, x, precision=_HI)
+
+
+def _gather_rows_i32(x: jnp.ndarray, oh_b: jnp.ndarray) -> jnp.ndarray:
+    """One-hot row gather for i32 values of unbounded magnitude (e.g.
+    iteration stamps > 2^24, where the f32 matmul would round): masked
+    select + integer sum over the one-hot axis."""
+    # oh_b [R, C] bool, x [C, F] i32 -> [R, F]
+    return jnp.where(oh_b[:, :, None], x[None, :, :], 0).sum(1)
+
+
 @partial(jax.jit, static_argnames=("cfg", "learn"))
 def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = True):
     """One TM step -> (new_state, raw anomaly score f32). Pure.
@@ -211,7 +254,9 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     """
     C, K, S, M = state["presyn"].shape
     N = C * K
-    L, W = cfg.learn_cap, cfg.winner_cap
+    L, Ac = cfg.learn_cap, cfg.col_cap
+    if K > 32:
+        raise ValueError("cells_per_column > 32 unsupported (packed cell masks)")
 
     presyn = state["presyn"]
     syn_perm = state["syn_perm"]
@@ -227,10 +272,7 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         0.0,
     )
 
-    prev_active_flat = state["prev_active"].reshape(-1)  # bool [N]
-    prev_winner_flat = state["prev_winner"].reshape(-1)
-    n_winners = prev_winner_flat.sum()
-    have_winners = n_winners > 0
+    have_winners = state["prev_winner"].any()
 
     predicted_cols, learn_mask, alloc, winner_extra, burst = _segment_learning_mask(
         cfg, active_cols, state["active_seg"], state["matching_seg"], state["seg_pot"],
@@ -247,38 +289,64 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         | winner_extra
     )
 
-    A = cfg.active_cap
-    prev_ids = _compact_ids(prev_active_flat, A)
-
+    overflow_learn = jnp.bool_(False)
     if learn:
         alloc_col, bn_k, bn_s = alloc
-
-        # --- burst-new allocation: clear slot (evict if LRU) + stamp ---
-        # Dense one-hot writes, not scatters: XLA's TPU scatter on the [C,K,S,M]
-        # pools serializes and drags transposed-layout copies along (~23 ms/tick
-        # each at G=1024 — profiled).
         burst_new = alloc_col < C  # [C]
-        sel_k_a = jnp.arange(K, dtype=bn_k.dtype)[None, :] == bn_k[:, None]  # [C, K]
-        sel_s_a = jnp.arange(S, dtype=bn_s.dtype)[None, :] == bn_s[:, None]  # [C, S]
-        alloc_mask = burst_new[:, None, None] & sel_k_a[:, :, None] & sel_s_a[:, None, :]
-        presyn = jnp.where(alloc_mask[..., None], -1, presyn)
-        syn_perm = jnp.where(alloc_mask[..., None], jnp.float32(0), syn_perm)
-        seg_pot0 = jnp.where(alloc_mask, 0, state["seg_pot"])
-        seg_last = jnp.where(alloc_mask, it, seg_last)
-        lm = learn_mask | alloc_mask
-        overflow = (lm.sum() > L) | (n_winners > W) | (prev_active_flat.sum() > A)
 
-        # --- compact gather of learning segments ---
-        idx = _compact_ids(lm.reshape(-1), L)
-        valid_l = idx < C * K * S
-        safe = jnp.clip(idx, 0, C * K * S - 1)
-        presyn_l = presyn.reshape(-1, M)[safe]
-        perm_l = syn_perm.reshape(-1, M)[safe]
-        pot_l = seg_pot0.reshape(-1)[safe]
+        # --- gather the active columns into the [Ac, ...] workspace ---
+        col_ids = _compact_ids(active_cols, Ac)  # [Ac], fills = C
+        col_oh_b = col_ids[:, None] == jnp.arange(C, dtype=jnp.int32)  # [Ac, C]
+        col_oh = col_oh_b.astype(jnp.float32)
+        hit_cols = col_oh_b.any(0)  # [C] columns actually captured (== active_cols sans overflow)
+
+        ws_presyn = jnp.round(
+            _gather_rows_f32(presyn.reshape(C, -1).astype(jnp.float32), col_oh)
+        ).astype(jnp.int32)  # [Ac, K*S*M]
+        ws_perm = _gather_rows_f32(syn_perm.reshape(C, -1), col_oh)  # [Ac, K*S*M]
+        ws_last = _gather_rows_i32(seg_last.reshape(C, -1), col_oh_b).reshape(Ac, K, S)
+        ws_pot = jnp.round(
+            _gather_rows_f32(state["seg_pot"].reshape(C, -1).astype(jnp.float32), col_oh)
+        ).astype(jnp.int32).reshape(Ac, K, S)  # seg_pot <= M << 2^24: f32-exact
+        ws_learn = (col_oh_b[:, :, None] & learn_mask.reshape(C, -1)[None]).any(1).reshape(Ac, K, S)
+
+        # --- burst-new allocation inside the workspace: clear slot + stamp ---
+        ws_bn = (col_oh_b & burst_new[None, :]).any(-1)  # [Ac]
+        ws_bnk = jnp.where(col_oh_b, bn_k[None, :], 0).sum(-1)  # [Ac]
+        ws_bns = jnp.where(col_oh_b, bn_s[None, :], 0).sum(-1)
+        sel_k = jnp.arange(K, dtype=jnp.int32)[None, :] == ws_bnk[:, None]  # [Ac, K]
+        sel_s = jnp.arange(S, dtype=jnp.int32)[None, :] == ws_bns[:, None]  # [Ac, S]
+        ws_alloc = ws_bn[:, None, None] & sel_k[:, :, None] & sel_s[:, None, :]  # [Ac, K, S]
+        ws_presyn = jnp.where(
+            ws_alloc.reshape(Ac, -1, 1).repeat(M, -1).reshape(Ac, -1), -1, ws_presyn
+        )
+        ws_perm = jnp.where(
+            ws_alloc.reshape(Ac, -1, 1).repeat(M, -1).reshape(Ac, -1), 0.0, ws_perm
+        )
+        ws_pot = jnp.where(ws_alloc, 0, ws_pot)
+        ws_last = jnp.where(ws_alloc, it, ws_last)
+        ws_learn = ws_learn | ws_alloc
+
+        # --- compact the <= learn_cap learning segments within the workspace ---
+        R2 = Ac * K * S
+        idx = _compact_ids(ws_learn.reshape(-1), L)  # [L], fills = R2
+        valid_l = idx < R2
+        row_oh_b = idx[:, None] == jnp.arange(R2, dtype=jnp.int32)  # [L, R2]
+        row_oh = row_oh_b.astype(jnp.float32)
+        ws_presyn_r = ws_presyn.reshape(R2, M)
+        ws_perm_r = ws_perm.reshape(R2, M)
+        presyn_l = jnp.round(
+            _gather_rows_f32(ws_presyn_r.astype(jnp.float32), row_oh)
+        ).astype(jnp.int32)  # [L, M]
+        perm_l = _gather_rows_f32(ws_perm_r, row_oh)  # [L, M]
+        pot_l = jnp.where(row_oh_b, ws_pot.reshape(-1)[None, :], 0).sum(-1)  # [L]
+
+        # prev-step active cells, column-compact (shared by reinforce + punish)
+        pcol_ids, pcol_masks, p_cols = _pack_active(state["prev_active"], Ac)
 
         # reinforce: +inc on synapses to prev-active cells, -dec on the rest
         exists = presyn_l >= 0
-        act = _presyn_active(presyn_l, prev_active_flat, prev_ids)
+        act = _presyn_active_packed(presyn_l, pcol_ids, pcol_masks, K)
         perm_l = jnp.clip(
             perm_l
             + cfg.permanence_increment * act
@@ -288,48 +356,45 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         )
 
         # grow toward previous winner cells (ascending id)
-        winner_ids = _compact_ids(prev_winner_flat, W)
+        winner_ids = _winner_id_list(state["prev_winner"], Ac)  # [Ac*K]
         n_grow = (cfg.new_synapse_count - pot_l).astype(jnp.int32)
         grown_presyn, grown_perm = _grow_compact(cfg, presyn_l, perm_l, n_grow, winner_ids, N)
         grow_ok = have_winners & valid_l
         presyn_l = jnp.where(grow_ok[:, None], grown_presyn, presyn_l)
         perm_l = jnp.where(grow_ok[:, None], grown_perm, perm_l)
 
-        if not _tpu_paths() or (C * K * S) * L > _MATCH_WRITEBACK_MAX:
-            # Plain row scatter. On CPU it is the fast path. On TPU it
-            # serializes per update row, but at large-model sizes (NAB preset:
-            # R = 1M, L = 128) the scatter is only ~L rows while the match
-            # matrix below would be R*L f32 = 512 MiB per stream — the scatter
-            # wins. idx is ascending with OOB fills; applied rows are unique.
-            hint = dict(mode="drop", unique_indices=True, indices_are_sorted=True)
-            presyn = presyn.reshape(-1, M).at[idx].set(presyn_l, **hint).reshape(C, K, S, M)
-            syn_perm = syn_perm.reshape(-1, M).at[idx].set(perm_l, **hint).reshape(C, K, S, M)
-            seg_last = seg_last.reshape(-1).at[idx].set(it, **hint).reshape(C, K, S)
-        else:
-            # Write-back as a one-hot matmul (MXU): XLA's TPU scatter
-            # serializes per update (~170 ms/tick at stream-group sizes) and
-            # row gathers / select-reduces drag transposed-layout pool copies
-            # along (~60 ms each — profiled). idx is unique, so inverting the
-            # scatter is an [R, L] equality match; each output row has at most
-            # one 1.0, so values pass through exactly (1.0*x accumulated with
-            # 0.0s in f32; presyn ids < 2^24).
-            rows = jnp.arange(C * K * S, dtype=idx.dtype)
-            match = rows[:, None] == idx[None, :]  # [R, L]
-            hit = match.any(-1)
-            match_f = match.astype(jnp.float32)
-            scat_presyn = jnp.round(
-                jax.lax.dot(match_f, presyn_l.astype(jnp.float32),
-                            precision=jax.lax.Precision.HIGHEST)
-            ).astype(jnp.int32)
-            scat_perm = jax.lax.dot(match_f, perm_l, precision=jax.lax.Precision.HIGHEST)
-            presyn = jnp.where(hit[:, None], scat_presyn, presyn.reshape(-1, M)).reshape(C, K, S, M)
-            syn_perm = jnp.where(hit[:, None], scat_perm, syn_perm.reshape(-1, M)).reshape(C, K, S, M)
-            seg_last = jnp.where(hit, it, seg_last.reshape(-1)).reshape(C, K, S)
+        # --- scatter learned rows back into the workspace (one-hot matmul) ---
+        hit_rows = row_oh_b.any(0)  # [R2]
+        scat_presyn = jnp.round(
+            jax.lax.dot(row_oh.T, presyn_l.astype(jnp.float32), precision=_HI)
+        ).astype(jnp.int32)
+        scat_perm = jax.lax.dot(row_oh.T, perm_l, precision=_HI)
+        ws_presyn_r = jnp.where(hit_rows[:, None], scat_presyn, ws_presyn_r)
+        ws_perm_r = jnp.where(hit_rows[:, None], scat_perm, ws_perm_r)
+        ws_last = jnp.where(hit_rows.reshape(Ac, K, S), it, ws_last)
+
+        # --- scatter the workspace back to the pools ---
+        pool_presyn = jnp.round(
+            jax.lax.dot(col_oh.T, ws_presyn_r.reshape(Ac, -1).astype(jnp.float32), precision=_HI)
+        ).astype(jnp.int32).reshape(C, K, S, M)
+        pool_perm = jax.lax.dot(
+            col_oh.T, ws_perm_r.reshape(Ac, -1), precision=_HI
+        ).reshape(C, K, S, M)
+        pool_last = jnp.where(
+            col_oh_b[:, :, None], ws_last.reshape(Ac, 1, -1), 0
+        ).sum(0).reshape(C, K, S)
+        presyn = jnp.where(hit_cols[:, None, None, None], pool_presyn, presyn)
+        syn_perm = jnp.where(hit_cols[:, None, None, None], pool_perm, syn_perm)
+        seg_last = jnp.where(hit_cols[:, None, None], pool_last, seg_last)
+
+        overflow_learn = (
+            (n_active > Ac) | (p_cols > Ac) | (ws_learn.sum() > L)
+        )
 
         # --- punish matching segments in columns that did not activate ---
         if cfg.predicted_segment_decrement > 0.0:
             pmask = state["matching_seg"] & ~active_cols[:, None, None]
-            pact = _presyn_active(presyn, prev_active_flat, prev_ids)
+            pact = _presyn_active_packed(presyn, pcol_ids, pcol_masks, K)
             syn_perm = jnp.where(
                 pmask[..., None] & pact,
                 jnp.maximum(syn_perm - cfg.predicted_segment_decrement, 0.0),
@@ -342,19 +407,14 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         nsyn = (presyn >= 0).sum(-1)
         seg_last = jnp.where((seg_last >= 0) & (nsyn == 0), -1, seg_last)
 
-        overflow_learn = overflow
-    else:
-        overflow_learn = jnp.bool_(False)
-
     # --- dendrite activity for t+1 over existing segments ---
     exists_seg = seg_last >= 0
-    active_flat = active_cells.reshape(-1)
-    act_ids = _compact_ids(active_flat, A)
-    # the act_ids truncation applies under inference too — count it always
+    acol_ids, acol_masks, a_cols = _pack_active(active_cells, Ac)
+    # the packed-column truncation applies under inference too — count it always
     tm_overflow = state["tm_overflow"] + (
-        overflow_learn | (active_flat.sum() > A)
+        overflow_learn | (a_cols > Ac)
     ).astype(jnp.int32)
-    syn_act = _presyn_active(presyn, active_flat, act_ids)
+    syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
     conn_count = (syn_act & (syn_perm >= cfg.connected_permanence)).sum(-1)
     pot_count = syn_act.sum(-1)
     active_seg = exists_seg & (conn_count >= cfg.activation_threshold)

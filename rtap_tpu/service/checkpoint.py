@@ -50,11 +50,6 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
         "sharded": grp.mesh is not None,
         "config": grp.cfg.to_dict(),
     }
-    # sweep residue from prior interrupted saves of this checkpoint
-    for stale in path.parent.glob(f".{path.name}.tmp-*"):
-        shutil.rmtree(stale, ignore_errors=True)
-    for stale in path.parent.glob(f".{path.name}.old-*"):
-        shutil.rmtree(stale, ignore_errors=True)
     tmp = path.parent / f".{path.name}.tmp-{uuid.uuid4().hex[:8]}"
     swapped = False
     try:
@@ -79,6 +74,39 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
     finally:
         if not swapped:
             shutil.rmtree(tmp, ignore_errors=True)
+    # Sweep residue from PRIOR interrupted saves only after this save fully
+    # landed: a complete `.old-*`/`.tmp-*` sibling is load_group's crash
+    # fallback and must never be deleted before a newer complete copy exists.
+    for stale in path.parent.glob(f".{path.name}.tmp-*"):
+        if stale != tmp:
+            shutil.rmtree(stale, ignore_errors=True)
+    for stale in path.parent.glob(f".{path.name}.old-*"):
+        shutil.rmtree(stale, ignore_errors=True)
+
+
+def _recover_residue(path: Path) -> Path:
+    """If `path` is missing but a complete residue sibling from an
+    interrupted save exists (meta.json present), rename it into place and
+    return `path`; otherwise return `path` unchanged (load will fail with
+    the underlying error)."""
+    if (path / "meta.json").exists():
+        return path
+    candidates = [
+        p
+        for pattern in (f".{path.name}.old-*", f".{path.name}.tmp-*")
+        for p in path.parent.glob(pattern)
+        if (p / "meta.json").exists()
+    ]
+    if candidates:
+        import logging
+
+        best = max(candidates, key=lambda p: (p / "meta.json").stat().st_mtime)
+        logging.getLogger(__name__).warning(
+            "checkpoint %s missing; recovering interrupted-save residue %s", path, best
+        )
+        if not path.exists():
+            best.rename(path)
+    return path
 
 
 def load_group(path: str | Path, mesh=None) -> StreamGroup:
@@ -92,7 +120,7 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
     import jax
     import orbax.checkpoint as ocp
 
-    path = Path(path).absolute()
+    path = _recover_residue(Path(path).absolute())
     meta = json.loads((path / "meta.json").read_text())
     cfg = ModelConfig.from_dict(meta["config"])
     if meta.get("sharded") and mesh is None:

@@ -1,0 +1,166 @@
+"""Profile the fused stream-group step on the real chip.
+
+Breaks the per-tick cost down by (a) group size scaling, (b) component
+ablation (encode / SP / TM, learn on/off), so optimization effort lands on
+the measured bottleneck (VERDICT r1 next-step 1). Run on hardware:
+
+    PYTHONPATH=/root/repo:/root/.axon_site python scripts/profile_step.py [--trace DIR]
+
+Prints a table to stderr; with --trace, wraps one measured chunk in a
+jax.profiler trace for xprof.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rtap_tpu.config import ModelConfig, cluster_preset
+from rtap_tpu.models.state import init_state
+from rtap_tpu.ops.encoders_tpu import bind_offsets, encode_device
+from rtap_tpu.ops.sp_tpu import sp_step
+from rtap_tpu.ops.tm_tpu import tm_step
+from rtap_tpu.ops.step import chunk_step, replicate_state
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_inputs(G, T, n_fields, seed=0):
+    rng = np.random.Generator(np.random.Philox(key=(seed, 77)))
+    vals = (35 + 20 * rng.random((T, G, n_fields))).astype(np.float32)
+    ts = (1_700_000_000 + np.arange(T)[:, None] + np.zeros((1, G), np.int64)).astype(np.int32)
+    return vals, ts
+
+
+def time_fn(fn, state, iters=3, warmup=1):
+    """fn(state) -> (state, aux); state buffers are donated, so thread them."""
+    for _ in range(warmup):
+        state, _ = fn(state)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = fn(state)
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters
+
+
+# ---- ablation kernels: scan-over-T, vmap-over-G, one component only ----
+
+def _scan_vmap(body, state, xs):
+    def step(s, inp):
+        return jax.vmap(body)(s, *inp)
+    return jax.lax.scan(step, state, xs)
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(0,))
+def encode_only(state, vals, ts, cfg: ModelConfig):
+    def body(s, v, t):
+        off, bound = bind_offsets(v, s["enc_offset"], s["enc_bound"])
+        s = {**s, "enc_offset": off, "enc_bound": bound}
+        sdr = encode_device(cfg, v, t, off, s["enc_resolution"])
+        return s, sdr.sum()
+    return _scan_vmap(body, state, (vals, ts))
+
+
+@partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
+def sp_only(state, vals, ts, cfg: ModelConfig, learn=True):
+    def body(s, v, t):
+        sdr = encode_device(cfg, v, t, s["enc_offset"], s["enc_resolution"])
+        s, active = sp_step(s, sdr, cfg.sp, learn)
+        return s, active.sum()
+    return _scan_vmap(body, state, (vals, ts))
+
+
+@partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
+def tm_only(state, actives, cfg: ModelConfig, learn=True):
+    def body(s, a):
+        s, raw = tm_step(s, a, cfg.tm, learn)
+        return s, raw
+    def step(s, a):
+        return jax.vmap(body)(s, a)
+    return jax.lax.scan(step, state, actives)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default=None)
+    ap.add_argument("--T", type=int, default=32)
+    ap.add_argument("--gs", type=int, nargs="*", default=[512, 2048, 4096, 8192])
+    args = ap.parse_args()
+
+    cfg = cluster_preset()
+    T = args.T
+    log(f"platform: {jax.devices()[0].platform} {jax.devices()[0].device_kind}")
+
+    log("\n== G scaling, full step (learn=True) ==")
+    results = {}
+    for G in args.gs:
+        try:
+            state = jax.device_put(replicate_state(init_state(cfg, 0), G))
+            vals, ts = make_inputs(G, T, cfg.n_fields)
+            dt = time_fn(lambda s: chunk_step(s, vals, ts, cfg, True), state, iters=2)
+            per_tick = dt / T
+            rate = G * T / dt
+            results[G] = rate
+            log(f"G={G:6d}: {per_tick*1e3:8.2f} ms/tick  {rate:10.0f} metrics/s")
+        except Exception as e:
+            log(f"G={G:6d}: FAILED {type(e).__name__}: {str(e)[:120]}")
+
+    G = max(g for g in results)
+    log(f"\n== ablations at G={G}, T={T} ==")
+    vals, ts = make_inputs(G, T, cfg.n_fields)
+
+    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    dt_full = time_fn(lambda s: chunk_step(s, vals, ts, cfg, True), st, iters=2)
+    log(f"full learn=True : {dt_full/T*1e3:8.2f} ms/tick")
+
+    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    dt_inf = time_fn(lambda s: chunk_step(s, vals, ts, cfg, False), st, iters=2)
+    log(f"full learn=False: {dt_inf/T*1e3:8.2f} ms/tick")
+
+    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    dt_enc = time_fn(lambda s: encode_only(s, vals, ts, cfg), st, iters=2)
+    log(f"encode only     : {dt_enc/T*1e3:8.2f} ms/tick")
+
+    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    dt_sp = time_fn(lambda s: sp_only(s, vals, ts, cfg, True), st, iters=2)
+    log(f"enc+SP learn    : {dt_sp/T*1e3:8.2f} ms/tick")
+
+    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    dt_spi = time_fn(lambda s: sp_only(s, vals, ts, cfg, False), st, iters=2)
+    log(f"enc+SP infer    : {dt_spi/T*1e3:8.2f} ms/tick")
+
+    # TM alone: feed plausible active-column masks (k of C)
+    rng = np.random.Generator(np.random.Philox(key=(1, 78)))
+    C, k = cfg.sp.columns, cfg.sp.num_active_columns
+    acts = np.zeros((T, G, C), bool)
+    idx = rng.integers(0, C, (T, G, k))
+    np.put_along_axis(acts, idx, True, axis=-1)
+    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    acts_d = jnp.asarray(acts)
+    dt_tm = time_fn(lambda s: tm_only(s, acts_d, cfg, True), st, iters=2)
+    log(f"TM only learn   : {dt_tm/T*1e3:8.2f} ms/tick")
+    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    dt_tmi = time_fn(lambda s: tm_only(s, acts_d, cfg, False), st, iters=2)
+    log(f"TM only infer   : {dt_tmi/T*1e3:8.2f} ms/tick")
+
+    if args.trace:
+        st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+        chunk_step(st, vals, ts, cfg, True)  # compiled above; warm anyway
+        st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+        with jax.profiler.trace(args.trace):
+            st, raw = chunk_step(st, vals, ts, cfg, True)
+            jax.block_until_ready(raw)
+        log(f"trace written to {args.trace}")
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,7 @@ def small_cfg(n_fields: int = 1) -> ModelConfig:
         sp=SPConfig(columns=256, num_active_columns=10),
         tm=TMConfig(cells_per_column=8, activation_threshold=6, min_threshold=4,
                     max_segments_per_cell=4, max_synapses_per_segment=16,
-                    new_synapse_count=8, learn_cap=48, winner_cap=64),
+                    new_synapse_count=8, learn_cap=48),
         n_fields=n_fields,
     )
 

@@ -74,7 +74,7 @@ def test_tm_parity_repeating_sequence(learn):
     C, cfg = 64, TMConfig(
         cells_per_column=8, activation_threshold=3, min_threshold=2,
         max_segments_per_cell=4, max_synapses_per_segment=12,
-        new_synapse_count=6, learn_cap=32, winner_cap=48,
+        new_synapse_count=6, learn_cap=32,
     )
     rng = np.random.default_rng(11)
     pats = [_pattern(rng, C, 5) for _ in range(4)]
@@ -88,7 +88,7 @@ def test_tm_parity_ambiguous_sequences():
     C, cfg = 64, TMConfig(
         cells_per_column=8, activation_threshold=3, min_threshold=2,
         max_segments_per_cell=4, max_synapses_per_segment=12,
-        new_synapse_count=6, learn_cap=32, winner_cap=48,
+        new_synapse_count=6, learn_cap=32,
     )
     rng = np.random.default_rng(5)
     A, B, Cp, D, E = (_pattern(rng, C, 5) for _ in range(5))
@@ -102,7 +102,7 @@ def test_tm_parity_random_stream_with_eviction():
     C, cfg = 32, TMConfig(
         cells_per_column=4, activation_threshold=2, min_threshold=1,
         max_segments_per_cell=2, max_synapses_per_segment=6,
-        new_synapse_count=4, learn_cap=32, winner_cap=32,
+        new_synapse_count=4, learn_cap=32,
     )
     rng = np.random.default_rng(23)
     seq = [_pattern(rng, C, 4) for _ in range(120)]
@@ -116,7 +116,7 @@ def test_tm_parity_punishment_path():
         cells_per_column=6, activation_threshold=2, min_threshold=1,
         max_segments_per_cell=3, max_synapses_per_segment=8,
         new_synapse_count=5, predicted_segment_decrement=0.02,
-        learn_cap=32, winner_cap=48,
+        learn_cap=32,
     )
     rng = np.random.default_rng(31)
     X, Y = _pattern(rng, C, 6), _pattern(rng, C, 6)
@@ -131,7 +131,7 @@ def test_tm_parity_empty_and_full_columns():
     C, cfg = 16, TMConfig(
         cells_per_column=4, activation_threshold=2, min_threshold=1,
         max_segments_per_cell=2, max_synapses_per_segment=6,
-        new_synapse_count=4, learn_cap=80, winner_cap=64,
+        new_synapse_count=4, learn_cap=80,
     )
     rng = np.random.default_rng(3)
     seq = [_pattern(rng, C, 3), np.arange(C), np.array([], np.int64),

@@ -67,10 +67,21 @@ def test_checkpoint_overwrite_atomic(tmp_path):
     assert residue == [], residue
 
 
-def test_config_validation_rejects_small_active_cap():
+def test_config_validation_rejects_small_col_cap():
     from rtap_tpu.config import ModelConfig, SPConfig, TMConfig
 
-    with pytest.raises(ValueError, match="active_cap"):
-        ModelConfig(sp=SPConfig(num_active_columns=40),
-                    tm=TMConfig(cells_per_column=32, active_cap=100))
+    with pytest.raises(ValueError, match="col_cap"):
+        ModelConfig(sp=SPConfig(num_active_columns=50),
+                    tm=TMConfig(cells_per_column=32, col_cap=10))
     ModelConfig()  # defaults must validate
+
+
+def test_from_dict_drops_retired_fields_and_clamps_col_cap():
+    from rtap_tpu.config import ModelConfig, SPConfig
+
+    old = ModelConfig(sp=SPConfig(num_active_columns=40)).to_dict()
+    old["tm"]["active_cap"] = 512  # retired field from an old serialization
+    old["tm"]["winner_cap"] = 192
+    old["tm"]["col_cap"] = 8  # pre-col_cap checkpoint migrated too low
+    cfg = ModelConfig.from_dict(old)
+    assert cfg.tm.col_cap == 40
