@@ -143,6 +143,32 @@ class TMConfig:
 
 
 @dataclass(frozen=True)
+class ClassifierConfig:
+    """SDR classifier (SURVEY.md C10) — decodes TM cell state to a predicted
+    value distribution, the "prediction" half of the reference's name.
+
+    Semantics follow the public NuPIC SDRClassifier (softmax regression from
+    active-cell patterns to encoder buckets, one-step-ahead): at record t the
+    pattern from t-1 is trained toward the bucket of the value at t
+    (error = onehot - softmax, SGD with rate ``alpha``); inference applies
+    the pattern at t to predict t+1. Per-bucket actual values are tracked
+    with an EMA (``act_value_alpha``) and the predicted value is the actual
+    value of the argmax bucket.
+
+    TPU-native layout: weights are a dense [num_cells, buckets] matrix per
+    stream; the pattern->logits matvec and the outer-product update both run
+    on the MXU. Buckets are the RDSE bucket index shifted by ``buckets // 2``
+    and clamped to [0, buckets) — offset binding centers the first value, and
+    NAB-style resolutions span the value range in ~130 buckets.
+    """
+
+    enabled: bool = False
+    buckets: int = 130
+    alpha: float = 0.01
+    act_value_alpha: float = 0.3
+
+
+@dataclass(frozen=True)
 class LikelihoodConfig:
     """Anomaly likelihood post-process (SURVEY.md C8) — stays on host.
 
@@ -178,6 +204,7 @@ class ModelConfig:
     sp: SPConfig = field(default_factory=SPConfig)
     tm: TMConfig = field(default_factory=TMConfig)
     likelihood: LikelihoodConfig = field(default_factory=LikelihoodConfig)
+    classifier: ClassifierConfig = field(default_factory=ClassifierConfig)
     n_fields: int = 1  # multivariate: number of scalar fields fused into one SDR
 
     def __post_init__(self) -> None:
@@ -246,6 +273,7 @@ class ModelConfig:
             sp=sp,
             tm=tm,
             likelihood=LikelihoodConfig(**known(LikelihoodConfig, d.get("likelihood", {}))),
+            classifier=ClassifierConfig(**known(ClassifierConfig, d.get("classifier", {}))),
             n_fields=d.get("n_fields", 1),
         )
 

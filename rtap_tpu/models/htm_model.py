@@ -34,12 +34,14 @@ def oracle_record_step(
     values: np.ndarray,
     ts_unix: int,
     learn: bool = True,
-) -> float:
+    classifier=None,
+) -> float | tuple[float, float, float]:
     """One oracle record through bind -> encode -> SP -> TM -> raw score.
 
     The single source of the CPU per-record composition, shared by
     HTMModel.run and the service layer's CPU stream groups; the device twin
-    is ops/step.step_impl.
+    is ops/step.step_impl. With a `classifier` (SDRClassifierOracle), also
+    decodes the predicted next value: returns (raw, prediction, prob).
     """
     bind = ~state["enc_bound"] & np.isfinite(values)
     if bind.any():
@@ -48,8 +50,23 @@ def oracle_record_step(
         state["enc_offset"] = np.where(bind, values, state["enc_offset"]).astype(np.float32)
         state["enc_bound"] = state["enc_bound"] | bind
     sdr = encode_record(cfg, values, int(ts_unix), state["enc_offset"], state["enc_resolution"])
+    # TM active cells at t-1: TMOracle rebinds (not mutates) prev_active, so
+    # the snapshot needs no copy; only taken when a classifier will read it
+    pattern_prev = state["prev_active"].reshape(-1) if classifier is not None else None
     active = sp_compute(state, sdr, cfg.sp, learn)
-    return tm.compute(active, learn)
+    raw = tm.compute(active, learn)
+    if classifier is None:
+        return raw
+    from rtap_tpu.models.oracle.classifier import classifier_bucket
+
+    bucket = classifier_bucket(
+        float(values[0]), float(state["enc_offset"][0]),
+        float(state["enc_resolution"][0]), cfg.classifier.buckets,
+    )
+    pred, prob = classifier.compute(
+        pattern_prev, state["prev_active"].reshape(-1), bucket, float(values[0]), learn
+    )
+    return raw, pred, prob
 
 
 @dataclass
@@ -59,6 +76,8 @@ class ModelResult:
     raw_score: float  # 1 - |active ∩ predicted| / |active|
     likelihood: float  # rolling-Gaussian tail probability complement
     log_likelihood: float  # NuPIC log-scaled likelihood (the detection score)
+    prediction: float | None = None  # predicted next value (SDR classifier)
+    prediction_prob: float | None = None  # probability of the argmax bucket
 
 
 class HTMModel:
@@ -72,8 +91,13 @@ class HTMModel:
         self.seed = seed
         self.state = init_state(cfg, seed)
         self.likelihood = AnomalyLikelihood(cfg.likelihood)
+        self._classifier = None
         if backend == "cpu":
             self._tm = TMOracle(self.state, cfg.tm)
+            if cfg.classifier.enabled:
+                from rtap_tpu.models.oracle.classifier import SDRClassifierOracle
+
+                self._classifier = SDRClassifierOracle(self.state, cfg.classifier)
         else:
             from rtap_tpu.ops.step import TpuStepRunner  # deferred: jax import
 
@@ -83,15 +107,25 @@ class HTMModel:
         """Process one record; returns scores. Mirrors model.run({...})."""
         values = np.atleast_1d(np.asarray(value, np.float32))
 
+        pred = prob = None
         if self.backend == "cpu":
-            raw = oracle_record_step(self.cfg, self.state, self._tm, values, int(timestamp), learn)
+            out = oracle_record_step(
+                self.cfg, self.state, self._tm, values, int(timestamp), learn,
+                classifier=self._classifier,
+            )
+            raw = out if self._classifier is None else out[0]
+            if self._classifier is not None:
+                pred, prob = out[1], out[2]
         else:
             # the tpu path performs the offset bind on device
             # (ops/encoders_tpu.bind_offsets) against its own state copy
-            raw = self._runner.step(values, int(timestamp), learn)
+            out = self._runner.step(values, int(timestamp), learn)
+            raw = out if not self.cfg.classifier.enabled else out[0]
+            if self.cfg.classifier.enabled:
+                pred, prob = out[1], out[2]
 
         lik, loglik = self.likelihood.update(float(raw))
-        return ModelResult(float(raw), lik, loglik)
+        return ModelResult(float(raw), lik, loglik, pred, prob)
 
 
 def create_model(

@@ -1,0 +1,90 @@
+"""SDR classifier — numpy oracle (SURVEY.md C10).
+
+Semantics per the public NuPIC SDRClassifier (`sdr_classifier.py` /
+`SDRClassifier.cpp`): a single-layer softmax regression from active-cell
+patterns to encoder buckets, trained one-step-ahead — at record t the
+pattern observed at t-1 is pushed toward the bucket of the value seen at t
+(error = onehot(bucket) - softmax(logits), plain SGD), and inference applies
+the pattern at t to produce the distribution for t+1. Per-bucket "actual
+values" are tracked with an exponential moving average; the predicted value
+is the actual value of the argmax bucket.
+
+Deliberate deviations (shared with the device twin, ops/classifier_tpu.py):
+
+- fixed bucket window [0, buckets) instead of NuPIC's growable bucket dict
+  (static shapes; offset binding centers the stream's first value, and the
+  NAB resolution rule spans the expected range in ~130 buckets, so clamping
+  only triggers on out-of-range excursions);
+- steps fixed at 1 (the reference's OPF models predict the next record);
+- arithmetic in float32 to mirror the device kernel (parity is tested to
+  float tolerance — softmax/exp may differ by ulps across backends).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.config import ClassifierConfig
+
+
+def classifier_bucket(
+    value: float, offset: float, resolution: float, n_buckets: int
+) -> int:
+    """Classifier bucket for one value: the RDSE bucket (f32 arithmetic,
+    identical to the encoder's) shifted to center the window, clamped."""
+    b = np.round(
+        (np.float32(value) - np.float32(offset)) / np.float32(resolution)
+    )
+    if not np.isfinite(b):
+        b = 0.0
+    return int(np.clip(b + n_buckets // 2, 0, n_buckets - 1))
+
+
+class SDRClassifierOracle:
+    """Per-record classifier compute over the shared state dict.
+
+    Operates in place on the same ``cls_w`` / ``cls_val`` / ``cls_cnt``
+    arrays that models/state.py allocates (and the device kernel carries),
+    mirroring how TMOracle shares the TM pools — one state layout for both
+    backends, one checkpoint path."""
+
+    def __init__(self, state: dict, cfg: ClassifierConfig):
+        self.state = state
+        self.cfg = cfg
+
+    def _softmax(self, pattern_flat: np.ndarray) -> np.ndarray:
+        z = pattern_flat.astype(np.float32) @ self.state["cls_w"]  # [B]
+        z = z - z.max()
+        e = np.exp(z, dtype=np.float32)
+        return e / e.sum(dtype=np.float32)
+
+    def compute(
+        self,
+        pattern_prev: np.ndarray,  # bool [n_cells] — active cells at t-1
+        pattern_now: np.ndarray,  # bool [n_cells] — active cells at t
+        bucket: int,  # classifier bucket of the value at t
+        value: float,  # the value at t
+        learn: bool = True,
+    ) -> tuple[float, float]:
+        """-> (predicted value for t+1, probability of the argmax bucket)."""
+        cfg = self.cfg
+        act_value, act_count = self.state["cls_val"], self.state["cls_cnt"]
+        if learn and np.isfinite(value):
+            # actual-value EMA for the observed bucket (first touch sets it)
+            if act_count[bucket] == 0:
+                act_value[bucket] = np.float32(value)
+            else:
+                act_value[bucket] = np.float32(
+                    (1.0 - np.float32(cfg.act_value_alpha)) * act_value[bucket]
+                    + np.float32(cfg.act_value_alpha) * np.float32(value)
+                )
+            act_count[bucket] += 1
+            if pattern_prev.any():
+                p = self._softmax(pattern_prev)
+                err = -p
+                err[bucket] += 1.0
+                self.state["cls_w"][pattern_prev] += np.float32(cfg.alpha) * err[None, :]
+
+        probs = self._softmax(pattern_now)
+        best = int(np.argmax(probs))  # first max, matching device argmax
+        return float(act_value[best]), float(probs[best])

@@ -81,4 +81,14 @@ def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
         "enc_offset": np.zeros(cfg.n_fields, np.float32),
         "enc_bound": np.zeros(cfg.n_fields, bool),
         "enc_resolution": np.full(cfg.n_fields, cfg.rdse.resolution, np.float32),
+        # SDR classifier (SURVEY.md C10), present only when enabled
+        **(
+            {
+                "cls_w": np.zeros((C * K, cfg.classifier.buckets), np.float32),
+                "cls_val": np.zeros(cfg.classifier.buckets, np.float32),
+                "cls_cnt": np.zeros(cfg.classifier.buckets, np.int32),
+            }
+            if cfg.classifier.enabled
+            else {}
+        ),
     }

@@ -1,0 +1,84 @@
+"""SDR classifier — device kernel (functional twin of oracle/classifier.py).
+
+The reference's SDRClassifier.cpp is a sparse-pattern softmax regression
+(SURVEY.md C10). TPU-native layout: per stream a dense weight matrix
+[num_cells, buckets]; the pattern->logits contraction and the outer-product
+SGD update are MXU matmuls over the 0/1 pattern vector, fused into the
+per-record step (ops/step.py) so prediction costs no extra dispatch.
+
+State keys (models/state.py, present only when cfg.classifier.enabled):
+    cls_w     f32 [num_cells, buckets]
+    cls_val   f32 [buckets]   per-bucket actual-value EMA
+    cls_cnt   i32 [buckets]   per-bucket observation count
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from rtap_tpu.config import ModelConfig
+
+
+def classifier_bucket_device(
+    value: jnp.ndarray, offset: jnp.ndarray, resolution: jnp.ndarray, n_buckets: int
+) -> jnp.ndarray:
+    """Classifier bucket (scalar i32) — same f32 arithmetic as the oracle."""
+    b = jnp.round((value - offset) / resolution)
+    b = jnp.where(jnp.isfinite(b), b, 0.0)
+    return jnp.clip(b + n_buckets // 2, 0, n_buckets - 1).astype(jnp.int32)
+
+
+def classifier_step(
+    state: dict,
+    pattern_prev: jnp.ndarray,  # bool [C, K] — active cells at t-1
+    pattern_now: jnp.ndarray,  # bool [C, K] — active cells at t
+    value: jnp.ndarray,  # scalar f32, the predicted field's value at t
+    cfg: ModelConfig,
+    learn: bool,
+):
+    """-> (new_state, predicted value for t+1 (f32), argmax-bucket prob)."""
+    ccfg = cfg.classifier
+    B = ccfg.buckets
+    w = state["cls_w"]
+    act_value = state["cls_val"]
+    act_count = state["cls_cnt"]
+
+    bucket = classifier_bucket_device(
+        value, state["enc_offset"][0], state["enc_resolution"][0], B
+    )
+    oh = jnp.arange(B, dtype=jnp.int32) == bucket  # [B]
+    finite = jnp.isfinite(value)
+
+    if learn:
+        # actual-value EMA for the observed bucket (first touch sets it)
+        a = jnp.float32(ccfg.act_value_alpha)
+        # one-hot count probe, not a scalar gather (vmapped gathers serialize)
+        first = jnp.where(oh, act_count, 0).sum() == 0
+        upd = jnp.where(first, value, (1.0 - a) * act_value + a * value)
+        act_value = jnp.where(oh & finite, upd, act_value)
+        act_count = act_count + (oh & finite)
+
+        pat = pattern_prev.reshape(-1).astype(jnp.float32)  # [N]
+        z = jax.lax.dot(pat, w, precision=jax.lax.Precision.HIGHEST)  # [B]
+        z = z - z.max()
+        e = jnp.exp(z)
+        p = e / e.sum()
+        err = oh.astype(jnp.float32) - p
+        do_learn = finite & pattern_prev.any()
+        w = w + jnp.where(
+            do_learn, jnp.float32(ccfg.alpha), 0.0
+        ) * pat[:, None] * err[None, :]
+
+    pat_now = pattern_now.reshape(-1).astype(jnp.float32)
+    z2 = jax.lax.dot(pat_now, w, precision=jax.lax.Precision.HIGHEST)
+    z2 = z2 - z2.max()
+    e2 = jnp.exp(z2)
+    p2 = e2 / e2.sum()
+    best = jnp.argmax(p2)  # first max, matching the oracle
+    best_oh = jnp.arange(B, dtype=jnp.int32) == best
+    pred = jnp.where(best_oh, act_value, 0.0).sum()
+    conf = jnp.where(best_oh, p2, 0.0).sum()
+
+    new_state = {**state, "cls_w": w, "cls_val": act_value, "cls_cnt": act_count}
+    return new_state, pred, conf

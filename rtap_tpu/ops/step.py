@@ -35,15 +35,27 @@ from rtap_tpu.ops.tm_tpu import tm_step
 
 
 def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
-    """One fused record step -> (new_state, raw f32). Pure/traceable.
+    """One fused record step -> (new_state, out). Pure/traceable.
 
     `values` is [n_fields] f32 (NaN = missing sample), `ts_unix` scalar i32.
+    `out` is the raw anomaly score (f32 scalar), or the tuple
+    (raw, predicted_value, prediction_prob) when the SDR classifier is
+    enabled (cfg.classifier.enabled — a static property, so call sites can
+    unpack unconditionally for a given config).
     """
     enc_offset, enc_bound = bind_offsets(values, state["enc_offset"], state["enc_bound"])
     state = {**state, "enc_offset": enc_offset, "enc_bound": enc_bound}
     sdr = encode_device(cfg, values, ts_unix, enc_offset, state["enc_resolution"])
+    pattern_prev = state["prev_active"]  # TM active cells at t-1
     state, active = sp_step(state, sdr, cfg.sp, learn)
     state, raw = tm_step(state, active, cfg.tm, learn)
+    if cfg.classifier.enabled:
+        from rtap_tpu.ops.classifier_tpu import classifier_step
+
+        state, pred, conf = classifier_step(
+            state, pattern_prev, state["prev_active"], values[0], cfg, learn
+        )
+        return state, (raw, pred, conf)
     return state, raw
 
 
@@ -151,7 +163,11 @@ class TpuStepRunner:
         self.cfg = cfg
         self.state = jax.device_put(state)
 
-    def step(self, values: np.ndarray, ts_unix: int, learn: bool = True) -> float:
+    def step(self, values: np.ndarray, ts_unix: int, learn: bool = True):
+        """-> raw score (float), or (raw, prediction, prob) floats when the
+        SDR classifier is enabled (static per config)."""
         v = jnp.asarray(np.atleast_1d(values), jnp.float32)
-        self.state, raw = fused_step(self.state, v, jnp.int32(ts_unix), self.cfg, learn)
-        return float(raw)
+        self.state, out = fused_step(self.state, v, jnp.int32(ts_unix), self.cfg, learn)
+        if self.cfg.classifier.enabled:
+            return float(out[0]), float(out[1]), float(out[2])
+        return float(out)

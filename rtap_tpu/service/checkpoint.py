@@ -38,6 +38,8 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
         model_state = {k: np.asarray(v) for k, v in jax.device_get(grp.state).items()}
         tree = {"model": model_state}
     else:
+        # per-stream state dicts include classifier cls_* arrays when enabled
+        # (the oracle operates on the shared state layout, like TMOracle)
         tree = {"model": {f"s{g}": grp._states[g] for g in range(grp.G)}}
     tree["likelihood"] = grp.likelihood.state_dict()
 

@@ -27,6 +27,7 @@ class ReplayResult:
     raw: np.ndarray  # [T, N] f32
     log_likelihood: np.ndarray  # [T, N] f64
     alerts: np.ndarray  # [T, N] bool
+    predictions: np.ndarray | None = None  # [T, N] f32 when classifier enabled
     throughput: dict = field(default_factory=dict)
 
 
@@ -66,6 +67,7 @@ def replay_streams(
     raw = np.empty((T, n), np.float32)
     loglik = np.empty((T, n), np.float64)
     alerts = np.zeros((T, n), bool)
+    preds = np.empty((T, n), np.float32) if cfg.classifier.enabled else None
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
 
@@ -87,6 +89,8 @@ def replay_streams(
             raw[t0:t1, lo : lo + live] = r[:, :live]
             loglik[t0:t1, lo : lo + live] = ll[:, :live]
             alerts[t0:t1, lo : lo + live] = al[:, :live]
+            if preds is not None:
+                preds[t0:t1, lo : lo + live] = grp.last_predictions[:, :live]
             counter.add((t1 - t0) * live)
             for i in range(t0, t1):
                 writer.emit_batch(sids, gt[i, :live], gv[i, :live],
@@ -99,6 +103,7 @@ def replay_streams(
         raw=raw,
         log_likelihood=loglik,
         alerts=alerts,
+        predictions=preds,
         throughput={**counter.stats(), "alerts": writer.count},
     )
 

@@ -30,6 +30,7 @@ class TickResult:
     likelihood: np.ndarray  # [G] f64
     log_likelihood: np.ndarray  # [G] f64
     alerts: np.ndarray  # [G] bool
+    prediction: np.ndarray | None = None  # [G] f32, when the classifier is on
 
 
 class StreamGroup:
@@ -52,6 +53,9 @@ class StreamGroup:
         self.mesh = mesh
         self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
         self.ticks = 0
+        # latest predicted values [T, G] (classifier only); kept in sync by
+        # both run_chunk and tick so it can never serve stale data
+        self.last_predictions: np.ndarray | None = None
         if backend == "tpu":
             import jax
 
@@ -71,16 +75,29 @@ class StreamGroup:
 
             self._states = [init_state(cfg, seed) for _ in range(self.G)]
             self._tms = [TMOracle(s, cfg.tm) for s in self._states]
+            self._classifiers = None
+            if cfg.classifier.enabled:
+                from rtap_tpu.models.oracle.classifier import SDRClassifierOracle
 
-    def _raw_cpu(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> np.ndarray:
+                self._classifiers = [
+                    SDRClassifierOracle(s, cfg.classifier) for s in self._states
+                ]
+
+    def _raw_cpu(self, values: np.ndarray, ts: np.ndarray, learn: bool = True):
         from rtap_tpu.models.htm_model import oracle_record_step
 
         raw = np.empty(self.G, np.float32)
+        pred = np.empty(self.G, np.float32) if self._classifiers else None
         for g in range(self.G):
-            raw[g] = oracle_record_step(
-                self.cfg, self._states[g], self._tms[g], values[g], int(ts[g]), learn
+            out = oracle_record_step(
+                self.cfg, self._states[g], self._tms[g], values[g], int(ts[g]), learn,
+                classifier=self._classifiers[g] if self._classifiers else None,
             )
-        return raw
+            if self._classifiers:
+                raw[g], pred[g] = out[0], out[1]
+            else:
+                raw[g] = out
+        return raw, pred
 
     def _put(self, x: np.ndarray, axis: int = 0):
         """Host array -> device, sharded on the stream axis when meshed.
@@ -102,45 +119,62 @@ class StreamGroup:
         if values.ndim == 1:
             values = values[:, None]
         ts = np.broadcast_to(np.asarray(ts, np.int32), (self.G,))
+        pred = None
         if self.backend == "tpu":
             if self.mesh is not None:
                 from rtap_tpu.ops.step import sharded_chunk_step
 
-                self.state, raw = sharded_chunk_step(
+                self.state, out = sharded_chunk_step(
                     self.state, self._put(values[None], axis=1),
                     self._put(ts[None].astype(np.int32), axis=1), self.cfg, self.mesh,
                     learn=learn,
                 )
-                raw = np.asarray(raw)[0]
+                raw, pred = self._unpack_out(out, time_axis=True)
             else:
                 from rtap_tpu.ops.step import group_step
 
-                self.state, raw = group_step(
+                self.state, out = group_step(
                     self.state, self._put(values), self._put(ts.astype(np.int32)), self.cfg,
                     learn=learn,
                 )
-                raw = np.asarray(raw)
+                raw, pred = self._unpack_out(out, time_axis=False)
         else:
-            raw = self._raw_cpu(values, ts, learn)
+            raw, pred = self._raw_cpu(values, ts, learn)
+        self.last_predictions = None if pred is None else pred[None, :]
         self.ticks += 1
         lik, loglik = self.likelihood.update(raw)
-        return TickResult(raw, lik, loglik, loglik >= self.threshold)
+        return TickResult(raw, lik, loglik, loglik >= self.threshold, pred)
+
+    def _unpack_out(self, out, time_axis: bool):
+        """Device step output -> (raw [G], pred [G]|None); strips the leading
+        1-tick time axis of the sharded path when present."""
+        if self.cfg.classifier.enabled:
+            raw, pred = np.asarray(out[0]), np.asarray(out[1])
+        else:
+            raw, pred = np.asarray(out), None
+        if time_axis:
+            raw = raw[0]
+            pred = None if pred is None else pred[0]
+        return raw, pred
 
     def run_chunk(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Replay T ticks in one device dispatch (TPU backend only).
 
         `values` [T, G] or [T, G, n_fields], `ts` [T, G] ->
-        (raw [T, G], log_likelihood [T, G], alerts [T, G]).
+        (raw [T, G], log_likelihood [T, G], alerts [T, G]). When the SDR
+        classifier is enabled, per-tick predicted values land in
+        `self.last_predictions` [T, G].
         """
         values = np.asarray(values, np.float32)
         if values.ndim == 2:
             values = values[..., None]
         T = values.shape[0]
+        pred = None
         if self.backend == "tpu":
             if self.mesh is not None:
                 from rtap_tpu.ops.step import sharded_chunk_step
 
-                self.state, raw = sharded_chunk_step(
+                self.state, out = sharded_chunk_step(
                     self.state, self._put(values, axis=1),
                     self._put(ts.astype(np.int32), axis=1), self.cfg, self.mesh,
                     learn=learn,
@@ -148,13 +182,17 @@ class StreamGroup:
             else:
                 from rtap_tpu.ops.step import chunk_step
 
-                self.state, raw = chunk_step(
+                self.state, out = chunk_step(
                     self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1),
                     self.cfg, learn=learn,
                 )
-            raw = np.asarray(raw)
+            raw, pred = self._unpack_out(out, time_axis=False)
         else:
-            raw = np.stack([self._raw_cpu(values[i], np.asarray(ts[i]), learn) for i in range(T)])
+            outs = [self._raw_cpu(values[i], np.asarray(ts[i]), learn) for i in range(T)]
+            raw = np.stack([o[0] for o in outs])
+            if self.cfg.classifier.enabled:
+                pred = np.stack([o[1] for o in outs])
+        self.last_predictions = pred
         self.ticks += T
         loglik = np.empty((T, self.G))
         for i in range(T):

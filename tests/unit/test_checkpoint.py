@@ -85,3 +85,25 @@ def test_from_dict_drops_retired_fields_and_clamps_col_cap():
     old["tm"]["col_cap"] = 8  # pre-col_cap checkpoint migrated too low
     cfg = ModelConfig.from_dict(old)
     assert cfg.tm.col_cap == 40
+
+
+@pytest.mark.parametrize("backend", ["tpu", "cpu"])
+def test_checkpoint_roundtrip_with_classifier(backend, tmp_path):
+    """Classifier weights/actual-values resume with the group: predictions
+    after resume match the uninterrupted run exactly."""
+    from tests.unit.test_classifier import _cfg, _periodic_values
+
+    cfg = _cfg()
+    ids = ["a", "b"]
+    vals = _periodic_values(120)
+    ref = StreamGroup(cfg, ids, backend=backend)
+    for i in range(60):
+        ref.tick(np.array([vals[i], vals[i] + 1], np.float32), 1_700_000_000 + i)
+    save_group(ref, tmp_path / "g")
+    resumed = load_group(tmp_path / "g")
+    for i in range(60, 120):
+        v = np.array([vals[i], vals[i] + 1], np.float32)
+        r_ref = ref.tick(v, 1_700_000_000 + i)
+        r_res = resumed.tick(v, 1_700_000_000 + i)
+        np.testing.assert_array_equal(r_ref.raw, r_res.raw, err_msg=f"tick {i}")
+        np.testing.assert_array_equal(r_ref.prediction, r_res.prediction, err_msg=f"tick {i}")
