@@ -30,13 +30,15 @@ from rtap_tpu.config import ClassifierConfig
 def classifier_bucket(
     value: float, offset: float, resolution: float, n_buckets: int
 ) -> int:
-    """Classifier bucket for one value: the RDSE bucket (f32 arithmetic,
-    identical to the encoder's) shifted to center the window, clamped."""
-    b = np.round(
-        (np.float32(value) - np.float32(offset)) / np.float32(resolution)
-    )
-    if not np.isfinite(b):
-        b = 0.0
+    """Classifier bucket for one value: the RDSE bucket (same f32 arithmetic
+    and overflow clamping — reuses encoders.rdse_bucket) shifted to center
+    the window and clamped to [0, n_buckets). Non-finite values map to the
+    center bucket (relative bucket 0)."""
+    from rtap_tpu.models.oracle.encoders import rdse_bucket
+
+    if not np.isfinite(value):
+        return n_buckets // 2
+    b = int(rdse_bucket(value, offset, resolution))
     return int(np.clip(b + n_buckets // 2, 0, n_buckets - 1))
 
 

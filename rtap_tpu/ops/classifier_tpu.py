@@ -23,9 +23,15 @@ from rtap_tpu.config import ModelConfig
 def classifier_bucket_device(
     value: jnp.ndarray, offset: jnp.ndarray, resolution: jnp.ndarray, n_buckets: int
 ) -> jnp.ndarray:
-    """Classifier bucket (scalar i32) — same f32 arithmetic as the oracle."""
+    """Classifier bucket (scalar i32) — same f32 arithmetic, overflow
+    clamping, and non-finite handling as the oracle's classifier_bucket."""
+    from rtap_tpu.config import RDSE_BUCKET_CLAMP
+
     b = jnp.round((value - offset) / resolution)
-    b = jnp.where(jnp.isfinite(b), b, 0.0)
+    # overflowed-but-finite-value divisions clamp to the edge (RDSE rule);
+    # non-finite values (NaN propagates through clip) map to relative 0
+    b = jnp.clip(b, -RDSE_BUCKET_CLAMP, RDSE_BUCKET_CLAMP)
+    b = jnp.where(jnp.isfinite(value) & jnp.isfinite(b), b, 0.0)
     return jnp.clip(b + n_buckets // 2, 0, n_buckets - 1).astype(jnp.int32)
 
 

@@ -12,8 +12,9 @@ scaling problem (SURVEY.md §5 "Long-context").
 from rtap_tpu.parallel.sharding import (
     init_distributed,
     make_stream_mesh,
+    put_sharded,
     shard_state,
     stream_sharding,
 )
 
-__all__ = ["init_distributed", "make_stream_mesh", "shard_state", "stream_sharding"]
+__all__ = ["init_distributed", "make_stream_mesh", "put_sharded", "shard_state", "stream_sharding"]

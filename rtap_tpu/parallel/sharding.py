@@ -58,20 +58,34 @@ def stream_sharding(mesh, ndim: int, axis: int = 0):
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
-def shard_state(state: dict, mesh) -> dict:
-    """device_put every leaf of a group state pytree with its leading axis
-    sharded over the mesh. Group size must be divisible by the mesh size
-    (the registry pads groups to a fixed size, so pick group_size as a
-    multiple of the chip count)."""
+def put_sharded(value: np.ndarray, mesh, axis: int = 0):
+    """Host array -> device array sharded on `axis` over the stream mesh.
+
+    Single-process: a plain device_put. Multi-process (DCN: one process per
+    host after init_distributed): jax.make_array_from_callback, where each
+    process materializes only the shards its local devices own — the
+    supported way to build a global array across hosts (device_put of a
+    global numpy array raises on non-addressable devices).
+    """
     import jax
 
+    value = np.asarray(value)
+    sharding = stream_sharding(mesh, max(np.ndim(value), 1), axis)
+    if jax.process_count() == 1:
+        return jax.device_put(value, sharding)
+    return jax.make_array_from_callback(value.shape, sharding, lambda idx: value[idx])
+
+
+def shard_state(state: dict, mesh) -> dict:
+    """Shard every leaf of a group state pytree on its leading (stream) axis
+    over the mesh. Group size must be divisible by the mesh size (the
+    registry pads groups to a fixed size, so pick group_size as a multiple
+    of the chip count). Works single-process and multi-host (see
+    :func:`put_sharded`)."""
     n = mesh.devices.size
     for k, v in state.items():
         if np.shape(v) and np.shape(v)[0] % n:
             raise ValueError(
                 f"state leaf {k!r} group axis {np.shape(v)[0]} not divisible by mesh size {n}"
             )
-    return {
-        k: jax.device_put(v, stream_sharding(mesh, max(np.ndim(v), 1)))
-        for k, v in state.items()
-    }
+    return {k: put_sharded(v, mesh) for k, v in state.items()}

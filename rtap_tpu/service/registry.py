@@ -109,9 +109,9 @@ class StreamGroup:
 
         if self.mesh is None:
             return jnp.asarray(x)
-        from rtap_tpu.parallel.sharding import stream_sharding
+        from rtap_tpu.parallel.sharding import put_sharded
 
-        return jax.device_put(np.asarray(x), stream_sharding(self.mesh, np.ndim(x), axis))
+        return put_sharded(np.asarray(x), self.mesh, axis)
 
     def tick(self, values: np.ndarray, ts: np.ndarray | int, learn: bool = True) -> TickResult:
         """Score one tick. `values` [G] or [G, n_fields]; `ts` scalar or [G]."""

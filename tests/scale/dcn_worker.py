@@ -1,0 +1,65 @@
+"""Worker for the 2-process DCN smoke test (launched by test_dcn.py).
+
+Each process is one "host": jax.distributed.initialize over a localhost
+coordinator, 2 virtual CPU devices per process -> a 4-device global mesh.
+Runs the production sharded stream-group step end to end and prints the
+process-local raw-score shard checksum for the parent to compare.
+
+Usage: python dcn_worker.py <coordinator> <num_processes> <process_id>
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, num_processes, process_id = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+    ).strip()
+
+    import numpy as np
+
+    from rtap_tpu.parallel import init_distributed, make_stream_mesh, put_sharded, shard_state
+
+    init_distributed(coordinator, num_processes, process_id)
+
+    import jax
+
+    assert jax.process_count() == num_processes, jax.process_count()
+    n_dev = len(jax.devices())
+    assert n_dev == 2 * num_processes, n_dev
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.models.state import init_state
+    from rtap_tpu.ops.step import replicate_state, sharded_chunk_step
+
+    cfg = cluster_preset()
+    mesh = make_stream_mesh()
+    G, T = 2 * n_dev, 3
+    state = shard_state(replicate_state(init_state(cfg, seed=0), G), mesh)
+    rng = np.random.Generator(np.random.Philox(key=(7, 3)))
+    values = put_sharded(
+        (30 + 5 * rng.random((T, G, cfg.n_fields))).astype(np.float32), mesh, axis=1
+    )
+    ts = put_sharded(
+        (1_700_000_000 + np.arange(T)[:, None] + np.zeros((1, G))).astype(np.int32),
+        mesh, axis=1,
+    )
+    state, raw = sharded_chunk_step(state, values, ts, cfg, mesh)
+    # every process holds only its addressable shards of the global [T, G] raw
+    local = np.concatenate(
+        [np.asarray(s.data) for s in sorted(raw.addressable_shards, key=lambda s: s.index[1].start)],
+        axis=1,
+    )
+    assert np.isfinite(local).all(), local
+    print(f"DCN_OK p{process_id} shard_sum={float(local.sum()):.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
