@@ -40,11 +40,26 @@ class SyntheticStreamConfig:
     n_anomalies: int = 3
     anomaly_magnitude: float = 4.0  # in units of (scaled) noise sigma
     noise_scale: float = 1.0  # multiplier on the metric's noise sigma
+    # AR(1) coefficient of the noise: real node metrics are autocorrelated
+    # (load moves smoothly), not white. 0 = iid Gaussian (legacy default);
+    # ~0.85 makes per-tick deltas small relative to the stationary sigma, the
+    # regime where an HTM at NAB-rule resolution can learn the baseline.
+    noise_phi: float = 0.0
     # which fault kinds to inject; "drift" and "stuck" are near-invisible to
     # point-anomaly detectors by design (gradual / too-regular) — include them
     # only when evaluating that hard class
     kinds: tuple[str, ...] = ANOMALY_KINDS
     start_unix: int = 1_700_000_000
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """Ground truth for one injected fault (SURVEY.md §3.5 eval unit)."""
+
+    kind: str  # one of ANOMALY_KINDS
+    onset: int  # unix sec the fault begins
+    end: int  # unix sec the injected interval ends
+    window: tuple[int, int]  # labeled detection window (onset/end + margin)
 
 
 @dataclass
@@ -55,6 +70,7 @@ class LabeledStream:
     timestamps: np.ndarray  # int64 unix seconds, [T]
     values: np.ndarray  # float32, [T]
     windows: list[tuple[int, int]] = field(default_factory=list)  # unix-sec spans
+    events: list[FaultEvent] = field(default_factory=list)  # kind-labeled faults
 
 
 def _rng_for(seed: int, stream_id: str) -> np.random.Generator:
@@ -81,13 +97,20 @@ def generate_stream(
     t_idx = np.arange(cfg.length, dtype=np.float64)
     t_unix = (cfg.start_unix + t_idx * cfg.cadence_s).astype(np.int64)
     phase = rng.uniform(0, 2 * np.pi)
+    noise = rng.normal(0.0, sigma, cfg.length)
+    if cfg.noise_phi > 0.0:
+        # AR(1) with stationary std == sigma: x_t = phi*x_{t-1} + eps*sqrt(1-phi^2)
+        noise *= np.sqrt(1.0 - cfg.noise_phi**2)
+        for i in range(1, cfg.length):
+            noise[i] += cfg.noise_phi * noise[i - 1]
     signal = (
         base
         + amp * np.sin(2 * np.pi * t_idx * cfg.cadence_s / cfg.period_s + phase)
-        + rng.normal(0.0, sigma, cfg.length)
+        + noise
     )
 
     windows: list[tuple[int, int]] = []
+    events: list[FaultEvent] = []
     if cfg.n_anomalies > 0:
         # keep injections clear of the likelihood probation region (~15%)
         lo = int(cfg.length * 0.25)
@@ -110,13 +133,15 @@ def generate_stream(
             elif kind == "dropout":
                 signal[s:e] = 0.0
             margin = max(2, dur // 2)
-            windows.append((int(t_unix[max(0, s - margin)]), int(t_unix[min(cfg.length - 1, e + margin)])))
+            win = (int(t_unix[max(0, s - margin)]), int(t_unix[min(cfg.length - 1, e + margin)]))
+            windows.append(win)
+            events.append(FaultEvent(kind, int(t_unix[s]), int(t_unix[e]), win))
 
     if clip[0] is not None:
         signal = np.maximum(signal, clip[0])
     if clip[1] is not None:
         signal = np.minimum(signal, clip[1])
-    return LabeledStream(stream_id, t_unix, signal.astype(np.float32), windows)
+    return LabeledStream(stream_id, t_unix, signal.astype(np.float32), windows, events)
 
 
 def generate_cluster(

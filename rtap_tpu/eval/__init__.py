@@ -1,0 +1,12 @@
+"""Detection-quality evaluation (SURVEY.md §3.5 + §6).
+
+The reference's "real" eval injects faults into a live cluster and measures
+whether the anomaly-likelihood alert fired around the fault onset (lead
+time, precision). Here the monitored cluster is the synthetic generator
+(rtap_tpu/data/synthetic.py) with kind-labeled fault events, and the
+measurement is :mod:`rtap_tpu.eval.fault_eval`.
+"""
+
+from rtap_tpu.eval.fault_eval import FaultEvalReport, run_fault_eval
+
+__all__ = ["FaultEvalReport", "run_fault_eval"]
