@@ -8,74 +8,186 @@ target of 100k concurrent 1s-cadence streams scored on a single chip
 (BASELINE.json), so vs_baseline = value / 100_000.
 
 Prints exactly ONE JSON line on stdout; progress goes to stderr.
+
+Unkillable-by-design (round-2 postmortem: a single slow G=2048 compile
+starved every fallback and the round ended with rc=124 and no number):
+
+- every attempt runs in a SUBPROCESS with a hard wall-clock budget, so one
+  hung compile or a wedged TPU tunnel can never eat the whole bench window;
+- a guaranteed-cheap config runs FIRST, so a number exists within minutes;
+- the persistent XLA compilation cache is enabled (``.jax_cache/``), so
+  retries and later rounds skip recompilation;
+- transient backend errors (UNAVAILABLE / tunnel flake) get one retry;
+- SIGTERM/SIGINT print the best result so far before exiting — a driver
+  timeout still yields the JSON line.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
-import numpy as np
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+TARGET = 100_000.0  # metrics/sec/chip north star (BASELINE.json)
+
+# (group_size, chunk_ticks): the cheap anchor first, then ascending toward
+# the HBM frontier. Attempt order is also failure-isolation order — a big-G
+# OOM or compile stall costs only its own budget.
+ATTEMPTS = [(256, 64), (2048, 64), (8192, 64), (16384, 64), (32768, 64)]
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def run_bench(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> float:
+# ---------------------------------------------------------------- child ----
+
+
+def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> dict:
     import jax
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    import numpy as np
 
     from rtap_tpu.config import cluster_preset
     from rtap_tpu.service.registry import StreamGroup
 
     cfg = cluster_preset()
     ids = [f"bench{i:06d}" for i in range(group_size)]
+    t0 = time.perf_counter()
     grp = StreamGroup(cfg, ids, backend="tpu")
+    log(f"  state init + device_put: {time.perf_counter() - t0:.1f}s")
 
     rng = np.random.Generator(np.random.Philox(key=(2026, 7)))
     t_idx = np.arange(chunk_ticks)[:, None]
-    base = 35.0 + 20.0 * np.sin(2 * np.pi * (t_idx + rng.integers(0, 86400, group_size)[None, :]) / 86400.0)
+    base = 35.0 + 20.0 * np.sin(
+        2 * np.pi * (t_idx + rng.integers(0, 86400, group_size)[None, :]) / 86400.0
+    )
     vals = (base + rng.normal(0, 3.0, (chunk_ticks, group_size))).astype(np.float32)
     ts = (1_700_000_000 + t_idx + np.zeros((1, group_size))).astype(np.int64)
 
     # warmup: compile + one chunk of real stepping
     t0 = time.perf_counter()
     grp.run_chunk(vals, ts)
-    log(f"warmup (compile + first chunk): {time.perf_counter() - t0:.1f}s")
+    log(f"  warmup (compile + first chunk): {time.perf_counter() - t0:.1f}s")
 
+    # steady state, pipelined: dispatch chunk i+1 before collecting chunk i so
+    # host likelihood + fetch overlap device compute (SURVEY.md §7 hard part 3)
     t0 = time.perf_counter()
-    for i in range(measure_chunks):
-        grp.run_chunk(vals, ts + (i + 1) * chunk_ticks)
+    pending = grp.dispatch_chunk(vals, ts + chunk_ticks)
+    for i in range(1, measure_chunks):
+        nxt = grp.dispatch_chunk(vals, ts + (i + 1) * chunk_ticks)
+        grp.collect_chunk(pending)
+        pending = nxt
+    grp.collect_chunk(pending)
     dt = time.perf_counter() - t0
     scored = measure_chunks * chunk_ticks * group_size
-    return scored / dt
+    return {"value": scored / dt, "G": group_size, "T": chunk_ticks, "wall_s": round(dt, 2)}
 
 
-def main() -> None:
-    target = 100_000.0  # metrics/sec/chip north star (BASELINE.json)
-    attempts = [(2048, 64), (1024, 64), (256, 32), (64, 16)]
-    value = None
-    for group_size, chunk_ticks in attempts:
-        try:
-            log(f"bench attempt: G={group_size}, T={chunk_ticks}")
-            value = run_bench(group_size, chunk_ticks)
-            break
-        except Exception as e:  # OOM / compile failure on small hosts: retry smaller
-            log(f"G={group_size} failed: {type(e).__name__}: {str(e)[:200]}")
-    if value is None:
-        raise SystemExit("all bench configurations failed")
+# --------------------------------------------------------------- parent ----
+
+
+def emit(best: dict | None) -> None:
+    if best is None:
+        return
     print(
         json.dumps(
             {
                 "metric": "anomaly_scored_metrics_per_sec_per_chip",
-                "value": round(value, 1),
+                "value": round(best["value"], 1),
                 "unit": "metrics/s",
-                "vs_baseline": round(value / target, 4),
+                "vs_baseline": round(best["value"] / TARGET, 4),
             }
-        )
+        ),
+        flush=True,
     )
 
 
+def main() -> None:
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    per_attempt = float(os.environ.get("BENCH_ATTEMPT_BUDGET_S", "330"))
+    t_start = time.monotonic()
+    best: dict | None = None
+    done = False
+    current_proc: list = [None]
+
+    def on_signal(signum, frame):
+        log(f"bench: signal {signum}, emitting best-so-far")
+        if current_proc[0] is not None and current_proc[0].poll() is None:
+            current_proc[0].kill()  # never orphan a TPU-holding child
+        if not done:
+            emit(best)
+        sys.exit(0 if best is not None else 1)
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    for group_size, chunk_ticks in ATTEMPTS:
+        remaining = budget - (time.monotonic() - t_start)
+        # never start an attempt we can't give a meaningful slice of budget
+        if remaining < 60:
+            log(f"bench: {remaining:.0f}s left, stopping attempts")
+            break
+        for attempt in range(2):  # one retry on transient backend errors
+            this_budget = min(per_attempt, budget - (time.monotonic() - t_start))
+            if this_budget < 60:
+                break
+            log(f"bench attempt: G={group_size}, T={chunk_ticks} (budget {this_budget:.0f}s)")
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--attempt",
+                 str(group_size), str(chunk_ticks)],
+                stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+            )
+            current_proc[0] = proc
+            try:
+                out, _ = proc.communicate(timeout=this_budget)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                log(f"  G={group_size}: killed at budget ({this_budget:.0f}s)")
+                break  # a timeout is not transient; don't retry, move on
+            finally:
+                current_proc[0] = None
+            res = None
+            if proc.returncode == 0:
+                # last parseable stdout line wins; stray library prints must
+                # never crash the parent and lose an earlier result
+                for line in reversed(out.strip().splitlines()):
+                    try:
+                        cand = json.loads(line)
+                        if isinstance(cand, dict) and "value" in cand:
+                            res = cand
+                            break
+                    except ValueError:
+                        continue
+            if res is not None:
+                log(f"  G={group_size}: {res['value']:.1f} metrics/s")
+                if best is None or res["value"] > best["value"]:
+                    best = res
+                break
+            transient = proc.returncode != 0 and attempt == 0
+            log(f"  G={group_size}: attempt failed rc={proc.returncode}"
+                + (", retrying once" if transient else ""))
+            if not transient:
+                break
+    if best is None:
+        raise SystemExit("all bench configurations failed")
+    emit(best)
+    done = True  # only after the line is out: a late signal must not double-emit
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 2 and sys.argv[1] == "--attempt":
+        g, t = int(sys.argv[2]), int(sys.argv[3])
+        print(json.dumps(run_attempt(g, t)), flush=True)
+    else:
+        main()

@@ -9,6 +9,7 @@ the reference's collector.poll() -> per-stream model.run() service loop.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
@@ -83,9 +84,9 @@ def replay_streams(
         gv[:, :live] = values[:, lo : lo + live]
         gt[:, :live] = ts[:, lo : lo + live]
 
-        for t0 in range(0, T, chunk_ticks):
-            t1 = min(t0 + chunk_ticks, T)
-            r, ll, al = grp.run_chunk(gv[t0:t1], gt[t0:t1], learn=learn)
+        def collect(span, handle):
+            t0, t1 = span
+            r, ll, al = grp.collect_chunk(handle)
             raw[t0:t1, lo : lo + live] = r[:, :live]
             loglik[t0:t1, lo : lo + live] = ll[:, :live]
             alerts[t0:t1, lo : lo + live] = al[:, :live]
@@ -95,6 +96,17 @@ def replay_streams(
             for i in range(t0, t1):
                 writer.emit_batch(sids, gt[i, :live], gv[i, :live],
                                   r[i - t0, :live], ll[i - t0, :live], al[i - t0, :live])
+
+        # depth-2 pipeline: the device computes chunk t+1 while the host
+        # post-processes chunk t (SURVEY.md §7 hard part 3 — overlapped feed)
+        pending: deque = deque()
+        for t0 in range(0, T, chunk_ticks):
+            t1 = min(t0 + chunk_ticks, T)
+            pending.append(((t0, t1), grp.dispatch_chunk(gv[t0:t1], gt[t0:t1], learn=learn)))
+            if len(pending) >= 2:
+                collect(*pending.popleft())
+        while pending:
+            collect(*pending.popleft())
     writer.close()
 
     return ReplayResult(
@@ -122,6 +134,7 @@ def live_loop(
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
     missed = 0
+    latencies = np.empty(n_ticks, np.float64)  # per-tick poll->emit seconds
     live = getattr(group, "n_live", group.G)  # never emit for registry pad slots
     for k in range(n_ticks):
         t_start = time.perf_counter()
@@ -130,11 +143,20 @@ def live_loop(
         writer.emit_batch(group.stream_ids[:live], np.full(live, ts), values[:live],
                           res.raw[:live], res.log_likelihood[:live], res.alerts[:live])
         counter.add(live)
-        budget = cadence_s - (time.perf_counter() - t_start)
+        elapsed = time.perf_counter() - t_start
+        latencies[k] = elapsed
+        budget = cadence_s - elapsed
         if budget < 0:
             missed += 1
         elif k + 1 < n_ticks:
             time.sleep(budget)
     writer.close()
+    lat = {}
+    if n_ticks > 0:
+        lat = {
+            f"latency_p{p}_ms": round(float(np.percentile(latencies, p)) * 1e3, 3)
+            for p in (50, 90, 99)
+        }
+        lat["latency_max_ms"] = round(float(latencies.max()) * 1e3, 3)
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
-            "ticks": n_ticks, "cadence_s": cadence_s}
+            "ticks": n_ticks, "cadence_s": cadence_s, **lat}

@@ -53,6 +53,8 @@ class StreamGroup:
         self.mesh = mesh
         self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
         self.ticks = 0
+        self._seq = 0  # dispatch sequence number (pipelined replay ordering)
+        self._collected = 0
         # latest predicted values [T, G] (classifier only); kept in sync by
         # both run_chunk and tick so it can never serve stale data
         self.last_predictions: np.ndarray | None = None
@@ -157,19 +159,24 @@ class StreamGroup:
             pred = None if pred is None else pred[0]
         return raw, pred
 
-    def run_chunk(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Replay T ticks in one device dispatch (TPU backend only).
+    def dispatch_chunk(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> dict:
+        """Enqueue T ticks on the device WITHOUT blocking on the result.
 
-        `values` [T, G] or [T, G, n_fields], `ts` [T, G] ->
-        (raw [T, G], log_likelihood [T, G], alerts [T, G]). When the SDR
-        classifier is enabled, per-tick predicted values land in
-        `self.last_predictions` [T, G].
+        JAX dispatch is asynchronous: this returns as soon as the transfer +
+        step program are queued, so the host can overlap the previous chunk's
+        likelihood post-process (and the next chunk's staging) with device
+        compute — the double-buffered feed of SURVEY.md §7 hard part 3.
+        Returns an opaque handle for :meth:`collect_chunk`. Handles MUST be
+        collected in dispatch order (the likelihood ring is sequential).
+
+        On the CPU backend there is no async device; the chunk is computed
+        here and the handle carries the finished scores.
         """
         values = np.asarray(values, np.float32)
         if values.ndim == 2:
             values = values[..., None]
         T = values.shape[0]
-        pred = None
+        self._seq += 1
         if self.backend == "tpu":
             if self.mesh is not None:
                 from rtap_tpu.ops.step import sharded_chunk_step
@@ -186,18 +193,43 @@ class StreamGroup:
                     self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1),
                     self.cfg, learn=learn,
                 )
-            raw, pred = self._unpack_out(out, time_axis=False)
+            return {"out": out, "T": T, "seq": self._seq, "device": True}
+        outs = [self._raw_cpu(values[i], np.asarray(ts[i]), learn) for i in range(T)]
+        raw = np.stack([o[0] for o in outs])
+        pred = np.stack([o[1] for o in outs]) if self.cfg.classifier.enabled else None
+        return {"raw": raw, "pred": pred, "T": T, "seq": self._seq, "device": False}
+
+    def collect_chunk(self, handle: dict) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Block on a dispatched chunk -> (raw [T,G], log_likelihood [T,G],
+        alerts [T,G]); classifier predictions land in `self.last_predictions`."""
+        if handle["seq"] != self._collected + 1:
+            raise RuntimeError(
+                f"collect_chunk out of order: handle seq {handle['seq']}, "
+                f"expected {self._collected + 1} (likelihood state is sequential)"
+            )
+        self._collected = handle["seq"]
+        if handle["device"]:
+            raw, pred = self._unpack_out(handle["out"], time_axis=False)
         else:
-            outs = [self._raw_cpu(values[i], np.asarray(ts[i]), learn) for i in range(T)]
-            raw = np.stack([o[0] for o in outs])
-            if self.cfg.classifier.enabled:
-                pred = np.stack([o[1] for o in outs])
+            raw, pred = handle["raw"], handle["pred"]
+        T = handle["T"]
         self.last_predictions = pred
         self.ticks += T
         loglik = np.empty((T, self.G))
         for i in range(T):
             _, loglik[i] = self.likelihood.update(raw[i])
         return raw, loglik, loglik >= self.threshold
+
+    def run_chunk(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Replay T ticks in one device dispatch, synchronously.
+
+        `values` [T, G] or [T, G, n_fields], `ts` [T, G] ->
+        (raw [T, G], log_likelihood [T, G], alerts [T, G]). When the SDR
+        classifier is enabled, per-tick predicted values land in
+        `self.last_predictions` [T, G]. For the overlapped replay fast path
+        use :meth:`dispatch_chunk` + :meth:`collect_chunk` instead.
+        """
+        return self.collect_chunk(self.dispatch_chunk(values, ts, learn))
 
 
 @dataclass
