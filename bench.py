@@ -48,16 +48,33 @@ def log(msg: str) -> None:
 
 
 def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> dict:
+    from rtap_tpu.utils.platform import maybe_force_cpu
+
+    maybe_force_cpu()  # RTAP_FORCE_CPU=1: deterministic CPU (tests/drives)
     import jax
 
     jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-    import numpy as np
+    # The axon sitecustomize selects jax_platforms="axon,cpu": if the TPU
+    # tunnel fast-fails at init, JAX silently falls back to CPU and this
+    # process would report a CPU number as the chip benchmark. Refuse.
+    # (BENCH_ALLOW_CPU=1 exists for driving the bench logic in tests.)
+    backend = jax.default_backend()
+    if backend == "cpu" and os.environ.get("BENCH_ALLOW_CPU") != "1":
+        raise RuntimeError(
+            "TPU backend unavailable (fell back to CPU); refusing to emit a "
+            "CPU number as the per-chip benchmark"
+        )
+    log(f"  backend: {backend} ({jax.devices()[0].device_kind})")
+    marker = os.environ.get("BENCH_INIT_MARKER")
+    if marker:  # tell the parent the backend came up (hang triage)
+        open(marker, "w").close()
 
     from rtap_tpu.config import cluster_preset
     from rtap_tpu.service.registry import StreamGroup
+    from rtap_tpu.utils.measure import make_sine_feed, measure_pipelined
 
     cfg = cluster_preset()
     ids = [f"bench{i:06d}" for i in range(group_size)]
@@ -65,39 +82,32 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
     grp = StreamGroup(cfg, ids, backend="tpu")
     log(f"  state init + device_put: {time.perf_counter() - t0:.1f}s")
 
-    rng = np.random.Generator(np.random.Philox(key=(2026, 7)))
-    t_idx = np.arange(chunk_ticks)[:, None]
-    base = 35.0 + 20.0 * np.sin(
-        2 * np.pi * (t_idx + rng.integers(0, 86400, group_size)[None, :]) / 86400.0
-    )
-    vals = (base + rng.normal(0, 3.0, (chunk_ticks, group_size))).astype(np.float32)
-    ts = (1_700_000_000 + t_idx + np.zeros((1, group_size))).astype(np.int64)
+    vals, ts, _ = make_sine_feed(group_size, chunk_ticks, key=(2026, 7))
 
     # warmup: compile + one chunk of real stepping
     t0 = time.perf_counter()
     grp.run_chunk(vals, ts)
     log(f"  warmup (compile + first chunk): {time.perf_counter() - t0:.1f}s")
 
-    # steady state, pipelined: dispatch chunk i+1 before collecting chunk i so
-    # host likelihood + fetch overlap device compute (SURVEY.md §7 hard part 3)
-    t0 = time.perf_counter()
-    pending = grp.dispatch_chunk(vals, ts + chunk_ticks)
-    for i in range(1, measure_chunks):
-        nxt = grp.dispatch_chunk(vals, ts + (i + 1) * chunk_ticks)
-        grp.collect_chunk(pending)
-        pending = nxt
-    grp.collect_chunk(pending)
-    dt = time.perf_counter() - t0
-    scored = measure_chunks * chunk_ticks * group_size
-    return {"value": scored / dt, "G": group_size, "T": chunk_ticks, "wall_s": round(dt, 2)}
+    # steady state, pipelined (host likelihood + fetch overlap device compute)
+    value, dt = measure_pipelined(grp, vals, ts, measure_chunks)
+    return {"value": value, "G": group_size, "T": chunk_ticks, "wall_s": round(dt, 2)}
 
 
 # --------------------------------------------------------------- parent ----
 
 
+_EMITTED = False
+
+
 def emit(best: dict | None) -> None:
-    if best is None:
+    """Print the single result line. Idempotent — the flag flips BEFORE the
+    print so a signal landing mid-emit can never produce a second line
+    (stdout must carry exactly one JSON object)."""
+    global _EMITTED
+    if best is None or _EMITTED:
         return
+    _EMITTED = True
     print(
         json.dumps(
             {
@@ -116,15 +126,13 @@ def main() -> None:
     per_attempt = float(os.environ.get("BENCH_ATTEMPT_BUDGET_S", "330"))
     t_start = time.monotonic()
     best: dict | None = None
-    done = False
     current_proc: list = [None]
 
     def on_signal(signum, frame):
         log(f"bench: signal {signum}, emitting best-so-far")
         if current_proc[0] is not None and current_proc[0].poll() is None:
             current_proc[0].kill()  # never orphan a TPU-holding child
-        if not done:
-            emit(best)
+        emit(best)  # idempotent: no-op if the line already went out
         sys.exit(0 if best is not None else 1)
 
     signal.signal(signal.SIGTERM, on_signal)
@@ -142,10 +150,14 @@ def main() -> None:
             if this_budget < 60:
                 break
             log(f"bench attempt: G={group_size}, T={chunk_ticks} (budget {this_budget:.0f}s)")
+            marker = os.path.join(CACHE_DIR, f".init_ok.{os.getpid()}")
+            if os.path.exists(marker):
+                os.unlink(marker)
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--attempt",
                  str(group_size), str(chunk_ticks)],
                 stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
+                env={**os.environ, "BENCH_INIT_MARKER": marker},
             )
             current_proc[0] = proc
             try:
@@ -154,6 +166,13 @@ def main() -> None:
                 proc.kill()
                 proc.wait()
                 log(f"  G={group_size}: killed at budget ({this_budget:.0f}s)")
+                if not os.path.exists(marker):
+                    # the child never even initialized the backend: the TPU
+                    # tunnel is hanging, and every further attempt would burn
+                    # its full budget the same way — stop the ladder
+                    log("bench: backend init hang detected, aborting attempts")
+                    emit(best)
+                    sys.exit(0 if best is not None else 1)
                 break  # a timeout is not transient; don't retry, move on
             finally:
                 current_proc[0] = None
@@ -182,7 +201,6 @@ def main() -> None:
     if best is None:
         raise SystemExit("all bench configurations failed")
     emit(best)
-    done = True  # only after the line is out: a late signal must not double-emit
 
 
 if __name__ == "__main__":

@@ -100,6 +100,11 @@ class SPConfig:
     min_pct_overlap_duty_cycle: float = 0.001
     syn_perm_below_stimulus_inc: float = 0.01  # bump for starved columns
     seed: int = 1956
+    # Permanence storage: 0 = f32 (reference semantics), 16/8 = fixed-point
+    # quanta on 1/(2^bits - 1) with exact integer arithmetic on both backends
+    # (models/perm.py). Quantization is the per-stream HBM lever (SURVEY.md
+    # §7 hard part 4): SP perm is the second-largest state tensor.
+    perm_bits: int = 0
 
 
 @dataclass(frozen=True)
@@ -133,6 +138,12 @@ class TMConfig:
     # Overflow is counted in state["tm_overflow"]; tests assert it stays zero
     # at the configured sizes.
     learn_cap: int = 128
+    # Permanence storage for the TM synapse pools — the single largest state
+    # tensor (see SPConfig.perm_bits; models/perm.py). At 8 bits the coarse
+    # quantum makes predicted_segment_decrement 1/255 ≈ 0.0039 (floored at one
+    # quantum); the detection-quality impact per domain is measured in
+    # eval/fault_eval, not assumed.
+    perm_bits: int = 0
     # Max simultaneously-active columns per step (>= SPConfig.num_active_columns,
     # validated in ModelConfig). The device kernel's membership tests and its
     # learning workspace are column-compact: active cells can only live in
@@ -194,6 +205,23 @@ class LikelihoodConfig:
     def probationary_period(self) -> int:
         return self.learning_period + self.estimation_samples
 
+    def safe_inject_frac(self, length: int, margin: int = 100, cap: float = 0.6) -> float:
+        """Earliest fault-injection point (fraction of a `length`-tick
+        stream) that clears the probation plus a settling margin — a fault
+        injected while the likelihood is pinned at 0.5 is undetectable by
+        construction, and scoring it corrupts recall with a measurement
+        artifact. Shared by the fault eval and the report script so the two
+        can never drift. Raises when the stream is too short to evaluate."""
+        frac = (self.probationary_period + margin) / length
+        if frac > cap:
+            raise ValueError(
+                f"stream length {length} too short to evaluate: probation "
+                f"{self.probationary_period} + margin {margin} is {frac:.0%} "
+                f"of it (cap {cap:.0%}); lengthen the streams or shorten the "
+                "likelihood learning period"
+            )
+        return frac
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -221,6 +249,9 @@ class ModelConfig:
                 "cells_per_column > 32 is unsupported: the device kernel packs a "
                 "column's cell activity into one int32 bit mask"
             )
+        for name, bits in (("sp", self.sp.perm_bits), ("tm", self.tm.perm_bits)):
+            if bits not in (0, 8, 16):
+                raise ValueError(f"{name}.perm_bits must be 0 (f32), 8, or 16; got {bits}")
         if self.sp.columns * self.tm.cells_per_column >= 1 << 24:
             # The kernel round-trips presynaptic cell ids through f32 one-hot
             # matmuls; ids >= 2^24 would lose bits silently.
@@ -309,22 +340,45 @@ def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
     )
 
 
-def cluster_preset() -> ModelConfig:
+def cluster_preset(perm_bits: int = 16) -> ModelConfig:
     """Small-footprint model for 1k-100k concurrent streams on one chip.
 
     Per-stream HBM budget dominates at 100k streams (16 GB HBM / 100k ~=
-    160 KB per stream — SURVEY.md §7 hard part 4). This preset's device
-    state is ~112 KB/stream in f32 (SP dense perms 256x139, TM pools
-    256x8x4x12), before bf16/int8 compression in the TPU backend.
+    160 KB per stream — SURVEY.md §7 hard part 4). Honest footprint (measure
+    with models/state.state_nbytes, which sums the actual arrays — a round-2
+    comment here claimed ~112 KB/stream by counting only SP perms and
+    misreading the TM pool product; the round-2 layout's real figure was
+    ~1015 KB/stream, dominated by the TM pools 256 cols x 8 cells x 4 seg x
+    12 syn = 98304 synapses x 8 B for (presyn i32, perm f32)). Current
+    measured state_nbytes totals — presyn narrows to int16 and seg_pot to
+    int16 automatically (num_cells = 2048 here), independent of perm_bits:
+
+    - perm_bits=0  (f32 perms):  826 KB/stream
+    - perm_bits=16 (u16 quanta): 564 KB/stream  (0.56x of round-2 layout)
+    - perm_bits=8  (u8 quanta):  433 KB/stream  (0.43x)
+
+    SCALING.md records the measured HBM frontier per domain on hardware.
     """
     return ModelConfig(
         rdse=RDSEConfig(size=128, active_bits=11, resolution=0.5),
         date=DateConfig(time_of_day_width=0, time_of_day_size=0, weekend_width=0),
         sp=SPConfig(columns=256, potential_pct=0.8, num_active_columns=10,
-                    syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002),
-        tm=TMConfig(cells_per_column=8, activation_threshold=7, min_threshold=5,
+                    syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002,
+                    perm_bits=perm_bits),
+        # activation_threshold/new_synapse_count ratio 5/10: a learned segment
+        # samples one winner cell from each of the 10 active columns, and
+        # activates on half of them recurring — measured on the fault-injection
+        # eval, the old brittle 7/8 ratio left steady-state raw ~0.23 (p90 =
+        # 0.9, i.e. frequent full bursts) vs 0.06 (p90 = 0.2) here, and f1
+        # 0.44 -> 0.61 (eval/fault_eval.py, 40 streams x 1000 s).
+        tm=TMConfig(cells_per_column=8, activation_threshold=5, min_threshold=4,
                     max_segments_per_cell=4, max_synapses_per_segment=12,
-                    new_synapse_count=8, learn_cap=32, col_cap=10),
+                    new_synapse_count=10, learn_cap=32, col_cap=10,
+                    perm_bits=perm_bits),
+        # probation 400: false-alert episodes cluster in ticks 150-400 with
+        # the short round-2 probation (the tiny model is still maturing when
+        # the likelihood starts firing) — measured 56 of 75 false episodes
+        # landed there.
         likelihood=LikelihoodConfig(mode="streaming", historic_window_size=512,
-                                    learning_period=100, estimation_samples=50),
+                                    learning_period=300, estimation_samples=100),
     )

@@ -50,6 +50,11 @@ class SyntheticStreamConfig:
     # only when evaluating that hard class
     kinds: tuple[str, ...] = ANOMALY_KINDS
     start_unix: int = 1_700_000_000
+    # earliest injection point, as a fraction of the stream. Evaluations set
+    # this past the detector's likelihood probation (a fault injected while
+    # the likelihood is still flat-0.5 is undetectable by construction and
+    # would poison recall with a measurement artifact, not a detector miss).
+    inject_after_frac: float = 0.25
 
 
 @dataclass(frozen=True)
@@ -112,8 +117,8 @@ def generate_stream(
     windows: list[tuple[int, int]] = []
     events: list[FaultEvent] = []
     if cfg.n_anomalies > 0:
-        # keep injections clear of the likelihood probation region (~15%)
-        lo = int(cfg.length * 0.25)
+        # keep injections clear of the likelihood probation region
+        lo = int(cfg.length * cfg.inject_after_frac)
         centers = np.sort(rng.choice(np.arange(lo, cfg.length - 50), size=cfg.n_anomalies, replace=False))
         for c in centers:
             kind = cfg.kinds[rng.integers(len(cfg.kinds))]

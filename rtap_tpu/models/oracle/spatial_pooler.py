@@ -18,6 +18,7 @@ from __future__ import annotations
 import numpy as np
 
 from rtap_tpu.config import SPConfig
+from rtap_tpu.models.perm import sp_domain
 
 
 def sp_overlap(state: dict, input_sdr: np.ndarray, cfg: SPConfig) -> np.ndarray:
@@ -27,7 +28,8 @@ def sp_overlap(state: dict, input_sdr: np.ndarray, cfg: SPConfig) -> np.ndarray:
     idx = np.nonzero(input_sdr)[0]
     if len(idx) == 0:
         return np.zeros(state["perm"].shape[0], np.int64)
-    cols = (state["perm"][:, idx] >= cfg.syn_perm_connected) & state["potential"][:, idx]
+    connected = sp_domain(cfg).threshold(cfg.syn_perm_connected)
+    cols = (state["perm"][:, idx] >= connected) & state["potential"][:, idx]
     return cols.sum(1, dtype=np.int64)
 
 
@@ -66,15 +68,19 @@ def sp_learn(
     the column saw, not what it would see after the update). Mutates `state`
     in place (the oracle is imperative; the TPU kernel is the functional twin).
     """
-    perm, potential = state["perm"], state["potential"]
+    dom = sp_domain(cfg)
+    potential = state["potential"]
     inc_mask = active[:, None] & potential & input_sdr[None, :]
     dec_mask = active[:, None] & potential & ~input_sdr[None, :]
-    # f32 constants: python float * bool-mask would promote to f64 and
-    # double-round on the in-place store, drifting 1 ulp from the device f32
-    # chain (see temporal_memory._reinforce_and_grow).
-    perm += np.float32(cfg.syn_perm_active_inc) * inc_mask
-    perm -= np.float32(cfg.syn_perm_inactive_dec) * dec_mask
-    np.clip(perm, 0.0, 1.0, out=perm)
+    # Arithmetic runs in the domain's compute dtype. f32 domain: np.float32
+    # constants (a python float * bool-mask would promote to f64 and
+    # double-round on the store, drifting 1 ulp from the device f32 chain —
+    # see temporal_memory._reinforce_and_grow). Quantized domain: int32, so
+    # adds can't wrap the narrow storage type before the clip.
+    perm = state["perm"].astype(dom.compute_dtype)
+    perm += dom.rate(cfg.syn_perm_active_inc) * inc_mask
+    perm -= dom.rate(cfg.syn_perm_inactive_dec) * dec_mask
+    np.clip(perm, dom.zero, dom.one, out=perm)
 
     it = int(state["sp_iter"]) + 1
     state["sp_iter"] = np.int32(it)
@@ -98,8 +104,9 @@ def sp_learn(
     min_duty = cfg.min_pct_overlap_duty_cycle * state["overlap_duty"].max()
     weak = state["overlap_duty"] < min_duty
     if weak.any():
-        perm += np.float32(cfg.syn_perm_below_stimulus_inc) * (weak[:, None] & potential)
-        np.clip(perm, 0.0, 1.0, out=perm)
+        perm += dom.rate(cfg.syn_perm_below_stimulus_inc) * (weak[:, None] & potential)
+        np.clip(perm, dom.zero, dom.one, out=perm)
+    state["perm"] = perm.astype(dom.dtype)
 
 
 def sp_compute(state: dict, input_sdr: np.ndarray, cfg: SPConfig, learn: bool = True) -> np.ndarray:

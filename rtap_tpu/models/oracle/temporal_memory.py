@@ -24,6 +24,7 @@ from __future__ import annotations
 import numpy as np
 
 from rtap_tpu.config import TMConfig
+from rtap_tpu.models.perm import tm_domain
 
 
 def _grow_synapses(
@@ -51,7 +52,7 @@ def _grow_synapses(
         free = np.nonzero(presyn < 0)[0]
     slots = free[: len(new_ids)]
     presyn[slots] = new_ids[: len(slots)]
-    perm[slots] = cfg.initial_permanence
+    perm[slots] = tm_domain(cfg).rate(cfg.initial_permanence)
 
 
 def _reinforce_and_grow(
@@ -70,16 +71,18 @@ def _reinforce_and_grow(
     presyn = state["presyn"][c, k, s]
     exists = presyn >= 0
     act = exists & prev_active_flat[np.clip(presyn, 0, None)]
-    # f32 constants: a python float * bool-array promotes to f64 in numpy and
-    # the f64-compute-then-f32-store double-rounds, diverging 1 ulp from the
-    # device's pure-f32 chain (observed). All perm arithmetic stays f32.
+    # Domain compute dtype: f32 constants in the f32 domain (a python float *
+    # bool-array promotes to f64 and the f64-compute-then-f32-store
+    # double-rounds, diverging 1 ulp from the device's pure-f32 chain —
+    # observed); int32 in quantized domains (no wrap before the clip).
+    dom = tm_domain(cfg)
     state["syn_perm"][c, k, s] = np.clip(
-        state["syn_perm"][c, k, s]
-        + np.float32(cfg.permanence_increment) * act
-        - np.float32(cfg.permanence_decrement) * (exists & ~act),
-        0.0,
-        1.0,
-    )
+        state["syn_perm"][c, k, s].astype(dom.compute_dtype)
+        + dom.rate(cfg.permanence_increment) * act
+        - dom.rate(cfg.permanence_decrement) * (exists & ~act),
+        dom.zero,
+        dom.one,
+    ).astype(dom.dtype)
     state["seg_last"][c, k, s] = it
     n_grow = cfg.new_synapse_count - int(state["seg_pot"][c, k, s])
     _grow_synapses(state, c, k, s, prev_winner_ids, n_grow, cfg)
@@ -169,12 +172,14 @@ class TMOracle:
             seg_mask = state["matching_seg"] & ~active_cols[:, None, None]
             idx = np.nonzero(seg_mask)
             if len(idx[0]):
+                dom = tm_domain(cfg)
                 presyn = state["presyn"][idx]
                 act = (presyn >= 0) & prev_active_flat[np.clip(presyn, 0, None)]
                 state["syn_perm"][idx] = np.maximum(
-                    state["syn_perm"][idx] - np.float32(cfg.predicted_segment_decrement) * act,
-                    np.float32(0.0),
-                )
+                    state["syn_perm"][idx].astype(dom.compute_dtype)
+                    - dom.rate(cfg.predicted_segment_decrement) * act,
+                    dom.zero,
+                ).astype(dom.dtype)
 
         if learn:
             # synapse death at permanence <= 0, then segment death at 0 synapses
@@ -188,11 +193,12 @@ class TMOracle:
         exist_idx = np.nonzero(state["seg_last"] >= 0)
         active_seg = np.zeros((C, K, S), bool)
         matching_seg = np.zeros((C, K, S), bool)
-        seg_pot = np.zeros((C, K, S), np.int32)
+        seg_pot = np.zeros((C, K, S), np.int16)
         if len(exist_idx[0]):
             presyn = state["presyn"][exist_idx]  # [Nseg, M]
             syn_act = (presyn >= 0) & active_cells.reshape(-1)[np.clip(presyn, 0, None)]
-            conn_count = (syn_act & (state["syn_perm"][exist_idx] >= cfg.connected_permanence)).sum(-1)
+            connected = tm_domain(cfg).threshold(cfg.connected_permanence)
+            conn_count = (syn_act & (state["syn_perm"][exist_idx] >= connected)).sum(-1)
             pot_count = syn_act.sum(-1)
             active_seg[exist_idx] = conn_count >= cfg.activation_threshold
             matching_seg[exist_idx] = pot_count >= cfg.min_threshold

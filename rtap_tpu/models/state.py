@@ -10,22 +10,30 @@ Layout (single stream; stream groups add a leading G axis):
 
 SP state:
     potential   bool [C, n_in]   fixed potential pool mask
-    perm        f32  [C, n_in]   permanences (0 outside potential)
+    perm        P_sp [C, n_in]   permanences (0 outside potential)
     boost       f32  [C]         boost factors (1.0 when boost_strength == 0)
     overlap_duty f32 [C]         overlap duty cycles
     active_duty f32  [C]         activation duty cycles
     sp_iter     i32  []          records seen
 
 TM state (dense bounded pools; C cols x K cells x S segments x M synapses):
-    presyn      i32 [C,K,S,M]    presynaptic flat cell id, -1 = empty slot
-    syn_perm    f32 [C,K,S,M]    synapse permanences (0 in empty slots)
+    presyn      i16/i32 [C,K,S,M] presynaptic flat cell id, -1 = empty slot
+                                 (i16 iff C*K <= 2^15 - 1)
+    syn_perm    P_tm [C,K,S,M]   synapse permanences (0 in empty slots)
     seg_last    i32 [C,K,S]      last-used iteration, -1 = segment free (LRU key)
     active_seg  bool [C,K,S]     segments active at end of previous step
     matching_seg bool [C,K,S]    segments matching at end of previous step
-    seg_pot     i32 [C,K,S]      active-potential synapse count at prev step
+    seg_pot     i16 [C,K,S]      active-potential synapse count at prev step
+                                 (<= max_synapses_per_segment)
     prev_active bool [C,K]       active cells at previous step
     prev_winner bool [C,K]       winner cells at previous step
     tm_iter     i32  []
+
+P_sp / P_tm are the permanence storage dtypes of the configured domains
+(models/perm.py): f32 at perm_bits=0, uint16/uint8 fixed-point quanta
+otherwise. The per-stream byte budget — the binding constraint at 100k
+streams (SURVEY.md §7 hard part 4) — is computed honestly by
+:func:`state_nbytes`, which sums the actual arrays.
 
 Encoder state:
     enc_offset  f32 [n_fields]   RDSE offset, bound to first seen value
@@ -40,6 +48,13 @@ from __future__ import annotations
 import numpy as np
 
 from rtap_tpu.config import ModelConfig
+from rtap_tpu.models.perm import sp_domain, tm_domain
+
+
+def presyn_dtype(cfg: ModelConfig):
+    """int16 whenever every cell id (< num_cells) fits, else int32. The -1
+    empty-slot sentinel needs a signed type either way."""
+    return np.int16 if cfg.num_cells <= (1 << 15) - 1 else np.int32
 
 
 def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
@@ -60,18 +75,18 @@ def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
     return {
         # SP
         "potential": potential,
-        "perm": perm,
+        "perm": sp_domain(cfg.sp).quantize_init(perm),
         "boost": np.ones(C, np.float32),
         "overlap_duty": np.zeros(C, np.float32),
         "active_duty": np.zeros(C, np.float32),
         "sp_iter": np.int32(0),
         # TM
-        "presyn": np.full((C, K, S, M), -1, np.int32),
-        "syn_perm": np.zeros((C, K, S, M), np.float32),
+        "presyn": np.full((C, K, S, M), -1, presyn_dtype(cfg)),
+        "syn_perm": np.zeros((C, K, S, M), tm_domain(cfg.tm).dtype),
         "seg_last": np.full((C, K, S), -1, np.int32),
         "active_seg": np.zeros((C, K, S), bool),
         "matching_seg": np.zeros((C, K, S), bool),
-        "seg_pot": np.zeros((C, K, S), np.int32),
+        "seg_pot": np.zeros((C, K, S), np.int16),
         "prev_active": np.zeros((C, K), bool),
         "prev_winner": np.zeros((C, K), bool),
         "tm_iter": np.int32(0),
@@ -92,3 +107,17 @@ def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
             else {}
         ),
     }
+
+
+def state_nbytes(cfg: ModelConfig, seed: int = 0) -> dict[str, int]:
+    """Honest per-stream device-state byte budget: sums the actual arrays of
+    one stream's state (the authoritative number for SCALING.md and the
+    preset docstrings; a hand-derived figure in round 2 was off by 9x).
+
+    Returns {"total": bytes, "<key>": bytes, ...} sorted descending by size.
+    """
+    st = init_state(cfg, seed)
+    per = {k: int(np.asarray(v).nbytes) for k, v in st.items()}
+    out = {"total": sum(per.values())}
+    out.update(sorted(per.items(), key=lambda kv: -kv[1]))
+    return out

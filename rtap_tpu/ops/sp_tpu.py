@@ -19,12 +19,14 @@ import jax
 import jax.numpy as jnp
 
 from rtap_tpu.config import SPConfig
+from rtap_tpu.models.perm import sp_domain
 
 
 def sp_overlap(perm: jnp.ndarray, potential: jnp.ndarray, sdr: jnp.ndarray, cfg: SPConfig) -> jnp.ndarray:
     """Overlap per column = |connected potential synapses ∩ active inputs|.
     0/1 f32 matmul -> MXU; exact integer counts."""
-    connected = ((perm >= cfg.syn_perm_connected) & potential).astype(jnp.float32)
+    thr = sp_domain(cfg).threshold(cfg.syn_perm_connected)
+    connected = ((perm >= thr) & potential).astype(jnp.float32)
     return jnp.dot(connected, sdr.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
 
 
@@ -54,12 +56,15 @@ def sp_learn(
     """Hebbian update on winners + duty cycles + boost + weak-column bump.
     Same op order as the oracle (hebbian -> clip -> duty -> boost -> bump ->
     clip); inc/dec masks are disjoint so the fused expression is bit-equal to
-    the oracle's sequential += / -=."""
-    perm, potential = state["perm"], state["potential"]
+    the oracle's sequential += / -=. Quantized domains compute in int32
+    (bit-equal to the oracle's int32 by construction)."""
+    dom = sp_domain(cfg)
+    potential = state["potential"]
     inc_mask = active[:, None] & potential & sdr[None, :]
     dec_mask = active[:, None] & potential & ~sdr[None, :]
-    perm = perm + cfg.syn_perm_active_inc * inc_mask - cfg.syn_perm_inactive_dec * dec_mask
-    perm = jnp.clip(perm, 0.0, 1.0)
+    perm = state["perm"].astype(dom.compute_dtype)
+    perm = perm + dom.rate(cfg.syn_perm_active_inc) * inc_mask - dom.rate(cfg.syn_perm_inactive_dec) * dec_mask
+    perm = jnp.clip(perm, dom.zero, dom.one)
 
     it = state["sp_iter"] + 1
     period = jnp.minimum(cfg.duty_cycle_period, it).astype(jnp.float32)
@@ -77,11 +82,14 @@ def sp_learn(
 
     min_duty = cfg.min_pct_overlap_duty_cycle * overlap_duty.max()
     weak = overlap_duty < min_duty
-    perm = jnp.clip(perm + cfg.syn_perm_below_stimulus_inc * (weak[:, None] & potential), 0.0, 1.0)
+    perm = jnp.clip(
+        perm + dom.rate(cfg.syn_perm_below_stimulus_inc) * (weak[:, None] & potential),
+        dom.zero, dom.one,
+    )
 
     return {
         **state,
-        "perm": perm,
+        "perm": perm.astype(dom.dtype),
         "boost": boost,
         "overlap_duty": overlap_duty,
         "active_duty": active_duty,

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 
 from rtap_tpu.config import TMConfig
+from rtap_tpu.models.perm import tm_domain
 
 INF = jnp.float32(jnp.inf)
 _HI = jax.lax.Precision.HIGHEST
@@ -185,10 +186,11 @@ def _segment_learning_mask(
 def _grow_compact(
     cfg: TMConfig,
     presyn_l: jnp.ndarray,  # i32 [L, M] (post-reinforce)
-    perm_l: jnp.ndarray,  # f32 [L, M]
+    perm_l: jnp.ndarray,  # f32 [L, M] (domain values: perms or quanta)
     n_grow: jnp.ndarray,  # i32 [L]
     winner_ids: jnp.ndarray,  # i32 [W] ascending where valid, fills = N
     n_cells: int,
+    initial_perm: jnp.ndarray,  # f32 scalar, domain value of initial_permanence
 ):
     """Oracle _grow_synapses, vectorized: per segment, add the first
     min(n_grow, #eligible) winner cells (ascending id, not already
@@ -226,7 +228,7 @@ def _grow_compact(
     assign = free & (frank < n_new[:, None])
     fill = new_ids[jnp.arange(L)[:, None], jnp.clip(frank, 0, G - 1)]
     presyn_l = jnp.where(assign, fill, presyn_l)
-    perm_l = jnp.where(assign, jnp.float32(cfg.initial_permanence), perm_l)
+    perm_l = jnp.where(assign, initial_perm, perm_l)
     return presyn_l, perm_l
 
 
@@ -257,6 +259,19 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     L, Ac = cfg.learn_cap, cfg.col_cap
     if K > 32:
         raise ValueError("cells_per_column > 32 unsupported (packed cell masks)")
+
+    # Permanence-domain constants (models/perm.py). The learning workspace
+    # computes on integer-VALUED f32 in quantized domains (quanta <= 65535
+    # < 2^24 are exact in f32, and the one-hot MXU gathers are f32 anyway),
+    # which agrees bit-for-bit with the oracle's int32 arithmetic.
+    dom = tm_domain(cfg)
+    p_dt = state["syn_perm"].dtype
+    p_one = jnp.float32(dom.one)
+    p_inc = jnp.float32(dom.rate(cfg.permanence_increment))
+    p_dec = jnp.float32(dom.rate(cfg.permanence_decrement))
+    p_init = jnp.float32(dom.rate(cfg.initial_permanence))
+    p_connected = dom.threshold(cfg.connected_permanence)
+    presyn_dt = state["presyn"].dtype
 
     presyn = state["presyn"]
     syn_perm = state["syn_perm"]
@@ -303,7 +318,7 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         ws_presyn = jnp.round(
             _gather_rows_f32(presyn.reshape(C, -1).astype(jnp.float32), col_oh)
         ).astype(jnp.int32)  # [Ac, K*S*M]
-        ws_perm = _gather_rows_f32(syn_perm.reshape(C, -1), col_oh)  # [Ac, K*S*M]
+        ws_perm = _gather_rows_f32(syn_perm.reshape(C, -1).astype(jnp.float32), col_oh)  # [Ac, K*S*M]
         ws_last = _gather_rows_i32(seg_last.reshape(C, -1), col_oh_b).reshape(Ac, K, S)
         ws_pot = jnp.round(
             _gather_rows_f32(state["seg_pot"].reshape(C, -1).astype(jnp.float32), col_oh)
@@ -348,17 +363,17 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         exists = presyn_l >= 0
         act = _presyn_active_packed(presyn_l, pcol_ids, pcol_masks, K)
         perm_l = jnp.clip(
-            perm_l
-            + cfg.permanence_increment * act
-            - cfg.permanence_decrement * (exists & ~act),
+            perm_l + p_inc * act - p_dec * (exists & ~act),
             0.0,
-            1.0,
+            p_one,
         )
 
         # grow toward previous winner cells (ascending id)
         winner_ids = _winner_id_list(state["prev_winner"], Ac)  # [Ac*K]
         n_grow = (cfg.new_synapse_count - pot_l).astype(jnp.int32)
-        grown_presyn, grown_perm = _grow_compact(cfg, presyn_l, perm_l, n_grow, winner_ids, N)
+        grown_presyn, grown_perm = _grow_compact(
+            cfg, presyn_l, perm_l, n_grow, winner_ids, N, p_init
+        )
         grow_ok = have_winners & valid_l
         presyn_l = jnp.where(grow_ok[:, None], grown_presyn, presyn_l)
         perm_l = jnp.where(grow_ok[:, None], grown_perm, perm_l)
@@ -376,10 +391,11 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         # --- scatter the workspace back to the pools ---
         pool_presyn = jnp.round(
             jax.lax.dot(col_oh.T, ws_presyn_r.reshape(Ac, -1).astype(jnp.float32), precision=_HI)
-        ).astype(jnp.int32).reshape(C, K, S, M)
-        pool_perm = jax.lax.dot(
-            col_oh.T, ws_perm_r.reshape(Ac, -1), precision=_HI
-        ).reshape(C, K, S, M)
+        ).astype(presyn_dt).reshape(C, K, S, M)
+        pool_perm_f = jax.lax.dot(col_oh.T, ws_perm_r.reshape(Ac, -1), precision=_HI)
+        if dom.bits:
+            pool_perm_f = jnp.round(pool_perm_f)  # exact already; belt+braces
+        pool_perm = pool_perm_f.astype(p_dt).reshape(C, K, S, M)
         pool_last = jnp.where(
             col_oh_b[:, :, None], ws_last.reshape(Ac, 1, -1), 0
         ).sum(0).reshape(C, K, S)
@@ -393,16 +409,18 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
 
         # --- punish matching segments in columns that did not activate ---
         if cfg.predicted_segment_decrement > 0.0:
+            pdec = dom.rate(cfg.predicted_segment_decrement)
             pmask = state["matching_seg"] & ~active_cols[:, None, None]
             pact = _presyn_active_packed(presyn, pcol_ids, pcol_masks, K)
+            sp_c = syn_perm.astype(dom.compute_dtype)
             syn_perm = jnp.where(
                 pmask[..., None] & pact,
-                jnp.maximum(syn_perm - cfg.predicted_segment_decrement, 0.0),
-                syn_perm,
-            )
+                jnp.maximum(sp_c - pdec, dom.zero),
+                sp_c,
+            ).astype(p_dt)
 
         # --- synapse death at permanence <= 0, then empty-segment death ---
-        dead = (presyn >= 0) & (syn_perm <= 0.0)
+        dead = (presyn >= 0) & (syn_perm <= dom.zero)
         presyn = jnp.where(dead, -1, presyn)
         nsyn = (presyn >= 0).sum(-1)
         seg_last = jnp.where((seg_last >= 0) & (nsyn == 0), -1, seg_last)
@@ -415,11 +433,11 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         overflow_learn | (a_cols > Ac)
     ).astype(jnp.int32)
     syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
-    conn_count = (syn_act & (syn_perm >= cfg.connected_permanence)).sum(-1)
+    conn_count = (syn_act & (syn_perm >= p_connected)).sum(-1)
     pot_count = syn_act.sum(-1)
     active_seg = exists_seg & (conn_count >= cfg.activation_threshold)
     matching_seg = exists_seg & (pot_count >= cfg.min_threshold)
-    seg_pot = jnp.where(exists_seg, pot_count, 0).astype(jnp.int32)
+    seg_pot = jnp.where(exists_seg, pot_count, 0).astype(jnp.int16)
     if learn:
         # LRU stamp for active segments (NuPIC stamps under learn only)
         seg_last = jnp.where(active_seg, it, seg_last)

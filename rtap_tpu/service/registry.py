@@ -176,7 +176,6 @@ class StreamGroup:
         if values.ndim == 2:
             values = values[..., None]
         T = values.shape[0]
-        self._seq += 1
         if self.backend == "tpu":
             if self.mesh is not None:
                 from rtap_tpu.ops.step import sharded_chunk_step
@@ -193,10 +192,14 @@ class StreamGroup:
                     self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1),
                     self.cfg, learn=learn,
                 )
+            # seq advances only on successful dispatch: a raise above must
+            # leave the pipeline collectable, not permanently desynced
+            self._seq += 1
             return {"out": out, "T": T, "seq": self._seq, "device": True}
         outs = [self._raw_cpu(values[i], np.asarray(ts[i]), learn) for i in range(T)]
         raw = np.stack([o[0] for o in outs])
         pred = np.stack([o[1] for o in outs]) if self.cfg.classifier.enabled else None
+        self._seq += 1
         return {"raw": raw, "pred": pred, "T": T, "seq": self._seq, "device": False}
 
     def collect_chunk(self, handle: dict) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -207,11 +210,13 @@ class StreamGroup:
                 f"collect_chunk out of order: handle seq {handle['seq']}, "
                 f"expected {self._collected + 1} (likelihood state is sequential)"
             )
-        self._collected = handle["seq"]
         if handle["device"]:
+            # the blocking fetch can surface a device error — only a chunk
+            # whose scores actually materialized counts as collected
             raw, pred = self._unpack_out(handle["out"], time_axis=False)
         else:
             raw, pred = handle["raw"], handle["pred"]
+        self._collected = handle["seq"]
         T = handle["T"]
         self.last_predictions = pred
         self.ticks += T

@@ -1,0 +1,138 @@
+"""Live metric sources for the service loop (SURVEY.md C18, L4).
+
+The reference's metrics collector polls per-node stats endpoints at a fixed
+cadence and normalizes them into (node, metric, t, value) tuples (SURVEY.md
+§2.2 C18, §3.3). These adapters are that collector for the TPU service loop:
+each is a callable matching `live_loop`'s source contract —
+``source(tick) -> (values [G] f32, ts unix-sec)`` — batching one value per
+registered stream id per tick, with NaN for streams the poll did not return
+(the encoder's missing-sample path scores them without corrupting state).
+
+Two transports:
+
+- :class:`HttpPollSource` — pull. Polls one endpoint returning JSON
+  ``{"ts": <unix>, "metrics": {"<stream_id>": <value>, ...}}`` (the
+  Prometheus-exporter-style shape the reference scrapes).
+- :class:`TcpJsonlSource` — push. A background listener accepts JSONL
+  records ``{"id": ..., "value": ..., "ts": ...}`` from any number of
+  producers; each tick drains the latest value per stream.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+
+class HttpPollSource:
+    """Poll an HTTP metrics endpoint once per tick.
+
+    Stream ids absent from a poll (or a failed poll) yield NaN for that tick:
+    a live service must keep scoring the healthy streams when one exporter
+    times out, not stall the whole group (the reference's collector has the
+    same per-poll timeout shape).
+    """
+
+    def __init__(self, url: str, stream_ids: list[str], timeout_s: float = 0.5):
+        self.url = url
+        self.stream_ids = list(stream_ids)
+        self.timeout_s = timeout_s
+        self.poll_failures = 0
+
+    def __call__(self, tick: int) -> tuple[np.ndarray, int]:
+        values = np.full(len(self.stream_ids), np.nan, np.float32)
+        ts = int(time.time())
+        try:
+            with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
+                payload = json.loads(r.read().decode())
+            metrics = payload.get("metrics", {})
+            ts = int(payload.get("ts", ts))
+            for i, sid in enumerate(self.stream_ids):
+                v = metrics.get(sid)
+                if v is not None:
+                    values[i] = np.float32(v)
+        except Exception:
+            self.poll_failures += 1
+        return values, ts
+
+
+class TcpJsonlSource:
+    """Push transport: listens on a TCP port for newline-delimited JSON
+    records and keeps the latest value per stream; each tick snapshots them.
+
+    Start/stop with a context manager (or .start()/.close()). The listener
+    thread is a daemon; record parse errors are counted, never raised (a
+    malformed producer must not kill the scoring loop).
+    """
+
+    def __init__(self, stream_ids: list[str], host: str = "127.0.0.1", port: int = 0):
+        self.stream_ids = list(stream_ids)
+        self._index = {sid: i for i, sid in enumerate(self.stream_ids)}
+        self._latest = np.full(len(self.stream_ids), np.nan, np.float32)
+        self._latest_ts = 0
+        self._lock = threading.Lock()
+        self.parse_errors = 0
+        self.unknown_ids = 0
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        rec = json.loads(line)
+                        i = outer._index.get(rec["id"])
+                        if i is None:
+                            outer.unknown_ids += 1
+                            continue
+                        with outer._lock:
+                            outer._latest[i] = np.float32(rec["value"])
+                            outer._latest_ts = max(outer._latest_ts, int(rec.get("ts", 0)))
+                    except Exception:
+                        outer.parse_errors += 1
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = self._server.server_address  # (host, bound port)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "TcpJsonlSource":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "TcpJsonlSource":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __call__(self, tick: int) -> tuple[np.ndarray, int]:
+        """Snapshot AND DRAIN: values reset to NaN after each tick, so a
+        producer that stops pushing yields missing samples (NaN) rather than
+        its stale last value being re-scored forever — a silent outage must
+        surface as missing data, not as a suspiciously flat healthy metric."""
+        with self._lock:
+            values = self._latest.copy()
+            self._latest[:] = np.nan
+            ts = self._latest_ts or int(time.time())
+        return values, ts
+
+
+def send_jsonl(address: tuple[str, int], records: list[dict]) -> None:
+    """Producer-side helper (used by tests and demos): push records to a
+    :class:`TcpJsonlSource` listener."""
+    with socket.create_connection(address, timeout=2.0) as s:
+        payload = "".join(json.dumps(r) + "\n" for r in records)
+        s.sendall(payload.encode())

@@ -1,0 +1,50 @@
+"""Shared benchmark-measurement building blocks.
+
+bench.py (the headline number), scripts/scaling_law.py (the G-sweep), and
+__graft_entry__ (the multi-chip dry run) all drive the same workload shape:
+a synthetic diurnal cluster feed through the depth-2 pipelined chunk replay.
+One implementation here, so a change to the feed or the measurement window
+can never make the bench and the scaling sweep measure different things.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def make_sine_feed(
+    G: int, chunk_ticks: int, key: tuple[int, int], t0: int = 0,
+    phase: np.ndarray | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Diurnal sine + Gaussian noise for G streams over one chunk.
+
+    -> (values [T, G] f32, ts [T, G] i64, phase [G]) — pass `phase` back in
+    to generate consecutive chunks of the same streams.
+    """
+    rng = np.random.Generator(np.random.Philox(key=key))
+    if phase is None:
+        phase = rng.integers(0, 86400, G)
+    t_idx = t0 + np.arange(chunk_ticks)[:, None]
+    base = 35.0 + 20.0 * np.sin(2 * np.pi * (t_idx + phase[None, :]) / 86400.0)
+    vals = (base + rng.normal(0, 3.0, (chunk_ticks, G))).astype(np.float32)
+    ts = (1_700_000_000 + t_idx + np.zeros((1, G))).astype(np.int64)
+    return vals, ts, phase
+
+
+def measure_pipelined(grp, vals: np.ndarray, ts: np.ndarray, measure_chunks: int = 3):
+    """Steady-state scored-metrics/s over `measure_chunks` re-dispatches of
+    one chunk (timestamps advanced), overlapped depth-2 (dispatch chunk i+1
+    before collecting chunk i — SURVEY.md §7 hard part 3). The group must
+    already be warmed up (compiled)."""
+    chunk_ticks, G = vals.shape[:2]
+    t0 = time.perf_counter()
+    pending = grp.dispatch_chunk(vals, ts + chunk_ticks)
+    for i in range(1, measure_chunks):
+        nxt = grp.dispatch_chunk(vals, ts + (i + 1) * chunk_ticks)
+        grp.collect_chunk(pending)
+        pending = nxt
+    grp.collect_chunk(pending)
+    dt = time.perf_counter() - t0
+    return measure_chunks * chunk_ticks * G / dt, dt

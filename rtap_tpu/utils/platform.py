@@ -1,0 +1,30 @@
+"""Platform selection helpers.
+
+This environment's axon sitecustomize pre-imports jax and sets
+``jax_platforms="axon,cpu"`` through jax.config at interpreter start, which
+OVERRIDES the ``JAX_PLATFORMS`` environment variable. Consequences:
+
+- ``JAX_PLATFORMS=cpu python script.py`` does NOT force CPU — the axon
+  backend still initializes first (and hangs the process whenever the TPU
+  tunnel is wedged rather than failing fast).
+- The only reliable way to force CPU is ``jax.config.update`` in-process,
+  BEFORE first backend use (what tests/conftest.py does for pytest).
+
+Scripts call :func:`maybe_force_cpu` at entry so ``RTAP_FORCE_CPU=1``
+gives a deterministic CPU run regardless of tunnel health.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_force_cpu(env_var: str = "RTAP_FORCE_CPU") -> bool:
+    """If ``$RTAP_FORCE_CPU`` is truthy, pin jax to the CPU platform (must be
+    called before any jax backend use). Returns whether CPU was forced."""
+    if os.environ.get(env_var, "") not in ("", "0"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False

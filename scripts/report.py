@@ -1,0 +1,183 @@
+"""Visualization report (SURVEY.md C22): replay + eval -> PNG overlays.
+
+The reference ships Grafana-style dashboards of metric + anomaly likelihood
+(SURVEY.md C22); the v1 plan is a matplotlib report script. Two artifacts:
+
+- ``overlay.png`` — per-stream small multiples: metric value with injected
+  fault windows shaded and alert marks, and (own axis, stacked — never a
+  dual axis) the anomaly log-likelihood with the alert threshold. Data comes
+  from an in-process replay of the synthetic cluster (deterministic seed).
+- ``fault_eval.png`` — per-kind recall bars + headline metrics from a
+  committed eval report JSON (reports/fault_eval.json).
+
+Usage:
+    RTAP_FORCE_CPU=1 python scripts/report.py --out-dir reports \
+        [--eval-report reports/fault_eval.json] [--streams 6] [--length 900]
+
+Design notes: colorblind-safe Okabe-Ito hues in fixed roles (value = blue,
+likelihood = orange); the status color (vermillion) is reserved for alert
+marks; fault windows are neutral gray bands; thin marks, recessive grid,
+no top/right spines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
+import matplotlib  # noqa: E402
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+
+# Okabe-Ito (CVD-safe): fixed roles, never cycled
+C_VALUE = "#0072B2"  # blue — the metric
+C_LIK = "#E69F00"  # orange — the likelihood
+C_ALERT = "#D55E00"  # vermillion — STATUS: alert marks only
+C_WINDOW = "#999999"  # neutral — labeled fault windows
+INK = "#333333"
+MUTED = "#767676"
+
+
+def _style(ax):
+    ax.spines[["top", "right"]].set_visible(False)
+    ax.spines[["left", "bottom"]].set_color(MUTED)
+    ax.tick_params(colors=MUTED, labelsize=8)
+    ax.grid(True, axis="y", color="#DDDDDD", linewidth=0.6, alpha=0.7)
+    ax.set_axisbelow(True)
+
+
+def overlay_figure(streams, res, threshold: float, max_streams: int = 4):
+    """Small multiples: per stream, value panel + log-likelihood panel."""
+    n = min(max_streams, len(streams))
+    fig, axes = plt.subplots(
+        2 * n, 1, figsize=(10, 2.2 * 2 * n), sharex=True,
+        layout="constrained",
+    )
+    axes = np.atleast_1d(axes)
+    t0 = res.timestamps[0]
+    tmin = (res.timestamps - t0) / 60.0  # minutes
+    for i in range(n):
+        s = streams[i]
+        ax_v, ax_l = axes[2 * i], axes[2 * i + 1]
+        for lo, hi in s.windows:
+            for ax in (ax_v, ax_l):
+                ax.axvspan((lo - t0) / 60.0, (hi - t0) / 60.0,
+                           color=C_WINDOW, alpha=0.25, linewidth=0)
+        ax_v.plot(tmin, s.values, color=C_VALUE, linewidth=1.2)
+        ax_v.set_ylabel("value", fontsize=8, color=INK)
+        ax_v.set_title(f"{s.stream_id} — metric, fault windows (gray), alerts",
+                       fontsize=9, color=INK, loc="left")
+        alerts = res.alerts[:, i]
+        if alerts.any():
+            ax_v.plot(tmin[alerts], s.values[alerts], linestyle="none",
+                      marker="v", markersize=5, color=C_ALERT, label="alert")
+            ax_v.legend(frameon=False, fontsize=8, loc="upper right",
+                        borderaxespad=0.1)
+        ax_l.plot(tmin, res.log_likelihood[:, i], color=C_LIK, linewidth=1.2)
+        ax_l.axhline(threshold, color=MUTED, linewidth=0.9, linestyle="--")
+        ax_l.text(tmin[-1], threshold, f" thr {threshold}", fontsize=7,
+                  color=MUTED, va="bottom", ha="right")
+        ax_l.set_ylabel("log-lik", fontsize=8, color=INK)
+        ax_l.set_ylim(-0.02, 1.02)
+        _style(ax_v)
+        _style(ax_l)
+    axes[-1].set_xlabel("minutes", fontsize=8, color=INK)
+    fig.suptitle("Synthetic cluster replay — anomaly detection overlay",
+                 fontsize=11, color=INK, ha="center")
+    return fig
+
+
+def eval_figure(report: dict):
+    """Per-kind recall bars (one measure across categories -> one hue) with
+    headline metrics in the title."""
+    kinds = sorted(report["per_kind"])
+    recalls = [report["per_kind"][k]["recall"] for k in kinds]
+    b = report["at_best"]
+    fig, ax = plt.subplots(figsize=(7, 0.6 * len(kinds) + 1.6))
+    y = np.arange(len(kinds))
+    ax.barh(y, recalls, height=0.55, color=C_VALUE, edgecolor="none")
+    for i, r in enumerate(recalls):
+        ax.text(min(r + 0.02, 1.02), i, f"{r:.2f}", va="center",
+                fontsize=8, color=INK)
+    ax.set_yticks(y, kinds, fontsize=9, color=INK)
+    ax.set_xlim(0, 1.12)
+    ax.set_xlabel("recall at F1-optimal threshold", fontsize=8, color=INK)
+    ax.set_title(
+        f"Fault-injection eval — f1 {b['f1']:.2f}, recall {b['recall']:.2f}, "
+        f"episode precision {b['precision']:.2f}, "
+        f"median latency {b['median_latency_s']} s",
+        fontsize=9, color=INK, loc="left",
+    )
+    _style(ax)
+    ax.grid(True, axis="x", color="#DDDDDD", linewidth=0.6, alpha=0.7)
+    ax.grid(False, axis="y")
+    fig.tight_layout()
+    return fig
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out-dir", default=os.path.join(REPO, "reports"))
+    ap.add_argument("--streams", type=int, default=6)
+    ap.add_argument("--length", type=int, default=900)
+    ap.add_argument("--threshold", type=float, default=0.39)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--eval-report", default=None,
+                    help="path to a fault_eval JSON report to chart")
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    import dataclasses
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_stream
+    from rtap_tpu.service.loop import replay_streams
+
+    base = cluster_preset()
+    cfg = dataclasses.replace(
+        base, likelihood=dataclasses.replace(base.likelihood, mode="window")
+    )
+    frac = cfg.likelihood.safe_inject_frac(args.length)
+    metrics = ("cpu", "mem", "net")
+    streams = [
+        generate_stream(
+            f"node{i:03d}.{metrics[i % 3]}",
+            SyntheticStreamConfig(
+                length=args.length, metric=metrics[i % 3], n_anomalies=2,
+                kinds=("spike", "level_shift", "dropout"), anomaly_magnitude=6.0,
+                noise_phi=0.97, noise_scale=0.5, inject_after_frac=frac,
+            ),
+            seed=args.seed,
+        )
+        for i in range(args.streams)
+    ]
+    res = replay_streams(streams, cfg, backend="tpu",
+                         threshold=args.threshold, chunk_ticks=128)
+    fig = overlay_figure(streams, res, args.threshold)
+    overlay_path = os.path.join(args.out_dir, "overlay.png")
+    fig.savefig(overlay_path, dpi=110)
+    plt.close(fig)
+    print(f"wrote {overlay_path}", file=sys.stderr)
+
+    if args.eval_report and os.path.exists(args.eval_report):
+        rep = json.load(open(args.eval_report))
+        fig = eval_figure(rep)
+        eval_path = os.path.join(args.out_dir, "fault_eval.png")
+        fig.savefig(eval_path, dpi=110)
+        plt.close(fig)
+        print(f"wrote {eval_path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

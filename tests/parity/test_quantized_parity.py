@@ -1,0 +1,112 @@
+"""Oracle-vs-device parity in the quantized permanence domains.
+
+Quantized domains (models/perm.py) change the *storage* of permanences to
+uint16/uint8 fixed-point quanta; parity must remain BIT-exact because both
+backends run the same integer arithmetic (oracle: int32; device kernel:
+integer-valued f32, exact below 2^24). This is the compression analog of
+SURVEY.md §4 item 2 (NuPIC's py/C++ compatibility tests).
+"""
+
+import jax as _jax
+import numpy as np
+import pytest
+
+from rtap_tpu.config import DateConfig, ModelConfig, RDSEConfig, SPConfig, TMConfig, cluster_preset
+from rtap_tpu.models.htm_model import HTMModel
+from rtap_tpu.models.perm import PermDomain
+from rtap_tpu.models.state import init_state, presyn_dtype, state_nbytes
+
+exact_only = pytest.mark.skipif(
+    _jax.devices()[0].platform != "cpu",
+    reason="bit-exact parity is asserted on the CPU test backend only",
+)
+
+
+def quant_cfg(perm_bits: int) -> ModelConfig:
+    return ModelConfig(
+        rdse=RDSEConfig(size=128, active_bits=11, resolution=0.7),
+        date=DateConfig(time_of_day_width=7, time_of_day_size=18, weekend_width=3),
+        sp=SPConfig(columns=256, num_active_columns=10, perm_bits=perm_bits),
+        tm=TMConfig(cells_per_column=8, activation_threshold=6, min_threshold=4,
+                    max_segments_per_cell=4, max_synapses_per_segment=16,
+                    new_synapse_count=8, learn_cap=48, perm_bits=perm_bits),
+    )
+
+
+def test_domain_constants():
+    d16 = PermDomain(16)
+    assert d16.dtype == np.uint16 and d16.one == 65535
+    assert d16.threshold(0.5) == 32768
+    assert d16.rate(0.1) == 6554 and d16.rate(0.0) == 0
+    d8 = PermDomain(8)
+    # a configured-nonzero rate is floored at one quantum, never a silent no-op
+    assert d8.rate(0.001) == 1
+    assert PermDomain(0).rate(0.1) == np.float32(0.1)
+
+
+def test_state_dtypes_and_bytes():
+    f32 = state_nbytes(cluster_preset(perm_bits=0))
+    q16 = state_nbytes(cluster_preset(perm_bits=16))
+    q8 = state_nbytes(cluster_preset(perm_bits=8))
+    # the honest budgets the cluster_preset docstring quotes (round-2 fix of
+    # the 9x understatement); the round-2 i32/f32 layout measured ~1015 KB
+    assert 0.80e6 < f32["total"] < 0.86e6, f32["total"]
+    assert 0.54e6 < q16["total"] < 0.58e6, q16["total"]
+    assert 0.41e6 < q8["total"] < 0.45e6, q8["total"]
+    r2_layout = 1_015_000
+    assert q16["total"] < 0.56 * r2_layout  # halved-or-better vs round 2
+    assert q8["total"] < 0.43 * r2_layout
+    st = init_state(cluster_preset(perm_bits=16))
+    assert st["syn_perm"].dtype == np.uint16
+    assert st["perm"].dtype == np.uint16
+    assert st["presyn"].dtype == np.int16  # 2048 cells fit int16
+    assert st["seg_pot"].dtype == np.int16
+    # nab preset has 65536 cells -> presyn must stay int32
+    from rtap_tpu.config import nab_preset
+
+    assert presyn_dtype(nab_preset()) == np.int32
+
+
+@exact_only
+@pytest.mark.parametrize("perm_bits", [16, 8])
+def test_e2e_state_parity_quantized(perm_bits):
+    """After N steps with quantized perms, device state == oracle bit-for-bit."""
+    import jax
+
+    cfg = quant_cfg(perm_bits)
+    cpu = HTMModel(cfg, seed=11, backend="cpu")
+    tpu = HTMModel(cfg, seed=11, backend="tpu")
+    rng = np.random.Generator(np.random.Philox(key=(21, 1)))
+    t = np.arange(300)
+    vals = (50 + 20 * np.sin(2 * np.pi * t / 60.0) + rng.normal(0, 2.0, 300)).astype(np.float32)
+    vals[150] += 40.0
+    for i in range(300):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+    dev = jax.device_get(tpu._runner.state)
+    for k in ("perm", "boost", "overlap_duty", "active_duty", "presyn", "syn_perm",
+              "seg_last", "active_seg", "matching_seg", "seg_pot", "prev_active",
+              "prev_winner", "enc_offset"):
+        np.testing.assert_array_equal(np.asarray(dev[k]), np.asarray(cpu.state[k]), err_msg=k)
+    assert dev["syn_perm"].dtype == {16: np.uint16, 8: np.uint8}[perm_bits]
+    assert int(dev["tm_overflow"]) == 0
+    # learning actually happened in the quantized domain
+    assert (np.asarray(dev["seg_last"]) >= 0).any()
+
+
+@exact_only
+def test_quantized_learning_tracks_f32():
+    """Quantized-domain anomaly scores stay close to f32 semantics on a
+    learnable periodic stream (the quantization deviation is bounded by the
+    one-time rounding of the configured rates)."""
+    cfg0, cfg16 = quant_cfg(0), quant_cfg(16)
+    m0 = HTMModel(cfg0, seed=5, backend="cpu")
+    m16 = HTMModel(cfg16, seed=5, backend="cpu")
+    t = np.arange(400)
+    vals = (50 + 20 * np.sin(2 * np.pi * t / 40.0)).astype(np.float32)
+    r0 = [m0.run(1_700_000_000 + 300 * i, float(vals[i])).raw_score for i in range(400)]
+    r16 = [m16.run(1_700_000_000 + 300 * i, float(vals[i])).raw_score for i in range(400)]
+    # both learn the cycle: late-window mean raw score drops well below early
+    assert np.mean(r16[-80:]) < 0.5 * np.mean(r16[40:120]) + 0.05
+    assert abs(np.mean(r16[-80:]) - np.mean(r0[-80:])) < 0.1
