@@ -58,6 +58,25 @@ class RDSEConfig:
 
 
 @dataclass(frozen=True)
+class ScalarEncoderConfig:
+    """Classic bucketed ScalarEncoder (SURVEY.md C2, NuPIC `scalar.py`):
+    a fixed [min_val, max_val] range mapped onto ``size`` bits with a
+    ``width``-bit contiguous run; bucket = round((v - min) * (size - width)
+    / (max - min)), input clipped into range (NuPIC clipInput=True).
+
+    Unlike the RDSE it needs the value range up front and wastes resolution
+    outside it — the detector presets keep the RDSE; this exists for parity
+    with the reference's encoder family and for fields with known ranges
+    (e.g. percentages). Selected per model via ``ModelConfig.scalar``.
+    """
+
+    size: int = 400
+    width: int = 21
+    min_val: float = 0.0
+    max_val: float = 100.0
+
+
+@dataclass(frozen=True)
 class DateConfig:
     """Date/time encoder (SURVEY.md C2): periodic time-of-day + weekend bits.
 
@@ -171,6 +190,12 @@ class ClassifierConfig:
     on the MXU. Buckets are the RDSE bucket index shifted by ``buckets // 2``
     and clamped to [0, buckets) — offset binding centers the first value, and
     NAB-style resolutions span the value range in ~130 buckets.
+
+    Memory note (state_nbytes includes it when enabled): ``cls_w`` is
+    [num_cells, buckets] f32 per stream — +1.06 MB/stream on the cluster
+    preset (2048 cells x 130, roughly DOUBLING its state) and +34 MB/stream
+    on the NAB preset. That is why it is off by default and should stay off
+    for massive-stream-count deployments unless predictions are required.
     """
 
     enabled: bool = False
@@ -234,6 +259,9 @@ class ModelConfig:
     likelihood: LikelihoodConfig = field(default_factory=LikelihoodConfig)
     classifier: ClassifierConfig = field(default_factory=ClassifierConfig)
     n_fields: int = 1  # multivariate: number of scalar fields fused into one SDR
+    # When set, value fields use the classic ScalarEncoder instead of the
+    # RDSE (same layout position; date bits unchanged). None = RDSE default.
+    scalar: ScalarEncoderConfig | None = None
 
     def __post_init__(self) -> None:
         # A col_cap below the SP winner count would silently truncate the
@@ -252,6 +280,19 @@ class ModelConfig:
         for name, bits in (("sp", self.sp.perm_bits), ("tm", self.tm.perm_bits)):
             if bits not in (0, 8, 16):
                 raise ValueError(f"{name}.perm_bits must be 0 (f32), 8, or 16; got {bits}")
+        if self.scalar is not None:
+            # An invalid scalar range corrupts SDRs silently (negative buckets
+            # wrap on host but drop on device — parity breaks) — fail loudly.
+            if self.scalar.width >= self.scalar.size:
+                raise ValueError(
+                    f"ScalarEncoderConfig.width={self.scalar.width} must be "
+                    f"< size={self.scalar.size}"
+                )
+            if not self.scalar.min_val < self.scalar.max_val:
+                raise ValueError(
+                    f"ScalarEncoderConfig needs min_val < max_val; got "
+                    f"[{self.scalar.min_val}, {self.scalar.max_val}]"
+                )
         if self.sp.columns * self.tm.cells_per_column >= 1 << 24:
             # The kernel round-trips presynaptic cell ids through f32 one-hot
             # matmuls; ids >= 2^24 would lose bits silently.
@@ -261,8 +302,13 @@ class ModelConfig:
             )
 
     @property
+    def field_size(self) -> int:
+        """Bits one value field occupies in the SDR (RDSE or classic scalar)."""
+        return self.scalar.size if self.scalar is not None else self.rdse.size
+
+    @property
     def input_size(self) -> int:
-        return self.rdse.size * self.n_fields + self.date.size
+        return self.field_size * self.n_fields + self.date.size
 
     @property
     def num_cells(self) -> int:
@@ -306,6 +352,11 @@ class ModelConfig:
             likelihood=LikelihoodConfig(**known(LikelihoodConfig, d.get("likelihood", {}))),
             classifier=ClassifierConfig(**known(ClassifierConfig, d.get("classifier", {}))),
             n_fields=d.get("n_fields", 1),
+            scalar=(
+                ScalarEncoderConfig(**known(ScalarEncoderConfig, d["scalar"]))
+                if d.get("scalar") is not None
+                else None
+            ),
         )
 
     @classmethod

@@ -12,7 +12,13 @@ from __future__ import annotations
 
 import numpy as np
 
-from rtap_tpu.config import RDSE_BUCKET_CLAMP, DateConfig, ModelConfig, RDSEConfig
+from rtap_tpu.config import (
+    RDSE_BUCKET_CLAMP,
+    DateConfig,
+    ModelConfig,
+    RDSEConfig,
+    ScalarEncoderConfig,
+)
 from rtap_tpu.utils.hashing import hash_bits_np
 
 SECONDS_PER_DAY = 86400
@@ -43,6 +49,20 @@ def rdse_bits(cfg: RDSEConfig, bucket: int, field_index: int = 0) -> np.ndarray:
     own hash stream via the seed."""
     keys = bucket + np.arange(cfg.active_bits, dtype=np.int64)
     return hash_bits_np(keys, cfg.seed + 0x1000 * field_index, cfg.size)
+
+
+def scalar_bucket(value: float | np.ndarray, cfg: ScalarEncoderConfig) -> np.ndarray:
+    """Classic ScalarEncoder bucket (SURVEY.md C2): clip into [min, max],
+    then round((v - min) * (size - width) / range). All-f32 so the device
+    twin is bit-identical (same contract as rdse_bucket)."""
+    v = np.clip(np.asarray(value, np.float32), np.float32(cfg.min_val), np.float32(cfg.max_val))
+    scale = np.float32(cfg.size - cfg.width) / (np.float32(cfg.max_val) - np.float32(cfg.min_val))
+    return np.round((v - np.float32(cfg.min_val)) * scale).astype(np.int64)
+
+
+def scalar_bits(cfg: ScalarEncoderConfig, bucket: int) -> np.ndarray:
+    """Contiguous ``width``-bit run starting at the bucket index."""
+    return bucket + np.arange(cfg.width)
 
 
 def time_of_day_bits(cfg: DateConfig, ts_unix: int) -> np.ndarray:
@@ -77,13 +97,17 @@ def encode_record(
     for f in range(cfg.n_fields):
         if not np.isfinite(values[f]):
             continue  # missing/garbled sample -> no bits for this field (NuPIC behavior)
+        if cfg.scalar is not None:
+            b = int(scalar_bucket(values[f], cfg.scalar))
+            sdr[f * cfg.field_size + scalar_bits(cfg.scalar, b)] = True
+            continue
         # Always round the resolution through f32: the state-carried array is
         # f32, and the two entry points (explicit array vs config default)
         # must agree on bucket assignment at boundaries.
         res = float(np.float32(cfg.rdse.resolution)) if enc_resolution is None else float(enc_resolution[f])
         b = int(rdse_bucket(values[f], float(enc_offset[f]), res))
-        sdr[f * cfg.rdse.size + rdse_bits(cfg.rdse, b, f)] = True
-    base = cfg.n_fields * cfg.rdse.size
+        sdr[f * cfg.field_size + rdse_bits(cfg.rdse, b, f)] = True
+    base = cfg.n_fields * cfg.field_size
     if cfg.date.time_of_day_width:
         sdr[base + time_of_day_bits(cfg.date, ts_unix)] = True
         base += cfg.date.time_of_day_size

@@ -33,22 +33,33 @@ def encode_device(
 
     `enc_resolution` defaults to the config's static resolution (rounded
     through f32, exactly like the state-carried per-stream array)."""
-    F, R, w = cfg.n_fields, cfg.rdse.size, cfg.rdse.active_bits
+    F, R = cfg.n_fields, cfg.field_size
     n_in = cfg.input_size
-    if enc_resolution is None:
-        enc_resolution = jnp.full(F, jnp.float32(cfg.rdse.resolution))
-
     finite = jnp.isfinite(values)
     v = jnp.where(finite, values, jnp.float32(0.0))
-    bucket = jnp.clip(
-        jnp.round((v - enc_offset) / enc_resolution.astype(jnp.float32)),
-        -RDSE_BUCKET_CLAMP,
-        RDSE_BUCKET_CLAMP,
-    ).astype(jnp.int32)
-    keys = bucket[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [F, w]
-    # per-field hash stream: seed + 0x1000 * field (same keying as the oracle)
-    seeds = jnp.uint32(cfg.rdse.seed) + jnp.uint32(0x1000) * jnp.arange(F, dtype=jnp.uint32)
-    bits = hash_bits(keys, seeds[:, None], R)  # [F, w]
+
+    if cfg.scalar is not None:
+        # classic ScalarEncoder: clipped fixed-range bucket, contiguous run
+        sc = cfg.scalar
+        vc = jnp.clip(v, jnp.float32(sc.min_val), jnp.float32(sc.max_val))
+        scale = jnp.float32(sc.size - sc.width) / (
+            jnp.float32(sc.max_val) - jnp.float32(sc.min_val)
+        )
+        bucket = jnp.round((vc - jnp.float32(sc.min_val)) * scale).astype(jnp.int32)
+        bits = bucket[:, None] + jnp.arange(sc.width, dtype=jnp.int32)[None, :]
+    else:
+        w = cfg.rdse.active_bits
+        if enc_resolution is None:
+            enc_resolution = jnp.full(F, jnp.float32(cfg.rdse.resolution))
+        bucket = jnp.clip(
+            jnp.round((v - enc_offset) / enc_resolution.astype(jnp.float32)),
+            -RDSE_BUCKET_CLAMP,
+            RDSE_BUCKET_CLAMP,
+        ).astype(jnp.int32)
+        keys = bucket[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [F, w]
+        # per-field hash stream: seed + 0x1000 * field (same keying as oracle)
+        seeds = jnp.uint32(cfg.rdse.seed) + jnp.uint32(0x1000) * jnp.arange(F, dtype=jnp.uint32)
+        bits = hash_bits(keys, seeds[:, None], R)  # [F, w]
     idx = bits + (jnp.arange(F, dtype=jnp.int32) * R)[:, None]
     idx = jnp.where(finite[:, None], idx, n_in)  # missing field -> dropped scatter
 

@@ -48,6 +48,13 @@ class BatchAnomalyLikelihood:
     `update(raw [G]) -> (likelihood [G], log_likelihood [G])`.
     """
 
+    # Window mode's [G, W] f64 ring is the one host allocation that scales
+    # with BOTH stream count and window size: G=100k x W=8640 = 6.9 GB.
+    # Above the soft limit we warn; above the hard limit (env-overridable,
+    # GiB) we refuse — streaming mode exists precisely for that regime
+    # (SURVEY.md §7 hard part 5).
+    RING_WARN_BYTES = 1 << 30
+
     def __init__(self, cfg: LikelihoodConfig, group_size: int):
         self.cfg = cfg
         self.G = int(group_size)
@@ -63,6 +70,25 @@ class BatchAnomalyLikelihood:
             self._s2 = np.zeros(self.G, np.float64)
             self.scores = None
         else:
+            import logging
+            import os
+
+            ring_bytes = 8 * self.G * cfg.historic_window_size
+            cap_gib = float(os.environ.get("RTAP_MAX_LIKELIHOOD_RING_GB", "8"))
+            if ring_bytes > cap_gib * (1 << 30):
+                raise ValueError(
+                    f"window-mode likelihood ring would be "
+                    f"{ring_bytes / (1 << 30):.1f} GiB host RAM for G={self.G} "
+                    f"x W={cfg.historic_window_size} (cap {cap_gib:g} GiB; "
+                    "RTAP_MAX_LIKELIHOOD_RING_GB to raise). Use "
+                    "mode='streaming' at this stream count."
+                )
+            if ring_bytes > self.RING_WARN_BYTES:
+                logging.getLogger(__name__).warning(
+                    "window-mode likelihood ring: %.1f GiB host RAM (G=%d, W=%d); "
+                    "consider mode='streaming' at scale",
+                    ring_bytes / (1 << 30), self.G, cfg.historic_window_size,
+                )
             # historic window ring [G, W]; cursor/fill shared (lockstep)
             self.scores = np.zeros((self.G, cfg.historic_window_size), np.float64)
 

@@ -76,3 +76,49 @@ def test_bind_offsets_matches_host_rule():
     # field0: NaN -> stays unbound; field1: binds to 2.5; field2: already bound
     np.testing.assert_array_equal(np.asarray(new_bound), [False, True, True])
     np.testing.assert_allclose(np.asarray(new_off), [0.0, 2.5, 0.0])
+
+
+def test_scalar_encoder_parity_and_properties():
+    """Classic ScalarEncoder (SURVEY.md C2): host/device bit-identical, and
+    the classic properties hold — nearby values share bits proportionally to
+    distance, out-of-range values clip to the edge runs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rtap_tpu.config import ModelConfig, ScalarEncoderConfig
+    from rtap_tpu.models.oracle.encoders import encode_record
+    from rtap_tpu.ops.encoders_tpu import encode_device
+
+    cfg = ModelConfig(scalar=ScalarEncoderConfig(size=100, width=9,
+                                                 min_val=0.0, max_val=50.0))
+    assert cfg.input_size == 100 + cfg.date.size
+    off = np.zeros(1, np.float32)
+    sdrs = {}
+    for v in (-5.0, 0.0, 1.0, 25.0, 26.0, 49.9, 50.0, 75.0, float("nan")):
+        host = encode_record(cfg, np.array([v]), 1_700_000_000, off)
+        dev = np.asarray(
+            encode_device(cfg, jnp.float32([v]), jnp.int32(1_700_000_000),
+                          jnp.asarray(off))
+        )
+        np.testing.assert_array_equal(host, dev, err_msg=str(v))
+        sdrs[v] = host[:100]
+    w = 9
+    assert sdrs[25.0].sum() == w
+    # adjacent buckets overlap in w-1 bits; distance decays overlap
+    assert (sdrs[25.0] & sdrs[26.0]).sum() in (w - 2, w - 1)
+    assert (sdrs[1.0] & sdrs[49.9]).sum() == 0
+    # clipping: out-of-range == edge encodings; NaN encodes nothing
+    np.testing.assert_array_equal(sdrs[-5.0], sdrs[0.0])
+    np.testing.assert_array_equal(sdrs[75.0], sdrs[50.0])
+    nan_sdr = encode_record(cfg, np.array([np.nan]), 1_700_000_000, off)
+    assert nan_sdr[:100].sum() == 0
+    # full pipeline compiles with the scalar encoder selected
+    from rtap_tpu.models.htm_model import HTMModel
+
+    m_cpu = HTMModel(cfg, seed=2, backend="cpu")
+    m_dev = HTMModel(cfg, seed=2, backend="tpu")
+    for i in range(30):
+        v = 25.0 + 10.0 * np.sin(i / 3)
+        r1 = m_cpu.run(1_700_000_000 + i, v)
+        r2 = m_dev.run(1_700_000_000 + i, v)
+        assert r1.raw_score == r2.raw_score, i

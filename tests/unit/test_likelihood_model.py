@@ -134,3 +134,34 @@ class TestAnomalyDetector:
             alerts.append(alert)
         assert not any(alerts[100:250])
         assert any(alerts[250:270])
+
+
+def test_window_ring_memory_guard(monkeypatch, caplog):
+    """Window mode's [G, W] host ring warns above 1 GB and refuses above the
+    (env-overridable) hard cap — the 100k-stream regime must use streaming
+    mode, not silently swallow host RAM (SURVEY.md §7 hard part 5)."""
+    import logging
+
+    import pytest
+
+    from rtap_tpu.config import LikelihoodConfig
+    from rtap_tpu.service.likelihood_batch import BatchAnomalyLikelihood
+
+    cfg = LikelihoodConfig(mode="window", historic_window_size=8640)
+    monkeypatch.setenv("RTAP_MAX_LIKELIHOOD_RING_GB", "0.05")
+    with pytest.raises(ValueError, match="streaming"):
+        BatchAnomalyLikelihood(cfg, 100_000)
+    # warn path: shrink the soft limit so the test ring stays tiny
+    monkeypatch.setenv("RTAP_MAX_LIKELIHOOD_RING_GB", "1000")
+    small = LikelihoodConfig(mode="window", historic_window_size=10)
+
+    class _Probe(BatchAnomalyLikelihood):
+        RING_WARN_BYTES = 1024
+
+    with caplog.at_level(logging.WARNING):
+        _Probe(small, 100)  # 8 * 100 * 10 = 8000 B > 1024 B probe limit
+    assert any("streaming" in r.message for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        BatchAnomalyLikelihood(small, 4)  # tiny ring: silent
+    assert not caplog.records

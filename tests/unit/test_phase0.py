@@ -166,3 +166,19 @@ class TestScorer:
         s_std = score_file(det >= 0.5, ts, windows, PROFILES["standard"])
         s_fp = score_file(det >= 0.5, ts, windows, PROFILES["reward_low_FP"])
         assert s_fp < s_std
+
+
+def test_scalar_encoder_config_validation():
+    import pytest
+
+    from rtap_tpu.config import ModelConfig, ScalarEncoderConfig
+
+    with pytest.raises(ValueError, match="width"):
+        ModelConfig(scalar=ScalarEncoderConfig(size=10, width=21))
+    with pytest.raises(ValueError, match="min_val"):
+        ModelConfig(scalar=ScalarEncoderConfig(min_val=5.0, max_val=5.0))
+    # round-trips through JSON including the optional scalar section
+    cfg = ModelConfig(scalar=ScalarEncoderConfig(size=80, width=9, max_val=50.0))
+    back = ModelConfig.from_json(cfg.to_json())
+    assert back.scalar == cfg.scalar and back.input_size == cfg.input_size
+    assert ModelConfig.from_json(ModelConfig().to_json()).scalar is None
