@@ -1,0 +1,169 @@
+"""Pallas TPU kernel: fused TM dendrite-activity pass.
+
+The dendrite pass — "for every synapse, is its presynaptic cell active, and
+is it connected?" followed by per-segment counts — runs EVERY tick on the
+full [C, K, S, M] pools (inference and learning alike; SURVEY.md §3.2 TM
+hot loop). The XLA formulation in tm_tpu.py materializes several
+pool-shaped intermediates ([..., Ac] compare, bit probe, two boolean
+masks) between HBM round-trips; this kernel fuses the whole pass in VMEM:
+
+    synapse activity:  msk = Σ_i where(presyn//K == col_ids[i], col_masks[i])
+                       act = presyn >= 0  &  (msk >> (presyn % K)) & 1
+    segment counts:    pot  = Σ_M act            (0/1 f32 matmul on the MXU
+                       conn = Σ_M act & (perm >= thr)   with a block-diagonal
+                                                        reduction matrix)
+
+Layout: the pools flatten to [C, K*S*M] (rows = columns, lanes = synapses),
+which keeps the VPU lanes dense for any preset; the Σ_M reduction is a
+[C, K*S*M] x [K*S*M, K*S] matmul whose operand is a static 0/1
+block-diagonal matrix — exact integer counts in f32 (counts <= M < 2^24).
+
+Semantics are bit-identical to `tm_tpu._presyn_active_packed` + the count
+reductions (asserted by tests/parity/test_pallas_tm.py, which runs the
+kernel in interpreter mode on CPU). OFF by default: enable with
+RTAP_TM_PALLAS=1 (or set USE_PALLAS) once profiled on silicon — shipping an
+unmeasured kernel as the default would repeat the round-1 mistake of
+hand-scheduling what XLA already does well.
+
+Interpreter-mode caveat: off-TPU the kernel runs through the Pallas
+interpreter, which is orders of magnitude slower to compile/run than the
+XLA formulation — fine for the small parity tests, pathological for large
+CPU replays (a G=256 x T=64 chunk fails to even compile within minutes).
+Only enable the flag on real TPU hardware or in small tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# None = read RTAP_TM_PALLAS env (default off); tests set True/False directly.
+USE_PALLAS: bool | None = None
+
+# The whole per-stream pool must fit VMEM (no grid/blocking in this v1
+# kernel): presyn i32 + perm f32 + reduce matrix + outputs, ~16 MiB budget.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+# Interpreter mode (off-TPU) is for parity tests only; refuse big shapes
+# instead of silently hanging for minutes.
+_INTERPRET_MAX_SYNAPSES = 1 << 18
+
+
+def use_pallas() -> bool:
+    """Whether tm_step routes the dendrite pass through the Pallas kernel.
+
+    NOTE: consulted at TRACE time — a compiled tm_step/group_step keeps
+    whichever path it was traced with. Toggle via :func:`set_use_pallas`
+    (which drops jit caches) rather than mutating the env mid-process.
+    """
+    if USE_PALLAS is not None:
+        return USE_PALLAS
+    return os.environ.get("RTAP_TM_PALLAS", "0") not in ("", "0")
+
+
+def set_use_pallas(on: bool | None) -> None:
+    """Set the kernel flag AND clear jit caches so already-traced step
+    functions re-trace with the new path (the flag is a trace-time constant,
+    not a jit cache key)."""
+    global USE_PALLAS
+    USE_PALLAS = on
+    jax.clear_caches()
+
+
+@functools.lru_cache(maxsize=None)
+def _reduce_matrix(ks: int, m: int) -> np.ndarray:
+    """Block-diagonal 0/1 [ks*m, ks] f32: column s sums synapse lanes
+    [s*m, (s+1)*m) — the Σ_M reduction as one MXU matmul."""
+    r = np.zeros((ks * m, ks), np.float32)
+    for s in range(ks):
+        r[s * m : (s + 1) * m, s] = 1.0
+    return r
+
+
+def _kernel(K: int, thr: float, Ac: int,
+            presyn_ref, perm_ref, ids_ref, masks_ref, red_ref,
+            conn_ref, pot_ref):
+    presyn = presyn_ref[:]  # [C, K*S*M] i32
+    c_pre = presyn // K  # -1 -> -1 (floor): never equals a valid col id
+    k_pre = presyn % K  # -1 -> K-1, masked by presyn >= 0 below
+    msk = jnp.zeros_like(presyn)
+    for i in range(Ac):  # static unroll: Ac = col_cap is tiny (10-40)
+        msk = msk + jnp.where(c_pre == ids_ref[0, i], masks_ref[0, i], 0)
+    syn_act = (presyn >= 0) & (((msk >> k_pre) & 1) > 0)
+    pot_f = syn_act.astype(jnp.float32)
+    conn_f = jnp.where(perm_ref[:] >= thr, pot_f, 0.0)
+    red = red_ref[:]
+    conn_ref[:] = jnp.round(
+        jnp.dot(conn_f, red, preferred_element_type=jnp.float32)
+    ).astype(jnp.int32)
+    pot_ref[:] = jnp.round(
+        jnp.dot(pot_f, red, preferred_element_type=jnp.float32)
+    ).astype(jnp.int32)
+
+
+def dendrite_activity_pallas(
+    presyn: jnp.ndarray,  # [C, K, S, M] int (any width; -1 = empty)
+    syn_perm: jnp.ndarray,  # [C, K, S, M] storage domain
+    col_ids: jnp.ndarray,  # [Ac] i32 active column ids (C fills)
+    col_masks: jnp.ndarray,  # [Ac] i32 packed K-bit cell masks
+    connected_thr,  # python scalar in the storage domain
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """-> (conn_count [C, K, S] i32, pot_count [C, K, S] i32).
+
+    `interpret` defaults to True off-TPU (CPU tests run the interpreter);
+    pass False only on real TPU.
+    """
+    C, K, S, M = presyn.shape
+    Ac = col_ids.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_syn = C * K * S * M
+    if interpret and n_syn > _INTERPRET_MAX_SYNAPSES:
+        raise ValueError(
+            f"Pallas dendrite kernel in INTERPRETER mode with {n_syn} synapses "
+            f"(> {_INTERPRET_MAX_SYNAPSES}): this path exists for small parity "
+            "tests; on CPU leave RTAP_TM_PALLAS off (the XLA formulation is "
+            "the fast path there)"
+        )
+    # v1 kernel has no grid/blocking: the whole per-stream pool must fit VMEM
+    block_bytes = n_syn * (4 + 4) + (K * S * M) * (K * S) * 4 + C * K * S * 2 * 4
+    if block_bytes > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"Pallas dendrite kernel needs ~{block_bytes >> 20} MiB VMEM for "
+            f"[C={C}, K={K}, S={S}, M={M}] (budget ~{_VMEM_BUDGET_BYTES >> 20} "
+            "MiB): this preset is too large for the unblocked v1 kernel — "
+            "leave RTAP_TM_PALLAS off for it"
+        )
+    kernel = functools.partial(_kernel, K, float(connected_thr), Ac)
+    conn, pot = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((C, K * S), jnp.int32),
+            jax.ShapeDtypeStruct((C, K * S), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(
+        presyn.reshape(C, K * S * M).astype(jnp.int32),
+        syn_perm.reshape(C, K * S * M).astype(jnp.float32),
+        col_ids.reshape(1, Ac).astype(jnp.int32),
+        col_masks.reshape(1, Ac).astype(jnp.int32),
+        jnp.asarray(_reduce_matrix(K * S, M)),
+    )
+    return conn.reshape(C, K, S), pot.reshape(C, K, S)

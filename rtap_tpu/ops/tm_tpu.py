@@ -432,9 +432,18 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     tm_overflow = state["tm_overflow"] + (
         overflow_learn | (a_cols > Ac)
     ).astype(jnp.int32)
-    syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
-    conn_count = (syn_act & (syn_perm >= p_connected)).sum(-1)
-    pot_count = syn_act.sum(-1)
+    from rtap_tpu.ops.pallas_tm import dendrite_activity_pallas, use_pallas
+
+    if use_pallas():
+        # fused VMEM kernel, bit-identical semantics (ops/pallas_tm.py);
+        # opt-in until profiled on silicon
+        conn_count, pot_count = dendrite_activity_pallas(
+            presyn, syn_perm, acol_ids, acol_masks, p_connected
+        )
+    else:
+        syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
+        conn_count = (syn_act & (syn_perm >= p_connected)).sum(-1)
+        pot_count = syn_act.sum(-1)
     active_seg = exists_seg & (conn_count >= cfg.activation_threshold)
     matching_seg = exists_seg & (pot_count >= cfg.min_threshold)
     seg_pot = jnp.where(exists_seg, pot_count, 0).astype(jnp.int16)

@@ -54,6 +54,42 @@ def run(tmp_root):
     return raw, loglik
 
 
+GOLDEN_Q16_PATH = Path(__file__).parent / "golden_cluster_q16.npz"
+Q16_ROWS = 900
+
+
+def run_quant():
+    """Golden for the quantized (u16) cluster preset over a deterministic
+    synthetic stream — pins the fixed-point arithmetic itself (a change to
+    quantum conversion or integer update order shows up here even if
+    oracle/device parity still holds, since both would drift together)."""
+    import dataclasses
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_stream
+    from rtap_tpu.models import AnomalyDetector
+
+    base = cluster_preset(perm_bits=16)
+    cfg = dataclasses.replace(
+        base, likelihood=dataclasses.replace(base.likelihood, mode="window")
+    )
+    s = generate_stream(
+        "golden.cpu",
+        SyntheticStreamConfig(length=Q16_ROWS, n_anomalies=1,
+                              kinds=("level_shift",), anomaly_magnitude=6.0,
+                              noise_phi=0.97, noise_scale=0.5,
+                              inject_after_frac=cfg.likelihood.safe_inject_frac(Q16_ROWS)),
+        seed=33,
+    )
+    det = AnomalyDetector(cfg, seed=0)
+    raw = np.zeros(Q16_ROWS)
+    loglik = np.zeros(Q16_ROWS)
+    for i in range(Q16_ROWS):
+        res = det.model.run(int(s.timestamps[i]), float(s.values[i]))
+        raw[i], loglik[i] = res.raw_score, res.log_likelihood
+    return raw, loglik
+
+
 if __name__ == "__main__":
     import tempfile
 
@@ -61,3 +97,6 @@ if __name__ == "__main__":
         raw, loglik = run(Path(td) / "nab")
     np.savez(GOLDEN_PATH, raw=raw, loglik=loglik)
     print(f"wrote {GOLDEN_PATH}: raw mean={raw.mean():.4f} loglik mean={loglik.mean():.4f}")
+    raw, loglik = run_quant()
+    np.savez(GOLDEN_Q16_PATH, raw=raw, loglik=loglik)
+    print(f"wrote {GOLDEN_Q16_PATH}: raw mean={raw.mean():.4f} loglik mean={loglik.mean():.4f}")

@@ -13,3 +13,16 @@ def test_golden_config1(tmp_path):
     raw, loglik = run(tmp_path / "nab")
     np.testing.assert_array_equal(raw, golden["raw"])
     np.testing.assert_allclose(loglik, golden["loglik"], atol=1e-12)
+
+
+def test_golden_cluster_quantized():
+    """Frozen sequence for the u16 cluster preset: pins the fixed-point
+    permanence arithmetic against history (parity tests can't catch a drift
+    that moves oracle and device together)."""
+    from tests.golden.generate_golden import GOLDEN_Q16_PATH, run_quant
+
+    assert GOLDEN_Q16_PATH.exists(), "run python tests/golden/generate_golden.py"
+    golden = np.load(GOLDEN_Q16_PATH)
+    raw, loglik = run_quant()
+    np.testing.assert_array_equal(raw, golden["raw"])
+    np.testing.assert_allclose(loglik, golden["loglik"], atol=1e-12)
