@@ -36,8 +36,10 @@ TARGET = 100_000.0  # metrics/sec/chip north star (BASELINE.json)
 
 # (group_size, chunk_ticks): the cheap anchor first, then ascending toward
 # the HBM frontier. Attempt order is also failure-isolation order — a big-G
-# OOM or compile stall costs only its own budget.
-ATTEMPTS = [(256, 64), (2048, 64), (8192, 64), (16384, 64), (32768, 64)]
+# OOM or compile stall costs only its own budget. Ceiling: the u16 cluster
+# preset is 564 KB/stream (SCALING.md), so ~24.5k streams fill a 16 GiB
+# chip with workspace headroom; 32k would OOM.
+ATTEMPTS = [(256, 64), (2048, 64), (8192, 64), (16384, 64), (24576, 64)]
 
 
 def log(msg: str) -> None:
@@ -48,14 +50,12 @@ def log(msg: str) -> None:
 
 
 def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> dict:
-    from rtap_tpu.utils.platform import maybe_force_cpu
+    from rtap_tpu.utils.platform import enable_compile_cache, maybe_force_cpu
 
     maybe_force_cpu()  # RTAP_FORCE_CPU=1: deterministic CPU (tests/drives)
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
 
     # The axon sitecustomize selects jax_platforms="axon,cpu": if the TPU
     # tunnel fast-fails at init, JAX silently falls back to CPU and this

@@ -10,6 +10,7 @@ scaling problem (SURVEY.md §5 "Long-context").
 """
 
 from rtap_tpu.parallel.sharding import (
+    broadcast_group_state,
     init_distributed,
     make_stream_mesh,
     put_sharded,
@@ -17,4 +18,11 @@ from rtap_tpu.parallel.sharding import (
     stream_sharding,
 )
 
-__all__ = ["init_distributed", "make_stream_mesh", "put_sharded", "shard_state", "stream_sharding"]
+__all__ = [
+    "broadcast_group_state",
+    "init_distributed",
+    "make_stream_mesh",
+    "put_sharded",
+    "shard_state",
+    "stream_sharding",
+]

@@ -76,6 +76,40 @@ def put_sharded(value: np.ndarray, mesh, axis: int = 0):
     return jax.make_array_from_callback(value.shape, sharding, lambda idx: value[idx])
 
 
+def broadcast_group_state(single: dict, group_size: int, mesh) -> dict:
+    """Build the sharded [G, ...] group state directly from ONE stream's
+    state dict, without ever materializing the full group on host.
+
+    `replicate_state` + `shard_state` peaks at several copies of the full
+    group (measured 4.7x of state size at G=4k — fatal at the 100k-stream
+    x ~54 GiB scale). Here each shard's host-side source is a numpy
+    broadcast VIEW of the single-stream leaf (zero bytes), copied exactly
+    once into its device buffer by make_array_from_callback. Works
+    single-process and multi-host (callback materializes only local shards).
+    """
+    import jax
+
+    n = mesh.devices.size
+    if group_size % n:
+        raise ValueError(
+            f"group size {group_size} not divisible by mesh size {n} (the "
+            "registry pads groups to a fixed size — pick a multiple of the "
+            "chip count)"
+        )
+    out = {}
+    for k, v in single.items():
+        v = np.asarray(v)
+        shape = (group_size, *v.shape)
+        sharding = stream_sharding(mesh, len(shape), 0)
+
+        def cb(idx, v=v):
+            n = len(range(*idx[0].indices(group_size)))
+            return np.broadcast_to(v[None], (n, *v.shape))
+
+        out[k] = jax.make_array_from_callback(shape, sharding, cb)
+    return out
+
+
 def shard_state(state: dict, mesh) -> dict:
     """Shard every leaf of a group state pytree on its leading (stream) axis
     over the mesh. Group size must be divisible by the mesh size (the

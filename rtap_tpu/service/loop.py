@@ -116,7 +116,7 @@ def replay_streams(
         log_likelihood=loglik,
         alerts=alerts,
         predictions=preds,
-        throughput={**counter.stats(), "alerts": writer.count},
+        throughput={**counter.stats(), "alerts": writer.count, **_occupancy()},
     )
 
 
@@ -159,4 +159,22 @@ def live_loop(
         }
         lat["latency_max_ms"] = round(float(latencies.max()) * 1e3, 3)
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
-            "ticks": n_ticks, "cadence_s": cadence_s, **lat}
+            "ticks": n_ticks, "cadence_s": cadence_s, **lat, **_occupancy()}
+
+
+def _occupancy() -> dict:
+    """Device HBM occupancy for the throughput stats (observability —
+    SURVEY.md §5 metrics/logging). Empty when the backend exposes none
+    (CPU test backend)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats() or {}
+        out = {}
+        if "bytes_in_use" in stats:
+            out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+        if "peak_bytes_in_use" in stats:
+            out["hbm_peak_bytes_in_use"] = int(stats["peak_bytes_in_use"])
+        return out
+    except Exception:
+        return {}

@@ -64,13 +64,14 @@ class StreamGroup:
             from rtap_tpu.models.state import init_state
             from rtap_tpu.ops.step import replicate_state
 
-            host_state = replicate_state(init_state(cfg, seed), self.G)
             if mesh is not None:
-                from rtap_tpu.parallel.sharding import shard_state
+                # memory-lean: per-shard broadcast views, never the full
+                # group on host (54 GiB at the 100k-stream scale)
+                from rtap_tpu.parallel.sharding import broadcast_group_state
 
-                self.state = shard_state(host_state, mesh)
+                self.state = broadcast_group_state(init_state(cfg, seed), self.G, mesh)
             else:
-                self.state = jax.device_put(host_state)
+                self.state = jax.device_put(replicate_state(init_state(cfg, seed), self.G))
         else:
             from rtap_tpu.models.oracle.temporal_memory import TMOracle
             from rtap_tpu.models.state import init_state

@@ -19,6 +19,31 @@ from __future__ import annotations
 import os
 
 
+def enable_compile_cache(repo_root: str) -> None:
+    """Turn on JAX's persistent compilation cache at `<repo_root>/.jax_cache`
+    (cache everything — min sizes/times zeroed). Shared by bench.py and the
+    scripts so retries and later rounds skip recompilation."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def force_virtual_devices(n: int) -> None:
+    """Give this process n virtual CPU devices (must run before first jax
+    backend use): sets --xla_force_host_platform_device_count and pins the
+    CPU platform (the axon sitecustomize would otherwise init the TPU)."""
+    import jax
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+
+
 def maybe_force_cpu(env_var: str = "RTAP_FORCE_CPU") -> bool:
     """If ``$RTAP_FORCE_CPU`` is truthy, pin jax to the CPU platform (must be
     called before any jax backend use). Returns whether CPU was forced."""
