@@ -165,7 +165,14 @@ def live_loop(
 def _occupancy() -> dict:
     """Device HBM occupancy for the throughput stats (observability —
     SURVEY.md §5 metrics/logging). Empty when the backend exposes none
-    (CPU test backend)."""
+    (CPU test backend). Only consulted when jax is ALREADY in use: a pure
+    CPU-oracle run must not initialize the TPU backend as a stats side
+    effect (backend init can hang on a wedged tunnel, and would claim the
+    exclusive chip out from under a concurrent device run)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return {}
     try:
         import jax
 

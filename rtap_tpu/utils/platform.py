@@ -33,14 +33,24 @@ def enable_compile_cache(repo_root: str) -> None:
 def force_virtual_devices(n: int) -> None:
     """Give this process n virtual CPU devices (must run before first jax
     backend use): sets --xla_force_host_platform_device_count and pins the
-    CPU platform (the axon sitecustomize would otherwise init the TPU)."""
+    CPU platform (the axon sitecustomize would otherwise init the TPU).
+    An existing flag with a DIFFERENT count is an error — silently keeping
+    it would make the later mesh construction fail far from the cause."""
+    import re
+
     import jax
 
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
+    elif int(m.group(1)) < n:
+        raise ValueError(
+            f"XLA_FLAGS already forces {m.group(1)} host devices but {n} "
+            "were requested; unset the flag or raise its value"
+        )
     jax.config.update("jax_platforms", "cpu")
 
 

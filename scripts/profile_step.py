@@ -13,11 +13,19 @@ jax.profiler trace for xprof.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from functools import partial
 
-import jax
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+# must precede the jax / rtap_tpu.ops imports below — ops modules hold
+# module-level jnp constants that initialize the backend at import time
+maybe_force_cpu()
+
+import jax  # noqa: E402
 import jax.numpy as jnp
 import numpy as np
 
@@ -94,7 +102,20 @@ def main():
     ap.add_argument("--trace", default=None)
     ap.add_argument("--T", type=int, default=32)
     ap.add_argument("--gs", type=int, nargs="*", default=[512, 2048, 4096, 8192])
+    ap.add_argument("--pallas", action="store_true",
+                    help="route the TM dendrite pass through the Pallas "
+                         "kernel (ops/pallas_tm.py) — compare a run with "
+                         "and without this flag on hardware")
     args = ap.parse_args()
+
+    from rtap_tpu.utils.platform import enable_compile_cache
+
+    enable_compile_cache(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    if args.pallas:
+        from rtap_tpu.ops.pallas_tm import set_use_pallas
+
+        set_use_pallas(True)
+        log("Pallas dendrite kernel: ENABLED")
 
     cfg = cluster_preset()
     T = args.T
