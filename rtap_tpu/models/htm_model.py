@@ -83,13 +83,16 @@ class ModelResult:
 class HTMModel:
     """One HTM anomaly model over one (possibly multivariate) metric stream."""
 
-    def __init__(self, cfg: ModelConfig, seed: int = 0, backend: str = "cpu"):
+    def __init__(self, cfg: ModelConfig, seed: int = 0, backend: str = "cpu",
+                 _state: dict | None = None):
         if backend not in BACKENDS:
             raise ValueError(f"backend must be one of {BACKENDS}, got {backend!r}")
         self.cfg = cfg
         self.backend = backend
         self.seed = seed
-        self.state = init_state(cfg, seed)
+        # _state: prebuilt state injection (HTMModel.load) — skips the RNG
+        # init whose arrays would be immediately overwritten
+        self.state = init_state(cfg, seed) if _state is None else _state
         self.likelihood = AnomalyLikelihood(cfg.likelihood)
         self._classifier = None
         if backend == "cpu":
@@ -126,6 +129,54 @@ class HTMModel:
 
         lik, loglik = self.likelihood.update(float(raw))
         return ModelResult(float(raw), lik, loglik, pred, prob)
+
+    # ---- single-model persistence (SURVEY.md C16: the reference's
+    # model.save() / ModelFactory.loadFromCheckpoint surface; group-scale
+    # checkpoints use service/checkpoint.py's orbax path instead) ----
+
+    def save(self, path: str) -> None:
+        """Serialize the FULL model (SDR state, likelihood state machine,
+        config, seed) to one .npz; `HTMModel.load` resumes bit-exactly.
+        The write is atomic (temp sibling + rename, like the group
+        checkpoint path): a crash mid-save can never corrupt an existing
+        checkpoint at `path`."""
+        import os
+
+        if self.backend == "cpu":
+            state = self.state
+        else:
+            import jax
+
+            state = jax.device_get(self._runner.state)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            np.savez_compressed(
+                tmp,
+                config_json=np.frombuffer(self.cfg.to_json().encode(), np.uint8),
+                seed=np.asarray(self.seed, np.int64),
+                **{f"lik_{k}": v for k, v in self.likelihood.state_dict().items()},
+                **{f"s_{k}": np.asarray(v) for k, v in state.items()},
+            )
+            # savez appends .npz when missing — mirror that for the temp name
+            if not tmp.endswith(".npz") and os.path.exists(tmp + ".npz"):
+                tmp += ".npz"
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp) and os.path.abspath(tmp) != os.path.abspath(path):
+                os.unlink(tmp)
+
+    @classmethod
+    def load(cls, path: str, backend: str = "cpu") -> "HTMModel":
+        """Rebuild a model from :meth:`save`. `backend` may differ from the
+        saving side (cpu<->tpu resume; the state layout is shared)."""
+        with np.load(path) as z:
+            cfg = ModelConfig.from_json(bytes(z["config_json"]).decode())
+            loaded = {k[2:]: z[k] for k in z.files if k.startswith("s_")}
+            lik_state = {k[4:]: z[k] for k in z.files if k.startswith("lik_")}
+            seed = int(z["seed"])
+        model = cls(cfg, seed=seed, backend=backend, _state=loaded)
+        model.likelihood.load_state_dict(lik_state)
+        return model
 
 
 def create_model(

@@ -79,6 +79,32 @@ class AnomalyLikelihood:
         self.std = max(math.sqrt(var), 1e-6)
         self.have_distribution = self.records >= self.cfg.probationary_period
 
+    # serialization seam, mirroring BatchAnomalyLikelihood.state_dict — the
+    # single source of truth for what this state machine persists
+    def state_dict(self) -> dict:
+        import numpy as np
+
+        return {
+            "records": np.asarray(self.records, np.int64),
+            "have_distribution": np.asarray(int(self.have_distribution), np.int64),
+            "scalars": np.array(
+                [self.mean, self.std, self._s0, self._s1, self._s2], np.float64
+            ),
+            "scores": np.asarray(self.scores, np.float64),
+            "recent": np.asarray(self.recent, np.float64),
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        from collections import deque
+
+        self.records = int(d["records"])
+        self.have_distribution = bool(d["have_distribution"])
+        self.mean, self.std, self._s0, self._s1, self._s2 = (
+            float(x) for x in d["scalars"]
+        )
+        self.scores = deque(d["scores"].tolist(), maxlen=self.cfg.historic_window_size)
+        self.recent = deque(d["recent"].tolist(), maxlen=self.cfg.averaging_window)
+
     def update(self, raw_score: float) -> tuple[float, float]:
         """Feed one raw anomaly score -> (likelihood, log_likelihood)."""
         self.records += 1

@@ -107,3 +107,66 @@ def test_checkpoint_roundtrip_with_classifier(backend, tmp_path):
         r_res = resumed.tick(v, 1_700_000_000 + i)
         np.testing.assert_array_equal(r_ref.raw, r_res.raw, err_msg=f"tick {i}")
         np.testing.assert_array_equal(r_ref.prediction, r_res.prediction, err_msg=f"tick {i}")
+
+
+class TestSingleModelSaveLoad:
+    """HTMModel.save/load (SURVEY.md C16 model.save surface): resume is
+    bit-exact vs an uninterrupted run, across backends and domains."""
+
+    def _vals(self, n=220):
+        import numpy as np
+
+        t = np.arange(n)
+        v = (50 + 20 * np.sin(2 * np.pi * t / 40.0)
+             + np.random.default_rng(8).normal(0, 2, n)).astype(np.float32)
+        v[int(0.77 * n)] += 35
+        return v
+
+    @pytest.mark.parametrize("perm_bits", [0, 16])
+    def test_roundtrip_bit_exact(self, tmp_path, perm_bits):
+        import dataclasses
+
+        import numpy as np
+
+        from rtap_tpu.models.htm_model import HTMModel
+
+        base = cluster_preset(perm_bits=perm_bits)
+        cfg = dataclasses.replace(
+            base, likelihood=dataclasses.replace(
+                base.likelihood, learning_period=60, estimation_samples=30)
+        )
+        vals = self._vals()
+        full = HTMModel(cfg, seed=4, backend="cpu")
+        ref = [full.run(1_700_000_000 + i, float(vals[i])) for i in range(220)]
+
+        m = HTMModel(cfg, seed=4, backend="cpu")
+        for i in range(150):
+            m.run(1_700_000_000 + i, float(vals[i]))
+        p = tmp_path / "model.npz"
+        m.save(str(p))
+        resumed = HTMModel.load(str(p))
+        assert resumed.cfg == cfg
+        out = [resumed.run(1_700_000_000 + i, float(vals[i])) for i in range(150, 220)]
+        for a, b in zip(out, ref[150:]):
+            assert a.raw_score == b.raw_score
+            assert a.log_likelihood == b.log_likelihood
+        # saved state untouched by the resumed run's mutation
+        with np.load(p) as z:
+            assert int(z["lik_records"]) == 150
+
+    def test_cpu_save_tpu_resume(self, tmp_path):
+        from rtap_tpu.models.htm_model import HTMModel
+
+        cfg = cluster_preset()
+        vals = self._vals(120)
+        m = HTMModel(cfg, seed=4, backend="cpu")
+        for i in range(80):
+            m.run(1_700_000_000 + i, float(vals[i]))
+        p = tmp_path / "model.npz"
+        m.save(str(p))
+        cpu = HTMModel.load(str(p), backend="cpu")
+        tpu = HTMModel.load(str(p), backend="tpu")
+        for i in range(80, 120):
+            a = cpu.run(1_700_000_000 + i, float(vals[i]))
+            b = tpu.run(1_700_000_000 + i, float(vals[i]))
+            assert a.raw_score == b.raw_score, i
