@@ -34,12 +34,15 @@ import time
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
 TARGET = 100_000.0  # metrics/sec/chip north star (BASELINE.json)
 
-# (group_size, chunk_ticks): the cheap anchor first, then ascending toward
-# the HBM frontier. Attempt order is also failure-isolation order — a big-G
-# OOM or compile stall costs only its own budget. Ceiling: the u16 cluster
-# preset is 564 KB/stream (SCALING.md), so ~24.5k streams fill a 16 GiB
-# chip with workspace headroom; 32k would OOM.
-ATTEMPTS = [(256, 64), (2048, 64), (8192, 64), (16384, 64), (24576, 64)]
+# (group_size, chunk_ticks): the cheap anchor first, then exploration.
+# Attempt order is also failure-isolation order — an OOM or compile stall
+# costs only its own budget (and OOM ends the ladder: larger G can only OOM
+# again). Measured on v5e (r3): throughput per chip FALLS with G (38,956 at
+# G=256 vs 29,725 at G=8192 — the per-stream kernel cost dominates and big
+# groups add nothing), and G=16384 is past the HBM frontier (XLA workspace
+# temps on top of the 564 KB/stream state). So the ladder brackets the
+# small-G peak and probes longer chunks to amortize per-dispatch overhead.
+ATTEMPTS = [(256, 64), (256, 256), (512, 128), (128, 64), (1024, 64), (2048, 64)]
 
 
 def log(msg: str) -> None:
@@ -50,9 +53,12 @@ def log(msg: str) -> None:
 
 
 def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> dict:
-    from rtap_tpu.utils.platform import enable_compile_cache, maybe_force_cpu
+    from rtap_tpu.utils.platform import (
+        enable_compile_cache, init_backend_or_die, maybe_force_cpu,
+    )
 
     maybe_force_cpu()  # RTAP_FORCE_CPU=1: deterministic CPU (tests/drives)
+    init_backend_or_die()  # wedged tunnel: die at 120s, not the full budget
     import jax
 
     enable_compile_cache(os.path.dirname(os.path.abspath(__file__)))
@@ -139,7 +145,15 @@ def main() -> None:
     signal.signal(signal.SIGINT, on_signal)
 
     os.makedirs(CACHE_DIR, exist_ok=True)
+    oom_at: tuple[int, int] | None = None  # (G, T) observed to OOM
     for group_size, chunk_ticks in ATTEMPTS:
+        if oom_at is not None and group_size >= oom_at[0] and chunk_ticks >= oom_at[1]:
+            # memory is monotone in G (state) and T (feed/workspace), so only
+            # configs dominating the observed OOM point in BOTH dims are
+            # doomed; smaller rungs later in the ladder still run
+            log(f"bench: skipping G={group_size},T={chunk_ticks} "
+                f"(dominates OOM point {oom_at})")
+            continue
         remaining = budget - (time.monotonic() - t_start)
         # never start an attempt we can't give a meaningful slice of budget
         if remaining < 60:
@@ -177,22 +191,39 @@ def main() -> None:
             finally:
                 current_proc[0] = None
             res = None
-            if proc.returncode == 0:
-                # last parseable stdout line wins; stray library prints must
-                # never crash the parent and lose an earlier result
-                for line in reversed(out.strip().splitlines()):
-                    try:
-                        cand = json.loads(line)
-                        if isinstance(cand, dict) and "value" in cand:
-                            res = cand
-                            break
-                    except ValueError:
-                        continue
+            oom = False
+            # last parseable stdout line wins; stray library prints must
+            # never crash the parent and lose an earlier result
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    cand = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(cand, dict) and cand.get("fatal") == "oom":
+                    oom = True
+                    break
+                if isinstance(cand, dict) and "value" in cand and proc.returncode == 0:
+                    res = cand
+                    break
+            if oom:
+                log(f"  G={group_size},T={chunk_ticks}: past the HBM frontier "
+                    "(OOM); skipping configs dominating this point")
+                oom_at = (group_size, chunk_ticks)
+                break
             if res is not None:
                 log(f"  G={group_size}: {res['value']:.1f} metrics/s")
                 if best is None or res["value"] > best["value"]:
                     best = res
                 break
+            if proc.returncode != 0 and not os.path.exists(marker) and attempt == 1:
+                # the child died without ever initializing the backend TWICE
+                # in a row (e.g. the init watchdog's 120s hard-exit on a
+                # wedged tunnel): every further attempt would fail the same
+                # way. A single init flake still gets its one retry first
+                # (the tunnel oscillates — see SCALING.md).
+                log("bench: backend init failure persisted, aborting attempts")
+                emit(best)
+                sys.exit(0 if best is not None else 1)
             transient = proc.returncode != 0 and attempt == 0
             log(f"  G={group_size}: attempt failed rc={proc.returncode}"
                 + (", retrying once" if transient else ""))
@@ -206,6 +237,14 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--attempt":
         g, t = int(sys.argv[2]), int(sys.argv[3])
-        print(json.dumps(run_attempt(g, t)), flush=True)
+        try:
+            print(json.dumps(run_attempt(g, t)), flush=True)
+        except Exception as e:  # noqa: BLE001 — classify for the parent
+            if "RESOURCE_EXHAUSTED" in str(e) or "out of memory" in str(e).lower():
+                # tell the parent this G is past the HBM frontier: no retry,
+                # and no larger config can succeed either
+                print(json.dumps({"fatal": "oom"}), flush=True)
+                sys.exit(3)
+            raise
     else:
         main()

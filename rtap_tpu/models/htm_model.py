@@ -162,8 +162,11 @@ class HTMModel:
                 tmp += ".npz"
             os.replace(tmp, path)
         finally:
-            if os.path.exists(tmp) and os.path.abspath(tmp) != os.path.abspath(path):
-                os.unlink(tmp)
+            # a failed savez may have left either spelling behind (numpy
+            # appends .npz to suffix-less names before writing)
+            for residue in (tmp, tmp if tmp.endswith(".npz") else tmp + ".npz"):
+                if os.path.exists(residue) and os.path.abspath(residue) != os.path.abspath(path):
+                    os.unlink(residue)
 
     @classmethod
     def load(cls, path: str, backend: str = "cpu") -> "HTMModel":

@@ -82,8 +82,6 @@ class AnomalyLikelihood:
     # serialization seam, mirroring BatchAnomalyLikelihood.state_dict — the
     # single source of truth for what this state machine persists
     def state_dict(self) -> dict:
-        import numpy as np
-
         return {
             "records": np.asarray(self.records, np.int64),
             "have_distribution": np.asarray(int(self.have_distribution), np.int64),
@@ -95,8 +93,6 @@ class AnomalyLikelihood:
         }
 
     def load_state_dict(self, d: dict) -> None:
-        from collections import deque
-
         self.records = int(d["records"])
         self.have_distribution = bool(d["have_distribution"])
         self.mean, self.std, self._s0, self._s1, self._s2 = (

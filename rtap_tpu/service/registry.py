@@ -59,10 +59,7 @@ class StreamGroup:
         # both run_chunk and tick so it can never serve stale data
         self.last_predictions: np.ndarray | None = None
         if backend == "tpu":
-            import jax
-
             from rtap_tpu.models.state import init_state
-            from rtap_tpu.ops.step import replicate_state
 
             if mesh is not None:
                 # memory-lean: per-shard broadcast views, never the full
@@ -71,7 +68,11 @@ class StreamGroup:
 
                 self.state = broadcast_group_state(init_state(cfg, seed), self.G, mesh)
             else:
-                self.state = jax.device_put(replicate_state(init_state(cfg, seed), self.G))
+                # one ~0.5 MB transfer + on-chip broadcast, never a [G, ...]
+                # host staging (208 s at the G=24k HBM frontier)
+                from rtap_tpu.ops.step import replicate_state_device
+
+                self.state = replicate_state_device(init_state(cfg, seed), self.G)
         else:
             from rtap_tpu.models.oracle.temporal_memory import TMOracle
             from rtap_tpu.models.state import init_state

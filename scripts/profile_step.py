@@ -19,11 +19,12 @@ import time
 from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+from rtap_tpu.utils.platform import init_backend_or_die, maybe_force_cpu  # noqa: E402
 
 # must precede the jax / rtap_tpu.ops imports below — ops modules hold
 # module-level jnp constants that initialize the backend at import time
 maybe_force_cpu()
+init_backend_or_die()  # the tunnel oscillates; die fast instead of hanging
 
 import jax  # noqa: E402
 import jax.numpy as jnp

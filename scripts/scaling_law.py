@@ -31,9 +31,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+from rtap_tpu.utils.platform import init_backend_or_die, maybe_force_cpu  # noqa: E402
 
 FORCED_CPU = maybe_force_cpu()
+
+# Marker separating the generated tables from hand-written analysis below it
+# (100k shard proof, likelihood-mode study, ...). write_scaling_md preserves
+# everything from this line on, so re-running the sweep can never destroy
+# committed measurements that were appended by other experiments.
+MANUAL_MARKER = "<!-- MANUAL: everything below survives scaling_law.py re-runs -->"
 
 HBM_BYTES = 16 * 1024**3  # v5e: 16 GiB HBM per chip
 WORKSPACE_RESERVE = 1.5 * 1024**3  # headroom for XLA workspace + feed buffers
@@ -117,7 +123,30 @@ def quality_rows(n_streams: int = 40, length: int = 1000):
     return rows
 
 
+def _carry_section(old_generated: str, heading_prefix: str) -> list[str] | None:
+    """Lines of the old generated section starting with `heading_prefix`, up
+    to the next '## ' heading — so a run without fresh data for a section
+    re-emits the previous run's measurements instead of a placeholder."""
+    lines = old_generated.splitlines()
+    start = next((i for i, l in enumerate(lines) if l.startswith(heading_prefix)), None)
+    if start is None:
+        return None
+    end = next(
+        (j for j in range(start + 1, len(lines)) if lines[j].startswith("## ")), len(lines)
+    )
+    block = lines[start:end]
+    while block and not block[-1].strip():  # normalize: exactly one trailing blank
+        block.pop()
+    return block + [""]
+
+
 def write_scaling_md(analytic, sweep, sweep_backend, quality) -> None:
+    path = os.path.join(REPO, "SCALING.md")
+    old = open(path).read() if os.path.exists(path) else ""
+    if MANUAL_MARKER in old:
+        old_generated, manual = old[: old.index(MANUAL_MARKER)], old[old.index(MANUAL_MARKER):]
+    else:
+        old_generated, manual = old, ""
     lines = [
         "# SCALING — measured memory & throughput laws (cluster preset)",
         "",
@@ -169,6 +198,8 @@ def write_scaling_md(analytic, sweep, sweep_backend, quality) -> None:
                     f"{r['hbm_bytes_in_use'] / 1024**3:.2f} GiB |"
                 )
         lines.append("")
+    elif carried := _carry_section(old_generated, "## Device G-sweep"):
+        lines += carried
     else:
         lines += [
             "## Device G-sweep",
@@ -192,14 +223,23 @@ def write_scaling_md(analytic, sweep, sweep_backend, quality) -> None:
                 f"{r['precision_episodes']:.3f} | {r['median_latency_s']} s |"
             )
         lines.append("")
-    with open(os.path.join(REPO, "SCALING.md"), "w") as f:
+    elif carried := _carry_section(old_generated, "## Detection quality"):
+        lines += carried
+    # idempotent tail: exactly one blank line, the manual block (normalized),
+    # one trailing newline — repeated runs must not accrete whitespace
+    while lines and not lines[-1].strip():
+        lines.pop()
+    lines += ["", (manual.rstrip() if manual else MANUAL_MARKER), ""]
+    with open(path, "w") as f:
         f.write("\n".join(lines))
     log({"wrote": "SCALING.md"})
 
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--gs", default="1024,4096,8192,16384,24576,32768",
+    # Default brackets the measured r3 frontier: throughput peaks at small G
+    # (38,956 at 256) and OOM lands between 8k and 16k (SCALING.md G-sweep).
+    ap.add_argument("--gs", default="256,512,1024,2048,4096,8192,12288,16384",
                     help="comma-separated group sizes for the device sweep")
     ap.add_argument("--quality", action="store_true",
                     help="run the (slow) per-domain fault-eval comparison")
@@ -210,6 +250,11 @@ def main() -> None:
     analytic = analytic_rows()
     sweep, backend = ([], "none")
     if not args.no_sweep and not FORCED_CPU:
+        # persist the analytic tables BEFORE touching the backend: the init
+        # watchdog hard-exits (os._exit) on a wedged tunnel, which would
+        # otherwise lose this run's results entirely
+        write_scaling_md(analytic, sweep, backend, [])
+        init_backend_or_die()
         sweep, backend = device_sweep([int(g) for g in args.gs.split(",")])
     quality = quality_rows() if args.quality else []
     write_scaling_md(analytic, sweep, backend, quality)
