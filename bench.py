@@ -36,8 +36,9 @@ TARGET = 100_000.0  # metrics/sec/chip north star (BASELINE.json)
 
 # (group_size, chunk_ticks): the cheap anchor first, then exploration.
 # Attempt order is also failure-isolation order — an OOM or compile stall
-# costs only its own budget (and OOM ends the ladder: larger G can only OOM
-# again). Measured on v5e (r3): throughput per chip FALLS with G (38,956 at
+# costs only its own budget (an OOM also skips every LATER rung that
+# dominates the failed (G, T) point in both dims; smaller rungs still run).
+# Measured on v5e (r3): throughput per chip FALLS with G (38,956 at
 # G=256 vs 29,725 at G=8192 — the per-stream kernel cost dominates and big
 # groups add nothing), and G=16384 is past the HBM frontier (XLA workspace
 # temps on top of the 564 KB/stream state). So the ladder brackets the
@@ -146,6 +147,7 @@ def main() -> None:
 
     os.makedirs(CACHE_DIR, exist_ok=True)
     oom_at: tuple[int, int] | None = None  # (G, T) observed to OOM
+    init_fail_streak = 0  # consecutive children that died without backend init
     for group_size, chunk_ticks in ATTEMPTS:
         if oom_at is not None and group_size >= oom_at[0] and chunk_ticks >= oom_at[1]:
             # memory is monotone in G (state) and T (feed/workspace), so only
@@ -180,6 +182,8 @@ def main() -> None:
                 proc.kill()
                 proc.wait()
                 log(f"  G={group_size}: killed at budget ({this_budget:.0f}s)")
+                if os.path.exists(marker):
+                    init_fail_streak = 0  # the backend DID come up this time
                 if not os.path.exists(marker):
                     # the child never even initialized the backend: the TPU
                     # tunnel is hanging, and every further attempt would burn
@@ -205,6 +209,8 @@ def main() -> None:
                 if isinstance(cand, dict) and "value" in cand and proc.returncode == 0:
                     res = cand
                     break
+            if os.path.exists(marker):
+                init_fail_streak = 0
             if oom:
                 log(f"  G={group_size},T={chunk_ticks}: past the HBM frontier "
                     "(OOM); skipping configs dominating this point")
@@ -215,15 +221,17 @@ def main() -> None:
                 if best is None or res["value"] > best["value"]:
                     best = res
                 break
-            if proc.returncode != 0 and not os.path.exists(marker) and attempt == 1:
-                # the child died without ever initializing the backend TWICE
-                # in a row (e.g. the init watchdog's 120s hard-exit on a
-                # wedged tunnel): every further attempt would fail the same
-                # way. A single init flake still gets its one retry first
-                # (the tunnel oscillates — see SCALING.md).
-                log("bench: backend init failure persisted, aborting attempts")
-                emit(best)
-                sys.exit(0 if best is not None else 1)
+            if proc.returncode != 0 and not os.path.exists(marker):
+                # the child died without ever initializing the backend (e.g.
+                # the init watchdog's 120s hard-exit on a wedged tunnel, or a
+                # fast-fail CPU fallback). One flake gets a retry — the
+                # tunnel oscillates (SCALING.md) — but two IN A ROW means
+                # every further attempt would fail the same way.
+                init_fail_streak += 1
+                if init_fail_streak >= 2:
+                    log("bench: backend init failure persisted, aborting attempts")
+                    emit(best)
+                    sys.exit(0 if best is not None else 1)
             transient = proc.returncode != 0 and attempt == 0
             log(f"  G={group_size}: attempt failed rc={proc.returncode}"
                 + (", retrying once" if transient else ""))

@@ -61,6 +61,40 @@ def _tpu_paths() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# Strategy switch for the learning workspace gather/scatter. "matmul" (the
+# round-2 default) routes row movement through one-hot MXU matmuls — but
+# each matmul reads/writes a FULL pool-shaped f32 array per tick, and the
+# v5e G-sweep (SCALING.md) shows the step is HBM-bound. "indexed" moves only
+# the <= col_cap touched rows with jnp.take / .at[].set(mode="drop"), cutting
+# full-pool f32 materializations out of the learning path. Both paths are
+# bit-identical (tests/parity/test_tpu_paths.py runs both); the default
+# stays "matmul" until "indexed" is measured faster on silicon — batched
+# (vmapped) gather/scatter lowering quality on TPU is exactly what the
+# experiment must answer. None = read RTAP_TM_SCATTER env (default matmul).
+SCATTER_MODE: str | None = None
+
+
+def scatter_mode() -> str:
+    import os
+
+    mode = SCATTER_MODE
+    if mode is None:
+        mode = os.environ.get("RTAP_TM_SCATTER", "matmul")
+    if mode not in ("matmul", "indexed"):
+        raise ValueError(f"RTAP_TM_SCATTER must be 'matmul' or 'indexed', got {mode!r}")
+    return mode
+
+
+def set_scatter_mode(mode: str | None) -> None:
+    """Set the workspace-movement strategy AND clear jit caches (the mode is
+    a trace-time constant, not a jit cache key)."""
+    if mode not in (None, "matmul", "indexed"):
+        raise ValueError(f"scatter mode must be None, 'matmul' or 'indexed', got {mode!r}")
+    global SCATTER_MODE
+    SCATTER_MODE = mode
+    jax.clear_caches()
+
+
 def _compact_ids(mask: jnp.ndarray, size: int) -> jnp.ndarray:
     """Indices of the first `size` True entries of `mask` [n], ascending,
     filled with n -> i32 [size].
@@ -310,20 +344,36 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         burst_new = alloc_col < C  # [C]
 
         # --- gather the active columns into the [Ac, ...] workspace ---
+        indexed = scatter_mode() == "indexed"
         col_ids = _compact_ids(active_cols, Ac)  # [Ac], fills = C
         col_oh_b = col_ids[:, None] == jnp.arange(C, dtype=jnp.int32)  # [Ac, C]
         col_oh = col_oh_b.astype(jnp.float32)
         hit_cols = col_oh_b.any(0)  # [C] columns actually captured (== active_cols sans overflow)
 
-        ws_presyn = jnp.round(
-            _gather_rows_f32(presyn.reshape(C, -1).astype(jnp.float32), col_oh)
-        ).astype(jnp.int32)  # [Ac, K*S*M]
-        ws_perm = _gather_rows_f32(syn_perm.reshape(C, -1).astype(jnp.float32), col_oh)  # [Ac, K*S*M]
-        ws_last = _gather_rows_i32(seg_last.reshape(C, -1), col_oh_b).reshape(Ac, K, S)
-        ws_pot = jnp.round(
-            _gather_rows_f32(state["seg_pot"].reshape(C, -1).astype(jnp.float32), col_oh)
-        ).astype(jnp.int32).reshape(Ac, K, S)  # seg_pot <= M << 2^24: f32-exact
-        ws_learn = (col_oh_b[:, :, None] & learn_mask.reshape(C, -1)[None]).any(1).reshape(Ac, K, S)
+        if indexed:
+            # move only the <= Ac touched rows; fill slots (id C) clamp to a
+            # junk copy of row C-1 that is masked out of learning (ws_learn /
+            # ws_alloc are False there) and dropped at scatter-back
+            idx_c = jnp.clip(col_ids, 0, C - 1)
+            ws_presyn = presyn.reshape(C, -1)[idx_c].astype(jnp.int32)
+            ws_perm = syn_perm.reshape(C, -1)[idx_c].astype(jnp.float32)
+            ws_last = seg_last.reshape(C, -1)[idx_c].reshape(Ac, K, S)
+            ws_pot = state["seg_pot"].reshape(C, -1)[idx_c].astype(jnp.int32).reshape(Ac, K, S)
+            ws_learn = (
+                learn_mask.reshape(C, -1)[idx_c] & (col_ids < C)[:, None]
+            ).reshape(Ac, K, S)
+        else:
+            ws_presyn = jnp.round(
+                _gather_rows_f32(presyn.reshape(C, -1).astype(jnp.float32), col_oh)
+            ).astype(jnp.int32)  # [Ac, K*S*M]
+            ws_perm = _gather_rows_f32(syn_perm.reshape(C, -1).astype(jnp.float32), col_oh)  # [Ac, K*S*M]
+            ws_last = _gather_rows_i32(seg_last.reshape(C, -1), col_oh_b).reshape(Ac, K, S)
+            ws_pot = jnp.round(
+                _gather_rows_f32(state["seg_pot"].reshape(C, -1).astype(jnp.float32), col_oh)
+            ).astype(jnp.int32).reshape(Ac, K, S)  # seg_pot <= M << 2^24: f32-exact
+            ws_learn = (
+                (col_oh_b[:, :, None] & learn_mask.reshape(C, -1)[None]).any(1).reshape(Ac, K, S)
+            )
 
         # --- burst-new allocation inside the workspace: clear slot + stamp ---
         ws_bn = (col_oh_b & burst_new[None, :]).any(-1)  # [Ac]
@@ -346,15 +396,21 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         R2 = Ac * K * S
         idx = _compact_ids(ws_learn.reshape(-1), L)  # [L], fills = R2
         valid_l = idx < R2
-        row_oh_b = idx[:, None] == jnp.arange(R2, dtype=jnp.int32)  # [L, R2]
-        row_oh = row_oh_b.astype(jnp.float32)
         ws_presyn_r = ws_presyn.reshape(R2, M)
         ws_perm_r = ws_perm.reshape(R2, M)
-        presyn_l = jnp.round(
-            _gather_rows_f32(ws_presyn_r.astype(jnp.float32), row_oh)
-        ).astype(jnp.int32)  # [L, M]
-        perm_l = _gather_rows_f32(ws_perm_r, row_oh)  # [L, M]
-        pot_l = jnp.where(row_oh_b, ws_pot.reshape(-1)[None, :], 0).sum(-1)  # [L]
+        if indexed:
+            idx_r = jnp.clip(idx, 0, R2 - 1)
+            presyn_l = ws_presyn_r[idx_r]  # [L, M]; fill rows junk, see below
+            perm_l = ws_perm_r[idx_r]
+            pot_l = jnp.where(valid_l, ws_pot.reshape(-1)[idx_r], 0)  # [L]
+        else:
+            row_oh_b = idx[:, None] == jnp.arange(R2, dtype=jnp.int32)  # [L, R2]
+            row_oh = row_oh_b.astype(jnp.float32)
+            presyn_l = jnp.round(
+                _gather_rows_f32(ws_presyn_r.astype(jnp.float32), row_oh)
+            ).astype(jnp.int32)  # [L, M]
+            perm_l = _gather_rows_f32(ws_perm_r, row_oh)  # [L, M]
+            pot_l = jnp.where(row_oh_b, ws_pot.reshape(-1)[None, :], 0).sum(-1)  # [L]
 
         # prev-step active cells, column-compact (shared by reinforce + punish)
         pcol_ids, pcol_masks, p_cols = _pack_active(state["prev_active"], Ac)
@@ -378,30 +434,59 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         presyn_l = jnp.where(grow_ok[:, None], grown_presyn, presyn_l)
         perm_l = jnp.where(grow_ok[:, None], grown_perm, perm_l)
 
-        # --- scatter learned rows back into the workspace (one-hot matmul) ---
-        hit_rows = row_oh_b.any(0)  # [R2]
-        scat_presyn = jnp.round(
-            jax.lax.dot(row_oh.T, presyn_l.astype(jnp.float32), precision=_HI)
-        ).astype(jnp.int32)
-        scat_perm = jax.lax.dot(row_oh.T, perm_l, precision=_HI)
-        ws_presyn_r = jnp.where(hit_rows[:, None], scat_presyn, ws_presyn_r)
-        ws_perm_r = jnp.where(hit_rows[:, None], scat_perm, ws_perm_r)
+        # --- scatter learned rows back into the workspace ---
+        if indexed:
+            hit_rows = jnp.zeros(R2, bool).at[idx].set(True, mode="drop")
+            ws_presyn_r = ws_presyn_r.at[idx].set(presyn_l, mode="drop")
+            ws_perm_r = ws_perm_r.at[idx].set(perm_l, mode="drop")
+        else:
+            hit_rows = row_oh_b.any(0)  # [R2]
+            scat_presyn = jnp.round(
+                jax.lax.dot(row_oh.T, presyn_l.astype(jnp.float32), precision=_HI)
+            ).astype(jnp.int32)
+            scat_perm = jax.lax.dot(row_oh.T, perm_l, precision=_HI)
+            ws_presyn_r = jnp.where(hit_rows[:, None], scat_presyn, ws_presyn_r)
+            ws_perm_r = jnp.where(hit_rows[:, None], scat_perm, ws_perm_r)
         ws_last = jnp.where(hit_rows.reshape(Ac, K, S), it, ws_last)
 
         # --- scatter the workspace back to the pools ---
-        pool_presyn = jnp.round(
-            jax.lax.dot(col_oh.T, ws_presyn_r.reshape(Ac, -1).astype(jnp.float32), precision=_HI)
-        ).astype(presyn_dt).reshape(C, K, S, M)
-        pool_perm_f = jax.lax.dot(col_oh.T, ws_perm_r.reshape(Ac, -1), precision=_HI)
-        if dom.bits:
-            pool_perm_f = jnp.round(pool_perm_f)  # exact already; belt+braces
-        pool_perm = pool_perm_f.astype(p_dt).reshape(C, K, S, M)
-        pool_last = jnp.where(
-            col_oh_b[:, :, None], ws_last.reshape(Ac, 1, -1), 0
-        ).sum(0).reshape(C, K, S)
-        presyn = jnp.where(hit_cols[:, None, None, None], pool_presyn, presyn)
-        syn_perm = jnp.where(hit_cols[:, None, None, None], pool_perm, syn_perm)
-        seg_last = jnp.where(hit_cols[:, None, None], pool_last, seg_last)
+        if indexed:
+            # only the <= Ac touched rows are written; fill ids (C) drop
+            presyn = (
+                presyn.reshape(C, -1)
+                .at[col_ids]
+                .set(ws_presyn_r.reshape(Ac, -1).astype(presyn_dt), mode="drop")
+                .reshape(C, K, S, M)
+            )
+            ws_perm_w = ws_perm_r.reshape(Ac, -1)
+            if dom.bits:
+                ws_perm_w = jnp.round(ws_perm_w)  # exact already; belt+braces
+            syn_perm = (
+                syn_perm.reshape(C, -1)
+                .at[col_ids]
+                .set(ws_perm_w.astype(p_dt), mode="drop")
+                .reshape(C, K, S, M)
+            )
+            seg_last = (
+                seg_last.reshape(C, -1)
+                .at[col_ids]
+                .set(ws_last.reshape(Ac, -1), mode="drop")
+                .reshape(C, K, S)
+            )
+        else:
+            pool_presyn = jnp.round(
+                jax.lax.dot(col_oh.T, ws_presyn_r.reshape(Ac, -1).astype(jnp.float32), precision=_HI)
+            ).astype(presyn_dt).reshape(C, K, S, M)
+            pool_perm_f = jax.lax.dot(col_oh.T, ws_perm_r.reshape(Ac, -1), precision=_HI)
+            if dom.bits:
+                pool_perm_f = jnp.round(pool_perm_f)  # exact already; belt+braces
+            pool_perm = pool_perm_f.astype(p_dt).reshape(C, K, S, M)
+            pool_last = jnp.where(
+                col_oh_b[:, :, None], ws_last.reshape(Ac, 1, -1), 0
+            ).sum(0).reshape(C, K, S)
+            presyn = jnp.where(hit_cols[:, None, None, None], pool_presyn, presyn)
+            syn_perm = jnp.where(hit_cols[:, None, None, None], pool_perm, syn_perm)
+            seg_last = jnp.where(hit_cols[:, None, None], pool_last, seg_last)
 
         overflow_learn = (
             (n_active > Ac) | (p_cols > Ac) | (ws_learn.sum() > L)

@@ -35,7 +35,7 @@ from rtap_tpu.models.state import init_state
 from rtap_tpu.ops.encoders_tpu import bind_offsets, encode_device
 from rtap_tpu.ops.sp_tpu import sp_step
 from rtap_tpu.ops.tm_tpu import tm_step
-from rtap_tpu.ops.step import chunk_step, replicate_state
+from rtap_tpu.ops.step import chunk_step, replicate_state_device
 
 
 def log(msg):
@@ -107,6 +107,11 @@ def main():
                     help="route the TM dendrite pass through the Pallas "
                          "kernel (ops/pallas_tm.py) — compare a run with "
                          "and without this flag on hardware")
+    ap.add_argument("--scatter", choices=("matmul", "indexed"), default=None,
+                    help="TM workspace-movement strategy (ops/tm_tpu.py "
+                         "SCATTER_MODE): 'indexed' moves only touched rows, "
+                         "'matmul' is the one-hot MXU formulation — A/B on "
+                         "hardware")
     args = ap.parse_args()
 
     from rtap_tpu.utils.platform import enable_compile_cache
@@ -117,6 +122,11 @@ def main():
 
         set_use_pallas(True)
         log("Pallas dendrite kernel: ENABLED")
+    if args.scatter:
+        from rtap_tpu.ops.tm_tpu import set_scatter_mode
+
+        set_scatter_mode(args.scatter)
+        log(f"TM workspace movement: {args.scatter}")
 
     cfg = cluster_preset()
     T = args.T
@@ -126,7 +136,7 @@ def main():
     results = {}
     for G in args.gs:
         try:
-            state = jax.device_put(replicate_state(init_state(cfg, 0), G))
+            state = replicate_state_device(init_state(cfg, 0), G)
             vals, ts = make_inputs(G, T, cfg.n_fields)
             dt = time_fn(lambda s: chunk_step(s, vals, ts, cfg, True), state, iters=2)
             per_tick = dt / T
@@ -140,23 +150,23 @@ def main():
     log(f"\n== ablations at G={G}, T={T} ==")
     vals, ts = make_inputs(G, T, cfg.n_fields)
 
-    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    st = replicate_state_device(init_state(cfg, 0), G)
     dt_full = time_fn(lambda s: chunk_step(s, vals, ts, cfg, True), st, iters=2)
     log(f"full learn=True : {dt_full/T*1e3:8.2f} ms/tick")
 
-    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    st = replicate_state_device(init_state(cfg, 0), G)
     dt_inf = time_fn(lambda s: chunk_step(s, vals, ts, cfg, False), st, iters=2)
     log(f"full learn=False: {dt_inf/T*1e3:8.2f} ms/tick")
 
-    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    st = replicate_state_device(init_state(cfg, 0), G)
     dt_enc = time_fn(lambda s: encode_only(s, vals, ts, cfg), st, iters=2)
     log(f"encode only     : {dt_enc/T*1e3:8.2f} ms/tick")
 
-    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    st = replicate_state_device(init_state(cfg, 0), G)
     dt_sp = time_fn(lambda s: sp_only(s, vals, ts, cfg, True), st, iters=2)
     log(f"enc+SP learn    : {dt_sp/T*1e3:8.2f} ms/tick")
 
-    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    st = replicate_state_device(init_state(cfg, 0), G)
     dt_spi = time_fn(lambda s: sp_only(s, vals, ts, cfg, False), st, iters=2)
     log(f"enc+SP infer    : {dt_spi/T*1e3:8.2f} ms/tick")
 
@@ -166,18 +176,18 @@ def main():
     acts = np.zeros((T, G, C), bool)
     idx = rng.integers(0, C, (T, G, k))
     np.put_along_axis(acts, idx, True, axis=-1)
-    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    st = replicate_state_device(init_state(cfg, 0), G)
     acts_d = jnp.asarray(acts)
     dt_tm = time_fn(lambda s: tm_only(s, acts_d, cfg, True), st, iters=2)
     log(f"TM only learn   : {dt_tm/T*1e3:8.2f} ms/tick")
-    st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+    st = replicate_state_device(init_state(cfg, 0), G)
     dt_tmi = time_fn(lambda s: tm_only(s, acts_d, cfg, False), st, iters=2)
     log(f"TM only infer   : {dt_tmi/T*1e3:8.2f} ms/tick")
 
     if args.trace:
-        st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+        st = replicate_state_device(init_state(cfg, 0), G)
         chunk_step(st, vals, ts, cfg, True)  # compiled above; warm anyway
-        st = jax.device_put(replicate_state(init_state(cfg, 0), G))
+        st = replicate_state_device(init_state(cfg, 0), G)
         with jax.profiler.trace(args.trace):
             st, raw = chunk_step(st, vals, ts, cfg, True)
             jax.block_until_ready(raw)

@@ -37,6 +37,47 @@ def test_e2e_parity_with_tpu_paths(force_tpu_paths):
         assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
 
 
+@pytest.fixture
+def indexed_scatter():
+    tm_tpu.set_scatter_mode("indexed")
+    yield
+    tm_tpu.set_scatter_mode(None)
+
+
+@exact_only
+@pytest.mark.parametrize("perm_bits", [0, 16])
+def test_e2e_parity_with_indexed_scatter(indexed_scatter, perm_bits):
+    """The indexed (take / .at[].set) workspace-movement strategy must be
+    bit-identical to the one-hot-matmul strategy — the SCATTER_MODE switch
+    is a pure layout/bandwidth experiment (ops/tm_tpu.py). Covered in both
+    the f32 and the u16 fixed-point permanence domains (the quantized branch
+    has its own round/astype epilogue)."""
+    from tests.parity.test_quantized_parity import quant_cfg
+
+    cfg = small_cfg() if perm_bits == 0 else quant_cfg(perm_bits)
+    cpu = HTMModel(cfg, seed=3, backend="cpu")
+    tpu = HTMModel(cfg, seed=3, backend="tpu")
+    vals = make_values(300, 1)
+    for i in range(300):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
+@exact_only
+def test_e2e_parity_indexed_scatter_with_tpu_paths(force_tpu_paths, indexed_scatter):
+    """Both strategy switches together = the exact program a hardware run
+    with RTAP_TM_SCATTER=indexed would trace."""
+    cfg = small_cfg()
+    cpu = HTMModel(cfg, seed=9, backend="cpu")
+    tpu = HTMModel(cfg, seed=9, backend="tpu")
+    vals = make_values(300, 1, seed=11)
+    for i in range(300):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
 @exact_only
 def test_compact_ids_matches_nonzero(force_tpu_paths):
     import jax.numpy as jnp
