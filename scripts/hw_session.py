@@ -1,0 +1,85 @@
+"""Run the full on-hardware measurement agenda in one tunnel-up window.
+
+The TPU tunnel oscillates (SCALING.md): it can be reachable for minutes and
+then hang backend init for an hour. When it IS up, this script spends the
+window optimally — every step is a subprocess with its own wall budget (a
+hang costs one step, not the session), ordered most-valuable-first:
+
+1. component ablation profile (where does the tick go?)         [matmul]
+2. the same under --scatter indexed  (workspace-movement A/B)
+3. the same under --pallas           (fused dendrite-kernel A/B)
+4. scaling_law G-sweep               (fills SCALING.md's table)
+5. bench.py                          (the headline number)
+
+Logs land in hw_results/<step>.log; a one-line verdict per step prints to
+stderr as it completes. Re-runs skip nothing (fresh measurements overwrite).
+
+Usage:  python scripts/hw_session.py [--budget-per-step 600] [--steps 1,2,5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "hw_results")
+
+
+def log(msg: str) -> None:
+    print(f"[hw_session] {msg}", file=sys.stderr, flush=True)
+
+
+STEPS: list[tuple[str, list[str]]] = [
+    ("profile_matmul", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                        "--gs", "1024"]),
+    ("profile_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                         "--gs", "1024", "--scatter", "indexed"]),
+    ("profile_pallas", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                        "--gs", "1024", "--pallas"]),
+    ("profile_f32_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                             "--gs", "1024", "--perm-bits", "0",
+                             "--scatter", "indexed"]),
+    ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),
+    ("bench", [sys.executable, "bench.py"]),
+]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--budget-per-step", type=float, default=600.0)
+    ap.add_argument("--steps", default=None,
+                    help="comma-separated 1-based step numbers (default all)")
+    args = ap.parse_args()
+    picked = (
+        [STEPS[int(i) - 1] for i in args.steps.split(",")] if args.steps else STEPS
+    )
+
+    os.makedirs(OUT, exist_ok=True)
+    for name, cmd in picked:
+        path = os.path.join(OUT, f"{name}.log")
+        log(f"step {name}: {' '.join(cmd[1:])} (budget {args.budget_per_step:.0f}s)")
+        t0 = time.monotonic()
+        with open(path, "w") as f:
+            try:
+                rc = subprocess.run(
+                    cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
+                    timeout=args.budget_per_step,
+                ).returncode
+            except subprocess.TimeoutExpired:
+                rc = -1
+        dt = time.monotonic() - t0
+        tail = ""
+        try:
+            lines = [l.strip() for l in open(path).read().splitlines() if l.strip()]
+            tail = lines[-1][:140] if lines else ""
+        except OSError:
+            pass
+        log(f"step {name}: rc={rc} in {dt:.0f}s — {tail}")
+
+
+if __name__ == "__main__":
+    main()

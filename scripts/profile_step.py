@@ -112,6 +112,11 @@ def main():
                          "SCATTER_MODE): 'indexed' moves only touched rows, "
                          "'matmul' is the one-hot MXU formulation — A/B on "
                          "hardware")
+    ap.add_argument("--perm-bits", type=int, default=16, choices=(0, 8, 16),
+                    help="permanence storage domain of the profiled cluster "
+                         "preset: u16/u8 halve HBM per stream but add per-tick "
+                         "storage<->compute conversions; f32 (0) skips them — "
+                         "the faster choice may differ from the denser one")
     args = ap.parse_args()
 
     from rtap_tpu.utils.platform import enable_compile_cache
@@ -128,9 +133,10 @@ def main():
         set_scatter_mode(args.scatter)
         log(f"TM workspace movement: {args.scatter}")
 
-    cfg = cluster_preset()
+    cfg = cluster_preset(perm_bits=args.perm_bits)
     T = args.T
-    log(f"platform: {jax.devices()[0].platform} {jax.devices()[0].device_kind}")
+    log(f"platform: {jax.devices()[0].platform} {jax.devices()[0].device_kind} "
+        f"(perm_bits={args.perm_bits})")
 
     log("\n== G scaling, full step (learn=True) ==")
     results = {}
