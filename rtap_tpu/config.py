@@ -391,6 +391,23 @@ def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
     )
 
 
+def node_preset(n_metrics: int = 3, perm_bits: int = 16) -> ModelConfig:
+    """Multivariate per-node model (SURVEY.md §6 benchmark config 4:
+    'multivariate per-node cpu/mem/net fused RDSE').
+
+    One HTM model per NODE, fusing its `n_metrics` scalar fields into a
+    single SDR (`ModelConfig.n_fields`; each field gets its own RDSE bit
+    range and per-field offset binding — models/oracle/encoders.py). The SP
+    learns cross-metric structure, so a fault visible in any one field (or a
+    correlated node-level fault across all of them) perturbs the shared
+    column code. Built on the cluster_preset footprint: only the SP potential
+    /permanence matrices grow with input_size (+~100 KB/stream at 3 fields,
+    u16 domain), the TM pools — the dominant state — are unchanged.
+    """
+    base = cluster_preset(perm_bits=perm_bits)
+    return dataclasses.replace(base, n_fields=n_metrics)
+
+
 def cluster_preset(perm_bits: int = 16) -> ModelConfig:
     """Small-footprint model for 1k-100k concurrent streams on one chip.
 

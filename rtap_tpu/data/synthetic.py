@@ -85,6 +85,34 @@ def _rng_for(seed: int, stream_id: str) -> np.random.Generator:
     return np.random.Generator(np.random.Philox(key=(seed, sid_hash)))
 
 
+def _inject(
+    signal: np.ndarray, t_unix: np.ndarray, rng: np.random.Generator,
+    cfg: SyntheticStreamConfig, sigma: float, kind: str, c: int, dur: int,
+) -> tuple[tuple[int, int], FaultEvent]:
+    """Inject one `kind` fault centered at index `c` into `signal` in place;
+    -> (window, event). Extracted verbatim from generate_stream so the
+    per-stream and per-node generators share one fault vocabulary (and
+    generate_stream's rng draw order — the bit-identical-regeneration
+    contract — is unchanged)."""
+    s, e = int(c), min(int(c) + dur, len(signal) - 1)
+    mag = cfg.anomaly_magnitude * sigma
+    if kind == "spike":
+        signal[s : s + max(1, dur // 4)] += mag * rng.choice([-1.0, 1.0])
+    elif kind == "level_shift":
+        signal[s:] += mag * rng.choice([-1.0, 1.0])
+    elif kind == "drift":
+        ramp = np.linspace(0.0, mag, e - s)
+        signal[s:e] += ramp
+        signal[e:] += mag
+    elif kind == "stuck":
+        signal[s:e] = signal[s]
+    elif kind == "dropout":
+        signal[s:e] = 0.0
+    margin = max(2, dur // 2)
+    win = (int(t_unix[max(0, s - margin)]), int(t_unix[min(len(signal) - 1, e + margin)]))
+    return win, FaultEvent(kind, int(t_unix[s]), int(t_unix[e]), win)
+
+
 def generate_stream(
     stream_id: str, cfg: SyntheticStreamConfig, seed: int = 0
 ) -> LabeledStream:
@@ -123,24 +151,9 @@ def generate_stream(
         for c in centers:
             kind = cfg.kinds[rng.integers(len(cfg.kinds))]
             dur = int(rng.integers(5, 40))
-            s, e = int(c), min(int(c) + dur, cfg.length - 1)
-            mag = cfg.anomaly_magnitude * sigma
-            if kind == "spike":
-                signal[s : s + max(1, dur // 4)] += mag * rng.choice([-1.0, 1.0])
-            elif kind == "level_shift":
-                signal[s:] += mag * rng.choice([-1.0, 1.0])
-            elif kind == "drift":
-                ramp = np.linspace(0.0, mag, e - s)
-                signal[s:e] += ramp
-                signal[e:] += mag
-            elif kind == "stuck":
-                signal[s:e] = signal[s]
-            elif kind == "dropout":
-                signal[s:e] = 0.0
-            margin = max(2, dur // 2)
-            win = (int(t_unix[max(0, s - margin)]), int(t_unix[min(cfg.length - 1, e + margin)]))
+            win, ev = _inject(signal, t_unix, rng, cfg, sigma, kind, int(c), dur)
             windows.append(win)
-            events.append(FaultEvent(kind, int(t_unix[s]), int(t_unix[e]), win))
+            events.append(ev)
 
     if clip[0] is not None:
         signal = np.maximum(signal, clip[0])
@@ -163,3 +176,94 @@ def generate_cluster(
             scfg = replace(cfg, metric=m)
             out.append(generate_stream(f"node{i:05d}.{m}", scfg, seed=seed))
     return out
+
+
+@dataclass
+class NodeStream:
+    """One node's fused multivariate stream (SURVEY.md §6 benchmark config 4:
+    'multivariate per-node cpu/mem/net fused RDSE'): values [T, F] feed ONE
+    HTM model with n_fields=F, versus `generate_cluster`'s one model per
+    node-metric."""
+
+    node_id: str
+    metrics: tuple[str, ...]
+    timestamps: np.ndarray  # int64 unix seconds, [T]
+    values: np.ndarray  # float32, [T, F]
+    windows: list[tuple[int, int]] = field(default_factory=list)
+    events: list[FaultEvent] = field(default_factory=list)
+    # which metric columns each event touched, index-aligned with `events`
+    event_metrics: list[tuple[str, ...]] = field(default_factory=list)
+
+
+def generate_node(
+    node_id: str,
+    cfg: SyntheticStreamConfig,
+    metrics: Sequence[str] = ("cpu", "mem", "net"),
+    seed: int = 0,
+    coupled_frac: float = 0.5,
+    fault_metrics: Sequence[str] | None = None,
+) -> NodeStream:
+    """Generate one node's multivariate stream with NODE-LEVEL faults.
+
+    Each metric gets its own clean base signal (phase/noise keyed by
+    `<node_id>.<metric>`, deterministic like everything else here). Faults
+    are placed once per NODE at shared times — each event hits either ALL
+    metrics simultaneously (probability `coupled_frac`: the node-saturation
+    shape, e.g. cpu+mem+net degrade together) or exactly one metric (a
+    single-metric fault the fused model must still catch). Windows are the
+    union over touched metrics; `event_metrics` records the ground truth of
+    which columns moved. `fault_metrics` restricts which metrics uncoupled
+    faults may land on (evaluations use it to avoid metrics whose natural
+    range makes a given fault kind in-distribution, e.g. a +6-sigma spike on
+    `net`, whose diurnal peak already reaches that level).
+    """
+    if fault_metrics is not None:
+        bad = set(fault_metrics) - set(metrics)
+        if bad or not fault_metrics:
+            raise ValueError(
+                f"fault_metrics must be a non-empty subset of metrics {tuple(metrics)}; "
+                f"got {tuple(fault_metrics)}"
+            )
+    n_anom = cfg.n_anomalies
+    cfg = replace(cfg, n_anomalies=0)  # per-metric injections off; node-level below
+    parts = [
+        generate_stream(f"{node_id}.{m}", replace(cfg, metric=m), seed=seed)
+        for m in metrics
+    ]
+    values = np.stack([p.values for p in parts], axis=1)  # [T, F]
+    t_unix = parts[0].timestamps
+    rng = _rng_for(seed, node_id)
+
+    windows: list[tuple[int, int]] = []
+    events: list[FaultEvent] = []
+    event_metrics: list[tuple[str, ...]] = []
+    lo = int(cfg.length * cfg.inject_after_frac)
+    centers = np.sort(rng.choice(np.arange(lo, cfg.length - 50), size=n_anom, replace=False))
+    for c in centers:
+        kind = cfg.kinds[rng.integers(len(cfg.kinds))]
+        dur = int(rng.integers(5, 40))
+        pool = tuple(fault_metrics) if fault_metrics is not None else tuple(metrics)
+        if rng.random() < coupled_frac:
+            touched = tuple(metrics)
+        else:
+            touched = (pool[rng.integers(len(pool))],)
+        # the window is a function of (c, dur, margin) only, so every touched
+        # metric of one event shares it — keep the first (win, ev) pair
+        win = ev = None
+        for f, m in enumerate(metrics):
+            if m not in touched:
+                continue
+            sigma = METRIC_PROFILES.get(m, METRIC_PROFILES["cpu"])[2] * cfg.noise_scale
+            col = np.ascontiguousarray(values[:, f], dtype=np.float64)
+            w, e = _inject(col, t_unix, rng, replace(cfg, metric=m), sigma, kind, int(c), dur)
+            win, ev = win or w, ev or e
+            lo_c, hi_c = METRIC_PROFILES.get(m, METRIC_PROFILES["cpu"])[3]
+            if lo_c is not None:
+                col = np.maximum(col, lo_c)
+            if hi_c is not None:
+                col = np.minimum(col, hi_c)
+            values[:, f] = col.astype(np.float32)
+        windows.append(win)
+        events.append(ev)
+        event_metrics.append(touched)
+    return NodeStream(node_id, tuple(metrics), t_unix, values, windows, events, event_metrics)

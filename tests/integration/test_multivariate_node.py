@@ -1,0 +1,152 @@
+"""Benchmark config 4 (SURVEY.md §6): multivariate per-node fused-RDSE model.
+
+One HTM model per node fuses cpu/mem/net into a single SDR
+(`node_preset(n_metrics=3)`); the synthetic generator injects NODE-level
+faults — some hitting all metrics at once (saturation shape), some exactly
+one metric. The fused model must flag both shapes: a single-metric fault
+perturbs that field's third of the SDR, which is enough to break the learned
+joint pattern.
+"""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import node_preset
+from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_node
+from rtap_tpu.models.htm_model import HTMModel
+
+LENGTH = 1400
+# injections start at tick 700: probation ends at 400 (cluster-preset
+# likelihood), leaving >= 300 ticks of post-probation joint-pattern learning
+# before the first fault — the same maturation the fault eval's floors assume
+INJECT_FRAC = 0.5
+# streaming-mode likelihood has ~3 s median detection latency (SCALING.md
+# likelihood-mode table); scan windows with that allowance, NAB-style
+LATENCY_TICKS = 15
+SEED = 4
+
+
+def _gen(seed=SEED):
+    cfg = node_preset(3)
+    cfg.likelihood.safe_inject_frac(LENGTH)  # raises if LENGTH can't be evaluated
+    return generate_node(
+        "node00042",
+        SyntheticStreamConfig(
+            length=LENGTH, cadence_s=1.0, n_anomalies=3,
+            kinds=("spike", "level_shift", "dropout"), anomaly_magnitude=6.0,
+            noise_phi=0.97, noise_scale=0.5, inject_after_frac=INJECT_FRAC,
+        ),
+        seed=seed,
+    )
+
+
+def test_generate_node_shape_and_determinism():
+    node = _gen()
+    T, F = node.values.shape
+    assert (T, F) == (LENGTH, 3) and node.metrics == ("cpu", "mem", "net")
+    assert len(node.windows) == len(node.events) == len(node.event_metrics) == 3
+    for touched in node.event_metrics:
+        assert set(touched) <= set(node.metrics) and len(touched) in (1, 3)
+    again = _gen()
+    np.testing.assert_array_equal(node.values, again.values)
+    assert node.windows == again.windows
+
+    # with 0.5 coupling and enough draws, both shapes appear across seeds
+    shapes = set()
+    for s in range(6):
+        shapes |= {len(t) for t in _gen(seed=s).event_metrics}
+    assert shapes == {1, 3}
+
+
+def test_fused_model_detects_node_faults():
+    """Every injected node fault is alertable: log-likelihood inside the
+    window (+ measured latency) clears the fault eval's F1-optimal operating
+    range (thresholds land in ~[0.20, 0.66) — eval/fault_eval.py sweep), and
+    the windows stand out from a clean background (steady-state raw p50 is
+    exactly 0 — the model fully learns the joint diurnal pattern)."""
+    node = _gen()
+    model = HTMModel(node_preset(3), seed=1, backend="cpu")
+    raw = np.empty(LENGTH)
+    loglik = np.empty(LENGTH)
+    for i in range(LENGTH):
+        r = model.run(int(node.timestamps[i]), node.values[i])
+        raw[i], loglik[i] = r.raw_score, r.log_likelihood
+
+    in_win = np.zeros(LENGTH, bool)
+    for a, b in node.windows:
+        in_win |= (node.timestamps >= a) & (node.timestamps <= b + LATENCY_TICKS)
+    post = slice(int(0.45 * LENGTH), None)  # past probation + settling
+
+    # the joint pattern is learned: quiet background (measured p50 = 0.0,
+    # p99 ~ 0.3 on this seed; bars at achieved-plus-margin)
+    assert np.median(raw[post][~in_win[post]]) <= 0.05
+    # every fault produces an alertable response (measured mins on this
+    # seed: 0.215 for the weakest — a 2-tick spike smeared by the 10-tick
+    # likelihood averaging window)
+    for (a, b), touched in zip(node.windows, node.event_metrics):
+        w = (node.timestamps >= a) & (node.timestamps <= b + LATENCY_TICKS)
+        assert loglik[w].max() > 0.15, (
+            f"no likelihood response in window {(a, b)} (metrics {touched}); "
+            f"max {loglik[w].max():.3f}"
+        )
+    background = np.median(loglik[post][~in_win[post]])
+    assert loglik[in_win].max() > background + 0.15
+
+
+def test_single_metric_fault_response_is_diluted_but_present():
+    """The documented trade-off of field fusion: a fault in ONE of F fields
+    perturbs ~1/F of the SDR, so the fused model's raw response is diluted
+    to roughly burst/F (vs ~1.0 for the same fault on a per-metric model —
+    the fault eval's measured regime). Deployments wanting full per-metric
+    sensitivity use one stream per node-metric (generate_cluster, the
+    reference's default shape); the fused node model trades that for 3x
+    fewer streams and coupled-fault context. This test pins the diluted
+    response: visible above the learned-quiet background, well short of a
+    full burst."""
+    # controlled injection: a clean node plus a deterministic +6-sigma bump
+    # on mem only (mem's tight 55 +- 10 range makes an upward bump truly
+    # out-of-distribution; the generator's own sign/duration lottery can
+    # legitimately produce in-distribution faults, which is not what this
+    # property test is about)
+    node = generate_node(
+        "node00007",
+        SyntheticStreamConfig(
+            length=LENGTH, cadence_s=1.0, n_anomalies=0,
+            noise_phi=0.97, noise_scale=0.5,
+        ),
+        seed=11,
+    )
+    mem = list(node.metrics).index("mem")
+    S, DUR = 900, 6
+    node.values[S : S + DUR, mem] += 6.0 * 0.75  # 6 x (mem sigma 1.5 x 0.5)
+
+    model = HTMModel(node_preset(3), seed=1, backend="cpu")
+    raw = np.empty(LENGTH)
+    for i in range(LENGTH):
+        raw[i] = model.run(int(node.timestamps[i]), node.values[i]).raw_score
+
+    post = slice(int(0.45 * LENGTH), None)
+    in_win = np.zeros(LENGTH, bool)
+    in_win[S : S + DUR + LATENCY_TICKS] = True
+    quiet = raw[post][~in_win[post]]
+    # background learned to near-silence...
+    assert np.percentile(quiet, 99) <= 0.15, np.percentile(quiet, 99)
+    # ...and the one-of-three-fields fault lifts raw clearly above it while
+    # staying well short of a full burst — the ~1/F dilution signature
+    resp = raw[S : S + DUR + LATENCY_TICKS].max()
+    assert 0.15 <= resp <= 0.9, f"expected diluted response, got {resp:.2f}"
+
+
+@pytest.mark.parametrize("n_fields", [2, 3])
+def test_node_preset_device_parity(n_fields):
+    """The fused multivariate step is bit-exact oracle-vs-device on the CPU
+    test backend (the same guarantee every other config enjoys)."""
+    cfg = node_preset(n_fields)
+    node = _gen()
+    cpu = HTMModel(cfg, seed=2, backend="cpu")
+    dev = HTMModel(cfg, seed=2, backend="tpu")
+    for i in range(0, 160):
+        v = node.values[i, :n_fields]
+        r1 = cpu.run(int(node.timestamps[i]), v).raw_score
+        r2 = dev.run(int(node.timestamps[i]), v).raw_score
+        assert r1 == pytest.approx(r2, abs=0.0), f"step {i}"
