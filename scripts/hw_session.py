@@ -43,6 +43,7 @@ STEPS: list[tuple[str, list[str]]] = [
     ("profile_f32_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
                              "--gs", "1024", "--perm-bits", "0",
                              "--scatter", "indexed"]),
+    ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
     ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),
     ("bench", [sys.executable, "bench.py"]),
 ]
