@@ -34,6 +34,7 @@ def log(msg: str) -> None:
 
 
 STEPS: list[tuple[str, list[str]]] = [
+    ("layout_probe", [sys.executable, "scripts/layout_probe.py"]),
     ("profile_matmul", [sys.executable, "scripts/profile_step.py", "--T", "32",
                         "--gs", "1024"]),
     ("profile_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
