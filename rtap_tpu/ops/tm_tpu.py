@@ -243,7 +243,15 @@ def _grow_compact(
 
     # extract chosen winner positions ascending -> [L, G]
     wpos = jnp.where(chosen, jnp.arange(W, dtype=jnp.int32), W)
-    wpos = jax.lax.sort(wpos, dimension=1)[:, :G]
+    if _tpu_paths():
+        # ascending distinct values via top_k (chosen positions are distinct;
+        # fills map to 0 and come out last) — full lax.sort serializes worse
+        # than top_k on the TPU vector unit for these tiny rows
+        wpos = W - jax.lax.top_k(W - wpos, min(G, W))[0]
+        if G > W:
+            wpos = jnp.concatenate([wpos, jnp.full((L, G - W), W, jnp.int32)], axis=1)
+    else:
+        wpos = jax.lax.sort(wpos, dimension=1)[:, :G]
     new_ids = jnp.where(wpos < W, winner_ids[jnp.clip(wpos, 0, W - 1)], n_cells)  # [L]
 
     # evict weakest occupied synapses if short of free slots (stable by slot)
@@ -251,7 +259,16 @@ def _grow_compact(
     n_free = M - occupied.sum(-1)
     short = n_new - n_free  # [L]
     key = jnp.where(occupied, perm_l, INF)
-    ranks = jnp.argsort(jnp.argsort(key, axis=-1, stable=True), axis=-1, stable=True)
+    if _tpu_paths():
+        # stable ascending rank by (key, slot) via compare-count: M is tiny
+        # (<= 32), so the [L, M, M] compare grid is cheap, branch-free VPU
+        # work — vs two serialized stable sorts
+        kj, ki = key[:, :, None], key[:, None, :]  # [L, M(j), M(i)]
+        jj = jnp.arange(M, dtype=jnp.int32)
+        before = (kj < ki) | ((kj == ki) & (jj[None, :, None] < jj[None, None, :]))
+        ranks = before.sum(1).astype(jnp.int32)  # [L, M]
+    else:
+        ranks = jnp.argsort(jnp.argsort(key, axis=-1, stable=True), axis=-1, stable=True)
     evict = occupied & (ranks < short[:, None])
     presyn_l = jnp.where(evict, -1, presyn_l)
     perm_l = jnp.where(evict, 0.0, perm_l)

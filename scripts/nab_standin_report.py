@@ -1,0 +1,73 @@
+"""Score the full NAB stand-in corpus and commit the result as an artifact.
+
+Runs the detector over every file of the stand-in corpus (8 files, 5 metric
+profiles — data/nab_corpus.STANDIN_FILES) through the full NAB machinery
+(per-file detection -> threshold sweep -> scaled-sigmoid window scoring ->
+normalization) and writes reports/nab_standin.json with per-profile scores.
+
+The stand-in is NOT the real NAB corpus (absent in this offline environment
+— SURVEY.md §6 blocker); its absolute scores are not comparable to the
+public scoreboard. What the artifact pins is (a) the full pipeline runs
+corpus-scale end to end, and (b) a quality reference point that future
+rounds must not regress (integration floors live in
+tests/integration/test_nab_run.py).
+
+    RTAP_FORCE_CPU=1 python scripts/nab_standin_report.py [--processes 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--processes", type=int, default=1)
+    ap.add_argument("--out", default=os.path.join(REPO, "reports", "nab_standin.json"))
+    args = ap.parse_args()
+
+    from rtap_tpu.data.nab_corpus import ensure_standin_corpus, load_corpus
+    from rtap_tpu.nab.runner import run_corpus
+
+    with tempfile.TemporaryDirectory() as td:
+        root = ensure_standin_corpus(td)
+        files = load_corpus(root)
+        t0 = time.time()
+        res = run_corpus(files, backend="cpu", processes=args.processes)
+        wall = time.time() - t0
+
+    report = {
+        "corpus": "stand-in (deterministic synthetic, NAB on-disk format)",
+        "files": [f.name for f in files],
+        "records": int(sum(len(f.values) for f in files)),
+        "wall_s": round(wall, 1),
+        "scores": {
+            prof: {"threshold": round(thr, 4), "score": round(score, 2)}
+            for prof, (thr, score) in res.scores.items()
+        },
+        "note": (
+            "Stand-in corpus scores are not comparable to the public NAB "
+            "scoreboard; they pin the pipeline end-to-end and guard "
+            "regressions. Real-corpus swap-in: set RTAP_NAB_CORPUS."
+        ),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report["scores"]))
+
+
+if __name__ == "__main__":
+    main()
