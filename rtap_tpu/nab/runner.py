@@ -50,6 +50,59 @@ def detect_file(
     return out
 
 
+def detect_files_batched(
+    files: list[NabFile],
+    cfg: ModelConfig | None = None,
+    seed: int = 0,
+    chunk_ticks: int = 64,
+) -> list[np.ndarray]:
+    """Benchmark config 2's real shape (SURVEY.md §6): every corpus file as
+    one stream of ONE vmapped device group — a chunk of ticks for the whole
+    corpus costs a single dispatch, vs one Python-loop record at a time per
+    file.
+
+    NAB's per-file encoder sizing survives batching because the RDSE
+    resolution is runtime state, not program structure (models/state.py
+    `enc_resolution`): one compiled program serves files with different
+    value ranges. Files shorter than the longest pad with NaN values (the
+    encoder's missing-sample path) on a continued cadence; padded rows are
+    sliced off the returned scores. Same per-file scores as `detect_file`
+    modulo backend rounding (exact on the CPU test platform —
+    tests/integration/test_nab_run.py pins it).
+    """
+    import jax.numpy as jnp
+
+    from rtap_tpu.config import nab_preset
+    from rtap_tpu.service.registry import StreamGroup
+
+    n = len(files)
+    T = max(len(f.values) for f in files)
+    base = cfg if cfg is not None else nab_preset(0.0, 100.0)
+    grp = StreamGroup(base, [f.name for f in files], seed=seed, backend="tpu")
+    res = np.array(
+        [rdse_resolution(float(np.nanmin(f.values)), float(np.nanmax(f.values)))
+         for f in files], np.float32,
+    )[:, None].repeat(base.n_fields, axis=1)  # [G, n_fields]
+    grp.state = {**grp.state, "enc_resolution": jnp.asarray(res)}
+
+    vals = np.full((T, n), np.nan, np.float32)
+    ts = np.zeros((T, n), np.int64)
+    for g, f in enumerate(files):
+        L = len(f.values)
+        vals[:L, g] = f.values
+        ts[:L, g] = f.timestamps
+        if L < T:  # continue the file's cadence so the date encoder stays sane
+            step = int(np.median(np.diff(f.timestamps))) if L > 1 else 1
+            ts[L:, g] = f.timestamps[-1] + np.arange(1, T - L + 1) * max(step, 1)
+
+    loglik = np.empty((T, n))
+    for t0 in range(0, T, chunk_ticks):
+        t1 = min(t0 + chunk_ticks, T)
+        _, ll, _ = grp.run_chunk(vals[t0:t1], ts[t0:t1])
+        loglik[t0:t1] = ll
+    return [loglik[: len(f.values), g] for g, f in enumerate(files)]
+
+
 def _detect_star(args):
     return detect_file(*args)
 
@@ -62,8 +115,15 @@ def run_corpus(
     processes: int = 1,
     profiles: tuple[str, ...] = ("standard", "reward_low_FP", "reward_low_FN"),
 ) -> NabRunResult:
-    """Detect + score + normalize over a corpus (NAB run.py analog)."""
-    if processes > 1 and backend == "cpu":
+    """Detect + score + normalize over a corpus (NAB run.py analog).
+
+    backend="cpu": one oracle detector per file (optionally one process per
+    file, the reference's parallelism). backend="tpu": all files batched
+    into one vmapped device group (:func:`detect_files_batched`).
+    """
+    if backend == "tpu":
+        scores = detect_files_batched(files, cfg, seed)
+    elif processes > 1:
         with mp.get_context("spawn").Pool(processes) as pool:
             scores = pool.map(_detect_star, [(nf, cfg, backend, seed) for nf in files])
     else:

@@ -45,6 +45,7 @@ STEPS: list[tuple[str, list[str]]] = [
                              "--gs", "1024", "--perm-bits", "0",
                              "--scatter", "indexed"]),
     ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
+    ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
     ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),
     ("bench", [sys.executable, "bench.py"]),
 ]

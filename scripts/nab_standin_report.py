@@ -35,21 +35,37 @@ maybe_force_cpu()
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--processes", type=int, default=1)
+    ap.add_argument("--backend", default="tpu", choices=("tpu", "cpu"),
+                    help="tpu = all files as ONE vmapped device group "
+                         "(detect_files_batched; ~minutes on a real chip). "
+                         "cpu = one oracle per file (hours at NAB-preset "
+                         "size on a 1-core host — use --rows to shrink)")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="truncate every file to this many rows (cheap drives)")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "nab_standin.json"))
     args = ap.parse_args()
 
-    from rtap_tpu.data.nab_corpus import ensure_standin_corpus, load_corpus
+    if args.backend == "tpu":
+        from rtap_tpu.utils.platform import init_backend_or_die
+
+        init_backend_or_die()  # the tunnel oscillates; die fast
+
+    from rtap_tpu.data.nab_corpus import NabFile, ensure_standin_corpus, load_corpus
     from rtap_tpu.nab.runner import run_corpus
 
     with tempfile.TemporaryDirectory() as td:
         root = ensure_standin_corpus(td)
         files = load_corpus(root)
+        if args.rows:
+            files = [NabFile(f.name, f.timestamps[: args.rows], f.values[: args.rows],
+                             f.windows) for f in files]
         t0 = time.time()
-        res = run_corpus(files, backend="cpu", processes=args.processes)
+        res = run_corpus(files, backend=args.backend, processes=args.processes)
         wall = time.time() - t0
 
     report = {
         "corpus": "stand-in (deterministic synthetic, NAB on-disk format)",
+        "backend": args.backend,
         "files": [f.name for f in files],
         "records": int(sum(len(f.values) for f in files)),
         "wall_s": round(wall, 1),
