@@ -185,6 +185,15 @@ def _store_lkg(best: dict) -> None:
         log(f"bench: could not store last-known-good: {e}")
 
 
+def _finish(best: dict | None) -> None:
+    """Single exit point: persist a fresh result, emit the line (fresh or
+    LKG fallback), exit 0 iff a line went out. Shared by the signal handler
+    and every abort path so their semantics can never drift."""
+    if best is not None:
+        _store_lkg(best)
+    sys.exit(0 if emit(best) else 1)
+
+
 def main() -> None:
     budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     per_attempt = float(os.environ.get("BENCH_ATTEMPT_BUDGET_S", "330"))
@@ -196,9 +205,7 @@ def main() -> None:
         log(f"bench: signal {signum}, emitting best-so-far")
         if current_proc[0] is not None and current_proc[0].poll() is None:
             current_proc[0].kill()  # never orphan a TPU-holding child
-        if best is not None:
-            _store_lkg(best)
-        sys.exit(0 if emit(best) else 1)
+        _finish(best)
 
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
@@ -247,9 +254,7 @@ def main() -> None:
                     # tunnel is hanging, and every further attempt would burn
                     # its full budget the same way — stop the ladder
                     log("bench: backend init hang detected, aborting attempts")
-                    if best is not None:
-                        _store_lkg(best)
-                    sys.exit(0 if emit(best) else 1)
+                    _finish(best)
                 break  # a timeout is not transient; don't retry, move on
             finally:
                 current_proc[0] = None
@@ -289,9 +294,7 @@ def main() -> None:
                 init_fail_streak += 1
                 if init_fail_streak >= 2:
                     log("bench: backend init failure persisted, aborting attempts")
-                    if best is not None:
-                        _store_lkg(best)
-                    sys.exit(0 if emit(best) else 1)
+                    _finish(best)
             transient = proc.returncode != 0 and attempt == 0
             log(f"  G={group_size}: attempt failed rc={proc.returncode}"
                 + (", retrying once" if transient else ""))

@@ -29,7 +29,10 @@ class NabRunResult:
 
 
 def _file_range_config(nf: NabFile, base_cfg: ModelConfig | None) -> ModelConfig:
-    lo, hi = float(nf.values.min()), float(nf.values.max())
+    # nan-aware: a missing sample (NaN value) must not poison the encoder
+    # resolution (min() would return NaN); detect_files_batched sizes with
+    # the same nan-aware range so both paths stay score-identical
+    lo, hi = float(np.nanmin(nf.values)), float(np.nanmax(nf.values))
     if base_cfg is None:
         return nab_preset(lo, hi)
     # rescale only the encoder resolution to this file's range, NAB-style

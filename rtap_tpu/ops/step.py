@@ -62,7 +62,10 @@ def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Model
 @partial(jax.jit, static_argnames=("cfg", "learn"))
 def fused_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
     """Single-stream fused step (see :func:`step_impl`)."""
-    return step_impl(state, values, ts_unix, cfg, learn)
+    from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
+
+    state, out = step_impl(to_kernel_layout(state), values, ts_unix, cfg, learn)
+    return from_kernel_layout(state, cfg.tm), out
 
 
 @partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
@@ -73,19 +76,31 @@ def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     State buffers are donated: at 100k streams the TM pools dominate HBM and
     the update must happen in place (SURVEY.md §7 hard part 4).
     """
-    return jax.vmap(lambda s, v, t: step_impl(s, v, t, cfg, learn))(state, values, ts_unix)
+    from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
+
+    state, out = jax.vmap(lambda s, v, t: step_impl(s, v, t, cfg, learn))(
+        to_kernel_layout(state), values, ts_unix
+    )
+    return from_kernel_layout(state, cfg.tm), out
 
 
 def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
     """Shared hot-loop body: scan the vmapped fused step over the time axis.
     Used identically by the single-device and shard_map entry points, so the
-    two can never diverge semantically."""
+    two can never diverge semantically.
+
+    The kernel-layout adapters sit OUTSIDE the scan: under RTAP_TM_LAYOUT=
+    flat the carry holds flat pools for all T ticks and the public [C,K,S,M]
+    layout is restored once per chunk (shape-only reshapes — checkpoints,
+    oracle parity, and the service API never see kernel layout)."""
+    from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
 
     def body(s, inp):
         v, t = inp
         return jax.vmap(lambda ss, vv, tt: step_impl(ss, vv, tt, cfg, learn))(s, v, t)
 
-    return jax.lax.scan(body, state, (values, ts_unix))
+    state, out = jax.lax.scan(body, to_kernel_layout(state), (values, ts_unix))
+    return from_kernel_layout(state, cfg.tm), out
 
 
 @partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))

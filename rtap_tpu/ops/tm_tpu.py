@@ -95,6 +95,74 @@ def set_scatter_mode(mode: str | None) -> None:
     jax.clear_caches()
 
 
+# Strategy switch for the kernel-side tensor layout. "aos" (default) carries
+# the pools as [C, K, S, M] and segment tensors as [C, K, S] — trailing dims
+# (S=4, M=12 at the cluster preset) that TPU tiling pads to (8, 128),
+# inflating every pool-shaped HBM round-trip up to ~20x unless XLA's layout
+# passes collapse them. "flat" carries pools as [C, K*S*M] and segment
+# tensors as [C, K*S] through the whole scan (adapters at the chunk/step
+# boundary — ops/step.py), with per-segment reductions as block-diagonal MXU
+# matmuls (the ops/pallas_tm.py trick) instead of sum-over-minor-dim.
+# Bit-identical (tests/parity/test_tpu_paths.py); A/B on silicon via
+# scripts/hw_session.py decides the default. None = read RTAP_TM_LAYOUT.
+LAYOUT_MODE: str | None = None
+
+
+def layout_mode() -> str:
+    import os
+
+    mode = LAYOUT_MODE
+    if mode is None:
+        mode = os.environ.get("RTAP_TM_LAYOUT", "aos")
+    if mode not in ("aos", "flat"):
+        raise ValueError(f"RTAP_TM_LAYOUT must be 'aos' or 'flat', got {mode!r}")
+    return mode
+
+
+def set_layout_mode(mode: str | None) -> None:
+    """Set the kernel tensor layout AND clear jit caches (trace-time
+    constant, not a jit cache key)."""
+    if mode not in (None, "aos", "flat"):
+        raise ValueError(f"layout mode must be None, 'aos' or 'flat', got {mode!r}")
+    global LAYOUT_MODE
+    LAYOUT_MODE = mode
+    jax.clear_caches()
+
+
+# TM state keys reshaped by the flat kernel layout: key -> how many trailing
+# dims collapse into one (pools: K,S,M -> K*S*M; segment tensors: K,S -> K*S).
+_FLAT_KEYS = {
+    "presyn": 3, "syn_perm": 3,
+    "seg_last": 2, "active_seg": 2, "matching_seg": 2, "seg_pot": 2,
+}
+
+
+def to_kernel_layout(state: dict) -> dict:
+    """Public state layout -> kernel layout (no-op in "aos" mode). Shape
+    change only — values are untouched, so checkpoints, the oracle, and the
+    parity harness all keep the public [C, K, S, M] layout."""
+    if layout_mode() != "flat":
+        return state
+    out = dict(state)
+    for k, nd in _FLAT_KEYS.items():
+        x = out[k]
+        out[k] = x.reshape(*x.shape[: x.ndim - nd], -1)
+    return out
+
+
+def from_kernel_layout(state: dict, cfg: TMConfig) -> dict:
+    """Kernel layout -> public state layout (no-op in "aos" mode)."""
+    if layout_mode() != "flat":
+        return state
+    K, S, M = cfg.cells_per_column, cfg.max_segments_per_cell, cfg.max_synapses_per_segment
+    tails = {3: (K, S, M), 2: (K, S)}
+    out = dict(state)
+    for k, nd in _FLAT_KEYS.items():
+        x = out[k]
+        out[k] = x.reshape(*x.shape[:-1], *tails[nd])
+    return out
+
+
 def _compact_ids(mask: jnp.ndarray, size: int) -> jnp.ndarray:
     """Indices of the first `size` True entries of `mask` [n], ascending,
     filled with n -> i32 [size].
@@ -305,11 +373,42 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     `state` uses the models/state.py TM layout plus "tm_overflow" (i32
     overflow counter, device-only observability).
     """
-    C, K, S, M = state["presyn"].shape
+    flat = layout_mode() == "flat"
+    if flat:
+        K, S, M = cfg.cells_per_column, cfg.max_segments_per_cell, cfg.max_synapses_per_segment
+        if state["presyn"].ndim != 2:
+            raise ValueError(
+                "RTAP_TM_LAYOUT=flat: tm_step expects kernel-layout state "
+                "([C, K*S*M] pools — ops/step.py applies to_kernel_layout); "
+                f"got presyn shape {state['presyn'].shape}"
+            )
+        C = state["presyn"].shape[0]
+    else:
+        C, K, S, M = state["presyn"].shape
     N = C * K
     L, Ac = cfg.learn_cap, cfg.col_cap
     if K > 32:
         raise ValueError("cells_per_column > 32 unsupported (packed cell masks)")
+
+    pool_shape = (C, K * S * M) if flat else (C, K, S, M)
+    seg_shape = (C, K * S) if flat else (C, K, S)
+
+    def seg_sum(x):
+        """Per-segment count over synapse lanes -> i32 [*seg_shape]. Flat
+        layout reduces via the block-diagonal 0/1 MXU matmul (counts <= M <<
+        2^24: f32-exact) instead of a minor-dim sum the tiler pads."""
+        if not flat:
+            return x.sum(-1)
+        from rtap_tpu.ops.pallas_tm import _reduce_matrix
+
+        red = jnp.asarray(_reduce_matrix(K * S, M))
+        return jnp.round(
+            jax.lax.dot(x.astype(jnp.float32), red, precision=_HI)
+        ).astype(jnp.int32)
+
+    def seg_expand(x):
+        """Broadcast a per-segment value onto its synapse lanes."""
+        return jnp.repeat(x, M, axis=-1) if flat else x[..., None]
 
     # Permanence-domain constants (models/perm.py). The learning workspace
     # computes on integer-VALUED f32 in quantized domains (quanta <= 65535
@@ -329,7 +428,14 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     seg_last = state["seg_last"]
     it = state["tm_iter"] + 1
 
-    prev_predictive = state["active_seg"].any(-1)  # [C, K]
+    # 4-D views of the SMALL segment tensors for the categorization logic
+    # (32 KB each — cheap to repack; the MB-scale pools never leave flat)
+    active_seg4 = state["active_seg"].reshape(C, K, S)
+    matching_seg4 = state["matching_seg"].reshape(C, K, S)
+    seg_pot4 = state["seg_pot"].reshape(C, K, S)
+    seg_last4 = seg_last.reshape(C, K, S)
+
+    prev_predictive = active_seg4.any(-1)  # [C, K]
     prev_pred_cols = prev_predictive.any(-1)
     n_active = active_cols.sum()
     raw = jnp.where(
@@ -341,8 +447,8 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     have_winners = state["prev_winner"].any()
 
     predicted_cols, learn_mask, alloc, winner_extra, burst = _segment_learning_mask(
-        cfg, active_cols, state["active_seg"], state["matching_seg"], state["seg_pot"],
-        seg_last, have_winners,
+        cfg, active_cols, active_seg4, matching_seg4, seg_pot4,
+        seg_last4, have_winners,
     )
 
     # cell activation / winner selection (pure function of prev state)
@@ -473,7 +579,7 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
                 presyn.reshape(C, -1)
                 .at[col_ids]
                 .set(ws_presyn_r.reshape(Ac, -1).astype(presyn_dt), mode="drop")
-                .reshape(C, K, S, M)
+                .reshape(*pool_shape)
             )
             ws_perm_w = ws_perm_r.reshape(Ac, -1)
             if dom.bits:
@@ -482,28 +588,30 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
                 syn_perm.reshape(C, -1)
                 .at[col_ids]
                 .set(ws_perm_w.astype(p_dt), mode="drop")
-                .reshape(C, K, S, M)
+                .reshape(*pool_shape)
             )
             seg_last = (
                 seg_last.reshape(C, -1)
                 .at[col_ids]
                 .set(ws_last.reshape(Ac, -1), mode="drop")
-                .reshape(C, K, S)
+                .reshape(*seg_shape)
             )
         else:
+            hit_pool = hit_cols.reshape(C, *([1] * (len(pool_shape) - 1)))
+            hit_seg = hit_cols.reshape(C, *([1] * (len(seg_shape) - 1)))
             pool_presyn = jnp.round(
                 jax.lax.dot(col_oh.T, ws_presyn_r.reshape(Ac, -1).astype(jnp.float32), precision=_HI)
-            ).astype(presyn_dt).reshape(C, K, S, M)
+            ).astype(presyn_dt).reshape(*pool_shape)
             pool_perm_f = jax.lax.dot(col_oh.T, ws_perm_r.reshape(Ac, -1), precision=_HI)
             if dom.bits:
                 pool_perm_f = jnp.round(pool_perm_f)  # exact already; belt+braces
-            pool_perm = pool_perm_f.astype(p_dt).reshape(C, K, S, M)
+            pool_perm = pool_perm_f.astype(p_dt).reshape(*pool_shape)
             pool_last = jnp.where(
                 col_oh_b[:, :, None], ws_last.reshape(Ac, 1, -1), 0
-            ).sum(0).reshape(C, K, S)
-            presyn = jnp.where(hit_cols[:, None, None, None], pool_presyn, presyn)
-            syn_perm = jnp.where(hit_cols[:, None, None, None], pool_perm, syn_perm)
-            seg_last = jnp.where(hit_cols[:, None, None], pool_last, seg_last)
+            ).sum(0).reshape(*seg_shape)
+            presyn = jnp.where(hit_pool, pool_presyn, presyn)
+            syn_perm = jnp.where(hit_pool, pool_perm, syn_perm)
+            seg_last = jnp.where(hit_seg, pool_last, seg_last)
 
         overflow_learn = (
             (n_active > Ac) | (p_cols > Ac) | (ws_learn.sum() > L)
@@ -512,11 +620,12 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         # --- punish matching segments in columns that did not activate ---
         if cfg.predicted_segment_decrement > 0.0:
             pdec = dom.rate(cfg.predicted_segment_decrement)
-            pmask = state["matching_seg"] & ~active_cols[:, None, None]
+            acols_seg = active_cols.reshape(C, *([1] * (len(seg_shape) - 1)))
+            pmask = state["matching_seg"] & ~acols_seg  # [*seg_shape]
             pact = _presyn_active_packed(presyn, pcol_ids, pcol_masks, K)
             sp_c = syn_perm.astype(dom.compute_dtype)
             syn_perm = jnp.where(
-                pmask[..., None] & pact,
+                seg_expand(pmask) & pact,
                 jnp.maximum(sp_c - pdec, dom.zero),
                 sp_c,
             ).astype(p_dt)
@@ -524,7 +633,7 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         # --- synapse death at permanence <= 0, then empty-segment death ---
         dead = (presyn >= 0) & (syn_perm <= dom.zero)
         presyn = jnp.where(dead, -1, presyn)
-        nsyn = (presyn >= 0).sum(-1)
+        nsyn = seg_sum(presyn >= 0)
         seg_last = jnp.where((seg_last >= 0) & (nsyn == 0), -1, seg_last)
 
     # --- dendrite activity for t+1 over existing segments ---
@@ -540,12 +649,15 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         # fused VMEM kernel, bit-identical semantics (ops/pallas_tm.py);
         # opt-in until profiled on silicon
         conn_count, pot_count = dendrite_activity_pallas(
-            presyn, syn_perm, acol_ids, acol_masks, p_connected
+            presyn.reshape(C, K, S, M), syn_perm.reshape(C, K, S, M),
+            acol_ids, acol_masks, p_connected,
         )
+        conn_count = conn_count.reshape(*seg_shape)
+        pot_count = pot_count.reshape(*seg_shape)
     else:
         syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
-        conn_count = (syn_act & (syn_perm >= p_connected)).sum(-1)
-        pot_count = syn_act.sum(-1)
+        conn_count = seg_sum(syn_act & (syn_perm >= p_connected))
+        pot_count = seg_sum(syn_act)
     active_seg = exists_seg & (conn_count >= cfg.activation_threshold)
     matching_seg = exists_seg & (pot_count >= cfg.min_threshold)
     seg_pot = jnp.where(exists_seg, pot_count, 0).astype(jnp.int16)

@@ -44,6 +44,11 @@ STEPS: list[tuple[str, list[str]]] = [
     ("profile_f32_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
                              "--gs", "1024", "--perm-bits", "0",
                              "--scatter", "indexed"]),
+    ("profile_flat", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                      "--gs", "1024", "--layout", "flat"]),
+    ("profile_flat_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                              "--gs", "1024", "--layout", "flat",
+                              "--scatter", "indexed"]),
     ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
     ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
     ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),

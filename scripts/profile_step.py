@@ -90,12 +90,15 @@ def sp_only(state, vals, ts, cfg: ModelConfig, learn=True):
 
 @partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
 def tm_only(state, actives, cfg: ModelConfig, learn=True):
+    from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
+
     def body(s, a):
         s, raw = tm_step(s, a, cfg.tm, learn)
         return s, raw
     def step(s, a):
         return jax.vmap(body)(s, a)
-    return jax.lax.scan(step, state, actives)
+    state, out = jax.lax.scan(step, to_kernel_layout(state), actives)
+    return from_kernel_layout(state, cfg.tm), out
 
 
 def main():
@@ -112,6 +115,11 @@ def main():
                          "SCATTER_MODE): 'indexed' moves only touched rows, "
                          "'matmul' is the one-hot MXU formulation — A/B on "
                          "hardware")
+    ap.add_argument("--layout", choices=("aos", "flat"), default=None,
+                    help="TM kernel tensor layout (ops/tm_tpu.py LAYOUT_MODE):"
+                         " 'flat' carries [C, K*S*M] pools through the scan "
+                         "(no trailing-dim tile padding), 'aos' is the 4-D "
+                         "original — A/B on hardware")
     ap.add_argument("--perm-bits", type=int, default=16, choices=(0, 8, 16),
                     help="permanence storage domain of the profiled cluster "
                          "preset: u16/u8 halve HBM per stream but add per-tick "
@@ -132,6 +140,11 @@ def main():
 
         set_scatter_mode(args.scatter)
         log(f"TM workspace movement: {args.scatter}")
+    if args.layout:
+        from rtap_tpu.ops.tm_tpu import set_layout_mode
+
+        set_layout_mode(args.layout)
+        log(f"TM kernel layout: {args.layout}")
 
     cfg = cluster_preset(perm_bits=args.perm_bits)
     T = args.T

@@ -44,6 +44,46 @@ def indexed_scatter():
     tm_tpu.set_scatter_mode(None)
 
 
+@pytest.fixture
+def flat_layout():
+    tm_tpu.set_layout_mode("flat")
+    yield
+    tm_tpu.set_layout_mode(None)
+
+
+@exact_only
+@pytest.mark.parametrize("perm_bits", [0, 16])
+def test_e2e_parity_with_flat_layout(flat_layout, perm_bits):
+    """RTAP_TM_LAYOUT=flat (pools carried [C, K*S*M], segment tensors
+    [C, K*S], per-segment counts via block-diagonal matmuls) is a pure
+    layout change: bit-identical to the 4-D kernel in both permanence
+    domains."""
+    from tests.parity.test_quantized_parity import quant_cfg
+
+    cfg = small_cfg() if perm_bits == 0 else quant_cfg(perm_bits)
+    cpu = HTMModel(cfg, seed=5, backend="cpu")
+    tpu = HTMModel(cfg, seed=5, backend="tpu")
+    vals = make_values(300, 1)
+    for i in range(300):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
+@exact_only
+def test_e2e_parity_flat_layout_all_tpu_paths(force_tpu_paths, flat_layout, indexed_scatter):
+    """The full hardware candidate: flat layout + indexed workspace movement
+    + TPU compact-ids paths, all at once."""
+    cfg = small_cfg()
+    cpu = HTMModel(cfg, seed=13, backend="cpu")
+    tpu = HTMModel(cfg, seed=13, backend="tpu")
+    vals = make_values(300, 1, seed=21)
+    for i in range(300):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
 @exact_only
 @pytest.mark.parametrize("perm_bits", [0, 16])
 def test_e2e_parity_with_indexed_scatter(indexed_scatter, perm_bits):
