@@ -1,0 +1,177 @@
+"""Operator CLI: ``python -m rtap_tpu <command>``.
+
+The reference is an application, not just a library — its operators launch
+the collector/service loop, replay corpora, and evaluate detection from the
+command line (SURVEY.md L4/L5, §3.3-3.5). This is that surface, thin glue
+over the library:
+
+    serve    live scoring loop at a fixed cadence, fed by a TCP JSONL push
+             listener or an HTTP poll endpoint (service/sources.py, C18)
+    replay   synthetic cluster replay through stream groups at full speed,
+             JSONL alerts + throughput/occupancy stats (service/loop.py)
+    eval     fault-injection evaluation -> JSON report (eval/fault_eval.py)
+    report   matplotlib overlays from a replay/eval (scripts/report.py)
+
+``bench``/``scaling``/``profile`` remain repo-root scripts (bench.py,
+scripts/) since they are driver/measurement surfaces, not operator ones.
+
+Every command honors ``RTAP_FORCE_CPU=1`` (tunnel-independent runs) and the
+kernel strategy env knobs (RTAP_TM_SCATTER / RTAP_TM_LAYOUT / RTAP_TM_PALLAS).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from rtap_tpu.utils.platform import maybe_force_cpu
+
+
+def _cmd_serve(args: argparse.Namespace) -> int:
+    from rtap_tpu.config import cluster_preset, nab_preset
+    from rtap_tpu.service.loop import live_loop
+    from rtap_tpu.service.registry import StreamGroup
+    from rtap_tpu.service.sources import HttpPollSource, TcpJsonlSource
+
+    ids = [s.strip() for s in args.streams.split(",") if s.strip()]
+    if not ids:
+        print("serve: --streams must name at least one stream id", file=sys.stderr)
+        return 2
+    cfg = nab_preset() if args.preset == "nab" else cluster_preset()
+    grp = StreamGroup(cfg, ids, backend=args.backend, threshold=args.threshold)
+    if args.http:
+        source = HttpPollSource(args.http, ids)
+        close = lambda: None  # noqa: E731
+    else:
+        tcp = TcpJsonlSource(ids, port=args.port).start()
+        host, port = tcp.address
+        print(f"serve: listening for JSONL records on {host}:{port}", file=sys.stderr)
+        source, close = tcp, tcp.close
+    try:
+        stats = live_loop(source, grp, n_ticks=args.ticks, cadence_s=args.cadence,
+                          alert_path=args.alerts)
+    finally:
+        close()
+    print(json.dumps(stats))
+    return 0
+
+
+def _cmd_replay(args: argparse.Namespace) -> int:
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_cluster
+    from rtap_tpu.service.loop import replay_streams
+
+    # the generator needs room for post-probation injections
+    # (inject_after_frac * length .. length - 50 must be non-empty)
+    min_len = 80
+    if args.length < min_len:
+        print(f"replay: --length must be >= {min_len} (fault injections land "
+              "past the probation region)", file=sys.stderr)
+        return 2
+    scfg = SyntheticStreamConfig(length=args.length, cadence_s=1.0,
+                                 anomaly_magnitude=args.magnitude,
+                                 noise_phi=0.97, noise_scale=0.5)
+    streams = generate_cluster(args.nodes, cfg=scfg, seed=args.seed)
+    res = replay_streams(streams, cluster_preset(), backend=args.backend,
+                         group_size=args.group_size, chunk_ticks=args.chunk_ticks,
+                         threshold=args.threshold, alert_path=args.alerts)
+    print(json.dumps({"streams": len(res.stream_ids), "ticks": len(res.timestamps),
+                      **res.throughput}))
+    return 0
+
+
+def _with_argv(argv: list[str], fn) -> int:
+    """Run `fn` under a temporary sys.argv (the wrapped mains parse it);
+    always restore — a programmatic main(['eval', ...]) call must not leave
+    stale args behind for the caller's own argparse users."""
+    saved = sys.argv
+    sys.argv = [saved[0], *argv]
+    try:
+        fn()
+    finally:
+        sys.argv = saved
+    return 0
+
+
+def _cmd_eval(args: argparse.Namespace) -> int:
+    from rtap_tpu.eval import fault_eval
+
+    argv = ["--streams", str(args.streams), "--length", str(args.length),
+            "--magnitude", str(args.magnitude), "--backend", args.backend]
+    if args.all_kinds:
+        argv.append("--all-kinds")
+    if args.out:
+        argv += ["--out", args.out]
+    return _with_argv(argv, fault_eval.main)
+
+
+def _cmd_report(args: argparse.Namespace) -> int:
+    import os
+    import runpy
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    argv = ["--out-dir", args.out_dir, "--streams", str(args.streams),
+            "--length", str(args.length)]
+    if args.eval_report:
+        argv += ["--eval-report", args.eval_report]
+    return _with_argv(
+        argv,
+        lambda: runpy.run_path(os.path.join(repo, "scripts", "report.py"),
+                               run_name="__main__"),
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    maybe_force_cpu()
+    ap = argparse.ArgumentParser(prog="python -m rtap_tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("serve", help="live scoring loop fed by TCP push or HTTP poll")
+    p.add_argument("--streams", required=True,
+                   help="comma-separated stream ids to register")
+    p.add_argument("--http", default=None,
+                   help="poll this metrics endpoint each tick (default: TCP listener)")
+    p.add_argument("--port", type=int, default=0, help="TCP listen port (0 = ephemeral)")
+    p.add_argument("--ticks", type=int, default=60)
+    p.add_argument("--cadence", type=float, default=1.0)
+    p.add_argument("--preset", choices=("cluster", "nab"), default="cluster")
+    p.add_argument("--backend", default="tpu")
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--alerts", default=None, help="JSONL alert sink path")
+    p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("replay", help="synthetic cluster replay at full speed")
+    p.add_argument("--nodes", type=int, default=32, help="nodes x 3 metrics = streams")
+    p.add_argument("--length", type=int, default=1500)
+    p.add_argument("--magnitude", type=float, default=6.0)
+    p.add_argument("--group-size", type=int, default=None)
+    p.add_argument("--chunk-ticks", type=int, default=64)
+    p.add_argument("--backend", default="tpu")
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--alerts", default=None)
+    p.set_defaults(fn=_cmd_replay)
+
+    p = sub.add_parser("eval", help="fault-injection evaluation -> JSON report")
+    p.add_argument("--streams", type=int, default=120)
+    p.add_argument("--length", type=int, default=1500)
+    p.add_argument("--magnitude", type=float, default=6.0)
+    p.add_argument("--all-kinds", action="store_true")
+    p.add_argument("--backend", default="tpu")
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=_cmd_eval)
+
+    p = sub.add_parser("report", help="matplotlib overlays (metric/likelihood/alerts)")
+    p.add_argument("--out-dir", default="reports")
+    p.add_argument("--streams", type=int, default=6)
+    p.add_argument("--length", type=int, default=900)
+    p.add_argument("--eval-report", default=None)
+    p.set_defaults(fn=_cmd_report)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,84 @@
+"""Operator CLI (`python -m rtap_tpu`) end-to-end: each subcommand drives
+its real pipeline at a tiny size and emits parseable JSON."""
+
+import json
+import os
+
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ENV = {**os.environ, "RTAP_FORCE_CPU": "1"}
+
+
+def run_cli(*args, timeout=600):
+    return subprocess.run(
+        [sys.executable, "-m", "rtap_tpu", *args],
+        cwd=REPO, env=ENV, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_replay_emits_throughput_stats():
+    p = run_cli("replay", "--nodes", "2", "--length", "900", "--backend", "cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["streams"] == 6 and out["ticks"] == 900
+    assert out["scored"] == 6 * 900
+
+
+def test_serve_tcp_scores_pushed_records(tmp_path):
+    alerts = tmp_path / "alerts.jsonl"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rtap_tpu", "serve", "--streams", "a,b",
+         "--ticks", "5", "--cadence", "0.2", "--backend", "cpu", "--port", "0",
+         "--alerts", str(alerts)],
+        cwd=REPO, env=ENV, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+    # the listener line tells us the bound port
+    port = None
+    deadline = time.time() + 120
+    lines = []
+
+    def feed():
+        nonlocal port
+        for line in proc.stderr:
+            lines.append(line)
+            if "listening for JSONL records on" in line:
+                port = int(line.rsplit(":", 1)[1])
+                break
+        # keep draining so the child never blocks on a full pipe
+        for line in proc.stderr:
+            lines.append(line)
+
+    t = threading.Thread(target=feed, daemon=True)
+    t.start()
+    while port is None and time.time() < deadline and proc.poll() is None:
+        time.sleep(0.05)
+    assert port, (proc.poll(), "".join(lines)[-2000:])
+
+    stop = threading.Event()
+
+    def produce():
+        from rtap_tpu.service.sources import send_jsonl
+
+        k = 0
+        while not stop.is_set():
+            try:
+                send_jsonl(("127.0.0.1", port),
+                           [{"id": "a", "value": 40 + k}, {"id": "b", "value": 60 - k}])
+            except OSError:
+                pass
+            k += 1
+            time.sleep(0.1)
+
+    pt = threading.Thread(target=produce, daemon=True)
+    pt.start()
+    out, _ = proc.communicate(timeout=300)
+    stop.set()
+    assert proc.returncode == 0, "".join(lines)[-2000:]
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert stats["ticks"] == 5 and stats["scored"] == 10
+    assert "latency_p50_ms" in stats
