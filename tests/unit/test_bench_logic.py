@@ -1,0 +1,91 @@
+"""The bench's failure machinery (bench.py): last-known-good fallback,
+CPU-drive guards, emit idempotence. Round 2 ended with no number because
+this machinery didn't exist; pin it."""
+
+import importlib.util
+import json
+import sys
+
+
+def load_bench(tmp_path, monkeypatch, lkg: dict | None):
+    """Import bench.py as an isolated module with LKG_PATH redirected."""
+    spec = importlib.util.spec_from_file_location("bench_under_test", "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.LKG_PATH = str(tmp_path / "BENCH_LKG.json")
+    if lkg is not None:
+        (tmp_path / "BENCH_LKG.json").write_text(json.dumps(lkg))
+    return mod
+
+
+def test_emit_prefers_fresh_result(tmp_path, monkeypatch, capsys):
+    b = load_bench(tmp_path, monkeypatch, {"value": 111.0, "measured_at": "x"})
+    assert b.emit({"value": 42.0}) is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 42.0 and "cached" not in out
+
+
+def test_emit_falls_back_to_lkg_flagged(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    b = load_bench(tmp_path, monkeypatch, {"value": 38956.1, "measured_at": "2026-07-30"})
+    assert b.emit(None) is True
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["cached"] is True and out["value"] == 38956.1
+    assert out["measured_at"] == "2026-07-30" and "cached_reason" in out
+
+
+def test_emit_cpu_drives_never_read_lkg(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
+    b = load_bench(tmp_path, monkeypatch, {"value": 38956.1, "measured_at": "x"})
+    assert b.emit(None) is False
+    assert capsys.readouterr().out == ""
+
+
+def test_emit_without_lkg_returns_false(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    b = load_bench(tmp_path, monkeypatch, None)
+    assert b.emit(None) is False
+    assert capsys.readouterr().out == ""
+
+
+def test_emit_is_idempotent(tmp_path, monkeypatch, capsys):
+    b = load_bench(tmp_path, monkeypatch, None)
+    assert b.emit({"value": 1.0}) is True
+    assert b.emit({"value": 2.0}) is True  # reports success, prints nothing new
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 1 and json.loads(lines[0])["value"] == 1.0
+
+
+def test_malformed_lkg_degrades_to_none(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    for bad in ('{"value": null}', "[1,2]", "not json"):
+        (tmp_path / "BENCH_LKG.json").write_text(bad)
+        b = load_bench(tmp_path, monkeypatch, None)
+        b.LKG_PATH = str(tmp_path / "BENCH_LKG.json")
+        assert b.emit(None) is False, bad
+    assert capsys.readouterr().out == ""
+
+
+def test_store_lkg_guard_and_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
+    b = load_bench(tmp_path, monkeypatch, None)
+    b._store_lkg({"value": 9.9, "G": 1, "T": 1})
+    assert not (tmp_path / "BENCH_LKG.json").exists()  # CPU drives never write
+
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    b._store_lkg({"value": 9.9, "G": 1, "T": 1})
+    stored = json.loads((tmp_path / "BENCH_LKG.json").read_text())
+    assert stored["value"] == 9.9 and stored["G"] == 1 and "measured_at" in stored
+    fallback, extra = b._load_lkg()
+    assert fallback == {"value": 9.9} and extra["cached"] is True
+
+
+def test_oom_dominance_skip_logic():
+    """The ladder-skip predicate: only configs dominating the observed OOM
+    point in BOTH dims are skipped."""
+    oom_at = (2048, 64)
+    skipped = [
+        (g, t) for g, t in [(4096, 64), (2048, 128), (1024, 64), (4096, 32), (2048, 64)]
+        if g >= oom_at[0] and t >= oom_at[1]
+    ]
+    assert skipped == [(4096, 64), (2048, 128), (2048, 64)]
