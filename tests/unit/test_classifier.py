@@ -42,19 +42,28 @@ def _periodic_values(n, period=6, unique=False):
     return np.tile(cycle, n // period + 1)[:n]
 
 
-def test_classifier_parity_cpu_vs_device():
+@pytest.mark.parametrize("layout", ["aos", "flat"])
+def test_classifier_parity_cpu_vs_device(layout):
     """Same records through the numpy oracle and the jitted device kernel:
-    predictions agree to float tolerance (softmax exp may differ by ulps)."""
+    predictions agree to float tolerance (softmax exp may differ by ulps).
+    Covered under both kernel layouts — the classifier consumes TM cell
+    state (prev_active), which the flat adapters must hand over unchanged."""
+    import rtap_tpu.ops.tm_tpu as tm_tpu
+
     cfg = _cfg()
     cpu = HTMModel(cfg, seed=1, backend="cpu")
-    dev = HTMModel(cfg, seed=1, backend="tpu")
-    vals = _periodic_values(200)
-    for i, v in enumerate(vals):
-        rc = cpu.run(1_700_000_000 + i, float(v))
-        rd = dev.run(1_700_000_000 + i, float(v))
-        assert rc.raw_score == pytest.approx(rd.raw_score, abs=0.0), f"step {i}"
-        assert rc.prediction == pytest.approx(rd.prediction, rel=1e-4, abs=1e-4), f"step {i}"
-        assert rc.prediction_prob == pytest.approx(rd.prediction_prob, rel=1e-3, abs=1e-5), f"step {i}"
+    tm_tpu.set_layout_mode(layout)
+    try:
+        dev = HTMModel(cfg, seed=1, backend="tpu")
+        vals = _periodic_values(200)
+        for i, v in enumerate(vals):
+            rc = cpu.run(1_700_000_000 + i, float(v))
+            rd = dev.run(1_700_000_000 + i, float(v))
+            assert rc.raw_score == pytest.approx(rd.raw_score, abs=0.0), f"step {i}"
+            assert rc.prediction == pytest.approx(rd.prediction, rel=1e-4, abs=1e-4), f"step {i}"
+            assert rc.prediction_prob == pytest.approx(rd.prediction_prob, rel=1e-3, abs=1e-5), f"step {i}"
+    finally:
+        tm_tpu.set_layout_mode(None)
 
 
 def _prediction_maes(vals, train=400):
