@@ -71,10 +71,15 @@ def test_e2e_parity_with_flat_layout(flat_layout, perm_bits):
 
 
 @exact_only
-def test_e2e_parity_flat_layout_all_tpu_paths(force_tpu_paths, flat_layout, indexed_scatter):
+@pytest.mark.parametrize("perm_bits", [0, 16])
+def test_e2e_parity_flat_layout_all_tpu_paths(
+    force_tpu_paths, flat_layout, indexed_scatter, perm_bits
+):
     """The full hardware candidate: flat layout + indexed workspace movement
-    + TPU compact-ids paths, all at once."""
-    cfg = small_cfg()
+    + TPU compact-ids paths, all at once, in both permanence domains."""
+    from tests.parity.test_quantized_parity import quant_cfg
+
+    cfg = small_cfg() if perm_bits == 0 else quant_cfg(perm_bits)
     cpu = HTMModel(cfg, seed=13, backend="cpu")
     tpu = HTMModel(cfg, seed=13, backend="tpu")
     vals = make_values(300, 1, seed=21)
