@@ -40,16 +40,32 @@ TARGET = 100_000.0  # metrics/sec/chip north star (BASELINE.json)
 # dead tunnel degrades the result's freshness, never its existence.
 LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LKG.json")
 
-# (group_size, chunk_ticks): the cheap anchor first, then exploration.
-# Attempt order is also failure-isolation order — an OOM or compile stall
-# costs only its own budget (an OOM also skips every LATER rung that
-# dominates the failed (G, T) point in both dims; smaller rungs still run).
-# Measured on v5e (r3): throughput per chip FALLS with G (38,956 at
-# G=256 vs 29,725 at G=8192 — the per-stream kernel cost dominates and big
-# groups add nothing), and G=16384 is past the HBM frontier (XLA workspace
-# temps on top of the 564 KB/stream state). So the ladder brackets the
-# small-G peak and probes longer chunks to amortize per-dispatch overhead.
-ATTEMPTS = [(256, 64), (256, 256), (512, 128), (128, 64), (1024, 64), (2048, 64)]
+# (group_size, chunk_ticks, env_overrides): the cheap anchor first, then the
+# round-4 kernel-strategy candidates at the measured-optimal rung, then the
+# G/T exploration ladder. Attempt order is also failure-isolation order — an
+# OOM or compile stall costs only its own budget (an OOM also skips every
+# LATER rung that dominates the failed (G, T) point in both dims; smaller
+# rungs still run). Measured on v5e (r3): throughput per chip FALLS with G
+# (38,956 at G=256 vs 29,725 at G=8192 — the per-stream kernel cost dominates
+# and big groups add nothing), and G=16384 is past the HBM frontier (XLA
+# workspace temps on top of the 564 KB/stream state). So the ladder brackets
+# the small-G peak and probes longer chunks to amortize per-dispatch
+# overhead. The strategy candidates (all bit-identical to the default kernel
+# — tests/parity/) ride the per-attempt subprocess env: flat layout kills the
+# [.., S, M]-trailing-dim tile padding, indexed scatter + compact sweep cut
+# the full-pool learning/punish/death traffic (ops/tm_tpu.py switch table).
+ATTEMPTS: list[tuple[int, int, dict]] = [
+    (256, 64, {}),
+    (256, 64, {"RTAP_TM_SCATTER": "indexed", "RTAP_TM_SWEEP": "compact"}),
+    (256, 64, {"RTAP_TM_LAYOUT": "flat", "RTAP_TM_SCATTER": "indexed",
+               "RTAP_TM_SWEEP": "compact"}),
+    (256, 64, {"RTAP_TM_LAYOUT": "flat"}),
+    (256, 256, {}),
+    (512, 128, {}),
+    (1024, 64, {"RTAP_TM_LAYOUT": "flat", "RTAP_TM_SCATTER": "indexed",
+                "RTAP_TM_SWEEP": "compact"}),
+    (2048, 64, {}),
+]
 
 
 def log(msg: str) -> None:
@@ -95,7 +111,7 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
     grp = StreamGroup(cfg, ids, backend="tpu")
     log(f"  state init + device_put: {time.perf_counter() - t0:.1f}s")
 
-    vals, ts, _ = make_sine_feed(group_size, chunk_ticks, key=(2026, 7))
+    vals, ts, phase = make_sine_feed(group_size, chunk_ticks, key=(2026, 7))
 
     # warmup: compile + one chunk of real stepping
     t0 = time.perf_counter()
@@ -103,34 +119,44 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
     log(f"  warmup (compile + first chunk): {time.perf_counter() - t0:.1f}s")
 
     # steady state, pipelined (host likelihood + fetch overlap device compute)
-    value, dt = measure_pipelined(grp, vals, ts, measure_chunks)
-    return {"value": value, "G": group_size, "T": chunk_ticks, "wall_s": round(dt, 2)}
+    # with NOVEL values per measured chunk (genuine learning, r3 weak #8)
+    value, dt = measure_pipelined(grp, vals, ts, measure_chunks, novel=((2026, 7), phase))
+    from rtap_tpu.ops.tm_tpu import layout_mode, scatter_mode, sweep_mode
+
+    modes = f"{layout_mode()}/{scatter_mode()}/{sweep_mode()}"
+    return {"value": value, "G": group_size, "T": chunk_ticks,
+            "wall_s": round(dt, 2), "modes": modes}
 
 
 # --------------------------------------------------------------- parent ----
 
 
-_EMITTED = False
+_EMITTED: int | None = None  # exit code of the emitted line, once emitted
+
+CACHED_EXIT = 4  # emitted-but-cached: distinct rc so exit-code-only consumers
+# can tell a dead-tunnel LKG fallback from a fresh measurement (the JSON line
+# also carries "cached": true; ADVICE.md round 3)
 
 
-def emit(best: dict | None) -> bool:
-    """Print the single result line. Idempotent — the flag flips BEFORE the
-    print so a signal landing mid-emit can never produce a second line
-    (stdout must carry exactly one JSON object). Falls back to the committed
-    last-known-good hardware measurement (flagged "cached") when this run
-    produced nothing."""
+def emit(best: dict | None) -> int | None:
+    """Print the single result line; returns the process exit code (0 fresh,
+    CACHED_EXIT for the LKG fallback) or None when nothing could be emitted.
+    Idempotent — the flag flips BEFORE the print so a signal landing mid-emit
+    can never produce a second line (stdout must carry exactly one JSON
+    object). Falls back to the committed last-known-good hardware measurement
+    (flagged "cached") when this run produced nothing."""
     global _EMITTED
-    if _EMITTED:
-        return True
+    if _EMITTED is not None:
+        return _EMITTED
     extra = {}
     if best is None:
         if os.environ.get("BENCH_ALLOW_CPU") == "1":
-            return False  # CPU test drives must exercise the real failure
+            return None  # CPU test drives must exercise the real failure
             # path, not mask it with the committed hardware measurement
         best, extra = _load_lkg()
         if best is None:
-            return False
-    _EMITTED = True
+            return None
+    _EMITTED = CACHED_EXIT if extra.get("cached") else 0
     print(
         json.dumps(
             {
@@ -143,7 +169,7 @@ def emit(best: dict | None) -> bool:
         ),
         flush=True,
     )
-    return True
+    return _EMITTED
 
 
 def _load_lkg() -> tuple[dict | None, dict]:
@@ -176,6 +202,7 @@ def _store_lkg(best: dict) -> None:
                     "value": round(best["value"], 1),
                     "G": best.get("G"),
                     "T": best.get("T"),
+                    "modes": best.get("modes"),
                     "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 },
                 f,
@@ -187,11 +214,13 @@ def _store_lkg(best: dict) -> None:
 
 def _finish(best: dict | None) -> None:
     """Single exit point: persist a fresh result, emit the line (fresh or
-    LKG fallback), exit 0 iff a line went out. Shared by the signal handler
-    and every abort path so their semantics can never drift."""
+    LKG fallback), exit with the emit code (0 fresh / CACHED_EXIT cached /
+    1 nothing). Shared by the signal handler and every abort path so their
+    semantics can never drift."""
     if best is not None:
         _store_lkg(best)
-    sys.exit(0 if emit(best) else 1)
+    code = emit(best)
+    sys.exit(1 if code is None else code)
 
 
 def main() -> None:
@@ -211,15 +240,18 @@ def main() -> None:
     signal.signal(signal.SIGINT, on_signal)
 
     os.makedirs(CACHE_DIR, exist_ok=True)
-    oom_at: tuple[int, int] | None = None  # (G, T) observed to OOM
+    # OOM dominance is tracked PER kernel-strategy config: memory is monotone
+    # in G (state) and T (feed/workspace) only with the kernel fixed — e.g.
+    # the flat layout exists precisely to shrink the padded HBM footprint, so
+    # an aos OOM must not veto the flat rungs
+    oom_at: dict[tuple, tuple[int, int]] = {}
     init_fail_streak = 0  # consecutive children that died without backend init
-    for group_size, chunk_ticks in ATTEMPTS:
-        if oom_at is not None and group_size >= oom_at[0] and chunk_ticks >= oom_at[1]:
-            # memory is monotone in G (state) and T (feed/workspace), so only
-            # configs dominating the observed OOM point in BOTH dims are
-            # doomed; smaller rungs later in the ladder still run
+    for group_size, chunk_ticks, strategy_env in ATTEMPTS:
+        strat_key = tuple(sorted(strategy_env.items()))
+        if strat_key in oom_at and group_size >= oom_at[strat_key][0] \
+                and chunk_ticks >= oom_at[strat_key][1]:
             log(f"bench: skipping G={group_size},T={chunk_ticks} "
-                f"(dominates OOM point {oom_at})")
+                f"(dominates OOM point {oom_at[strat_key]} for {strat_key})")
             continue
         remaining = budget - (time.monotonic() - t_start)
         # never start an attempt we can't give a meaningful slice of budget
@@ -230,7 +262,8 @@ def main() -> None:
             this_budget = min(per_attempt, budget - (time.monotonic() - t_start))
             if this_budget < 60:
                 break
-            log(f"bench attempt: G={group_size}, T={chunk_ticks} (budget {this_budget:.0f}s)")
+            log(f"bench attempt: G={group_size}, T={chunk_ticks} "
+                f"{strategy_env or ''} (budget {this_budget:.0f}s)")
             marker = os.path.join(CACHE_DIR, f".init_ok.{os.getpid()}")
             if os.path.exists(marker):
                 os.unlink(marker)
@@ -238,7 +271,7 @@ def main() -> None:
                 [sys.executable, os.path.abspath(__file__), "--attempt",
                  str(group_size), str(chunk_ticks)],
                 stdout=subprocess.PIPE, stderr=sys.stderr, text=True,
-                env={**os.environ, "BENCH_INIT_MARKER": marker},
+                env={**os.environ, "BENCH_INIT_MARKER": marker, **strategy_env},
             )
             current_proc[0] = proc
             try:
@@ -277,8 +310,8 @@ def main() -> None:
                 init_fail_streak = 0
             if oom:
                 log(f"  G={group_size},T={chunk_ticks}: past the HBM frontier "
-                    "(OOM); skipping configs dominating this point")
-                oom_at = (group_size, chunk_ticks)
+                    "(OOM); skipping same-strategy configs dominating this point")
+                oom_at[strat_key] = (group_size, chunk_ticks)
                 break
             if res is not None:
                 log(f"  G={group_size}: {res['value']:.1f} metrics/s")
@@ -302,8 +335,10 @@ def main() -> None:
                 break
     if best is not None:
         _store_lkg(best)
-    if not emit(best):
+    code = emit(best)
+    if code is None:
         raise SystemExit("all bench configurations failed and no last-known-good exists")
+    sys.exit(code)
 
 
 if __name__ == "__main__":

@@ -39,7 +39,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("serve: --streams must name at least one stream id", file=sys.stderr)
         return 2
     cfg = nab_preset() if args.preset == "nab" else cluster_preset()
-    grp = StreamGroup(cfg, ids, backend=args.backend, threshold=args.threshold)
+    grp = StreamGroup(cfg, ids, backend=args.backend, threshold=args.threshold,
+                      debounce=args.debounce)
     if args.http:
         source = HttpPollSource(args.http, ids)
         close = lambda: None  # noqa: E731
@@ -75,7 +76,10 @@ def _cmd_replay(args: argparse.Namespace) -> int:
     streams = generate_cluster(args.nodes, cfg=scfg, seed=args.seed)
     res = replay_streams(streams, cluster_preset(), backend=args.backend,
                          group_size=args.group_size, chunk_ticks=args.chunk_ticks,
-                         threshold=args.threshold, alert_path=args.alerts)
+                         threshold=args.threshold, alert_path=args.alerts,
+                         checkpoint_dir=args.checkpoint_dir,
+                         checkpoint_every=args.checkpoint_every,
+                         debounce=args.debounce)
     print(json.dumps({"streams": len(res.stream_ids), "ticks": len(res.timestamps),
                       **res.throughput}))
     return 0
@@ -84,21 +88,23 @@ def _cmd_replay(args: argparse.Namespace) -> int:
 def _with_argv(argv: list[str], fn) -> int:
     """Run `fn` under a temporary sys.argv (the wrapped mains parse it);
     always restore — a programmatic main(['eval', ...]) call must not leave
-    stale args behind for the caller's own argparse users."""
+    stale args behind for the caller's own argparse users. Propagates the
+    wrapped main's int return code (ADVICE.md r3: a failing eval/report must
+    not exit 0)."""
     saved = sys.argv
     sys.argv = [saved[0], *argv]
     try:
-        fn()
+        return int(fn() or 0)
     finally:
         sys.argv = saved
-    return 0
 
 
 def _cmd_eval(args: argparse.Namespace) -> int:
     from rtap_tpu.eval import fault_eval
 
     argv = ["--streams", str(args.streams), "--length", str(args.length),
-            "--magnitude", str(args.magnitude), "--backend", args.backend]
+            "--magnitude", str(args.magnitude), "--backend", args.backend,
+            "--debounce", str(args.debounce)]
     if args.all_kinds:
         argv.append("--all-kinds")
     if args.out:
@@ -138,6 +144,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--preset", choices=("cluster", "nab"), default="cluster")
     p.add_argument("--backend", default="tpu")
     p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--debounce", type=int, default=2,
+                   help="alert only after this many consecutive ticks at/"
+                        "above threshold (reports/quality_study.json)")
     p.add_argument("--alerts", default=None, help="JSONL alert sink path")
     p.set_defaults(fn=_cmd_serve)
 
@@ -151,6 +160,16 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--threshold", type=float, default=0.5)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--alerts", default=None)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="atomic per-group resume checkpoints; a rerun with "
+                        "the same dir resumes each group from its last "
+                        "checkpointed tick (crash recovery)")
+    p.add_argument("--checkpoint-every", type=int, default=4,
+                   help="checkpoint cadence in collected chunks (with "
+                        "--checkpoint-dir)")
+    p.add_argument("--debounce", type=int, default=2,
+                   help="alert only after this many consecutive ticks at/"
+                        "above threshold")
     p.set_defaults(fn=_cmd_replay)
 
     p = sub.add_parser("eval", help="fault-injection evaluation -> JSON report")
@@ -159,6 +178,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--magnitude", type=float, default=6.0)
     p.add_argument("--all-kinds", action="store_true")
     p.add_argument("--backend", default="tpu")
+    p.add_argument("--debounce", type=int, default=2)
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_eval)
 

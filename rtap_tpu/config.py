@@ -170,6 +170,23 @@ class TMConfig:
     # K-bit per-column cell mask replaces comparing against a flat active-cell
     # id list (8-32x fewer VPU ops at preset sizes).
     col_cap: int = 40
+    # Static capacity of the RTAP_TM_SWEEP=compact punish/death pass (ops/
+    # tm_tpu.py): at most `punish_cap` matching segments in non-active columns
+    # are punished per step; overflow is counted in state["tm_overflow"].
+    # Dense-sweep mode (the round-3 semantics) ignores it.
+    punish_cap: int = 256
+    # Forward-index fanout capacity F (RTAP_TM_DENDRITE=forward, ops/
+    # fwd_index.py): max synapse slots per presynaptic cell tracked by
+    # fwd_slots [num_cells, F]. A cell exceeding F drops appends — counted in
+    # state["fwd_of"] (a dropped entry corrupts dendrite counts, so tests
+    # assert the counter stays zero). Memory when the index is enabled:
+    # num_cells * F * 4 B (+1-2 B/synapse slot for fwd_pos). Size F to the
+    # fanout TAIL: hot winner cells concentrate synapses (measured on the
+    # cluster preset's diurnal feed: max fanout 231-382 after 12k ticks and
+    # still rising — docs/FORWARD_INDEX_DESIGN.md round-4 measurement), so
+    # production forward-mode runs need F >= ~512 at that workload. The
+    # default stays small because the index is opt-in and tests own their F.
+    fanout_cap: int = 64
 
 
 @dataclass(frozen=True)
@@ -280,6 +297,13 @@ class ModelConfig:
         for name, bits in (("sp", self.sp.perm_bits), ("tm", self.tm.perm_bits)):
             if bits not in (0, 8, 16):
                 raise ValueError(f"{name}.perm_bits must be 0 (f32), 8, or 16; got {bits}")
+        if self.tm.punish_cap < 1:
+            raise ValueError(f"TMConfig.punish_cap must be >= 1; got {self.tm.punish_cap}")
+        if not 1 <= self.tm.fanout_cap <= (1 << 15) - 1:
+            raise ValueError(
+                f"TMConfig.fanout_cap must be in [1, 32767] (fwd_pos is int16 at "
+                f"widest); got {self.tm.fanout_cap}"
+            )
         if self.scalar is not None:
             # An invalid scalar range corrupts SDRs silently (negative buckets
             # wrap on host but drop on device — parity breaks) — fail loudly.
@@ -439,9 +463,13 @@ def cluster_preset(perm_bits: int = 16) -> ModelConfig:
         # eval, the old brittle 7/8 ratio left steady-state raw ~0.23 (p90 =
         # 0.9, i.e. frequent full bursts) vs 0.06 (p90 = 0.2) here, and f1
         # 0.44 -> 0.61 (eval/fault_eval.py, 40 streams x 1000 s).
+        # learn_cap 64: the round-4 replay drive caught learn_cap=32
+        # truncating learning bursts on the default synthetic workload
+        # (tm_overflow_total=2 at magnitude 6; 48 clears it — kept at 64 for
+        # headroom, the [learn_cap, M] workspace is tiny next to the pools)
         tm=TMConfig(cells_per_column=8, activation_threshold=5, min_threshold=4,
                     max_segments_per_cell=4, max_synapses_per_segment=12,
-                    new_synapse_count=10, learn_cap=32, col_cap=10,
+                    new_synapse_count=10, learn_cap=64, col_cap=10,
                     perm_bits=perm_bits),
         # probation 400: false-alert episodes cluster in ticks 150-400 with
         # the short round-2 probation (the tiny model is still maturing when

@@ -225,6 +225,19 @@ def generate_node(
                 f"got {tuple(fault_metrics)}"
             )
     n_anom = cfg.n_anomalies
+    # A too-short stream makes the fault-center draw below degenerate (empty
+    # or undersized candidate range -> opaque numpy ValueError); fail with
+    # the actual constraint instead (ADVICE.md r3 — the CLI guards its own
+    # replay path, but node_eval and other callers come through here).
+    lo_check = int(cfg.length * cfg.inject_after_frac)
+    n_candidates = cfg.length - 50 - lo_check
+    if n_candidates < n_anom:
+        raise ValueError(
+            f"stream length {cfg.length} too short: the injection range "
+            f"[{lo_check}, {cfg.length - 50}) has {max(n_candidates, 0)} candidate "
+            f"centers for n_anomalies={n_anom}; lengthen the stream or lower "
+            "inject_after_frac/n_anomalies"
+        )
     cfg = replace(cfg, n_anomalies=0)  # per-metric injections off; node-level below
     parts = [
         generate_stream(f"{node_id}.{m}", replace(cfg, metric=m), seed=seed)

@@ -67,12 +67,29 @@ class FaultEvalReport:
     default_threshold: float
     best_threshold: float
     at_default: dict  # overall metrics at the service default threshold
-    at_best: dict  # overall metrics at the F1-optimal threshold
-    per_kind: dict[str, dict]  # per-kind stats at the best threshold
+    at_best: dict  # overall metrics at the F1-optimal (threshold, debounce)
+    per_kind: dict[str, dict]  # per-kind stats at the best operating point
     throughput: dict
+    default_debounce: int = 1
+    best_debounce: int = 1
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), indent=2, sort_keys=True)
+
+
+def debounce_mask(hits: np.ndarray, d: int) -> np.ndarray:
+    """Apply the service's consecutive-tick debounce (StreamGroup._debounced)
+    to a [T, N] hit mask: a stream alerts at t iff hits held for the last
+    `d` ticks. Equivalent to the service's running counter, vectorized as an
+    AND of d shifted slices (the sweep calls this ~190x per eval; a per-tick
+    Python loop would add millions of interpreter iterations)."""
+    if d <= 1:
+        return hits
+    out = hits.copy()
+    for k in range(1, d):
+        out[k:] &= hits[:-k]
+        out[:k] = False
+    return out
 
 
 def _episodes(alert_ts: np.ndarray, cooldown_s: float) -> list[tuple[int, int]]:
@@ -171,6 +188,7 @@ def run_fault_eval(
     default_threshold: float = 0.5,
     seed: int = 11,
     chunk_ticks: int = 256,
+    default_debounce: int = 2,
 ) -> FaultEvalReport:
     """Generate a kind-labeled cluster, replay it, sweep the detection
     threshold (NAB methodology), and score the alerts.
@@ -209,20 +227,29 @@ def run_fault_eval(
     res = replay_streams(streams, cfg, backend=backend, chunk_ticks=chunk_ticks,
                          threshold=default_threshold)
 
-    # NAB-style threshold sweep on the log-likelihood scores. The grid spans
-    # the full useful log-likelihood range (probation emits ~0.03; 0.97 is
-    # the top of the log scale) — a narrow grid can miss the optimum NAB's
-    # sweeper would find (round-2 verdict weak #4). The service default is
-    # always included so at_best can never be worse than at_default.
+    # NAB-style sweep, jointly over threshold x debounce. The threshold grid
+    # spans the full useful log-likelihood range (probation emits ~0.03;
+    # 0.97 is the top of the log scale) — a narrow grid can miss the optimum
+    # NAB's sweeper would find (round-2 verdict weak #4). Debounce (alert
+    # only after d consecutive hit ticks — the service's StreamGroup
+    # semantics) attacks episode precision: false episodes are dominated by
+    # 1-2-tick likelihood flickers while injected faults persist. The
+    # service operating point is always included so at_best can never be
+    # worse than at_default.
     grid = np.union1d(np.arange(0.05, 0.96, 0.02), [default_threshold])
-    best = (None, -1.0, None, None)  # (thr, f1, per_kind, overall)
-    for thr in grid:
-        pk, ov = match_alerts(streams, res.log_likelihood >= thr, res.timestamps)
-        if ov["f1"] > best[1]:
-            best = (float(thr), ov["f1"], pk, ov)
-    _, _, best_pk, best_overall = best
+    debounces = sorted({1, 2, 3, 4, default_debounce})
+    best = (None, -1.0, None, None, None)  # (thr, f1, per_kind, overall, d)
+    for d in debounces:
+        for thr in grid:
+            al = debounce_mask(res.log_likelihood >= thr, d)
+            pk, ov = match_alerts(streams, al, res.timestamps)
+            if ov["f1"] > best[1]:
+                best = (float(thr), ov["f1"], pk, ov, d)
+    _, _, best_pk, best_overall, best_d = best
     _, default_overall = match_alerts(
-        streams, res.log_likelihood >= default_threshold, res.timestamps
+        streams,
+        debounce_mask(res.log_likelihood >= default_threshold, default_debounce),
+        res.timestamps,
     )
     return FaultEvalReport(
         n_streams=n_streams,
@@ -233,6 +260,8 @@ def run_fault_eval(
         at_best=best_overall,
         per_kind={k: v.summary() for k, v in best_pk.items() if v.events},
         throughput=res.throughput,
+        default_debounce=default_debounce,
+        best_debounce=best_d,
     )
 
 
@@ -248,6 +277,9 @@ def main() -> None:
                     help="include the hard gradual kinds (drift, stuck)")
     ap.add_argument("--backend", default="tpu")
     ap.add_argument("--threshold", type=float, default=0.5)
+    ap.add_argument("--debounce", type=int, default=2,
+                    help="service debounce (consecutive hit ticks) for the "
+                         "at_default operating point")
     ap.add_argument("--perm-bits", type=int, default=None, choices=(0, 8, 16),
                     help="override the cluster preset's permanence domain "
                          "(compression quality comparison, models/perm.py)")
@@ -264,7 +296,7 @@ def main() -> None:
     report = run_fault_eval(
         n_streams=args.streams, length=args.length, kinds=kinds,
         magnitude=args.magnitude, cfg=cfg, backend=args.backend,
-        default_threshold=args.threshold,
+        default_threshold=args.threshold, default_debounce=args.debounce,
     )
     print(report.to_json())
     if args.out:

@@ -57,8 +57,32 @@ def presyn_dtype(cfg: ModelConfig):
     return np.int16 if cfg.num_cells <= (1 << 15) - 1 else np.int32
 
 
-def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
-    """Build the full per-stream state dict (see module docstring for layout)."""
+def fwd_index_arrays(cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """Fresh (all-empty) forward-index arrays for an empty synapse pool
+    (RTAP_TM_DENDRITE=forward — ops/fwd_index.py): fwd_slots [N, F] i32,
+    fwd_pos [pool] i8/i16, fwd_of i32 overflow counter. Derived state —
+    checkpoints drop them and loads rebuild from `presyn`."""
+    tm = cfg.tm
+    F = tm.fanout_cap
+    pool = cfg.sp.columns * tm.cells_per_column * tm.max_segments_per_cell * tm.max_synapses_per_segment
+    return {
+        "fwd_slots": np.full((cfg.num_cells, F), -1, np.int32),
+        "fwd_pos": np.full(pool, -1, np.int8 if F <= 127 else np.int16),
+        "fwd_of": np.int32(0),
+    }
+
+
+def init_state(
+    cfg: ModelConfig, seed: int = 0, include_fwd: bool | None = None
+) -> dict[str, np.ndarray]:
+    """Build the full per-stream state dict (see module docstring for layout).
+
+    `include_fwd` adds the forward-index arrays (None = yes iff the kernel's
+    dendrite mode is "forward", so callers stay mode-agnostic)."""
+    if include_fwd is None:
+        from rtap_tpu.ops.tm_tpu import dendrite_mode
+
+        include_fwd = dendrite_mode() == "forward"
     rng = np.random.Generator(np.random.Philox(key=(seed, 0xC0FFEE)))
     C, n_in = cfg.sp.columns, cfg.input_size
     K, S, M = cfg.tm.cells_per_column, cfg.tm.max_segments_per_cell, cfg.tm.max_synapses_per_segment
@@ -96,6 +120,8 @@ def init_state(cfg: ModelConfig, seed: int = 0) -> dict[str, np.ndarray]:
         "enc_offset": np.zeros(cfg.n_fields, np.float32),
         "enc_bound": np.zeros(cfg.n_fields, bool),
         "enc_resolution": np.full(cfg.n_fields, cfg.rdse.resolution, np.float32),
+        # forward synapse index (derived; present only in forward dendrite mode)
+        **(fwd_index_arrays(cfg) if include_fwd else {}),
         # SDR classifier (SURVEY.md C10), present only when enabled
         **(
             {

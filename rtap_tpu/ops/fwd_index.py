@@ -1,0 +1,207 @@
+"""Forward synapse index: dendrite work scaling with ACTIVE cells, not pool size.
+
+The reference's `Connections.computeActivity` (SURVEY.md C5, §3.2 hot path)
+never scans all synapses — it walks a presynaptic-cell -> synapse adjacency,
+so per-record dendrite cost tracks the active-cell count (tens) instead of
+pool capacity (1e5-3e7 synapses). The round-3 TPU kernel used a full-pool
+scan instead, whose measured HBM floor (~40k metrics/s/chip, SCALING.md)
+is the round-4 target to break (docs/FORWARD_INDEX_DESIGN.md).
+
+This module is the TPU-native translation of that adjacency: a fixed-capacity
+**forward index** carried as two dense tensors alongside the synapse pools,
+
+    fwd_slots  i32 [N, F]     flat pool-slot ids where cell n is presynaptic
+                              (-1 = free); F = cfg.tm.fanout_cap
+    fwd_pos    i8/i16 [pool]  each slot's position within its presynaptic
+                              cell's fwd row (-1 when empty) — the back
+                              pointer that makes removal O(1)
+
+plus an i32 overflow counter (a dropped append would silently corrupt
+dendrite counts, so it is counted like tm_overflow and tests assert zero).
+
+The index is DERIVED state: rebuilt from `presyn` on checkpoint load
+(:func:`build_fwd_index` — checkpoints never store it, so the on-disk schema
+is unchanged), maintained incrementally inside the learning step
+(:func:`apply_removals` / :func:`apply_appends`), and consumed by
+:func:`dendrite_counts` which gathers only the <= col_cap*K active cells'
+rows (~KBs) instead of sweeping the MB-scale pools.
+
+Two bit-identical accumulation strategies for the segment-count histogram
+(RTAP_TM_FWD_IMPL, raced on silicon by scripts/hw_session.py):
+
+- "scatter": jnp ``.at[seg].add`` — native scatter-add.
+- "matmul": the factored one-hot contraction. Segment ids split into
+  (hi, lo) digits; counts[hi, lo] = sum_e A[e, hi] * B[e, lo] with A/B 0/1
+  indicator matrices -> ONE MXU matmul [hi, E] x [E, lo] producing the dense
+  count grid. Counts <= max_synapses_per_segment << 2^24, so f32 accumulation
+  at HIGHEST precision is exact.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HI = jax.lax.Precision.HIGHEST
+
+# lo-digit width of the factored histogram: the TPU lane dimension. The hi
+# digit then spans ceil(n_seg / 128) rows of the count grid.
+_LO = 128
+
+
+def pos_dtype(fanout_cap: int):
+    """Narrowest signed dtype holding positions in [0, F) plus the -1 fill."""
+    return jnp.int8 if fanout_cap <= 127 else jnp.int16
+
+
+def build_fwd_index(presyn: jnp.ndarray, n_cells: int, fanout_cap: int):
+    """Derive (fwd_slots [N, F], fwd_pos [pool], overflow i32) from a presyn
+    pool (any shape; flattened row-major — slot id = flat index).
+
+    Canonical layout: each cell's slots ascend. Jittable and vmappable (used
+    per stream on checkpoint load); the incremental maintenance inside the
+    step does NOT reproduce this canonical order — only count parity is a
+    contract, the row layout is free.
+    """
+    F = fanout_cap
+    pool = int(np.prod(presyn.shape))
+    p = presyn.reshape(-1).astype(jnp.int32)
+    slot = jnp.arange(pool, dtype=jnp.int32)
+    sorted_p, sorted_slot = jax.lax.sort_key_val(p, slot)
+    # rank within each equal-presyn run = position - first occurrence
+    start = jnp.searchsorted(sorted_p, sorted_p, side="left").astype(jnp.int32)
+    rank = jnp.arange(pool, dtype=jnp.int32) - start
+    valid = sorted_p >= 0
+    keep = valid & (rank < F)
+    rows = jnp.where(keep, sorted_p, n_cells)  # n_cells = out of bounds -> dropped
+    fwd_slots = (
+        jnp.full((n_cells, F), -1, jnp.int32)
+        .at[rows, jnp.clip(rank, 0, F - 1)]
+        .set(sorted_slot, mode="drop")
+    )
+    pdt = pos_dtype(F)
+    fwd_pos = (
+        jnp.full(pool, -1, pdt)
+        .at[jnp.where(keep, sorted_slot, pool)]
+        .set(rank.astype(pdt), mode="drop")
+    )
+    overflow = (valid & (rank >= F)).sum().astype(jnp.int32)
+    return fwd_slots, fwd_pos, overflow
+
+
+def dendrite_counts(
+    fwd_slots: jnp.ndarray,  # i32 [N, F]
+    syn_perm_flat: jnp.ndarray,  # [pool] storage dtype
+    act_ids: jnp.ndarray,  # i32 [A] active-cell flat ids, fills = N
+    p_connected,  # domain threshold (f32 or int)
+    n_seg: int,
+    syn_per_seg: int,
+    impl: str,
+):
+    """Per-segment (conn_count, pot_count) i32 [n_seg] from the forward index.
+
+    Reads only the A = len(act_ids) active cells' fwd rows plus an [A, F]
+    permanence gather — vs the full-pool sweep of the scan formulation.
+    Bit-identical to the scan for a consistent index (tests/parity/
+    test_fwd_index.py asserts per-step equality).
+    """
+    N, F = fwd_slots.shape
+    pool = syn_perm_flat.shape[0]
+    M = syn_per_seg
+    rows = fwd_slots[jnp.clip(act_ids, 0, N - 1)]  # [A, F]
+    valid = (act_ids < N)[:, None] & (rows >= 0)
+    rowc = jnp.clip(rows, 0, pool - 1)
+    perms = syn_perm_flat[rowc]  # [A, F]
+    conn = valid & (perms >= p_connected)
+    seg = rowc // M  # junk where ~valid; masked below
+
+    if impl == "scatter":
+        pot = (
+            jnp.zeros(n_seg, jnp.int32)
+            .at[jnp.where(valid, seg, n_seg)]
+            .add(1, mode="drop")
+        )
+        connc = (
+            jnp.zeros(n_seg, jnp.int32)
+            .at[jnp.where(conn, seg, n_seg)]
+            .add(1, mode="drop")
+        )
+        return connc, pot
+
+    # factored one-hot MXU contraction (exact: 0/1 entries, counts <= M < 2^24)
+    lo_n = min(_LO, n_seg)
+    hi_n = -(-n_seg // lo_n)  # ceil
+    seg_f = seg.reshape(-1)
+    valid_f = valid.reshape(-1)
+    conn_f = conn.reshape(-1)
+    hi = seg_f // lo_n
+    lo = seg_f % lo_n
+    a = (
+        (hi[:, None] == jnp.arange(hi_n, dtype=jnp.int32)) & valid_f[:, None]
+    ).astype(jnp.float32)  # [E, hi_n]
+    b = (lo[:, None] == jnp.arange(lo_n, dtype=jnp.int32)).astype(jnp.float32)  # [E, lo_n]
+    pot = jnp.round(jax.lax.dot(a.T, b, precision=_HI)).astype(jnp.int32)
+    ac = a * conn_f[:, None].astype(jnp.float32)
+    connc = jnp.round(jax.lax.dot(ac.T, b, precision=_HI)).astype(jnp.int32)
+    return connc.reshape(-1)[:n_seg], pot.reshape(-1)[:n_seg]
+
+
+def apply_removals(
+    fwd_slots: jnp.ndarray,
+    fwd_pos: jnp.ndarray,
+    slots: jnp.ndarray,  # i32 [E] flat pool-slot ids (may contain fills)
+    old_presyn: jnp.ndarray,  # i32 [E] presyn id being removed from each slot
+    remove: jnp.ndarray,  # bool [E]
+):
+    """Detach `slots` from their presynaptic cells' fwd rows (O(1) each via
+    the fwd_pos back pointer). Slot ids must be distinct where `remove`."""
+    N = fwd_slots.shape[0]
+    pool = fwd_pos.shape[0]
+    slotc = jnp.clip(slots, 0, pool - 1)
+    pos = fwd_pos[slotc].astype(jnp.int32)  # [E]
+    ok = remove & (old_presyn >= 0) & (pos >= 0)
+    rows = jnp.where(ok, old_presyn, N)  # N -> dropped
+    fwd_slots = fwd_slots.at[rows, jnp.clip(pos, 0, fwd_slots.shape[1] - 1)].set(
+        -1, mode="drop"
+    )
+    fwd_pos = fwd_pos.at[jnp.where(ok, slotc, pool)].set(
+        jnp.asarray(-1, fwd_pos.dtype), mode="drop"
+    )
+    return fwd_slots, fwd_pos
+
+
+def apply_appends(
+    fwd_slots: jnp.ndarray,
+    fwd_pos: jnp.ndarray,
+    slots: jnp.ndarray,  # i32 [E] flat pool-slot ids
+    new_presyn: jnp.ndarray,  # i32 [E] presyn id now occupying each slot
+    append: jnp.ndarray,  # bool [E]
+):
+    """Attach `slots` to their (new) presynaptic cells' fwd rows, assigning
+    distinct free positions to multiple same-cell appends in one step.
+    Returns (fwd_slots, fwd_pos, n_dropped) — n_dropped counts appends that
+    found no free position (fanout_cap overflow; corrupts counts, so the
+    caller adds it to the stream's overflow counter)."""
+    N, F = fwd_slots.shape
+    pool = fwd_pos.shape[0]
+    E = slots.shape[0]
+    # rank among earlier same-target appends -> each needs its own free slot
+    same = (
+        (new_presyn[:, None] == new_presyn[None, :]) & append[:, None] & append[None, :]
+    )
+    ee = jnp.arange(E, dtype=jnp.int32)
+    rank = (same & (ee[None, :] < ee[:, None])).sum(-1).astype(jnp.int32)  # [E]
+    rowdata = fwd_slots[jnp.clip(new_presyn, 0, N - 1)]  # [E, F] (post-removal)
+    free = rowdata < 0
+    cum = jnp.cumsum(free, axis=-1)
+    hit = free & (cum == (rank + 1)[:, None])  # the (rank+1)-th free slot
+    pos = jnp.argmax(hit, axis=-1).astype(jnp.int32)
+    ok = append & (new_presyn >= 0) & hit.any(-1)
+    dropped = (append & (new_presyn >= 0) & ~hit.any(-1)).sum().astype(jnp.int32)
+    rows = jnp.where(ok, new_presyn, N)
+    fwd_slots = fwd_slots.at[rows, pos].set(slots, mode="drop")
+    fwd_pos = fwd_pos.at[jnp.where(ok, jnp.clip(slots, 0, pool - 1), pool)].set(
+        pos.astype(fwd_pos.dtype), mode="drop"
+    )
+    return fwd_slots, fwd_pos, dropped

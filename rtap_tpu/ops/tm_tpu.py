@@ -23,10 +23,14 @@ same semantics down by an order of magnitude):
 
 Step outline: dense column categorization (predicted / burst-matching /
 burst-new) -> workspace learning (alloc, reinforce, grow toward previous
-winner cells with weakest-synapse eviction) -> dense punishment of matching
+winner cells with weakest-synapse eviction) -> punishment of matching
 segments in non-active columns -> synapse/segment death -> dendrite activity
 for t+1. Tie-breaks are lowest-index everywhere, matching the oracle exactly;
-parity is bit-for-bit (tests/parity/test_tm_parity.py).
+parity is bit-for-bit (tests/parity/test_tm_parity.py). Punish/death run
+either as dense full-pool sweeps or as the round-4 compact touched-rows pass
+(RTAP_TM_SWEEP), and dendrite activity as a full-pool scan or through the
+forward synapse index (RTAP_TM_DENDRITE; ops/fwd_index.py) — see the switch
+table below; every combination is parity-pinned.
 
 Capacity bounds (col_cap active columns, learn_cap learning segments per
 step) are static-shape requirements of XLA; overflow beyond the bounds is
@@ -61,72 +65,123 @@ def _tpu_paths() -> bool:
     return jax.default_backend() == "tpu"
 
 
-# Strategy switch for the learning workspace gather/scatter. "matmul" (the
-# round-2 default) routes row movement through one-hot MXU matmuls — but
-# each matmul reads/writes a FULL pool-shaped f32 array per tick, and the
-# v5e G-sweep (SCALING.md) shows the step is HBM-bound. "indexed" moves only
-# the <= col_cap touched rows with jnp.take / .at[].set(mode="drop"), cutting
-# full-pool f32 materializations out of the learning path. Both paths are
-# bit-identical (tests/parity/test_tpu_paths.py runs both); the default
-# stays "matmul" until "indexed" is measured faster on silicon — batched
-# (vmapped) gather/scatter lowering quality on TPU is exactly what the
-# experiment must answer. None = read RTAP_TM_SCATTER env (default matmul).
-SCATTER_MODE: str | None = None
+# ---------------------------------------------------------------------------
+# Kernel strategy switches. Each is a trace-time constant (NOT a jit cache
+# key): the env var is read ONCE at import — mutating os.environ mid-process
+# has no effect (set_*_mode() is the only supported runtime override, and it
+# clears the jit caches so stale compiled kernels can never mix modes).
+# All alternatives are bit-identical (tests/parity/); scripts/hw_session.py
+# races them on silicon and the measured winners become defaults.
+#
+#   RTAP_TM_SCATTER  matmul|indexed   workspace row movement: one-hot MXU
+#                                     matmuls (full-pool f32 round trips) vs
+#                                     jnp.take/.at[].set of touched rows only
+#   RTAP_TM_LAYOUT   aos|flat         pools [C,K,S,M] (TPU tiling pads the
+#                                     tiny trailing dims up to ~20x) vs
+#                                     [C, K*S*M] with block-diagonal-matmul
+#                                     per-segment reductions
+#   RTAP_TM_SWEEP    dense|compact    punish/death as full-pool sweeps vs
+#                                     gather/update/scatter of the <=
+#                                     punish_cap + learn_cap touched segment
+#                                     rows (ops/tm_tpu.py round 4)
+#   RTAP_TM_DENDRITE scan|forward     dendrite activity as a full-pool scan
+#                                     vs the forward synapse index
+#                                     (ops/fwd_index.py; state carries
+#                                     fwd_slots/fwd_pos/fwd_of)
+#   RTAP_TM_FWD_IMPL scatter|matmul   forward-index histogram accumulation:
+#                                     native scatter-add vs factored one-hot
+#                                     MXU contraction
+# ---------------------------------------------------------------------------
+import os as _os
+
+_MODE_CHOICES = {
+    "scatter": ("matmul", "indexed"),
+    "layout": ("aos", "flat"),
+    "sweep": ("dense", "compact"),
+    "dendrite": ("scan", "forward"),
+    "fwd_impl": ("scatter", "matmul"),
+}
+_ENV_NAMES = {
+    "scatter": "RTAP_TM_SCATTER",
+    "layout": "RTAP_TM_LAYOUT",
+    "sweep": "RTAP_TM_SWEEP",
+    "dendrite": "RTAP_TM_DENDRITE",
+    "fwd_impl": "RTAP_TM_FWD_IMPL",
+}
+# start-of-process env snapshot (read once; see block comment above)
+_MODES: dict[str, str] = {
+    k: _os.environ.get(env, _MODE_CHOICES[k][0]) for k, env in _ENV_NAMES.items()
+}
+for _k, _v in _MODES.items():
+    if _v not in _MODE_CHOICES[_k]:
+        raise ValueError(
+            f"{_ENV_NAMES[_k]} must be one of {_MODE_CHOICES[_k]}, got {_v!r}"
+        )
+# runtime overrides (set_*_mode); None = keep the env snapshot value
+_OVERRIDES: dict[str, str | None] = {k: None for k in _MODES}
+
+
+def _mode(kind: str) -> str:
+    ov = _OVERRIDES[kind]
+    return _MODES[kind] if ov is None else ov
+
+
+def _set_mode(kind: str, mode: str | None) -> None:
+    if mode is not None and mode not in _MODE_CHOICES[kind]:
+        raise ValueError(
+            f"{kind} mode must be None or one of {_MODE_CHOICES[kind]}, got {mode!r}"
+        )
+    _OVERRIDES[kind] = mode
+    jax.clear_caches()
 
 
 def scatter_mode() -> str:
-    import os
-
-    mode = SCATTER_MODE
-    if mode is None:
-        mode = os.environ.get("RTAP_TM_SCATTER", "matmul")
-    if mode not in ("matmul", "indexed"):
-        raise ValueError(f"RTAP_TM_SCATTER must be 'matmul' or 'indexed', got {mode!r}")
-    return mode
-
-
-def set_scatter_mode(mode: str | None) -> None:
-    """Set the workspace-movement strategy AND clear jit caches (the mode is
-    a trace-time constant, not a jit cache key)."""
-    if mode not in (None, "matmul", "indexed"):
-        raise ValueError(f"scatter mode must be None, 'matmul' or 'indexed', got {mode!r}")
-    global SCATTER_MODE
-    SCATTER_MODE = mode
-    jax.clear_caches()
-
-
-# Strategy switch for the kernel-side tensor layout. "aos" (default) carries
-# the pools as [C, K, S, M] and segment tensors as [C, K, S] — trailing dims
-# (S=4, M=12 at the cluster preset) that TPU tiling pads to (8, 128),
-# inflating every pool-shaped HBM round-trip up to ~20x unless XLA's layout
-# passes collapse them. "flat" carries pools as [C, K*S*M] and segment
-# tensors as [C, K*S] through the whole scan (adapters at the chunk/step
-# boundary — ops/step.py), with per-segment reductions as block-diagonal MXU
-# matmuls (the ops/pallas_tm.py trick) instead of sum-over-minor-dim.
-# Bit-identical (tests/parity/test_tpu_paths.py); A/B on silicon via
-# scripts/hw_session.py decides the default. None = read RTAP_TM_LAYOUT.
-LAYOUT_MODE: str | None = None
+    return _mode("scatter")
 
 
 def layout_mode() -> str:
-    import os
+    return _mode("layout")
 
-    mode = LAYOUT_MODE
-    if mode is None:
-        mode = os.environ.get("RTAP_TM_LAYOUT", "aos")
-    if mode not in ("aos", "flat"):
-        raise ValueError(f"RTAP_TM_LAYOUT must be 'aos' or 'flat', got {mode!r}")
-    return mode
+
+def sweep_mode() -> str:
+    return _mode("sweep")
+
+
+def dendrite_mode() -> str:
+    return _mode("dendrite")
+
+
+def fwd_impl() -> str:
+    return _mode("fwd_impl")
+
+
+def set_scatter_mode(mode: str | None) -> None:
+    """Override the workspace-movement strategy AND clear jit caches."""
+    _set_mode("scatter", mode)
 
 
 def set_layout_mode(mode: str | None) -> None:
-    """Set the kernel tensor layout AND clear jit caches (trace-time
-    constant, not a jit cache key)."""
-    if mode not in (None, "aos", "flat"):
-        raise ValueError(f"layout mode must be None, 'aos' or 'flat', got {mode!r}")
-    global LAYOUT_MODE
-    LAYOUT_MODE = mode
-    jax.clear_caches()
+    """Override the kernel tensor layout AND clear jit caches."""
+    _set_mode("layout", mode)
+
+
+def set_sweep_mode(mode: str | None) -> None:
+    """Override the punish/death sweep strategy AND clear jit caches."""
+    _set_mode("sweep", mode)
+
+
+def set_dendrite_mode(mode: str | None) -> None:
+    """Override the dendrite-activity strategy AND clear jit caches.
+
+    "forward" requires state built with the forward index present
+    (models/state.init_state reads this mode; checkpoint load rebuilds the
+    index from `presyn` — service/checkpoint.py)."""
+    _set_mode("dendrite", mode)
+
+
+def set_fwd_impl(mode: str | None) -> None:
+    """Override the forward-index histogram strategy AND clear jit caches."""
+    _set_mode("fwd_impl", mode)
 
 
 # TM state keys reshaped by the flat kernel layout: key -> how many trailing
@@ -461,6 +516,23 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         | winner_extra
     )
 
+    # Strategy resolution for this trace. The forward index cannot survive a
+    # dense death sweep (presyn mutates without index updates), so forward
+    # dendrite mode forces the compact sweep under learning.
+    forward = dendrite_mode() == "forward"
+    compact_sweep = forward or sweep_mode() == "compact"
+    if forward and "fwd_slots" not in state:
+        raise ValueError(
+            "RTAP_TM_DENDRITE=forward: state lacks the forward index "
+            "(fwd_slots/fwd_pos/fwd_of) — build it via models/state.init_state "
+            "under forward mode, or rebuild from presyn with "
+            "ops.fwd_index.build_fwd_index (checkpoint loads do this)"
+        )
+    fwd_slots = state.get("fwd_slots")
+    fwd_pos = state.get("fwd_pos")
+    fwd_of = state.get("fwd_of")
+    n_seg = C * K * S
+
     overflow_learn = jnp.bool_(False)
     if learn:
         alloc_col, bn_k, bn_s = alloc
@@ -498,6 +570,10 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
                 (col_oh_b[:, :, None] & learn_mask.reshape(C, -1)[None]).any(1).reshape(Ac, K, S)
             )
 
+        # original pool content of the workspace (pre alloc-clear): the
+        # forward-index maintenance diffs learned rows against it
+        ws_presyn0_r = ws_presyn.reshape(Ac * K * S, M) if forward else None
+
         # --- burst-new allocation inside the workspace: clear slot + stamp ---
         ws_bn = (col_oh_b & burst_new[None, :]).any(-1)  # [Ac]
         ws_bnk = jnp.where(col_oh_b, bn_k[None, :], 0).sum(-1)  # [Ac]
@@ -521,11 +597,14 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         valid_l = idx < R2
         ws_presyn_r = ws_presyn.reshape(R2, M)
         ws_perm_r = ws_perm.reshape(R2, M)
+        presyn_l0 = None
         if indexed:
             idx_r = jnp.clip(idx, 0, R2 - 1)
             presyn_l = ws_presyn_r[idx_r]  # [L, M]; fill rows junk, see below
             perm_l = ws_perm_r[idx_r]
             pot_l = jnp.where(valid_l, ws_pot.reshape(-1)[idx_r], 0)  # [L]
+            if forward:
+                presyn_l0 = ws_presyn0_r[idx_r]
         else:
             row_oh_b = idx[:, None] == jnp.arange(R2, dtype=jnp.int32)  # [L, R2]
             row_oh = row_oh_b.astype(jnp.float32)
@@ -534,6 +613,10 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
             ).astype(jnp.int32)  # [L, M]
             perm_l = _gather_rows_f32(ws_perm_r, row_oh)  # [L, M]
             pot_l = jnp.where(row_oh_b, ws_pot.reshape(-1)[None, :], 0).sum(-1)  # [L]
+            if forward:
+                presyn_l0 = jnp.round(
+                    _gather_rows_f32(ws_presyn0_r.astype(jnp.float32), row_oh)
+                ).astype(jnp.int32)
 
         # prev-step active cells, column-compact (shared by reinforce + punish)
         pcol_ids, pcol_masks, p_cols = _pack_active(state["prev_active"], Ac)
@@ -557,6 +640,18 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         presyn_l = jnp.where(grow_ok[:, None], grown_presyn, presyn_l)
         perm_l = jnp.where(grow_ok[:, None], grown_perm, perm_l)
 
+        last_l = jnp.full((L,), 1, jnp.int32) * it  # [L] seg_last of learned rows
+        if compact_sweep:
+            # Synapse death (perm <= 0 after reinforce) and empty-segment
+            # death applied IN the workspace: learned rows are the only
+            # active-column rows whose perms moved this step, so handling
+            # them here (and punished rows below) makes the dense full-pool
+            # death sweep redundant — that equivalence is the compact-sweep
+            # contract (tests/parity/test_sweep_parity.py).
+            dead_l = (presyn_l >= 0) & (perm_l <= jnp.float32(dom.zero))
+            presyn_l = jnp.where(dead_l, -1, presyn_l)
+            last_l = jnp.where((presyn_l >= 0).sum(-1) == 0, -1, last_l)
+
         # --- scatter learned rows back into the workspace ---
         if indexed:
             hit_rows = jnp.zeros(R2, bool).at[idx].set(True, mode="drop")
@@ -570,7 +665,15 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
             scat_perm = jax.lax.dot(row_oh.T, perm_l, precision=_HI)
             ws_presyn_r = jnp.where(hit_rows[:, None], scat_presyn, ws_presyn_r)
             ws_perm_r = jnp.where(hit_rows[:, None], scat_perm, ws_perm_r)
-        ws_last = jnp.where(hit_rows.reshape(Ac, K, S), it, ws_last)
+        if indexed:
+            ws_last = (
+                ws_last.reshape(R2).at[idx].set(last_l, mode="drop").reshape(Ac, K, S)
+            )
+        else:
+            last_scat = jnp.where(row_oh_b, last_l[:, None], 0).sum(0)  # [R2]
+            ws_last = jnp.where(
+                hit_rows.reshape(Ac, K, S), last_scat.reshape(Ac, K, S), ws_last
+            )
 
         # --- scatter the workspace back to the pools ---
         if indexed:
@@ -617,47 +720,150 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
             (n_active > Ac) | (p_cols > Ac) | (ws_learn.sum() > L)
         )
 
-        # --- punish matching segments in columns that did not activate ---
-        if cfg.predicted_segment_decrement > 0.0:
-            pdec = dom.rate(cfg.predicted_segment_decrement)
-            acols_seg = active_cols.reshape(C, *([1] * (len(seg_shape) - 1)))
-            pmask = state["matching_seg"] & ~acols_seg  # [*seg_shape]
-            pact = _presyn_active_packed(presyn, pcol_ids, pcol_masks, K)
-            sp_c = syn_perm.astype(dom.compute_dtype)
-            syn_perm = jnp.where(
-                seg_expand(pmask) & pact,
-                jnp.maximum(sp_c - pdec, dom.zero),
-                sp_c,
-            ).astype(p_dt)
+        slots_p = old_p = rem_p = None
+        if compact_sweep:
+            # --- compact punish/death (RTAP_TM_SWEEP=compact): gather the
+            # <= punish_cap matching segments in non-active columns, punish
+            # + kill them there, scatter back. Together with the in-workspace
+            # death above this covers every synapse whose permanence moved
+            # this step (learned rows and punished rows are disjoint by
+            # column), so the full-pool punish/death sweeps are skipped
+            # entirely — the dense sweeps re-derive death for ALL synapses,
+            # but an untouched synapse can never newly satisfy perm <= 0
+            # (death ran last learn step; inference leaves perms alone). ---
+            if cfg.predicted_segment_decrement > 0.0:
+                pdec = dom.rate(cfg.predicted_segment_decrement)
+                P = min(cfg.punish_cap, n_seg)
+                pmask_seg = (matching_seg4 & ~active_cols[:, None, None]).reshape(-1)
+                pids = _compact_ids(pmask_seg, P)  # [P], fills = n_seg
+                valid_p = pids < n_seg
+                pidc = jnp.clip(pids, 0, n_seg - 1)
+                pres_p = presyn.reshape(n_seg, M)[pidc].astype(jnp.int32)  # [P, M]
+                perm_p = syn_perm.reshape(n_seg, M)[pidc]
+                pact_p = _presyn_active_packed(pres_p, pcol_ids, pcol_masks, K)
+                sp_c = perm_p.astype(dom.compute_dtype)
+                perm_pn = jnp.where(pact_p, jnp.maximum(sp_c - pdec, dom.zero), sp_c)
+                dead_p = (pres_p >= 0) & (perm_pn <= dom.zero)
+                pres_pn = jnp.where(dead_p, -1, pres_p)
+                sl_p = seg_last.reshape(-1)[pidc]
+                sl_pn = jnp.where((sl_p >= 0) & ((pres_pn >= 0).sum(-1) == 0), -1, sl_p)
+                drop_ids = jnp.where(valid_p, pids, n_seg)  # fills -> dropped
+                syn_perm = (
+                    syn_perm.reshape(n_seg, M)
+                    .at[drop_ids]
+                    .set(perm_pn.astype(p_dt), mode="drop")
+                    .reshape(*pool_shape)
+                )
+                presyn = (
+                    presyn.reshape(n_seg, M)
+                    .at[drop_ids]
+                    .set(pres_pn.astype(presyn_dt), mode="drop")
+                    .reshape(*pool_shape)
+                )
+                seg_last = (
+                    seg_last.reshape(-1)
+                    .at[drop_ids]
+                    .set(sl_pn, mode="drop")
+                    .reshape(*seg_shape)
+                )
+                overflow_learn = overflow_learn | (pmask_seg.sum() > P)
+                if forward:
+                    slots_p = pidc[:, None] * M + jnp.arange(M, dtype=jnp.int32)
+                    old_p = pres_p
+                    rem_p = valid_p[:, None] & dead_p
+        else:
+            # --- dense punish: matching segments in columns that did not
+            # activate, over the full pool ---
+            if cfg.predicted_segment_decrement > 0.0:
+                pdec = dom.rate(cfg.predicted_segment_decrement)
+                acols_seg = active_cols.reshape(C, *([1] * (len(seg_shape) - 1)))
+                pmask = state["matching_seg"] & ~acols_seg  # [*seg_shape]
+                pact = _presyn_active_packed(presyn, pcol_ids, pcol_masks, K)
+                sp_c = syn_perm.astype(dom.compute_dtype)
+                syn_perm = jnp.where(
+                    seg_expand(pmask) & pact,
+                    jnp.maximum(sp_c - pdec, dom.zero),
+                    sp_c,
+                ).astype(p_dt)
 
-        # --- synapse death at permanence <= 0, then empty-segment death ---
-        dead = (presyn >= 0) & (syn_perm <= dom.zero)
-        presyn = jnp.where(dead, -1, presyn)
-        nsyn = seg_sum(presyn >= 0)
-        seg_last = jnp.where((seg_last >= 0) & (nsyn == 0), -1, seg_last)
+            # --- synapse death at permanence <= 0, then empty-segment death ---
+            dead = (presyn >= 0) & (syn_perm <= dom.zero)
+            presyn = jnp.where(dead, -1, presyn)
+            nsyn = seg_sum(presyn >= 0)
+            seg_last = jnp.where((seg_last >= 0) & (nsyn == 0), -1, seg_last)
+
+        if forward:
+            # --- forward-index maintenance: diff the touched rows against
+            # their original pool content and apply removals, then appends
+            # (ops/fwd_index.py). Touched rows = the L learned workspace rows
+            # (evictions, alloc-clears, growth, reinforce-death) + the P
+            # punished rows (death only). ---
+            from rtap_tpu.ops.fwd_index import apply_appends, apply_removals
+
+            a_i = idx // (K * S)
+            gcol = jnp.where(valid_l, col_ids[jnp.clip(a_i, 0, Ac - 1)], C)
+            vs_l = valid_l & (gcol < C)  # [L]
+            seg_flat_l = jnp.where(vs_l, gcol * (K * S) + (idx % (K * S)), n_seg)
+            slots_l = seg_flat_l[:, None] * M + jnp.arange(M, dtype=jnp.int32)  # [L, M]
+            changed = presyn_l0 != presyn_l
+            rem_l = vs_l[:, None] & changed & (presyn_l0 >= 0)
+            add_l = vs_l[:, None] & changed & (presyn_l >= 0)
+            if slots_p is not None:
+                slots_all = jnp.concatenate([slots_l.reshape(-1), slots_p.reshape(-1)])
+                old_all = jnp.concatenate([presyn_l0.reshape(-1), old_p.reshape(-1)])
+                rem_all = jnp.concatenate([rem_l.reshape(-1), rem_p.reshape(-1)])
+            else:
+                slots_all = slots_l.reshape(-1)
+                old_all = presyn_l0.reshape(-1)
+                rem_all = rem_l.reshape(-1)
+            fwd_slots, fwd_pos = apply_removals(
+                fwd_slots, fwd_pos, slots_all, old_all, rem_all
+            )
+            fwd_slots, fwd_pos, ndrop = apply_appends(
+                fwd_slots, fwd_pos, slots_l.reshape(-1),
+                presyn_l.reshape(-1), add_l.reshape(-1),
+            )
+            fwd_of = fwd_of + ndrop
 
     # --- dendrite activity for t+1 over existing segments ---
     exists_seg = seg_last >= 0
-    acol_ids, acol_masks, a_cols = _pack_active(active_cells, Ac)
-    # the packed-column truncation applies under inference too — count it always
-    tm_overflow = state["tm_overflow"] + (
-        overflow_learn | (a_cols > Ac)
-    ).astype(jnp.int32)
-    from rtap_tpu.ops.pallas_tm import dendrite_activity_pallas, use_pallas
+    if forward:
+        # forward index: gather only the <= Ac*K active cells' fanout rows
+        # (ops/fwd_index.py) instead of sweeping the pools
+        from rtap_tpu.ops.fwd_index import dendrite_counts
 
-    if use_pallas():
-        # fused VMEM kernel, bit-identical semantics (ops/pallas_tm.py);
-        # opt-in until profiled on silicon
-        conn_count, pot_count = dendrite_activity_pallas(
-            presyn.reshape(C, K, S, M), syn_perm.reshape(C, K, S, M),
-            acol_ids, acol_masks, p_connected,
+        a_cols = active_cells.any(-1).sum()
+        tm_overflow = state["tm_overflow"] + (
+            overflow_learn | (a_cols > Ac)
+        ).astype(jnp.int32)
+        act_ids = _winner_id_list(active_cells, Ac)  # [Ac*K], fills = N
+        conn_c, pot_c = dendrite_counts(
+            fwd_slots, syn_perm.reshape(-1), act_ids, p_connected,
+            n_seg, M, fwd_impl(),
         )
-        conn_count = conn_count.reshape(*seg_shape)
-        pot_count = pot_count.reshape(*seg_shape)
+        conn_count = conn_c.reshape(*seg_shape)
+        pot_count = pot_c.reshape(*seg_shape)
     else:
-        syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
-        conn_count = seg_sum(syn_act & (syn_perm >= p_connected))
-        pot_count = seg_sum(syn_act)
+        acol_ids, acol_masks, a_cols = _pack_active(active_cells, Ac)
+        # the packed-column truncation applies under inference too — count it always
+        tm_overflow = state["tm_overflow"] + (
+            overflow_learn | (a_cols > Ac)
+        ).astype(jnp.int32)
+        from rtap_tpu.ops.pallas_tm import dendrite_activity_pallas, use_pallas
+
+        if use_pallas():
+            # fused VMEM kernel, bit-identical semantics (ops/pallas_tm.py);
+            # opt-in until profiled on silicon
+            conn_count, pot_count = dendrite_activity_pallas(
+                presyn.reshape(C, K, S, M), syn_perm.reshape(C, K, S, M),
+                acol_ids, acol_masks, p_connected,
+            )
+            conn_count = conn_count.reshape(*seg_shape)
+            pot_count = pot_count.reshape(*seg_shape)
+        else:
+            syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
+            conn_count = seg_sum(syn_act & (syn_perm >= p_connected))
+            pot_count = seg_sum(syn_act)
     active_seg = exists_seg & (conn_count >= cfg.activation_threshold)
     matching_seg = exists_seg & (pot_count >= cfg.min_threshold)
     seg_pot = jnp.where(exists_seg, pot_count, 0).astype(jnp.int16)
@@ -678,4 +884,8 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         "tm_iter": it.astype(jnp.int32),  # oracle increments under inference too
         "tm_overflow": tm_overflow,
     }
+    if forward:
+        new_state["fwd_slots"] = fwd_slots
+        new_state["fwd_pos"] = fwd_pos
+        new_state["fwd_of"] = fwd_of
     return new_state, raw

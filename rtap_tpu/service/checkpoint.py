@@ -34,20 +34,36 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
     import orbax.checkpoint as ocp
 
     path = Path(path).absolute()
+    # the forward synapse index (fwd_*) is derived state: never stored —
+    # load_group rebuilds it from `presyn` — so the on-disk schema is
+    # identical across dendrite modes (ops/fwd_index.py)
     if grp.backend == "tpu":
-        model_state = {k: np.asarray(v) for k, v in jax.device_get(grp.state).items()}
+        model_state = {
+            k: np.asarray(v)
+            for k, v in jax.device_get(grp.state).items()
+            if not k.startswith("fwd_")
+        }
         tree = {"model": model_state}
     else:
         # per-stream state dicts include classifier cls_* arrays when enabled
         # (the oracle operates on the shared state layout, like TMOracle)
-        tree = {"model": {f"s{g}": grp._states[g] for g in range(grp.G)}}
+        tree = {
+            "model": {
+                f"s{g}": {
+                    k: v for k, v in grp._states[g].items() if not k.startswith("fwd_")
+                }
+                for g in range(grp.G)
+            }
+        }
     tree["likelihood"] = grp.likelihood.state_dict()
+    tree["alert_run"] = np.asarray(grp._alert_run)  # debounce counters
 
     meta = {
         "backend": grp.backend,
         "stream_ids": grp.stream_ids,
         "ticks": grp.ticks,
         "threshold": grp.threshold,
+        "debounce": grp.debounce,
         "n_live": getattr(grp, "n_live", grp.G),
         "sharded": grp.mesh is not None,
         "config": grp.cfg.to_dict(),
@@ -134,23 +150,48 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
         )
     grp = StreamGroup(
         cfg, meta["stream_ids"], backend=meta["backend"], threshold=meta["threshold"],
-        mesh=mesh,
+        mesh=mesh, debounce=int(meta.get("debounce", 1)),
     )
     with ocp.PyTreeCheckpointer() as ckptr:
         tree = ckptr.restore(path / "state")
     if grp.backend == "tpu":
+        from rtap_tpu.ops.tm_tpu import dendrite_mode
+
+        model = {k: v for k, v in tree["model"].items() if not k.startswith("fwd_")}
+        if dendrite_mode() == "forward":
+            # rebuild the derived forward index from the restored pools
+            # (per stream; any fanout_cap overflow lands in fwd_of and the
+            # service's overflow observability picks it up)
+            from functools import partial
+
+            from rtap_tpu.ops.fwd_index import build_fwd_index
+
+            slots, pos, of = jax.vmap(
+                partial(
+                    build_fwd_index,
+                    n_cells=cfg.num_cells,
+                    fanout_cap=cfg.tm.fanout_cap,
+                )
+            )(np.asarray(model["presyn"]))
+            model["fwd_slots"] = np.asarray(slots)
+            model["fwd_pos"] = np.asarray(pos)
+            model["fwd_of"] = np.asarray(of)
         if mesh is not None:
             from rtap_tpu.parallel.sharding import shard_state
 
-            grp.state = shard_state(tree["model"], mesh)
+            grp.state = shard_state(model, mesh)
         else:
-            grp.state = jax.device_put(tree["model"])
+            grp.state = jax.device_put(model)
     else:
         for g in range(grp.G):
             saved = tree["model"][f"s{g}"]
             for k in grp._states[g]:
+                if k.startswith("fwd_"):
+                    continue  # derived, oracle-unused; fresh arrays stay
                 grp._states[g][k] = np.asarray(saved[k])
     grp.likelihood.load_state_dict(tree["likelihood"])
+    if "alert_run" in tree:  # pre-debounce checkpoints lack it (zeros then)
+        grp._alert_run = np.asarray(tree["alert_run"]).astype(np.int64)
     grp.ticks = int(meta["ticks"])
     grp.n_live = int(meta["n_live"])
     return grp

@@ -41,6 +41,9 @@ def replay_streams(
     threshold: float = 0.5,
     alert_path: str | None = None,
     learn: bool = True,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
+    debounce: int = 1,
 ) -> ReplayResult:
     """Replay equal-length streams through grouped models at full speed.
 
@@ -48,6 +51,18 @@ def replay_streams(
     used for the result). Groups are sized `group_size` (default: all streams
     in one group) and each chunk of `chunk_ticks` ticks costs one device
     dispatch per group.
+
+    Crash recovery (SURVEY.md §5 checkpoint/resume as *elastic recovery*):
+    with `checkpoint_dir` + `checkpoint_every=k`, each group's full resume
+    state (model + likelihood ring + tick count) is saved atomically every k
+    collected chunks — the depth-2 pipeline is DRAINED first, because a
+    donated in-flight chunk means the device state is already ahead of the
+    last collected tick. On a later call with the same `checkpoint_dir`, any
+    group with a checkpoint resumes from its recorded tick instead of from
+    scratch; ticks before the resume point are left NaN in the result (they
+    were scored by the earlier, killed run) and `throughput["resumed_from"]`
+    records the boundary. tests/integration/test_crash_resume.py kills a
+    replay mid-stream and proves score-identical continuation.
     """
     n = len(streams)
     T = len(streams[0].values)
@@ -57,7 +72,8 @@ def replay_streams(
     group_size = group_size or n
     ids = [s.stream_id for s in streams]
 
-    reg = StreamGroupRegistry(cfg, group_size=group_size, backend=backend, threshold=threshold)
+    reg = StreamGroupRegistry(cfg, group_size=group_size, backend=backend,
+                              threshold=threshold, debounce=debounce)
     for sid in ids:
         reg.add_stream(sid)
     reg.finalize()
@@ -65,16 +81,62 @@ def replay_streams(
     values = np.stack([s.values for s in streams], axis=1)  # [T, N]
     ts = np.stack([s.timestamps for s in streams], axis=1).astype(np.int64)  # [T, N]
 
-    raw = np.empty((T, n), np.float32)
-    loglik = np.empty((T, n), np.float64)
+    raw = np.full((T, n), np.nan, np.float32)
+    loglik = np.full((T, n), np.nan, np.float64)
     alerts = np.zeros((T, n), bool)
-    preds = np.empty((T, n), np.float32) if cfg.classifier.enabled else None
+    # NaN-fill like raw/loglik: on a resumed run the pre-resume rows were
+    # scored by the earlier (killed) process and must read as absent here
+    preds = np.full((T, n), np.nan, np.float32) if cfg.classifier.enabled else None
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
+    resumed_from: dict[str, int] = {}
 
     # streams were added in order, so group i owns the contiguous slice
     # ids[i*group_size : i*group_size + n_live], at slots 0..n_live-1
     for gi, grp in enumerate(reg.groups):
+        ck_path = None
+        if checkpoint_dir is not None:
+            import os
+
+            ck_path = os.path.join(checkpoint_dir, f"group{gi:04d}")
+            if os.path.isdir(ck_path):
+                from rtap_tpu.service.checkpoint import load_group
+
+                resumed = load_group(ck_path)
+                if resumed.stream_ids != grp.stream_ids:
+                    raise ValueError(
+                        f"checkpoint {ck_path} holds streams "
+                        f"{resumed.stream_ids[:3]}... but group {gi} expects "
+                        f"{grp.stream_ids[:3]}...; refusing to resume"
+                    )
+                # a resumed group silently carries its checkpoint's alerting
+                # semantics and model config — mixing those with different
+                # current-call parameters would blend two semantics in one
+                # result, so mismatches are errors, not surprises
+                mismatches = [
+                    f"{name}: checkpoint={a!r} vs requested={b!r}"
+                    for name, a, b in (
+                        ("config", resumed.cfg, cfg),
+                        ("threshold", resumed.threshold, threshold),
+                        ("debounce", resumed.debounce, debounce),
+                    )
+                    if a != b
+                ]
+                if mismatches:
+                    raise ValueError(
+                        f"checkpoint {ck_path} disagrees with this call's "
+                        f"parameters ({'; '.join(mismatches)}); rerun with "
+                        "the checkpointed settings or use a fresh "
+                        "checkpoint dir"
+                    )
+                if resumed.ticks % chunk_ticks and resumed.ticks < T:
+                    raise ValueError(
+                        f"checkpoint {ck_path} at tick {resumed.ticks} is not "
+                        f"on the chunk grid ({chunk_ticks}); replay it with "
+                        "the chunk size it was saved under"
+                    )
+                grp = reg.groups[gi] = resumed
+                resumed_from[f"group{gi}"] = grp.ticks
         lo = gi * group_size
         live = grp.n_live
         sids = ids[lo : lo + live]
@@ -100,15 +162,42 @@ def replay_streams(
         # depth-2 pipeline: the device computes chunk t+1 while the host
         # post-processes chunk t (SURVEY.md §7 hard part 3 — overlapped feed)
         pending: deque = deque()
-        for t0 in range(0, T, chunk_ticks):
+        chunks_done = 0
+        for t0 in range(grp.ticks, T, chunk_ticks):
             t1 = min(t0 + chunk_ticks, T)
             pending.append(((t0, t1), grp.dispatch_chunk(gv[t0:t1], gt[t0:t1], learn=learn)))
             if len(pending) >= 2:
                 collect(*pending.popleft())
+                chunks_done += 1
+            if ck_path is not None and checkpoint_every and chunks_done and \
+                    chunks_done % checkpoint_every == 0 and pending:
+                # drain before saving: grp.state must correspond exactly to
+                # the last COLLECTED tick or resume would double-step
+                while pending:
+                    collect(*pending.popleft())
+                    chunks_done += 1
+                from rtap_tpu.service.checkpoint import save_group
+
+                save_group(grp, ck_path)
         while pending:
             collect(*pending.popleft())
+            chunks_done += 1
+        if ck_path is not None and checkpoint_every and grp.ticks >= T:
+            from rtap_tpu.service.checkpoint import save_group
+
+            save_group(grp, ck_path)  # final state, resumable past the end
     writer.close()
 
+    stats = {**counter.stats(), "alerts": writer.count, **_occupancy()}
+    overflow = _overflow_total(reg.groups)
+    if overflow is not None:
+        # kernel capacity-overflow observability (learn_cap/col_cap/
+        # punish_cap/fanout_cap): nonzero means some stream exceeded a
+        # static bound and its scores deviate from the oracle — surface it
+        # in the replay stats instead of leaving it buried in device state
+        stats["tm_overflow_total"] = overflow
+    if resumed_from:
+        stats["resumed_from"] = resumed_from
     return ReplayResult(
         stream_ids=ids,
         timestamps=streams[0].timestamps,
@@ -116,7 +205,7 @@ def replay_streams(
         log_likelihood=loglik,
         alerts=alerts,
         predictions=preds,
-        throughput={**counter.stats(), "alerts": writer.count, **_occupancy()},
+        throughput=stats,
     )
 
 
@@ -160,6 +249,23 @@ def live_loop(
         lat["latency_max_ms"] = round(float(latencies.max()) * 1e3, 3)
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": n_ticks, "cadence_s": cadence_s, **lat, **_occupancy()}
+
+
+def _overflow_total(groups) -> int | None:
+    """Sum the per-stream kernel overflow counters (tm_overflow + fwd_of)
+    across device groups; None for CPU-oracle groups (the oracle has no
+    capacity bounds to overflow)."""
+    total = 0
+    saw_device = False
+    for grp in groups:
+        if grp.backend != "tpu":
+            continue
+        saw_device = True
+        st = grp.state
+        total += int(np.asarray(st["tm_overflow"]).sum())
+        if "fwd_of" in st:
+            total += int(np.asarray(st["fwd_of"]).sum())
+    return total if saw_device else None
 
 
 def _occupancy() -> dict:

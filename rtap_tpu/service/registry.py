@@ -44,12 +44,22 @@ class StreamGroup:
         backend: str = "tpu",
         threshold: float = 0.5,
         mesh=None,
+        debounce: int = 1,
     ):
+        if debounce < 1:
+            raise ValueError(f"debounce must be >= 1, got {debounce}")
         self.cfg = cfg
         self.stream_ids = list(stream_ids)
         self.G = len(self.stream_ids)
         self.backend = backend
         self.threshold = threshold
+        # alert debouncing (SURVEY.md C20; round-4 quality study): a stream
+        # alerts only after `debounce` CONSECUTIVE ticks at/above threshold.
+        # False episodes are dominated by 1-2-tick likelihood flickers while
+        # real faults persist (reports/quality_study.json), so debounce
+        # trades a few ticks of latency for episode precision.
+        self.debounce = int(debounce)
+        self._alert_run = np.zeros(self.G, np.int64)  # consecutive hit count
         self.mesh = mesh
         self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
         self.ticks = 0
@@ -147,7 +157,13 @@ class StreamGroup:
         self.last_predictions = None if pred is None else pred[None, :]
         self.ticks += 1
         lik, loglik = self.likelihood.update(raw)
-        return TickResult(raw, lik, loglik, loglik >= self.threshold, pred)
+        return TickResult(raw, lik, loglik, self._debounced(loglik), pred)
+
+    def _debounced(self, loglik: np.ndarray) -> np.ndarray:
+        """Advance the consecutive-hit counters one tick -> alert mask [G]."""
+        hits = loglik >= self.threshold
+        self._alert_run = np.where(hits, self._alert_run + 1, 0)
+        return self._alert_run >= self.debounce
 
     def _unpack_out(self, out, time_axis: bool):
         """Device step output -> (raw [G], pred [G]|None); strips the leading
@@ -223,9 +239,11 @@ class StreamGroup:
         self.last_predictions = pred
         self.ticks += T
         loglik = np.empty((T, self.G))
+        alerts = np.empty((T, self.G), bool)
         for i in range(T):
             _, loglik[i] = self.likelihood.update(raw[i])
-        return raw, loglik, loglik >= self.threshold
+            alerts[i] = self._debounced(loglik[i])
+        return raw, loglik, alerts
 
     def run_chunk(self, values: np.ndarray, ts: np.ndarray, learn: bool = True) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Replay T ticks in one device dispatch, synchronously.
@@ -262,12 +280,14 @@ class StreamGroupRegistry:
         seed: int = 0,
         threshold: float = 0.5,
         mesh=None,
+        debounce: int = 1,
     ):
         self.cfg = cfg
         self.group_size = int(group_size)
         self.backend = backend
         self.seed = seed
         self.threshold = threshold
+        self.debounce = int(debounce)
         self.mesh = mesh
         self.groups: list[StreamGroup] = []
         self._slots: dict[str, _Slot] = {}
@@ -289,6 +309,7 @@ class StreamGroupRegistry:
         grp = StreamGroup(
             self.cfg, padded, seed=self.seed + len(self.groups),
             backend=self.backend, threshold=self.threshold, mesh=self.mesh,
+            debounce=self.debounce,
         )
         grp.n_live = len(ids)
         for i, sid in enumerate(ids):

@@ -33,16 +33,39 @@ def make_sine_feed(
     return vals, ts, phase
 
 
-def measure_pipelined(grp, vals: np.ndarray, ts: np.ndarray, measure_chunks: int = 3):
-    """Steady-state scored-metrics/s over `measure_chunks` re-dispatches of
-    one chunk (timestamps advanced), overlapped depth-2 (dispatch chunk i+1
-    before collecting chunk i — SURVEY.md §7 hard part 3). The group must
-    already be warmed up (compiled)."""
+def measure_pipelined(
+    grp, vals: np.ndarray, ts: np.ndarray, measure_chunks: int = 3,
+    novel: tuple[tuple[int, int], np.ndarray] | None = None,
+):
+    """Steady-state scored-metrics/s over `measure_chunks` chunk dispatches,
+    overlapped depth-2 (dispatch chunk i+1 before collecting chunk i —
+    SURVEY.md §7 hard part 3). The group must already be warmed up (compiled).
+
+    `novel=(key, phase)`: each measured chunk carries FRESH values continuing
+    `vals`' streams via the phase-advancing feed (per-chunk noise key), so
+    steady state includes genuine novelty and the learning path's real cost —
+    re-dispatching one chunk lets the TM fully learn a T-tick loop and
+    flatters throughput (round-3 verdict, weak #8). Chunks are pre-generated
+    OUTSIDE the timed window (the live service overlaps ingest with device
+    compute; host rng is not the thing under measurement). Default (None)
+    keeps the old re-dispatch behavior for A/B comparability.
+    """
     chunk_ticks, G = vals.shape[:2]
+    if novel is not None:
+        key, phase = novel
+        chunks = []
+        for i in range(measure_chunks):
+            v, t, _ = make_sine_feed(
+                G, chunk_ticks, key=(key[0], key[1] + 1 + i),
+                t0=(i + 1) * chunk_ticks, phase=phase,
+            )
+            chunks.append((v, t))
+    else:
+        chunks = [(vals, ts + (i + 1) * chunk_ticks) for i in range(measure_chunks)]
     t0 = time.perf_counter()
-    pending = grp.dispatch_chunk(vals, ts + chunk_ticks)
+    pending = grp.dispatch_chunk(*chunks[0])
     for i in range(1, measure_chunks):
-        nxt = grp.dispatch_chunk(vals, ts + (i + 1) * chunk_ticks)
+        nxt = grp.dispatch_chunk(*chunks[i])
         grp.collect_chunk(pending)
         pending = nxt
     grp.collect_chunk(pending)

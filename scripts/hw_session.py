@@ -49,6 +49,20 @@ STEPS: list[tuple[str, list[str]]] = [
     ("profile_flat_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
                               "--gs", "1024", "--layout", "flat",
                               "--scatter", "indexed"]),
+    # round-4 strategies: compact punish/death sweep; forward-index dendrite
+    # (both fwd histogram impls); the stacked best-guess candidate
+    ("profile_sweep_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                               "--gs", "1024", "--scatter", "indexed",
+                               "--sweep", "compact"]),
+    ("profile_fwd_scatter", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                             "--gs", "1024", "--scatter", "indexed",
+                             "--dendrite", "forward"]),
+    ("profile_fwd_matmul", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                            "--gs", "1024", "--scatter", "indexed",
+                            "--dendrite", "forward", "--fwd-impl", "matmul"]),
+    ("profile_fwd_flat", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                          "--gs", "1024", "--layout", "flat",
+                          "--scatter", "indexed", "--dendrite", "forward"]),
     ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
     ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
     ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),

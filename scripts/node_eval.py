@@ -73,7 +73,12 @@ def main() -> None:
     for g, node in enumerate(nodes):
         for (a, b), touched in zip(node.windows, node.event_metrics):
             kind = "coupled" if len(touched) == len(node.metrics) else "single"
-            w = (node.timestamps >= a) & (node.timestamps <= b + args.latency_ticks)
+            # window bounds are unix seconds: convert the tick allowance via
+            # the stream cadence (ADVICE.md r3 — a non-1s cadence would
+            # silently shrink/shift the detection window otherwise)
+            w = (node.timestamps >= a) & (
+                node.timestamps <= b + args.latency_ticks * scfg.cadence_s
+            )
             resp = float(loglik[w, g].max())
             shapes[kind]["events"] += 1
             shapes[kind]["responses"].append(round(resp, 3))

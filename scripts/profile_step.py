@@ -125,6 +125,25 @@ def main():
                          "preset: u16/u8 halve HBM per stream but add per-tick "
                          "storage<->compute conversions; f32 (0) skips them — "
                          "the faster choice may differ from the denser one")
+    ap.add_argument("--sweep", choices=("dense", "compact"), default=None,
+                    help="TM punish/death strategy (ops/tm_tpu.py SWEEP_MODE):"
+                         " 'compact' touches only the <= punish_cap+learn_cap "
+                         "affected segment rows, 'dense' sweeps the full "
+                         "pools — A/B on hardware")
+    ap.add_argument("--dendrite", choices=("scan", "forward"), default=None,
+                    help="TM dendrite-activity strategy: 'forward' gathers "
+                         "the active cells' forward-index rows (ops/"
+                         "fwd_index.py; state grows by the index), 'scan' "
+                         "sweeps the pools — A/B on hardware")
+    ap.add_argument("--fwd-impl", choices=("scatter", "matmul"), default=None,
+                    help="forward-index histogram accumulation: native "
+                         "scatter-add vs factored one-hot MXU contraction")
+    ap.add_argument("--fanout-cap", type=int, default=None,
+                    help="forward-index row width F (default: 384 under "
+                         "--dendrite forward — the measured diurnal-workload "
+                         "fanout tail; preset default otherwise). An "
+                         "undersized F trips fwd_of and corrupts the "
+                         "dendrite dynamics, invalidating the A/B")
     args = ap.parse_args()
 
     from rtap_tpu.utils.platform import enable_compile_cache
@@ -145,8 +164,29 @@ def main():
 
         set_layout_mode(args.layout)
         log(f"TM kernel layout: {args.layout}")
+    if args.sweep:
+        from rtap_tpu.ops.tm_tpu import set_sweep_mode
+
+        set_sweep_mode(args.sweep)
+        log(f"TM punish/death sweep: {args.sweep}")
+    if args.dendrite:
+        from rtap_tpu.ops.tm_tpu import set_dendrite_mode
+
+        set_dendrite_mode(args.dendrite)
+        log(f"TM dendrite strategy: {args.dendrite}")
+    if args.fwd_impl:
+        from rtap_tpu.ops.tm_tpu import set_fwd_impl
+
+        set_fwd_impl(args.fwd_impl)
+        log(f"forward-index histogram impl: {args.fwd_impl}")
 
     cfg = cluster_preset(perm_bits=args.perm_bits)
+    if args.fanout_cap or args.dendrite == "forward":
+        import dataclasses
+
+        F = args.fanout_cap or 384
+        cfg = dataclasses.replace(cfg, tm=dataclasses.replace(cfg.tm, fanout_cap=F))
+        log(f"forward-index fanout cap: {F}")
     T = args.T
     log(f"platform: {jax.devices()[0].platform} {jax.devices()[0].device_kind} "
         f"(perm_bits={args.perm_bits})")

@@ -96,9 +96,16 @@ def test_tcp_jsonl_source_live_loop(group):
         assert not np.isnan(combined).any(), combined
         np.testing.assert_allclose(combined, 30.0 + np.arange(G))
         assert ts == 1_700_000_000 + G - 1
+        # the second connection's handler thread updates the error counters
+        # asynchronously — wait for BOTH its records to be processed before
+        # asserting (the round-3 flake: asserting as soon as the first
+        # connection's values landed raced the second handler)
+        deadline = time.time() + 2.0
+        while time.time() < deadline and src.unknown_ids + src.parse_errors < 2:
+            time.sleep(0.02)
+        assert src.unknown_ids == 1 and src.parse_errors == 1
         # drained: with no new pushes the next tick reports missing samples
         values, _ = src(1)
         assert np.isnan(values).all()
-        assert src.unknown_ids == 1 and src.parse_errors == 1
         stats = live_loop(src, group, n_ticks=5, cadence_s=0.1)
         assert stats["ticks"] == 5 and stats["scored"] == 5 * G

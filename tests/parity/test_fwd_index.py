@@ -1,0 +1,171 @@
+"""RTAP_TM_DENDRITE=forward parity: the forward synapse index must produce
+bit-identical dendrite counts (hence scores AND full state) to the full-pool
+scan, with the index maintained incrementally through learning — evictions,
+alloc-clears, growth, reinforce-death, punish-death (ops/fwd_index.py,
+docs/FORWARD_INDEX_DESIGN.md).
+
+The index itself is derived state with a free row layout; its contract is
+(a) count parity per step, (b) set-consistency with `presyn` (every synapse
+slot appears in exactly its presynaptic cell's row), (c) overflow counted,
+never silent. (b) is asserted directly by rebuilding canonically and
+comparing membership sets.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import rtap_tpu.ops.tm_tpu as tm_tpu
+from rtap_tpu.models.htm_model import HTMModel
+from rtap_tpu.ops.fwd_index import build_fwd_index
+
+from tests.parity.test_e2e_parity import exact_only, make_values, small_cfg
+
+
+@pytest.fixture
+def forward_dendrite():
+    tm_tpu.set_dendrite_mode("forward")
+    yield
+    tm_tpu.set_dendrite_mode(None)
+
+
+def fwd_cfg(perm_bits: int = 0):
+    """small_cfg with a fanout cap high enough that the 2048-cell pool can
+    never overflow a row on the test trajectories (tests assert fwd_of == 0;
+    measured: the seed-3 300-step run peaks at fanout 129 — a 128 cap
+    correctly tripped fwd_of=1 and diverged, which is the overflow contract
+    working)."""
+    if perm_bits == 0:
+        base = small_cfg()
+    else:
+        from tests.parity.test_quantized_parity import quant_cfg
+
+        base = quant_cfg(perm_bits)
+    return dataclasses.replace(base, tm=dataclasses.replace(base.tm, fanout_cap=320))
+
+
+def test_build_fwd_index_matches_numpy():
+    """Canonical build vs a direct numpy construction on random pools."""
+    rng = np.random.Generator(np.random.Philox(key=(3, 14)))
+    N, F = 64, 8
+    pool = 512
+    for density in (0.0, 0.1, 0.5):
+        presyn = np.where(
+            rng.random(pool) < density, rng.integers(0, N, pool), -1
+        ).astype(np.int32)
+        slots, pos, of = map(np.asarray, build_fwd_index(presyn, N, F))
+        want_of = 0
+        for n in range(N):
+            where = np.flatnonzero(presyn == n)
+            want_of += max(0, len(where) - F)
+            got_row = slots[n][slots[n] >= 0]
+            np.testing.assert_array_equal(np.sort(got_row), where[:F], err_msg=f"cell {n}")
+        assert int(of) == want_of
+        # back pointers: fwd_slots[presyn[s], fwd_pos[s]] == s for indexed slots
+        for s in np.flatnonzero(pos >= 0):
+            assert slots[presyn[s], pos[s]] == s
+
+
+@exact_only
+@pytest.mark.parametrize("perm_bits", [0, 16])
+def test_e2e_parity_forward_dendrite(forward_dendrite, perm_bits):
+    cfg = fwd_cfg(perm_bits)
+    cpu = HTMModel(cfg, seed=3, backend="cpu")
+    tpu = HTMModel(cfg, seed=3, backend="tpu")
+    vals = make_values(300, 1)
+    for i in range(300):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
+@exact_only
+@pytest.mark.parametrize("impl", ["scatter", "matmul"])
+def test_forward_vs_scan_full_state(impl):
+    """Forward dendrite (both histogram impls) vs the scan on identical
+    inputs -> identical full state each run, and the incrementally-maintained
+    index stays set-consistent with a canonical rebuild from presyn. (Each
+    variant runs straight through under one mode — per-step flips would
+    clear the jit caches 640x.)"""
+    import jax
+
+    cfg = fwd_cfg()
+    vals = make_values(320, 1, seed=29)
+
+    def run_mode(dendrite):
+        tm_tpu.set_dendrite_mode(dendrite)
+        tm_tpu.set_fwd_impl(impl if dendrite else None)
+        try:
+            m = HTMModel(cfg, seed=11, backend="tpu")
+            raws = [
+                m.run(1_700_000_000 + 300 * i, float(vals[i, 0]),
+                      learn=(i % 13) != 5).raw_score  # inference interludes
+                for i in range(320)
+            ]
+            return raws, jax.device_get(m._runner.state)
+        finally:
+            tm_tpu.set_dendrite_mode(None)
+            tm_tpu.set_fwd_impl(None)
+
+    raws_f, a = run_mode("forward")
+    raws_s, b = run_mode(None)
+    assert raws_f == raws_s
+    for k in sorted(b):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+    assert int(a["tm_overflow"]) == 0
+    assert int(a["fwd_of"]) == 0
+
+    # index consistency: maintained rows hold exactly the slot sets of a
+    # canonical rebuild (row order is free; membership is the contract)
+    slots_c, pos_c, of_c = map(
+        np.asarray, build_fwd_index(np.asarray(a["presyn"]), cfg.num_cells, cfg.tm.fanout_cap)
+    )
+    assert int(of_c) == 0
+    maint = np.asarray(a["fwd_slots"])
+    for n in range(cfg.num_cells):
+        got = np.sort(maint[n][maint[n] >= 0])
+        want = np.sort(slots_c[n][slots_c[n] >= 0])
+        np.testing.assert_array_equal(got, want, err_msg=f"cell {n}")
+    # back pointers agree with the rows
+    pos_m = np.asarray(a["fwd_pos"])
+    presyn_flat = np.asarray(a["presyn"]).reshape(-1)
+    for s in np.flatnonzero(presyn_flat >= 0):
+        assert pos_m[s] >= 0, f"slot {s} unindexed"
+        assert maint[presyn_flat[s], pos_m[s]] == s, f"slot {s} back pointer"
+    assert np.count_nonzero(pos_m >= 0) == np.count_nonzero(presyn_flat >= 0)
+
+
+@exact_only
+def test_forward_save_load_roundtrip(forward_dendrite, tmp_path):
+    """model.save under forward mode stores no fwd arrays; load rebuilds the
+    index and resumes bit-exactly."""
+    cfg = fwd_cfg()
+    m = HTMModel(cfg, seed=9, backend="tpu")
+    vals = make_values(260, 1, seed=41)
+    for i in range(200):
+        m.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+    p = str(tmp_path / "fwd_model.npz")
+    m.save(p)
+    with np.load(p) as z:
+        assert not any(k.startswith("s_fwd_") for k in z.files)
+    m2 = HTMModel.load(p, backend="tpu")
+    for i in range(200, 260):
+        r1 = m.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r2 = m2.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r1.raw_score == pytest.approx(r2.raw_score, abs=0.0), f"step {i}"
+
+
+@exact_only
+def test_fanout_overflow_counts(forward_dendrite):
+    """A fanout_cap of 1 must trip fwd_of (dropped appends are counted,
+    never silent)."""
+    import jax
+
+    base = small_cfg()
+    cfg = dataclasses.replace(base, tm=dataclasses.replace(base.tm, fanout_cap=1))
+    m = HTMModel(cfg, seed=5, backend="tpu")
+    vals = make_values(300, 1, seed=43)
+    for i in range(300):
+        m.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+    assert int(jax.device_get(m._runner.state)["fwd_of"]) > 0

@@ -1,0 +1,125 @@
+"""RTAP_TM_SWEEP=compact parity: the gather/punish/death-on-touched-rows
+formulation must be bit-identical to the dense full-pool sweeps (which are
+themselves pinned to the oracle by test_e2e_parity.py).
+
+The compact sweep's correctness argument (ops/tm_tpu.py): synapse death can
+only newly occur on rows whose permanences moved this step — the <= learn_cap
+workspace rows and the <= punish_cap punished rows — because the previous
+learn step's death pass already removed every perm<=0 synapse and inference
+steps never move permanences. These tests check the equivalence end-to-end
+(vs the oracle) and state-for-state (compact vs dense on the same inputs),
+in all permanence domains and under the other kernel strategy switches.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import rtap_tpu.ops.tm_tpu as tm_tpu
+from rtap_tpu.models.htm_model import HTMModel
+
+from tests.parity.test_e2e_parity import exact_only, make_values, small_cfg
+
+
+@pytest.fixture
+def compact_sweep():
+    tm_tpu.set_sweep_mode("compact")
+    yield
+    tm_tpu.set_sweep_mode(None)
+
+
+def _cfg(perm_bits: int):
+    if perm_bits == 0:
+        return small_cfg()
+    from tests.parity.test_quantized_parity import quant_cfg
+
+    return quant_cfg(perm_bits)
+
+
+@exact_only
+@pytest.mark.parametrize("perm_bits", [0, 16, 8])
+def test_e2e_parity_compact_sweep(compact_sweep, perm_bits):
+    cfg = _cfg(perm_bits)
+    cpu = HTMModel(cfg, seed=3, backend="cpu")
+    tpu = HTMModel(cfg, seed=3, backend="tpu")
+    vals = make_values(300, 1)
+    for i in range(300):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
+@exact_only
+@pytest.mark.parametrize("scatter", ["matmul", "indexed"])
+def test_e2e_parity_compact_sweep_all_strategies(compact_sweep, scatter):
+    """Compact sweep under both workspace-movement strategies + flat layout +
+    TPU compact-ids paths — the full hardware-candidate matrix."""
+    old = tm_tpu.FORCE_TPU_PATHS
+    tm_tpu.FORCE_TPU_PATHS = True
+    tm_tpu.set_scatter_mode(scatter)
+    tm_tpu.set_layout_mode("flat")
+    try:
+        cfg = _cfg(16)
+        cpu = HTMModel(cfg, seed=7, backend="cpu")
+        tpu = HTMModel(cfg, seed=7, backend="tpu")
+        vals = make_values(300, 1, seed=17)
+        for i in range(300):
+            r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+            r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+            assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+    finally:
+        tm_tpu.FORCE_TPU_PATHS = old
+        tm_tpu.set_scatter_mode(None)
+        tm_tpu.set_layout_mode(None)
+
+
+@exact_only
+def test_compact_vs_dense_full_state():
+    """Same inputs through compact-sweep and dense-sweep device models ->
+    bit-identical FULL state (not just scores), including after punishment
+    and death events. Inference interludes check the perms-don't-move
+    invariant the equivalence rests on. (Each variant runs straight through
+    under one mode — a per-step mode flip would clear the jit caches 700x.)"""
+    import jax
+
+    cfg = small_cfg()
+    vals = make_values(350, 1, seed=23)
+
+    def run_mode(mode):
+        tm_tpu.set_sweep_mode(mode)
+        try:
+            m = HTMModel(cfg, seed=11, backend="tpu")
+            raws = [
+                m.run(1_700_000_000 + 300 * i, float(vals[i, 0]),
+                      learn=(i % 10) < 8).raw_score  # inference interludes
+                for i in range(350)
+            ]
+            return raws, jax.device_get(m._runner.state)
+        finally:
+            tm_tpu.set_sweep_mode(None)
+
+    raws_c, a = run_mode("compact")
+    raws_d, b = run_mode(None)
+    assert raws_c == raws_d
+    assert set(a) == set(b)
+    for k in sorted(a):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+    assert int(a["tm_overflow"]) == 0
+
+
+@exact_only
+def test_punish_cap_overflow_counts(compact_sweep):
+    """A punish_cap of 1 must trip the overflow counter (not crash, not
+    silently drop): the counter is the contract that the capacity bound is
+    observable."""
+    import jax
+
+    base = small_cfg()
+    cfg = dataclasses.replace(base, tm=dataclasses.replace(base.tm, punish_cap=1))
+    m = HTMModel(cfg, seed=5, backend="tpu")
+    vals = make_values(400, 1, seed=31)
+    for i in range(400):
+        m.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+    overflow = int(jax.device_get(m._runner.state)["tm_overflow"])
+    assert overflow > 0

@@ -20,7 +20,7 @@ def load_bench(tmp_path, monkeypatch, lkg: dict | None):
 
 def test_emit_prefers_fresh_result(tmp_path, monkeypatch, capsys):
     b = load_bench(tmp_path, monkeypatch, {"value": 111.0, "measured_at": "x"})
-    assert b.emit({"value": 42.0}) is True
+    assert b.emit({"value": 42.0}) == 0  # fresh result -> exit code 0
     out = json.loads(capsys.readouterr().out.strip())
     assert out["value"] == 42.0 and "cached" not in out
 
@@ -28,7 +28,9 @@ def test_emit_prefers_fresh_result(tmp_path, monkeypatch, capsys):
 def test_emit_falls_back_to_lkg_flagged(tmp_path, monkeypatch, capsys):
     monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
     b = load_bench(tmp_path, monkeypatch, {"value": 38956.1, "measured_at": "2026-07-30"})
-    assert b.emit(None) is True
+    # cached fallback is emitted but exits CACHED_EXIT so exit-code-only
+    # consumers can tell a dead-tunnel LKG from a fresh number (ADVICE.md r3)
+    assert b.emit(None) == b.CACHED_EXIT
     out = json.loads(capsys.readouterr().out.strip())
     assert out["cached"] is True and out["value"] == 38956.1
     assert out["measured_at"] == "2026-07-30" and "cached_reason" in out
@@ -37,21 +39,21 @@ def test_emit_falls_back_to_lkg_flagged(tmp_path, monkeypatch, capsys):
 def test_emit_cpu_drives_never_read_lkg(tmp_path, monkeypatch, capsys):
     monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
     b = load_bench(tmp_path, monkeypatch, {"value": 38956.1, "measured_at": "x"})
-    assert b.emit(None) is False
+    assert b.emit(None) is None
     assert capsys.readouterr().out == ""
 
 
-def test_emit_without_lkg_returns_false(tmp_path, monkeypatch, capsys):
+def test_emit_without_lkg_returns_none(tmp_path, monkeypatch, capsys):
     monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
     b = load_bench(tmp_path, monkeypatch, None)
-    assert b.emit(None) is False
+    assert b.emit(None) is None
     assert capsys.readouterr().out == ""
 
 
 def test_emit_is_idempotent(tmp_path, monkeypatch, capsys):
     b = load_bench(tmp_path, monkeypatch, None)
-    assert b.emit({"value": 1.0}) is True
-    assert b.emit({"value": 2.0}) is True  # reports success, prints nothing new
+    assert b.emit({"value": 1.0}) == 0
+    assert b.emit({"value": 2.0}) == 0  # reports success, prints nothing new
     lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
     assert len(lines) == 1 and json.loads(lines[0])["value"] == 1.0
 
@@ -62,7 +64,7 @@ def test_malformed_lkg_degrades_to_none(tmp_path, monkeypatch, capsys):
         (tmp_path / "BENCH_LKG.json").write_text(bad)
         b = load_bench(tmp_path, monkeypatch, None)
         b.LKG_PATH = str(tmp_path / "BENCH_LKG.json")
-        assert b.emit(None) is False, bad
+        assert b.emit(None) is None, bad
     assert capsys.readouterr().out == ""
 
 
