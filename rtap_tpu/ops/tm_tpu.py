@@ -528,6 +528,17 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
             "under forward mode, or rebuild from presyn with "
             "ops.fwd_index.build_fwd_index (checkpoint loads do this)"
         )
+    if not forward and learn and "fwd_slots" in state:
+        # learning under scan mode mutates presyn WITHOUT index maintenance;
+        # a later switch to forward mode would then read a silently-stale
+        # index. Refuse now instead: rebuild state under the target mode
+        # (A/B runs construct one state per mode; checkpoints are
+        # mode-agnostic and rebuild on load).
+        raise ValueError(
+            "state carries a forward index but RTAP_TM_DENDRITE=scan would "
+            "learn without maintaining it (silent index corruption); "
+            "re-init the state under scan mode or run with forward dendrite"
+        )
     fwd_slots = state.get("fwd_slots")
     fwd_pos = state.get("fwd_pos")
     fwd_of = state.get("fwd_of")

@@ -2,11 +2,13 @@
 
 The reference's evaluation method is fault injection against a monitored
 cluster; this is the round-3 hardening of eval/fault_eval.py (round-2
-verdict: "zero tests, unexercised"). Floors are set at what the detector
-actually achieves minus a safety margin (measured on this exact seed/config:
-f1 0.722, recall 0.875, episode precision 0.614, median latency 1 s,
-median lead ~32 s), so a regression in the encoder/SP/TM/likelihood chain
-or in the preset tuning trips them.
+verdict: "zero tests, unexercised"), with round-4 floors raised to the
+quality-study results (reports/quality_study.json: the production streaming
+config measures f1 0.853 / precision 0.831 / recall 0.875 on the 40-stream
+fixture and 0.789/0.760/0.821 at the 120-stream artifact scale; the
+window-mode fixture here stays the NuPIC-faithful comparison config). A
+regression in the encoder/SP/TM/likelihood chain or in the preset tuning
+trips the floors.
 
 Note the floors certify the DEFAULT cluster preset, i.e. the quantized
 u16 permanence domain — compression and quality are tested together.
@@ -102,15 +104,24 @@ def test_probation_alignment():
 
 
 def test_streaming_mode_floors():
-    """The AT-SCALE configuration (streaming likelihood, exactly as bench.py
-    and the 100k path run it) holds its own floors — measured f1 0.853,
-    episode precision 0.831 on this seed (better than window mode; the ring
-    replacement is not a quality trade, SCALING.md)."""
+    """The PRODUCTION configuration (streaming likelihood, exactly as the
+    preset, bench.py, and the 100k path run it) holds its own floors —
+    measured this round: f1 0.853, episode precision 0.831, recall 0.875 at
+    (thr 0.27, debounce 1) on this seed; 0.760/0.821 at the 120-stream
+    artifact scale (reports/fault_eval.json, reports/quality_study.json).
+    Floors are achieved-minus-margin per the r3 verdict item 4; the
+    120-stream artifact also clears the verdict target (precision >= 0.70
+    at recall >= 0.75)."""
     from rtap_tpu.config import cluster_preset
 
     rep = run_fault_eval(n_streams=40, length=1000, cfg=cluster_preset(),
                          backend="tpu", chunk_ticks=128)
     b = rep.at_best
-    assert b["f1"] >= 0.75, b
-    assert b["recall"] >= 0.80, b
-    assert b["precision"] >= 0.70, b
+    assert b["f1"] >= 0.80, b
+    assert b["recall"] >= 0.82, b
+    assert b["precision"] >= 0.77, b
+    # the shipped default operating point (thr 0.5, debounce 2) leans
+    # precision-first; it must stay a usable page-on-it default
+    d = rep.at_default
+    assert d["precision"] >= 0.85, d
+    assert d["recall"] >= 0.45, d
