@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from rtap_tpu.utils.platform import maybe_force_cpu
@@ -104,7 +105,9 @@ def _cmd_eval(args: argparse.Namespace) -> int:
 
     argv = ["--streams", str(args.streams), "--length", str(args.length),
             "--magnitude", str(args.magnitude), "--backend", args.backend,
-            "--debounce", str(args.debounce)]
+            "--debounce", str(args.debounce), "--likelihood", args.likelihood]
+    if args.learning_period is not None:
+        argv += ["--learning-period", str(args.learning_period)]
     if args.all_kinds:
         argv.append("--all-kinds")
     if args.out:
@@ -179,6 +182,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--all-kinds", action="store_true")
     p.add_argument("--backend", default="tpu")
     p.add_argument("--debounce", type=int, default=2)
+    p.add_argument("--likelihood", choices=("window", "streaming"),
+                   default="streaming",
+                   help="likelihood mode; streaming is the production config "
+                        "behind the headline artifact (reports/"
+                        "fault_eval.json), window the comparison study")
+    p.add_argument("--learning-period", type=int, default=None,
+                   help="override the likelihood probation length in ticks")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_eval)
 
@@ -190,6 +200,13 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=_cmd_report)
 
     args = ap.parse_args(argv)
+    if getattr(args, "backend", None) == "tpu":
+        # fail in 120s on a wedged tunnel instead of hanging the operator's
+        # terminal, and reuse compiled programs across service restarts
+        from rtap_tpu.utils.platform import enable_compile_cache, init_backend_or_die
+
+        init_backend_or_die()
+        enable_compile_cache(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     return args.fn(args)
 
 

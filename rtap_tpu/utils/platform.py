@@ -54,15 +54,22 @@ def force_virtual_devices(n: int) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+INIT_WATCHDOG_EXIT = 113  # distinctive: rc=2 would collide with argparse
+# usage errors and CLI validation returns, and supervisors (scripts/
+# hw_watch.py) key tunnel-down retry semantics off this exact code
+
+
 def init_backend_or_die(timeout_s: float = 120.0) -> None:
     """Initialize the jax backend with a hard deadline.
 
     The axon TPU tunnel oscillates: backend init either completes in ~1 s or
     blocks indefinitely inside the PJRT client (observed: >10 min hangs, also
     hit by the round-2 judge). A hung init can't be interrupted in-process —
-    the watchdog hard-exits (os._exit(2)) so callers (scripts, bench attempt
-    subprocesses) fail fast instead of silently eating their wall budget.
-    No-op cost when the tunnel is healthy: one timer thread.
+    the watchdog hard-exits (os._exit(INIT_WATCHDOG_EXIT)) so callers
+    (scripts, bench attempt subprocesses) fail fast instead of silently
+    eating their wall budget, and supervisors can tell "tunnel down" from a
+    step's own usage/validation errors. No-op cost when the tunnel is
+    healthy: one timer thread.
     """
     import threading
 
@@ -74,7 +81,7 @@ def init_backend_or_die(timeout_s: float = 120.0) -> None:
                 f"backend init exceeded {timeout_s:.0f}s (TPU tunnel wedged); aborting",
                 file=__import__("sys").stderr, flush=True,
             )
-            os._exit(2)
+            os._exit(INIT_WATCHDOG_EXIT)
 
     t = threading.Thread(target=watchdog, daemon=True)
     t.start()

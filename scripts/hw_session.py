@@ -66,8 +66,20 @@ STEPS: list[tuple[str, list[str]]] = [
     ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
     ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
     ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),
-    ("bench", [sys.executable, "bench.py"]),
+    # bench subprocess-isolates its own attempts under BENCH_BUDGET_S=1500;
+    # the step budget must exceed that or the runner would SIGKILL it before
+    # its own SIGTERM-emit path can print the result line
+    ("bench", [sys.executable, "bench.py"], 1700.0),
+    # round-4 service-shape experiments (verdict weak #3 / #7); the soak is
+    # startup (up to ~300 s compile) + a >= 5 min paced loop by design
+    ("multigroup", [sys.executable, "scripts/multigroup_sched.py"], 1200.0),
+    ("live_soak", [sys.executable, "scripts/live_soak.py"], 1500.0),
 ]
+
+
+def step_budget(step: tuple, default: float) -> float:
+    """STEPS entries are (name, cmd) or (name, cmd, budget)."""
+    return step[2] if len(step) > 2 else default
 
 
 def main() -> None:
@@ -81,17 +93,27 @@ def main() -> None:
     )
 
     os.makedirs(OUT, exist_ok=True)
-    for name, cmd in picked:
+    for step in picked:
+        name, cmd = step[0], step[1]
+        budget = max(step_budget(step, args.budget_per_step), args.budget_per_step)
         path = os.path.join(OUT, f"{name}.log")
-        log(f"step {name}: {' '.join(cmd[1:])} (budget {args.budget_per_step:.0f}s)")
+        log(f"step {name}: {' '.join(cmd[1:])} (budget {budget:.0f}s)")
         t0 = time.monotonic()
         with open(path, "w") as f:
+            # own session + group kill: steps spawn grandchildren (serve,
+            # bench attempts) that must not outlive a timeout holding the TPU
+            proc = subprocess.Popen(cmd, cwd=REPO, stdout=f,
+                                    stderr=subprocess.STDOUT, start_new_session=True)
             try:
-                rc = subprocess.run(
-                    cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
-                    timeout=args.budget_per_step,
-                ).returncode
+                rc = proc.wait(timeout=budget)
             except subprocess.TimeoutExpired:
+                import signal
+
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                proc.wait()
                 rc = -1
         dt = time.monotonic() - t0
         tail = ""

@@ -1,0 +1,174 @@
+"""Harvest oscillating-tunnel windows: retry the hw_session agenda to done.
+
+The TPU tunnel oscillates (SCALING.md: reachable for minutes, then backend
+init hangs for tens of minutes — observed again this round: a window opened,
+served layout_probe + half a profile, and wedged 6 minutes in). A one-shot
+`hw_session.py` run burns its most-valuable-first steps on a dead tunnel;
+this watcher instead loops the SAME agenda with a completion ledger:
+
+- steps that exit rc=0 are recorded in hw_results/done.json and never rerun;
+- a step that dies on backend init (the 120s watchdog,
+  rc=INIT_WATCHDOG_EXIT) means the tunnel is down: sleep, then retry the
+  same step — the init attempt IS the cheapest possible probe. Down-tunnel
+  deaths never count toward --max-attempts (a wedged tunnel must never park
+  the agenda), which is exactly why the watchdog code is distinctive and
+  not 2 (argparse usage errors would retry forever);
+- a step that times out mid-run (tunnel dropped under it) is retried too,
+  up to --max-attempts, then parked as "gave_up" so one cursed step can't
+  starve the rest of the agenda;
+- hw_results/status.json always holds the live view (current step, tunnel
+  state, ledger) for anything coordinating CPU-heavy work around the
+  1-core host.
+
+Usage: python scripts/hw_watch.py [--wall-budget 36000] [--budget-per-step 900]
+       [--retry-sleep 90] [--max-attempts 6] [--steps 1,2,5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from hw_session import OUT, REPO, STEPS, step_budget  # noqa: E402
+
+sys.path.insert(0, REPO)
+from rtap_tpu.utils.platform import INIT_WATCHDOG_EXIT as INIT_FAIL_RC  # noqa: E402
+
+DONE = os.path.join(OUT, "done.json")
+STATUS = os.path.join(OUT, "status.json")
+
+
+def log(msg: str) -> None:
+    print(f"[hw_watch] {time.strftime('%H:%M:%S')} {msg}", file=sys.stderr, flush=True)
+
+
+def _load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save(path: str, obj: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+    os.replace(tmp, path)
+
+
+def _status(ledger: dict, current: str | None, tunnel_up: bool | None) -> None:
+    _save(STATUS, {
+        "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "current": current, "tunnel_up": tunnel_up,
+        "done": sorted(k for k, v in ledger.items() if v.get("rc") == 0),
+        "gave_up": sorted(k for k, v in ledger.items() if v.get("gave_up")),
+    })
+
+
+def run_step(name: str, cmd: list[str], budget: float) -> int:
+    """One attempt; stdout+stderr -> hw_results/<name>.log (overwrite).
+
+    The step runs in its own session and a timeout kills the whole process
+    GROUP: steps like live_soak spawn grandchildren (`python -m rtap_tpu
+    serve`) that would otherwise survive the kill holding the TPU (and,
+    historically, a fixed TCP port) into every later attempt."""
+    import signal
+
+    path = os.path.join(OUT, f"{name}.log")
+    with open(path, "w") as f:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        try:
+            return proc.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            return -1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--wall-budget", type=float, default=36000.0)
+    ap.add_argument("--budget-per-step", type=float, default=900.0)
+    ap.add_argument("--retry-sleep", type=float, default=90.0)
+    ap.add_argument("--max-attempts", type=int, default=6)
+    ap.add_argument("--steps", default=None,
+                    help="comma-separated 1-based step numbers (default all)")
+    args = ap.parse_args()
+    picked = (
+        [STEPS[int(i) - 1] for i in args.steps.split(",")] if args.steps else STEPS
+    )
+
+    os.makedirs(OUT, exist_ok=True)
+    ledger = _load(DONE)
+    t_start = time.monotonic()
+    attempts: dict[str, int] = {
+        k: v.get("attempts", 0) for k, v in ledger.items()
+    }
+    tunnel_up: bool | None = None
+
+    while time.monotonic() - t_start < args.wall_budget:
+        pending = [
+            s for s in picked
+            if ledger.get(s[0], {}).get("rc") != 0
+            and not ledger.get(s[0], {}).get("gave_up")
+        ]
+        if not pending:
+            log("agenda complete")
+            _status(ledger, None, tunnel_up)
+            return 0
+        step = pending[0]
+        name, cmd = step[0], step[1]
+        budget = max(step_budget(step, args.budget_per_step), args.budget_per_step)
+        _status(ledger, name, tunnel_up)
+        log(f"step {name} (attempt {attempts.get(name, 0) + 1}/{args.max_attempts}, "
+            f"{len(pending)} pending, budget {budget:.0f}s)")
+        t0 = time.monotonic()
+        rc = run_step(name, cmd, budget)
+        dt = time.monotonic() - t0
+        if rc != INIT_FAIL_RC:
+            # an init-watchdog death is the tunnel's fault, not the step's:
+            # only attempts that actually reached the backend count toward
+            # the give-up limit (a down-tunnel must never park the agenda)
+            attempts[name] = attempts.get(name, 0) + 1
+        tail = ""
+        try:
+            lines = [l.strip() for l in
+                     open(os.path.join(OUT, f"{name}.log")).read().splitlines()
+                     if l.strip()]
+            tail = lines[-1][:140] if lines else ""
+        except OSError:
+            pass
+        log(f"step {name}: rc={rc} in {dt:.0f}s — {tail}")
+        entry = {"rc": rc, "wall_s": round(dt, 1), "attempts": attempts.get(name, 0),
+                 "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+        if rc == 0:
+            tunnel_up = True
+            ledger[name] = entry
+        else:
+            tunnel_up = False if rc == INIT_FAIL_RC else tunnel_up
+            if attempts.get(name, 0) >= args.max_attempts:
+                entry["gave_up"] = True
+                log(f"step {name}: giving up after {attempts[name]} attempts")
+            ledger[name] = entry
+            if not entry.get("gave_up"):
+                log(f"tunnel looks {'down' if rc == INIT_FAIL_RC else 'flaky'}; "
+                    f"sleeping {args.retry_sleep:.0f}s")
+                time.sleep(args.retry_sleep)
+        _save(DONE, ledger)
+        _status(ledger, None, tunnel_up)
+    log("wall budget exhausted")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""Live-serving soak at realistic scale (round-3 verdict, weak #7).
+
+Round 3's live-path evidence was smoke-scale (a handful of streams, ~12
+ticks at 0.1 s cadence). The round-2 ask was "zero missed deadlines at a
+realistic G": this script runs the REAL operator surface —
+``python -m rtap_tpu serve`` with G >= 1024 streams at 1 s cadence for >= 5
+minutes, fed by an external TCP JSONL producer (the reference's
+collector-push shape, SURVEY.md §3.3) — and commits the resulting stats
+(missed deadlines, p50/p90/p99 tick latency, throughput, HBM occupancy) to
+reports/live_soak.json.
+
+The serve child binds an EPHEMERAL port (parsed from its own "listening"
+line) so a previous attempt's orphan can never answer the readiness probe;
+the feeder runs in THIS process as a real network producer, its pushed-tick
+count and any death are recorded in the artifact, and a feeder that died
+mid-soak fails the run (a "zero missed deadlines" line is only evidence if
+data was actually flowing). Values follow the diurnal sine + noise profile
+so the TM keeps learning novel input for the whole soak.
+
+Usage: python scripts/live_soak.py [--streams 1024] [--ticks 330]
+       [--cadence 1.0] [--backend tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FEEDER_DIED_EXIT = 5
+
+
+def log(msg: str) -> None:
+    print(f"[soak] {msg}", file=sys.stderr, flush=True)
+
+
+class Feeder:
+    """Push one record per stream per cadence over one persistent connection.
+
+    Tracks `ticks_pushed` and records any fatal `error` instead of dying
+    silently — the soak artifact must say whether data was actually flowing.
+    """
+
+    def __init__(self, port: int, ids: list[str], cadence_s: float):
+        self.port = port
+        self.ids = ids
+        self.cadence_s = cadence_s
+        self.stop = threading.Event()
+        self.ticks_pushed = 0
+        self.error: str | None = None
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self) -> None:
+        rng = np.random.Generator(np.random.Philox(key=(7, 42)))
+        phase = rng.integers(0, 86400, len(self.ids))
+        try:
+            sock = socket.create_connection(("127.0.0.1", self.port), timeout=5.0)
+            # a paced producer should tolerate serve stalling a few ticks
+            # (device hiccup) without dying; 30 s of backpressure = fatal
+            sock.settimeout(30.0)
+            f = sock.makefile("wb")
+            while not self.stop.is_set():
+                t_start = time.perf_counter()
+                ts = int(time.time())
+                base = 35.0 + 20.0 * np.sin(
+                    2 * np.pi * (self.ticks_pushed + phase) / 86400.0)
+                vals = base + rng.normal(0, 3.0, len(self.ids))
+                lines = [
+                    json.dumps({"id": sid, "value": float(v), "ts": ts})
+                    for sid, v in zip(self.ids, vals)
+                ]
+                f.write(("\n".join(lines) + "\n").encode())
+                f.flush()
+                self.ticks_pushed += 1
+                budget = self.cadence_s - (time.perf_counter() - t_start)
+                if budget > 0:
+                    self.stop.wait(budget)
+            f.close()
+            sock.close()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # serve finished its tick budget and closed the listener
+        except Exception as e:  # noqa: BLE001 — recorded, surfaced, fatal
+            self.error = f"{type(e).__name__}: {e}"
+
+
+def wait_for_listener(proc: subprocess.Popen, stderr_lines: list[str],
+                      deadline_s: float) -> int:
+    """Parse serve's own 'listening for JSONL records on host:port' stderr
+    line -> bound port. Only THIS child's line is trusted (an orphan from a
+    killed earlier attempt can answer a connect-probe; it cannot write to
+    this process's pipe)."""
+    pat = re.compile(r"listening for JSONL records on \S+?:(\d+)")
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for line in stderr_lines:
+            m = pat.search(line)
+            if m:
+                return int(m.group(1))
+        if proc.poll() is not None:
+            sys.stderr.write("".join(stderr_lines))
+            log(f"serve exited early rc={proc.returncode}")
+            # propagate the child's code: the init watchdog's
+            # INIT_WATCHDOG_EXIT must reach hw_watch as-is or a down
+            # tunnel would be misread as a real step failure
+            raise SystemExit(proc.returncode)
+        time.sleep(0.25)
+    raise SystemExit("serve never reported its TCP listener")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=1024)
+    ap.add_argument("--ticks", type=int, default=330)
+    ap.add_argument("--cadence", type=float, default=1.0)
+    ap.add_argument("--backend", default="tpu")
+    ap.add_argument("--startup-timeout", type=float, default=420.0,
+                    help="budget for serve's backend init + first compile")
+    ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
+    args = ap.parse_args()
+
+    ids = [f"node{i // 4:04d}.m{i % 4}" for i in range(args.streams)]
+    alerts_path = os.path.join(REPO, "reports", "live_soak_alerts.jsonl")
+    cmd = [
+        sys.executable, "-m", "rtap_tpu", "serve",
+        "--streams", ",".join(ids),
+        "--port", "0",
+        "--ticks", str(args.ticks),
+        "--cadence", str(args.cadence),
+        "--backend", args.backend,
+        "--alerts", alerts_path,
+    ]
+    log(f"starting serve: G={args.streams} ticks={args.ticks} "
+        f"cadence={args.cadence}s backend={args.backend}")
+    proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    stderr_lines: list[str] = []
+    drain = threading.Thread(
+        target=lambda: stderr_lines.extend(iter(proc.stderr.readline, "")),
+        daemon=True)
+    drain.start()
+
+    feeder = None
+    try:
+        port = wait_for_listener(proc, stderr_lines, args.startup_timeout)
+        feeder = Feeder(port, ids, args.cadence)
+        feeder.thread.start()
+        log(f"feeder attached on port {port}; soaking...")
+        out = proc.stdout.read()  # EOF = serve exited; drain thread owns stderr
+        proc.wait()
+    finally:
+        if feeder is not None:
+            feeder.stop.set()
+            feeder.thread.join(timeout=5)
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    if proc.returncode != 0:
+        sys.stderr.write("".join(stderr_lines))
+        log(f"serve failed rc={proc.returncode}")
+        raise SystemExit(proc.returncode)  # keep INIT_WATCHDOG_EXIT intact
+
+    stats = json.loads(out.strip().splitlines()[-1])
+    n_alert_lines = 0
+    if os.path.exists(alerts_path):
+        with open(alerts_path) as f:
+            n_alert_lines = sum(1 for _ in f)
+        os.remove(alerts_path)  # large; the count is the committed evidence
+    result = {
+        "streams": args.streams, "ticks": args.ticks, "cadence_s": args.cadence,
+        "backend": args.backend, "alert_lines": n_alert_lines,
+        "feeder_ticks_pushed": feeder.ticks_pushed,
+        "feeder_error": feeder.error, **stats,
+    }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result))
+    if feeder.error is not None:
+        log(f"feeder died mid-soak: {feeder.error} — failing the run")
+        return FEEDER_DIED_EXIT
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
