@@ -51,19 +51,26 @@ LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LKG.j
 # workspace temps on top of the 564 KB/stream state). So the ladder brackets
 # the small-G peak and probes longer chunks to amortize per-dispatch
 # overhead. The strategy candidates (all bit-identical to the default kernel
-# — tests/parity/) ride the per-attempt subprocess env: flat layout kills the
-# [.., S, M]-trailing-dim tile padding, indexed scatter + compact sweep cut
-# the full-pool learning/punish/death traffic (ops/tm_tpu.py switch table).
+# — tests/parity/) ride the per-attempt subprocess env. First silicon A/B
+# (2026-07-31, hw_results/): the CPU-drive signal INVERTED on TPU — indexed
+# scatter loses big (18.1k vs matmul 28.1k metrics/s at G=1024) and Pallas
+# loses too (24.3k), while flat layout wins (31.9k). So the ladder races the
+# flat base plus the r4 learning-path cuts (compact punish/death sweep,
+# forward-index dendrite) on TOP of flat/matmul, not the CPU-guess
+# indexed base that round-3 shipped.
+# NOTE: the process default is flat/matmul since the r4 flip, so `{}` IS the
+# flat base; env overrides stay minimal because strat_key (the env tuple) is
+# also the per-strategy OOM-dominance key — a redundant RTAP_TM_LAYOUT=flat
+# would fragment dominance skipping across identical kernels.
 ATTEMPTS: list[tuple[int, int, dict]] = [
     (256, 64, {}),
-    (256, 64, {"RTAP_TM_SCATTER": "indexed", "RTAP_TM_SWEEP": "compact"}),
-    (256, 64, {"RTAP_TM_LAYOUT": "flat", "RTAP_TM_SCATTER": "indexed",
-               "RTAP_TM_SWEEP": "compact"}),
-    (256, 64, {"RTAP_TM_LAYOUT": "flat"}),
+    (256, 64, {"RTAP_TM_LAYOUT": "aos"}),  # r3-default reference rung
+    (256, 64, {"RTAP_TM_SWEEP": "compact"}),
+    (256, 64, {"RTAP_TM_SWEEP": "compact",
+               "RTAP_TM_DENDRITE": "forward", "RTAP_TM_FWD_IMPL": "matmul"}),
     (256, 256, {}),
     (512, 128, {}),
-    (1024, 64, {"RTAP_TM_LAYOUT": "flat", "RTAP_TM_SCATTER": "indexed",
-                "RTAP_TM_SWEEP": "compact"}),
+    (1024, 64, {"RTAP_TM_SWEEP": "compact"}),
     (2048, 64, {}),
 ]
 

@@ -279,6 +279,24 @@ class ModelConfig:
     # When set, value fields use the classic ScalarEncoder instead of the
     # RDSE (same layout position; date bits unchanged). None = RDSE default.
     scalar: ScalarEncoderConfig | None = None
+    # Learning cadence: learn on ticks where tm_iter % learn_every == 0 (or
+    # tm_iter < learn_full_until — the maturity window learns every tick).
+    # 1 = NuPIC-faithful continuous learning (default). The silicon A/B
+    # (SCALING.md round-4) measured the learning pass as ~85% of the fused
+    # step with inference-only at ~155k metrics/s/chip, so thinning mature
+    # streams' learning to every k-th tick is the single-chip throughput
+    # lever; its detection-quality cost is measured, not assumed
+    # (eval/fault_eval.py --learn-every).
+    learn_every: int = 1
+    learn_full_until: int = 0
+
+    def learns_on(self, it):
+        """The cadence predicate, shared by the device schedule
+        (ops/step.py:_tick, traced jnp scalar) and the host twin
+        (HTMModel.run, python int) so the two can never diverge:
+        learn when `it` (completed steps) is inside the full-rate maturity
+        window or on the cadence."""
+        return (it < self.learn_full_until) | (it % self.learn_every == 0)
 
     def __post_init__(self) -> None:
         # A col_cap below the SP winner count would silently truncate the
@@ -317,6 +335,12 @@ class ModelConfig:
                     f"ScalarEncoderConfig needs min_val < max_val; got "
                     f"[{self.scalar.min_val}, {self.scalar.max_val}]"
                 )
+        if self.learn_every < 1:
+            raise ValueError(f"learn_every must be >= 1; got {self.learn_every}")
+        if self.learn_full_until < 0:
+            raise ValueError(
+                f"learn_full_until must be >= 0; got {self.learn_full_until}"
+            )
         if self.sp.columns * self.tm.cells_per_column >= 1 << 24:
             # The kernel round-trips presynaptic cell ids through f32 one-hot
             # matmuls; ids >= 2^24 would lose bits silently.

@@ -323,6 +323,16 @@ def main() -> None:
                     help="override likelihood probation length (the measured "
                          "precision lever: false episodes cluster in the "
                          "post-probation maturity window)")
+    ap.add_argument("--learn-every", type=int, default=1,
+                    help="learning cadence (ModelConfig.learn_every): learn "
+                         "on every k-th tick after --learn-full-until. The "
+                         "single-chip throughput lever (SCALING.md r4 "
+                         "silicon A/B: learning = ~85%% of the step); this "
+                         "flag measures its detection-quality price")
+    ap.add_argument("--learn-full-until", type=int, default=None,
+                    help="ticks of full-rate learning before the cadence "
+                         "kicks in (default: the likelihood probation "
+                         "length, so maturity and cadence align)")
     ap.add_argument("--out", default=None, help="write the JSON report here")
     args = ap.parse_args()
 
@@ -331,6 +341,12 @@ def main() -> None:
     if args.learning_period is not None:
         lik = dataclasses.replace(lik, learning_period=args.learning_period)
     cfg = dataclasses.replace(base, likelihood=lik)
+    if args.learn_every > 1:
+        full_until = (args.learn_full_until if args.learn_full_until is not None
+                      else lik.learning_period)
+        cfg = dataclasses.replace(
+            cfg, learn_every=args.learn_every, learn_full_until=full_until
+        )
     kinds = ANOMALY_KINDS if args.all_kinds else ("spike", "level_shift", "dropout")
     report = run_fault_eval(
         n_streams=args.streams, length=args.length, kinds=kinds,

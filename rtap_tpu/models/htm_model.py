@@ -110,6 +110,16 @@ class HTMModel:
         """Process one record; returns scores. Mirrors model.run({...})."""
         values = np.atleast_1d(np.asarray(value, np.float32))
 
+        if learn and self.cfg.learn_every > 1:
+            # host-side twin of ops/step.py:_tick's schedule (same clock:
+            # tm_iter = completed steps, checkpointed, advances under
+            # inference; same predicate: cfg.learns_on) so single-stream
+            # runs match grouped device runs record-for-record
+            it = int(self.state["tm_iter"]) if self.backend == "cpu" else int(
+                self._runner.state["tm_iter"]
+            )
+            learn = bool(self.cfg.learns_on(it))
+
         pred = prob = None
         if self.backend == "cpu":
             out = oracle_record_step(

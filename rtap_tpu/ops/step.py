@@ -68,6 +68,29 @@ def fused_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     return from_kernel_layout(state, cfg.tm), out
 
 
+def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
+    """One group tick on KERNEL-layout state, honoring cfg.learn_every.
+
+    With a learning cadence (cfg.learn_every > 1 and learn=True) the
+    learn/infer choice is a `lax.cond` on a SCALAR schedule flag derived
+    from the group's lockstep tick counter (`tm_iter`, which advances under
+    inference too) — the cond must sit OUTSIDE the vmap: a per-stream
+    predicate would lower to select and execute BOTH branches, paying the
+    learning pass it exists to skip. Groups tick in lockstep (registry
+    invariant), so one flag serves all G streams.
+    """
+
+    def step_all(lrn):
+        return lambda ss: jax.vmap(
+            lambda s1, vv, tt: step_impl(s1, vv, tt, cfg, lrn)
+        )(ss, values, ts_unix)
+
+    if not (learn and cfg.learn_every > 1):
+        return step_all(learn)(s)
+    tick = s["tm_iter"].reshape(-1)[0]  # completed steps so far (lockstep)
+    return jax.lax.cond(cfg.learns_on(tick), step_all(True), step_all(False), s)
+
+
 @partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
 def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
     """Stream-group fused step: every state leaf carries a leading G axis;
@@ -78,9 +101,7 @@ def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     """
     from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
 
-    state, out = jax.vmap(lambda s, v, t: step_impl(s, v, t, cfg, learn))(
-        to_kernel_layout(state), values, ts_unix
-    )
+    state, out = _tick(to_kernel_layout(state), values, ts_unix, cfg, learn)
     return from_kernel_layout(state, cfg.tm), out
 
 
@@ -97,7 +118,7 @@ def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mod
 
     def body(s, inp):
         v, t = inp
-        return jax.vmap(lambda ss, vv, tt: step_impl(ss, vv, tt, cfg, learn))(s, v, t)
+        return _tick(s, v, t, cfg, learn)
 
     state, out = jax.lax.scan(body, to_kernel_layout(state), (values, ts_unix))
     return from_kernel_layout(state, cfg.tm), out

@@ -108,9 +108,20 @@ _ENV_NAMES = {
     "dendrite": "RTAP_TM_DENDRITE",
     "fwd_impl": "RTAP_TM_FWD_IMPL",
 }
+# Defaults are the measured silicon winners (SCALING.md round-4 A/B,
+# hw_results/ 2026-07-31): flat layout beat aos by 13% on the full
+# learning step (31.9k vs 28.1k metrics/s at G=1024) and matmul scatter
+# beat indexed by 1.55x — the reverse of the CPU-drive signal.
+_MODE_DEFAULTS = {
+    "scatter": "matmul",
+    "layout": "flat",
+    "sweep": "dense",
+    "dendrite": "scan",
+    "fwd_impl": "scatter",
+}
 # start-of-process env snapshot (read once; see block comment above)
 _MODES: dict[str, str] = {
-    k: _os.environ.get(env, _MODE_CHOICES[k][0]) for k, env in _ENV_NAMES.items()
+    k: _os.environ.get(env, _MODE_DEFAULTS[k]) for k, env in _ENV_NAMES.items()
 }
 for _k, _v in _MODES.items():
     if _v not in _MODE_CHOICES[_k]:

@@ -33,45 +33,72 @@ def log(msg: str) -> None:
     print(f"[hw_session] {msg}", file=sys.stderr, flush=True)
 
 
-STEPS: list[tuple[str, list[str]]] = [
+# entries are (name, cmd) or (name, cmd, budget_s)
+STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     ("layout_probe", [sys.executable, "scripts/layout_probe.py"]),
+    # every step pins --layout: the process default flipped to flat with the
+    # r4 A/B, so an omitted flag would silently re-measure (and on a rerun
+    # OVERWRITE the committed evidence logs of) a different config than the
+    # step's name claims
     ("profile_matmul", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                        "--gs", "1024"]),
+                        "--gs", "1024", "--layout", "aos"]),
     ("profile_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                         "--gs", "1024", "--scatter", "indexed"]),
+                         "--gs", "1024", "--layout", "aos",
+                         "--scatter", "indexed"]),
     ("profile_pallas", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                        "--gs", "1024", "--pallas"]),
+                        "--gs", "1024", "--layout", "aos", "--pallas"]),
     ("profile_f32_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                             "--gs", "1024", "--perm-bits", "0",
-                             "--scatter", "indexed"]),
+                             "--gs", "1024", "--layout", "aos",
+                             "--perm-bits", "0", "--scatter", "indexed"]),
     ("profile_flat", [sys.executable, "scripts/profile_step.py", "--T", "32",
                       "--gs", "1024", "--layout", "flat"]),
     ("profile_flat_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
                               "--gs", "1024", "--layout", "flat",
                               "--scatter", "indexed"]),
     # round-4 strategies: compact punish/death sweep; forward-index dendrite
-    # (both fwd histogram impls); the stacked best-guess candidate
-    ("profile_sweep_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                               "--gs", "1024", "--scatter", "indexed",
-                               "--sweep", "compact"]),
+    # (both fwd histogram impls). The first silicon batch (2026-07-31,
+    # hw_results/profile_{matmul,indexed,flat,...}.log) measured the CPU
+    # "indexed wins 2.4x" signal INVERTED on TPU (indexed 18.1k vs matmul
+    # 28.1k vs flat/matmul 31.9k metrics/s at G=1024), so the r4 candidates
+    # are raced on the silicon winner's base (matmul scatter, aos + flat)
+    # rather than the CPU-guess base (--scatter indexed) they shipped with.
+    # layouts explicit everywhere: the process default flipped to flat with
+    # the r4 A/B, and an omitted --layout would silently duplicate configs
+    ("profile_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                         "--gs", "1024", "--layout", "aos",
+                         "--sweep", "compact"]),
+    ("profile_flat_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                              "--gs", "1024", "--layout", "flat",
+                              "--sweep", "compact"]),
     ("profile_fwd_scatter", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                             "--gs", "1024", "--scatter", "indexed",
-                             "--dendrite", "forward"]),
+                             "--gs", "1024", "--layout", "flat",
+                             "--dendrite", "forward", "--fwd-impl", "scatter"]),
     ("profile_fwd_matmul", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                            "--gs", "1024", "--scatter", "indexed",
+                            "--gs", "1024", "--layout", "flat",
                             "--dendrite", "forward", "--fwd-impl", "matmul"]),
-    ("profile_fwd_flat", [sys.executable, "scripts/profile_step.py", "--T", "32",
+    ("profile_fwd_aos", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                         "--gs", "1024", "--layout", "aos",
+                         "--dendrite", "forward", "--fwd-impl", "matmul"]),
+    # learning cadence (r4 feature): learning measured ~85% of the step, so
+    # learn-every-k projects ~79k/s (k=4) to ~104k/s (k=8); verify the cond
+    # actually skips the learning pass on silicon (a select would not)
+    ("profile_cadence4", [sys.executable, "scripts/profile_step.py", "--T", "32",
                           "--gs", "1024", "--layout", "flat",
-                          "--scatter", "indexed", "--dendrite", "forward"]),
-    ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
-    ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
-    ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),
-    # bench subprocess-isolates its own attempts under BENCH_BUDGET_S=1500;
-    # the step budget must exceed that or the runner would SIGKILL it before
-    # its own SIGTERM-emit path can print the result line
+                          "--learn-every", "4"]),
+    ("profile_cadence8", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                          "--gs", "1024", "--layout", "flat",
+                          "--learn-every", "8"]),
+    # bench early: the headline artifact must not starve behind experiments
+    # if the tunnel window closes (r3 lesson — the whole agenda died queued)
     ("bench", [sys.executable, "bench.py"], 1700.0),
+    ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),
+    ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
+    ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
     # round-4 service-shape experiments (verdict weak #3 / #7); the soak is
-    # startup (up to ~300 s compile) + a >= 5 min paced loop by design
+    # startup (up to ~300 s compile) + a >= 5 min paced loop by design.
+    # bench above subprocess-isolates its own attempts under
+    # BENCH_BUDGET_S=1500; its step budget must exceed that or the runner
+    # would SIGKILL it before its own SIGTERM-emit path can print the line.
     ("multigroup", [sys.executable, "scripts/multigroup_sched.py"], 1200.0),
     ("live_soak", [sys.executable, "scripts/live_soak.py"], 1500.0),
 ]
@@ -82,47 +109,74 @@ def step_budget(step: tuple, default: float) -> float:
     return step[2] if len(step) > 2 else default
 
 
+def pick_steps(spec: str | None) -> list[tuple]:
+    """Resolve a --steps '1,5,7' spec (1-based) against STEPS, loudly."""
+    if not spec:
+        return STEPS
+    picked = []
+    for tok in spec.split(","):
+        i = int(tok)
+        if not 1 <= i <= len(STEPS):
+            raise SystemExit(
+                f"--steps: {i} out of range (steps are 1..{len(STEPS)})"
+            )
+        picked.append(STEPS[i - 1])
+    return picked
+
+
+def run_step(name: str, cmd: list[str], budget: float) -> int:
+    """One step attempt; stdout+stderr -> hw_results/<name>.log (overwrite).
+
+    The step runs in its own session and a timeout kills the whole process
+    GROUP: steps spawn grandchildren (`python -m rtap_tpu serve`, bench's
+    attempt subprocesses) that must not outlive the timeout holding the TPU
+    (and, historically, a fixed TCP port). Shared by hw_watch.py — kill
+    semantics must not diverge between the one-shot and harvest runners."""
+    import signal
+
+    path = os.path.join(OUT, f"{name}.log")
+    with open(path, "w") as f:
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        try:
+            return proc.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
+            return -1
+
+
+def log_tail(name: str, limit: int = 140) -> str:
+    """Last nonempty line of a step's log, for one-line verdicts."""
+    try:
+        lines = [l.strip() for l in
+                 open(os.path.join(OUT, f"{name}.log")).read().splitlines()
+                 if l.strip()]
+        return lines[-1][:limit] if lines else ""
+    except OSError:
+        return ""
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--budget-per-step", type=float, default=600.0)
     ap.add_argument("--steps", default=None,
                     help="comma-separated 1-based step numbers (default all)")
     args = ap.parse_args()
-    picked = (
-        [STEPS[int(i) - 1] for i in args.steps.split(",")] if args.steps else STEPS
-    )
+    picked = pick_steps(args.steps)
 
     os.makedirs(OUT, exist_ok=True)
     for step in picked:
         name, cmd = step[0], step[1]
         budget = max(step_budget(step, args.budget_per_step), args.budget_per_step)
-        path = os.path.join(OUT, f"{name}.log")
         log(f"step {name}: {' '.join(cmd[1:])} (budget {budget:.0f}s)")
         t0 = time.monotonic()
-        with open(path, "w") as f:
-            # own session + group kill: steps spawn grandchildren (serve,
-            # bench attempts) that must not outlive a timeout holding the TPU
-            proc = subprocess.Popen(cmd, cwd=REPO, stdout=f,
-                                    stderr=subprocess.STDOUT, start_new_session=True)
-            try:
-                rc = proc.wait(timeout=budget)
-            except subprocess.TimeoutExpired:
-                import signal
-
-                try:
-                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    proc.kill()
-                proc.wait()
-                rc = -1
+        rc = run_step(name, cmd, budget)
         dt = time.monotonic() - t0
-        tail = ""
-        try:
-            lines = [l.strip() for l in open(path).read().splitlines() if l.strip()]
-            tail = lines[-1][:140] if lines else ""
-        except OSError:
-            pass
-        log(f"step {name}: rc={rc} in {dt:.0f}s — {tail}")
+        log(f"step {name}: rc={rc} in {dt:.0f}s — {log_tail(name)}")
 
 
 if __name__ == "__main__":

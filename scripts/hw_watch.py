@@ -29,12 +29,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from hw_session import OUT, REPO, STEPS, step_budget  # noqa: E402
+from hw_session import (  # noqa: E402
+    OUT, REPO, STEPS, log_tail, pick_steps, run_step, step_budget,
+)
 
 sys.path.insert(0, REPO)
 from rtap_tpu.utils.platform import INIT_WATCHDOG_EXIT as INIT_FAIL_RC  # noqa: E402
@@ -71,30 +72,6 @@ def _status(ledger: dict, current: str | None, tunnel_up: bool | None) -> None:
     })
 
 
-def run_step(name: str, cmd: list[str], budget: float) -> int:
-    """One attempt; stdout+stderr -> hw_results/<name>.log (overwrite).
-
-    The step runs in its own session and a timeout kills the whole process
-    GROUP: steps like live_soak spawn grandchildren (`python -m rtap_tpu
-    serve`) that would otherwise survive the kill holding the TPU (and,
-    historically, a fixed TCP port) into every later attempt."""
-    import signal
-
-    path = os.path.join(OUT, f"{name}.log")
-    with open(path, "w") as f:
-        proc = subprocess.Popen(cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
-                                start_new_session=True)
-        try:
-            return proc.wait(timeout=budget)
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                proc.kill()
-            proc.wait()
-            return -1
-
-
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--wall-budget", type=float, default=36000.0)
@@ -104,9 +81,7 @@ def main() -> int:
     ap.add_argument("--steps", default=None,
                     help="comma-separated 1-based step numbers (default all)")
     args = ap.parse_args()
-    picked = (
-        [STEPS[int(i) - 1] for i in args.steps.split(",")] if args.steps else STEPS
-    )
+    picked = pick_steps(args.steps)
 
     os.makedirs(OUT, exist_ok=True)
     ledger = _load(DONE)
@@ -116,11 +91,17 @@ def main() -> int:
     }
     tunnel_up: bool | None = None
 
+    def is_done(s: tuple) -> bool:
+        """rc==0 counts only if the ledgered cmd matches the CURRENT cmd:
+        a step edited between runs (same name, new flags) must re-run, or
+        the old log would masquerade as evidence for the new config."""
+        e = ledger.get(s[0], {})
+        return e.get("rc") == 0 and e.get("cmd", s[1][1:]) == s[1][1:]
+
     while time.monotonic() - t_start < args.wall_budget:
         pending = [
             s for s in picked
-            if ledger.get(s[0], {}).get("rc") != 0
-            and not ledger.get(s[0], {}).get("gave_up")
+            if not is_done(s) and not ledger.get(s[0], {}).get("gave_up")
         ]
         if not pending:
             log("agenda complete")
@@ -140,17 +121,10 @@ def main() -> int:
             # only attempts that actually reached the backend count toward
             # the give-up limit (a down-tunnel must never park the agenda)
             attempts[name] = attempts.get(name, 0) + 1
-        tail = ""
-        try:
-            lines = [l.strip() for l in
-                     open(os.path.join(OUT, f"{name}.log")).read().splitlines()
-                     if l.strip()]
-            tail = lines[-1][:140] if lines else ""
-        except OSError:
-            pass
-        log(f"step {name}: rc={rc} in {dt:.0f}s — {tail}")
+        log(f"step {name}: rc={rc} in {dt:.0f}s — {log_tail(name)}")
         entry = {"rc": rc, "wall_s": round(dt, 1), "attempts": attempts.get(name, 0),
-                 "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+                 "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 "cmd": cmd[1:]}  # argv sans interpreter: the is_done() key
         if rc == 0:
             tunnel_up = True
             ledger[name] = entry

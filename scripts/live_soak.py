@@ -33,7 +33,6 @@ import sys
 import threading
 import time
 
-import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FEEDER_DIED_EXIT = 5
@@ -60,8 +59,9 @@ class Feeder:
         self.thread = threading.Thread(target=self._run, daemon=True)
 
     def _run(self) -> None:
-        rng = np.random.Generator(np.random.Philox(key=(7, 42)))
-        phase = rng.integers(0, 86400, len(self.ids))
+        from rtap_tpu.utils.measure import make_sine_feed
+
+        phase = None  # first chunk draws it; passed back for continuity
         try:
             sock = socket.create_connection(("127.0.0.1", self.port), timeout=5.0)
             # a paced producer should tolerate serve stalling a few ticks
@@ -71,12 +71,17 @@ class Feeder:
             while not self.stop.is_set():
                 t_start = time.perf_counter()
                 ts = int(time.time())
-                base = 35.0 + 20.0 * np.sin(
-                    2 * np.pi * (self.ticks_pushed + phase) / 86400.0)
-                vals = base + rng.normal(0, 3.0, len(self.ids))
+                # the same diurnal profile every other experiment feeds;
+                # per-tick key = fresh noise (make_sine_feed reseeds per
+                # call — the multigroup/measure chunk idiom), phase threads
+                # stream continuity
+                chunk, _, phase = make_sine_feed(
+                    len(self.ids), 1, key=(7, 42 + self.ticks_pushed),
+                    t0=self.ticks_pushed, phase=phase,
+                )
                 lines = [
                     json.dumps({"id": sid, "value": float(v), "ts": ts})
-                    for sid, v in zip(self.ids, vals)
+                    for sid, v in zip(self.ids, chunk[0])
                 ]
                 f.write(("\n".join(lines) + "\n").encode())
                 f.flush()
@@ -185,6 +190,15 @@ def main() -> int:
     print(json.dumps(result))
     if feeder.error is not None:
         log(f"feeder died mid-soak: {feeder.error} — failing the run")
+        return FEEDER_DIED_EXIT
+    if feeder.ticks_pushed < args.ticks - 2:
+        # BrokenPipe is normal at the END (serve closes after its tick
+        # budget); a connection drop mid-soak leaves error=None but a tick
+        # shortfall — a "zero missed deadlines" line without data flowing
+        # is not evidence (2 ticks of slack: the final tick can race
+        # serve's close)
+        log(f"feeder pushed only {feeder.ticks_pushed}/{args.ticks} ticks "
+            f"— connection dropped mid-soak; failing the run")
         return FEEDER_DIED_EXIT
     return 0
 

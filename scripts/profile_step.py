@@ -138,6 +138,10 @@ def main():
     ap.add_argument("--fwd-impl", choices=("scatter", "matmul"), default=None,
                     help="forward-index histogram accumulation: native "
                          "scatter-add vs factored one-hot MXU contraction")
+    ap.add_argument("--learn-every", type=int, default=1,
+                    help="learning cadence (ModelConfig.learn_every) with "
+                         "learn_full_until=0: measures the cadenced steady "
+                         "state (the lax.cond schedule in ops/step.py)")
     ap.add_argument("--fanout-cap", type=int, default=None,
                     help="forward-index row width F (default: 384 under "
                          "--dendrite forward — the measured diurnal-workload "
@@ -187,6 +191,14 @@ def main():
         F = args.fanout_cap or 384
         cfg = dataclasses.replace(cfg, tm=dataclasses.replace(cfg.tm, fanout_cap=F))
         log(f"forward-index fanout cap: {F}")
+    if args.learn_every > 1:
+        import dataclasses
+
+        # learn_full_until=0: cadence applies from tick 0 so the measured
+        # steady state is the cadenced one (quality study owns the maturity
+        # window; this is a pure throughput probe)
+        cfg = dataclasses.replace(cfg, learn_every=args.learn_every)
+        log(f"learning cadence: every {args.learn_every} ticks")
     T = args.T
     log(f"platform: {jax.devices()[0].platform} {jax.devices()[0].device_kind} "
         f"(perm_bits={args.perm_bits})")

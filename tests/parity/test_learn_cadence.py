@@ -1,0 +1,157 @@
+"""Learning-cadence (cfg.learn_every) parity and semantics.
+
+The cadence schedule exists because the round-4 silicon A/B measured the
+learning pass as ~85% of the fused step (SCALING.md): mature streams learn
+every k-th tick instead of every tick. These tests pin:
+
+1. the device schedule (a scalar `lax.cond` in ops/step.py:_tick, clocked
+   by the checkpointed `tm_iter`) bit-identical to the oracle stepped with
+   the SAME explicit learn/infer flag sequence;
+2. the chunked path == the per-tick path (the cond composes with scan);
+3. the host-side twin in HTMModel.run (both backends) == the device group
+   schedule, so single-stream and grouped execution agree record-for-record;
+4. learn_every=1 is exactly the old always-learn behavior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rtap_tpu.config import ModelConfig, RDSEConfig, DateConfig, SPConfig, TMConfig
+from rtap_tpu.models.htm_model import HTMModel, oracle_record_step
+from rtap_tpu.models.oracle.temporal_memory import TMOracle
+from rtap_tpu.models.state import init_state
+from rtap_tpu.ops.step import chunk_step, group_step, replicate_state
+
+exact_only = pytest.mark.skipif(
+    jax.devices()[0].platform != "cpu",
+    reason="bit-exact parity is asserted on the CPU test backend only",
+)
+
+
+def cadence_cfg(learn_every=4, learn_full_until=20) -> ModelConfig:
+    return ModelConfig(
+        rdse=RDSEConfig(size=128, active_bits=11, resolution=0.7),
+        date=DateConfig(time_of_day_width=7, time_of_day_size=18, weekend_width=3),
+        sp=SPConfig(columns=256, num_active_columns=10),
+        tm=TMConfig(cells_per_column=8, activation_threshold=6, min_threshold=4,
+                    max_segments_per_cell=4, max_synapses_per_segment=16,
+                    new_synapse_count=8, learn_cap=48),
+        learn_every=learn_every, learn_full_until=learn_full_until,
+    )
+
+
+def expected_flags(n, cfg):
+    """The schedule ops/step.py derives from tm_iter (= completed steps)."""
+    return [
+        i < cfg.learn_full_until or i % cfg.learn_every == 0 for i in range(n)
+    ]
+
+
+def make_vals(n, G, seed=3):
+    rng = np.random.Generator(np.random.Philox(key=(seed, 2)))
+    t = np.arange(n)[:, None]
+    base = 40 + 15 * np.sin(2 * np.pi * (t + 7 * np.arange(G)[None, :]) / 60.0)
+    v = (base + rng.normal(0, 2.0, (n, G))).astype(np.float32)
+    v[n // 2] += 30.0
+    return v
+
+
+@exact_only
+def test_cadence_device_matches_oracle_with_explicit_flags():
+    """group_step under cfg.learn_every == oracle fed the same flag sequence."""
+    cfg = cadence_cfg()
+    G, n = 3, 90
+    gstate = jax.device_put(replicate_state(init_state(cfg, seed=5), G))
+    oracles = []
+    for _ in range(G):
+        st = init_state(cfg, seed=5)
+        oracles.append((st, TMOracle(st, cfg.tm)))
+    vals = make_vals(n, G)
+    flags = expected_flags(n, cfg)
+
+    for i in range(n):
+        ts = np.full(G, 1_700_000_000 + i, np.int32)
+        gstate, graw = group_step(
+            gstate, jnp.asarray(vals[i][:, None]), jnp.asarray(ts), cfg, learn=True
+        )
+        for g in range(G):
+            st, tm = oracles[g]
+            raw = oracle_record_step(
+                cfg, st, tm, vals[i, g : g + 1], int(ts[g]), flags[i]
+            )
+            assert float(raw) == float(graw[g]), f"step {i} stream {g}"
+
+    dev = jax.device_get(gstate)
+    for k in ("perm", "presyn", "syn_perm", "seg_last", "prev_active",
+              "prev_winner", "boost", "enc_offset"):
+        for g in range(G):
+            np.testing.assert_array_equal(
+                np.asarray(dev[k][g]), np.asarray(oracles[g][0][k]),
+                err_msg=f"{k} stream {g}",
+            )
+
+
+@exact_only
+def test_cadence_chunked_matches_per_tick():
+    """chunk_step's scanned cond == per-tick group_step, same schedule."""
+    cfg = cadence_cfg(learn_every=3, learn_full_until=10)
+    G, T, chunks = 2, 16, 3
+    s_tick = jax.device_put(replicate_state(init_state(cfg, seed=8), G))
+    s_chunk = jax.device_put(replicate_state(init_state(cfg, seed=8), G))
+    vals = make_vals(T * chunks, G, seed=9)
+
+    raws_tick = []
+    for i in range(T * chunks):
+        ts = np.full(G, 1_700_000_000 + i, np.int32)
+        s_tick, raw = group_step(
+            s_tick, jnp.asarray(vals[i][:, None]), jnp.asarray(ts), cfg
+        )
+        raws_tick.append(np.asarray(raw))
+    raws_chunk = []
+    for c in range(chunks):
+        v = jnp.asarray(vals[c * T : (c + 1) * T][:, :, None])
+        ts = jnp.asarray(
+            1_700_000_000 + np.arange(c * T, (c + 1) * T)[:, None]
+            + np.zeros((1, G)), jnp.int32
+        )
+        s_chunk, raw = chunk_step(s_chunk, v, ts, cfg)
+        raws_chunk.append(np.asarray(raw))
+    np.testing.assert_array_equal(
+        np.stack(raws_tick), np.concatenate(raws_chunk).reshape(-1, G)
+    )
+    a, b = jax.device_get(s_tick), jax.device_get(s_chunk)
+    for k in ("presyn", "syn_perm", "perm", "tm_iter"):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+@exact_only
+def test_cadence_htm_model_both_backends():
+    """HTMModel.run's host-side schedule == the device schedule, cpu == tpu."""
+    cfg = cadence_cfg(learn_every=5, learn_full_until=8)
+    cpu = HTMModel(cfg, seed=3, backend="cpu")
+    tpu = HTMModel(cfg, seed=3, backend="tpu")
+    vals = make_vals(60, 1)
+    for i in range(60):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
+@exact_only
+def test_learn_every_one_is_always_learn():
+    """Default cadence is bit-identical to the pre-cadence always-learn path."""
+    base = cadence_cfg(learn_every=1, learn_full_until=0)
+    G, n = 2, 40
+    s_a = jax.device_put(replicate_state(init_state(base, seed=4), G))
+    s_b = jax.device_put(replicate_state(init_state(base, seed=4), G))
+    vals = make_vals(n, G, seed=5)
+    for i in range(n):
+        ts = np.full(G, 1_700_000_000 + i, np.int32)
+        s_a, raw_a = group_step(s_a, jnp.asarray(vals[i][:, None]), jnp.asarray(ts), base)
+        # learn=True static path (cadence disabled) is the exact old code path
+        s_b, raw_b = group_step(
+            s_b, jnp.asarray(vals[i][:, None]), jnp.asarray(ts), base, learn=True
+        )
+        np.testing.assert_array_equal(np.asarray(raw_a), np.asarray(raw_b))

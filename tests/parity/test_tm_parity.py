@@ -17,7 +17,7 @@ import pytest
 
 from rtap_tpu.config import TMConfig
 from rtap_tpu.models.oracle.temporal_memory import TMOracle
-from rtap_tpu.ops.tm_tpu import tm_step
+from rtap_tpu.ops.tm_tpu import from_kernel_layout, tm_step, to_kernel_layout
 
 TM_KEYS = (
     "presyn", "syn_perm", "seg_last", "active_seg", "matching_seg",
@@ -53,7 +53,10 @@ def _assert_state_equal(host, dev, step):
 
 def _run_parity(C, cfg, sequences, learn=True):
     host = _init_tm_state(C, cfg)
-    dev = {k: jnp.asarray(v) for k, v in copy.deepcopy(host).items()}
+    # the kernel runs whatever layout is the process default (flat since the
+    # r4 silicon A/B); the public [C, K, S, M] layout crosses the boundary
+    # via the same reshape adapters ops/step.py uses
+    dev = to_kernel_layout({k: jnp.asarray(v) for k, v in copy.deepcopy(host).items()})
     oracle = TMOracle(host, cfg)
     for step, cols in enumerate(sequences):
         active = np.zeros(C, bool)
@@ -61,7 +64,7 @@ def _run_parity(C, cfg, sequences, learn=True):
         raw_host = oracle.compute(active, learn=learn)
         dev, raw_dev = tm_step(dev, jnp.asarray(active), cfg, learn=learn)
         assert abs(raw_host - float(raw_dev)) < 1e-6, f"raw score step {step}"
-        _assert_state_equal(host, dev, step)
+        _assert_state_equal(host, from_kernel_layout(dev, cfg), step)
 
 
 def _pattern(rng, C, n_active):
@@ -107,6 +110,30 @@ def test_tm_parity_random_stream_with_eviction():
     rng = np.random.default_rng(23)
     seq = [_pattern(rng, C, 4) for _ in range(120)]
     _run_parity(C, cfg, seq)
+
+
+@pytest.mark.parametrize("layout", ["aos", "flat"])
+def test_tm_parity_explicit_layouts(layout):
+    """Full state parity under BOTH kernel layouts, explicitly pinned.
+
+    The other tests run the process default (flat since the r4 silicon
+    A/B); aos is still shipped and raced as the hardware reference rung
+    (bench.py ladder), so a full-state regression in the aos path must
+    not ride on the classifier test's raw-score check alone."""
+    from rtap_tpu.ops import tm_tpu
+
+    C, cfg = 32, TMConfig(
+        cells_per_column=4, activation_threshold=2, min_threshold=1,
+        max_segments_per_cell=2, max_synapses_per_segment=6,
+        new_synapse_count=4, learn_cap=32,
+    )
+    rng = np.random.default_rng(29)
+    seq = [_pattern(rng, C, 4) for _ in range(60)]
+    tm_tpu.set_layout_mode(layout)
+    try:
+        _run_parity(C, cfg, seq)
+    finally:
+        tm_tpu.set_layout_mode(None)
 
 
 def test_tm_parity_punishment_path():
