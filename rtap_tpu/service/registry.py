@@ -100,6 +100,14 @@ class StreamGroup:
     def _raw_cpu(self, values: np.ndarray, ts: np.ndarray, learn: bool = True):
         from rtap_tpu.models.htm_model import oracle_record_step
 
+        if learn and self.cfg.learn_every > 1:
+            # host twin of the device schedule (ops/step.py:_tick): same
+            # clock (tm_iter = completed steps, lockstep across the group),
+            # same predicate (cfg.learns_on) — without this the CPU backend
+            # would silently ignore the learning cadence and backends would
+            # diverge (caught by the r4 cadence quality sweep coming back
+            # bit-identical across k)
+            learn = bool(self.cfg.learns_on(int(self._states[0]["tm_iter"])))
         raw = np.empty(self.G, np.float32)
         pred = np.empty(self.G, np.float32) if self._classifiers else None
         for g in range(self.G):

@@ -86,22 +86,30 @@ def main() -> int:
     os.makedirs(OUT, exist_ok=True)
     ledger = _load(DONE)
     t_start = time.monotonic()
+    # attempt counts carry over ONLY for entries recorded under the step's
+    # current cmd — a redefined step is a new experiment with a fresh budget
+    cmd_by_name = {s[0]: s[1][1:] for s in picked}
     attempts: dict[str, int] = {
         k: v.get("attempts", 0) for k, v in ledger.items()
+        if v.get("cmd") == cmd_by_name.get(k)
     }
     tunnel_up: bool | None = None
 
-    def is_done(s: tuple) -> bool:
-        """rc==0 counts only if the ledgered cmd matches the CURRENT cmd:
-        a step edited between runs (same name, new flags) must re-run, or
-        the old log would masquerade as evidence for the new config."""
+    def entry_for(s: tuple) -> dict:
+        """The ledger entry, ONLY if it was recorded for the CURRENT cmd.
+
+        A step edited between runs (same name, new flags) must re-run —
+        whether it previously succeeded (the old log would masquerade as
+        evidence for the new config) or gave up (a parked old experiment
+        must not park its replacement). Entries without a recorded cmd
+        (pre-cmd-ledger runs) are likewise no evidence."""
         e = ledger.get(s[0], {})
-        return e.get("rc") == 0 and e.get("cmd", s[1][1:]) == s[1][1:]
+        return e if e.get("cmd") == s[1][1:] else {}
 
     while time.monotonic() - t_start < args.wall_budget:
         pending = [
             s for s in picked
-            if not is_done(s) and not ledger.get(s[0], {}).get("gave_up")
+            if entry_for(s).get("rc") != 0 and not entry_for(s).get("gave_up")
         ]
         if not pending:
             log("agenda complete")

@@ -140,6 +140,36 @@ def test_cadence_htm_model_both_backends():
 
 
 @exact_only
+def test_cadence_registry_cpu_matches_tpu_backend():
+    """StreamGroupRegistry honors the cadence on BOTH backends identically.
+
+    Regression pin for the r4 bug where the registry's CPU oracle path
+    passed the raw learn flag through (no schedule) while the device path
+    applied it — the cadence quality sweep came back bit-identical across
+    k because the cpu-backend eval never thinned learning at all."""
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    cfg = cadence_cfg(learn_every=4, learn_full_until=12)
+    G, n = 4, 60
+    ids = [f"s{i}" for i in range(G)]
+    reg_cpu = StreamGroupRegistry(cfg, group_size=G, backend="cpu")
+    reg_tpu = StreamGroupRegistry(cfg, group_size=G, backend="tpu")
+    for r in (reg_cpu, reg_tpu):
+        for sid in ids:
+            r.add_stream(sid)
+        r.finalize()
+    vals = make_vals(n, G, seed=13)
+    for i in range(n):
+        ts = 1_700_000_000 + i
+        for gc, gt in zip(reg_cpu.groups, reg_tpu.groups):
+            a = gc.tick(vals[i], ts)
+            b = gt.tick(vals[i], ts)
+            np.testing.assert_array_equal(
+                np.asarray(a.raw), np.asarray(b.raw), err_msg=f"tick {i}"
+            )
+
+
+@exact_only
 def test_learn_every_one_is_always_learn():
     """Default cadence is bit-identical to the pre-cadence always-learn path."""
     base = cadence_cfg(learn_every=1, learn_full_until=0)
