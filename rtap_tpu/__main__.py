@@ -29,6 +29,14 @@ import sys
 from rtap_tpu.utils.platform import maybe_force_cpu
 
 
+def _apply_cadence(cfg, args: argparse.Namespace):
+    """ModelConfig.learn_every from the operator flag (SCALING.md
+    "Learning-cadence operating curve"). Delegates to
+    ModelConfig.with_learn_every — the shared policy — so an invalid k
+    (0, negative) fails loudly instead of silently running full-rate."""
+    return cfg.with_learn_every(getattr(args, "learn_every", 1))
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     from rtap_tpu.config import cluster_preset, nab_preset
     from rtap_tpu.service.loop import live_loop
@@ -40,6 +48,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("serve: --streams must name at least one stream id", file=sys.stderr)
         return 2
     cfg = nab_preset() if args.preset == "nab" else cluster_preset()
+    cfg = _apply_cadence(cfg, args)
     grp = StreamGroup(cfg, ids, backend=args.backend, threshold=args.threshold,
                       debounce=args.debounce)
     if args.http:
@@ -75,7 +84,8 @@ def _cmd_replay(args: argparse.Namespace) -> int:
                                  anomaly_magnitude=args.magnitude,
                                  noise_phi=0.97, noise_scale=0.5)
     streams = generate_cluster(args.nodes, cfg=scfg, seed=args.seed)
-    res = replay_streams(streams, cluster_preset(), backend=args.backend,
+    res = replay_streams(streams, _apply_cadence(cluster_preset(), args),
+                         backend=args.backend,
                          group_size=args.group_size, chunk_ticks=args.chunk_ticks,
                          threshold=args.threshold, alert_path=args.alerts,
                          checkpoint_dir=args.checkpoint_dir,
@@ -108,6 +118,8 @@ def _cmd_eval(args: argparse.Namespace) -> int:
             "--debounce", str(args.debounce), "--likelihood", args.likelihood]
     if args.learning_period is not None:
         argv += ["--learning-period", str(args.learning_period)]
+    if args.learn_every != 1:
+        argv += ["--learn-every", str(args.learn_every)]
     if args.all_kinds:
         argv.append("--all-kinds")
     if args.out:
@@ -151,6 +163,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="alert only after this many consecutive ticks at/"
                         "above threshold (reports/quality_study.json)")
     p.add_argument("--alerts", default=None, help="JSONL alert sink path")
+    p.add_argument("--learn-every", type=int, default=1,
+                   help="learning cadence: learn every k-th tick after the "
+                        "probation window (SCALING.md operating curve; "
+                        "k=1 = full-rate production default)")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("replay", help="synthetic cluster replay at full speed")
@@ -173,6 +189,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--debounce", type=int, default=2,
                    help="alert only after this many consecutive ticks at/"
                         "above threshold")
+    p.add_argument("--learn-every", type=int, default=1,
+                   help="learning cadence: learn every k-th tick after the "
+                        "probation window (SCALING.md operating curve; "
+                        "k=1 = full-rate production default)")
     p.set_defaults(fn=_cmd_replay)
 
     p = sub.add_parser("eval", help="fault-injection evaluation -> JSON report")
@@ -189,6 +209,10 @@ def main(argv: list[str] | None = None) -> int:
                         "fault_eval.json), window the comparison study")
     p.add_argument("--learning-period", type=int, default=None,
                    help="override the likelihood probation length in ticks")
+    p.add_argument("--learn-every", type=int, default=1,
+                   help="learning cadence: learn every k-th tick after the "
+                        "probation window (SCALING.md operating curve; "
+                        "k=1 = full-rate production default)")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_eval)
 

@@ -298,6 +298,20 @@ class ModelConfig:
         window or on the cadence."""
         return (it < self.learn_full_until) | (it % self.learn_every == 0)
 
+    def with_learn_every(self, k: int, full_until: int | None = None) -> "ModelConfig":
+        """Cadence config with the standard maturity alignment: full-rate
+        learning until the likelihood probation ends (or an explicit
+        `full_until`). The single policy shared by the operator CLI and
+        the fault eval so quality numbers always describe the config the
+        service runs. Invalid k (< 1) fails loudly via validation."""
+        if k == 1 and full_until is None:
+            return self
+        return dataclasses.replace(
+            self, learn_every=k,
+            learn_full_until=(self.likelihood.learning_period
+                              if full_until is None else full_until),
+        )
+
     def __post_init__(self) -> None:
         # A col_cap below the SP winner count would silently truncate the
         # kernel's column-compact active set and corrupt dendrite counts (the
@@ -405,6 +419,9 @@ class ModelConfig:
                 if d.get("scalar") is not None
                 else None
             ),
+            # pre-cadence checkpoints default to full-rate learning
+            learn_every=d.get("learn_every", 1),
+            learn_full_until=d.get("learn_full_until", 0),
         )
 
     @classmethod

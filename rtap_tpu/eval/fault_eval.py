@@ -341,12 +341,10 @@ def main() -> None:
     if args.learning_period is not None:
         lik = dataclasses.replace(lik, learning_period=args.learning_period)
     cfg = dataclasses.replace(base, likelihood=lik)
-    if args.learn_every > 1:
-        full_until = (args.learn_full_until if args.learn_full_until is not None
-                      else lik.learning_period)
-        cfg = dataclasses.replace(
-            cfg, learn_every=args.learn_every, learn_full_until=full_until
-        )
+    if args.learn_every != 1 or args.learn_full_until is not None:
+        # shared policy with the operator CLI (ModelConfig.with_learn_every):
+        # invalid k fails loudly; default maturity = likelihood probation
+        cfg = cfg.with_learn_every(args.learn_every, args.learn_full_until)
     kinds = ANOMALY_KINDS if args.all_kinds else ("spike", "level_shift", "dropout")
     report = run_fault_eval(
         n_streams=args.streams, length=args.length, kinds=kinds,

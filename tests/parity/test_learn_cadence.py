@@ -170,6 +170,29 @@ def test_cadence_registry_cpu_matches_tpu_backend():
 
 
 @exact_only
+def test_cadence_survives_save_load(tmp_path):
+    """Resume mid-schedule continues the cadence phase (tm_iter is the
+    clock and is checkpointed): save at a tick that is NOT a multiple of
+    k, reload, and the continued run must match an uninterrupted one
+    record-for-record."""
+    cfg = cadence_cfg(learn_every=4, learn_full_until=8)
+    vals = make_vals(50, 1, seed=21)
+    a = HTMModel(cfg, seed=9, backend="cpu")
+    b = HTMModel(cfg, seed=9, backend="cpu")
+    cut = 22  # 22 % 4 != 0: mid-phase
+    for i in range(cut):
+        a.run(1_700_000_000 + i, float(vals[i, 0]))
+        b.run(1_700_000_000 + i, float(vals[i, 0]))
+    p = str(tmp_path / "cadence_model")
+    b.save(p)
+    b2 = HTMModel.load(p, backend="cpu")
+    for i in range(cut, 50):
+        ra = a.run(1_700_000_000 + i, float(vals[i, 0]))
+        rb = b2.run(1_700_000_000 + i, float(vals[i, 0]))
+        assert ra.raw_score == pytest.approx(rb.raw_score, abs=0.0), f"step {i}"
+
+
+@exact_only
 def test_learn_every_one_is_always_learn():
     """Default cadence is bit-identical to the pre-cadence always-learn path."""
     base = cadence_cfg(learn_every=1, learn_full_until=0)
