@@ -164,9 +164,9 @@ def main(argv: list[str] | None = None) -> int:
                         "above threshold (reports/quality_study.json)")
     p.add_argument("--alerts", default=None, help="JSONL alert sink path")
     p.add_argument("--learn-every", type=int, default=1,
-                   help="learning cadence: learn every k-th tick after the "
-                        "probation window (SCALING.md operating curve; "
-                        "k=1 = full-rate production default)")
+                   help="learning cadence: learn every k-th tick once the "
+                        "likelihood learning_period has passed (SCALING.md "
+                        "operating curve; k=1 = full-rate default)")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("replay", help="synthetic cluster replay at full speed")
@@ -190,9 +190,9 @@ def main(argv: list[str] | None = None) -> int:
                    help="alert only after this many consecutive ticks at/"
                         "above threshold")
     p.add_argument("--learn-every", type=int, default=1,
-                   help="learning cadence: learn every k-th tick after the "
-                        "probation window (SCALING.md operating curve; "
-                        "k=1 = full-rate production default)")
+                   help="learning cadence: learn every k-th tick once the "
+                        "likelihood learning_period has passed (SCALING.md "
+                        "operating curve; k=1 = full-rate default)")
     p.set_defaults(fn=_cmd_replay)
 
     p = sub.add_parser("eval", help="fault-injection evaluation -> JSON report")
@@ -210,9 +210,9 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--learning-period", type=int, default=None,
                    help="override the likelihood probation length in ticks")
     p.add_argument("--learn-every", type=int, default=1,
-                   help="learning cadence: learn every k-th tick after the "
-                        "probation window (SCALING.md operating curve; "
-                        "k=1 = full-rate production default)")
+                   help="learning cadence: learn every k-th tick once the "
+                        "likelihood learning_period has passed (SCALING.md "
+                        "operating curve; k=1 = full-rate default)")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_eval)
 

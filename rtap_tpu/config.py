@@ -300,8 +300,12 @@ class ModelConfig:
 
     def with_learn_every(self, k: int, full_until: int | None = None) -> "ModelConfig":
         """Cadence config with the standard maturity alignment: full-rate
-        learning until the likelihood probation ends (or an explicit
-        `full_until`). The single policy shared by the operator CLI and
+        learning for the likelihood learning_period (or an explicit
+        `full_until`; note this is the Gaussian-fit window, NOT the full
+        probation — probation additionally spans estimation_samples ticks
+        during which the likelihood is still pinned at 0.5 but learning
+        already thins; the measured cadence curve in SCALING.md used
+        exactly this boundary). The single policy shared by the operator CLI and
         the fault eval so quality numbers always describe the config the
         service runs. Invalid k (< 1) fails loudly via validation."""
         if k == 1 and full_until is None:
