@@ -331,8 +331,8 @@ def main() -> None:
                          "flag measures its detection-quality price")
     ap.add_argument("--learn-full-until", type=int, default=None,
                     help="ticks of full-rate learning before the cadence "
-                         "kicks in (default: the likelihood probation "
-                         "length, so maturity and cadence align)")
+                         "kicks in (default: the likelihood "
+                         "learning_period, the Gaussian-fit window)")
     ap.add_argument("--out", default=None, help="write the JSON report here")
     args = ap.parse_args()
 
@@ -343,7 +343,7 @@ def main() -> None:
     cfg = dataclasses.replace(base, likelihood=lik)
     if args.learn_every != 1 or args.learn_full_until is not None:
         # shared policy with the operator CLI (ModelConfig.with_learn_every):
-        # invalid k fails loudly; default maturity = likelihood probation
+        # invalid k fails loudly; default full-rate window = learning_period
         cfg = cfg.with_learn_every(args.learn_every, args.learn_full_until)
     kinds = ANOMALY_KINDS if args.all_kinds else ("spike", "level_shift", "dropout")
     report = run_fault_eval(

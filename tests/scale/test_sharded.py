@@ -45,6 +45,28 @@ def test_sharded_matches_single_device():
     assert len(leaf.sharding.device_set) == 8
 
 
+def test_sharded_cadence_matches_single_device():
+    """Learning cadence under shard_map: the schedule cond reads each
+    shard's own tm_iter slice (lockstep across shards by construction), so
+    sharded and single-device execution must stay bit-identical with
+    learn_every set. Pins the r4 cadence feature on the production
+    multi-chip path."""
+    import dataclasses
+
+    cfg = dataclasses.replace(cluster_preset(), learn_every=3, learn_full_until=10)
+    G, T = 16, 30
+    ids = [f"s{i}" for i in range(G)]
+    mesh = make_stream_mesh(8)
+    plain = StreamGroup(cfg, ids, backend="tpu")
+    sharded = StreamGroup(cfg, ids, backend="tpu", mesh=mesh)
+    vals = _vals(T, G, seed=9)
+    ts = (1_700_000_000 + np.arange(T)[:, None] + np.zeros((1, G))).astype(np.int64)
+    r_p, ll_p, _ = plain.run_chunk(vals, ts)
+    r_s, ll_s, _ = sharded.run_chunk(vals, ts)
+    np.testing.assert_array_equal(r_p, r_s)
+    np.testing.assert_array_equal(ll_p, ll_s)
+
+
 def test_hot_loop_is_collective_free():
     """No cross-chip communication in the compiled sharded step — the whole
     point of the stream-axis design (SURVEY.md §2.3). Plain jit over sharded
