@@ -72,6 +72,30 @@ def _status(ledger: dict, current: str | None, tunnel_up: bool | None) -> None:
     })
 
 
+def ledger_entry_for(step: tuple, ledger: dict) -> dict:
+    """The ledger entry for a step, ONLY if it was recorded for the step's
+    CURRENT cmd (argv sans interpreter).
+
+    A step edited between runs (same name, new flags) must re-run —
+    whether it previously succeeded (the old log would masquerade as
+    evidence for the new config) or gave up (a parked old experiment must
+    not park its replacement). Entries without a recorded cmd
+    (pre-cmd-ledger runs) are likewise no evidence."""
+    e = ledger.get(step[0], {})
+    return e if e.get("cmd") == step[1][1:] else {}
+
+
+def pending_steps(picked: list[tuple], ledger: dict) -> list[tuple]:
+    """Steps still owed a run: not completed-for-this-cmd, not given-up-
+    for-this-cmd. Unit-tested (tests/unit/test_hw_watch_logic.py) — this
+    decision gates which hardware evidence the round presents."""
+    return [
+        s for s in picked
+        if ledger_entry_for(s, ledger).get("rc") != 0
+        and not ledger_entry_for(s, ledger).get("gave_up")
+    ]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--wall-budget", type=float, default=36000.0)
@@ -88,29 +112,13 @@ def main() -> int:
     t_start = time.monotonic()
     # attempt counts carry over ONLY for entries recorded under the step's
     # current cmd — a redefined step is a new experiment with a fresh budget
-    cmd_by_name = {s[0]: s[1][1:] for s in picked}
     attempts: dict[str, int] = {
-        k: v.get("attempts", 0) for k, v in ledger.items()
-        if v.get("cmd") == cmd_by_name.get(k)
+        s[0]: ledger_entry_for(s, ledger).get("attempts", 0) for s in picked
     }
     tunnel_up: bool | None = None
 
-    def entry_for(s: tuple) -> dict:
-        """The ledger entry, ONLY if it was recorded for the CURRENT cmd.
-
-        A step edited between runs (same name, new flags) must re-run —
-        whether it previously succeeded (the old log would masquerade as
-        evidence for the new config) or gave up (a parked old experiment
-        must not park its replacement). Entries without a recorded cmd
-        (pre-cmd-ledger runs) are likewise no evidence."""
-        e = ledger.get(s[0], {})
-        return e if e.get("cmd") == s[1][1:] else {}
-
     while time.monotonic() - t_start < args.wall_budget:
-        pending = [
-            s for s in picked
-            if entry_for(s).get("rc") != 0 and not entry_for(s).get("gave_up")
-        ]
+        pending = pending_steps(picked, ledger)
         if not pending:
             log("agenda complete")
             _status(ledger, None, tunnel_up)

@@ -1,0 +1,71 @@
+"""Unit tests for the tunnel-harvester ledger decisions (scripts/hw_watch.py).
+
+The done.json ledger gates which hardware measurements the round presents
+as evidence, across oscillating-tunnel retries AND agenda edits between
+runs — the same test-the-measurement-machinery practice as
+tests/unit/test_bench_logic.py. Pure logic; no subprocess, no backend.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", "scripts"))
+from hw_watch import ledger_entry_for, pending_steps  # noqa: E402
+from hw_session import pick_steps, step_budget, STEPS  # noqa: E402
+
+S_A = ("probe", ["python", "scripts/probe.py"])
+S_B = ("bench", ["python", "bench.py", "--x"], 1700.0)
+
+
+def test_fresh_ledger_everything_pending():
+    assert pending_steps([S_A, S_B], {}) == [S_A, S_B]
+
+
+def test_completed_step_with_matching_cmd_not_pending():
+    ledger = {"probe": {"rc": 0, "cmd": ["scripts/probe.py"]}}
+    assert pending_steps([S_A, S_B], ledger) == [S_B]
+
+
+def test_completed_step_with_changed_cmd_reruns():
+    """A step redefined between runs (same name, new flags) must re-run;
+    the old success is no evidence for the new config."""
+    ledger = {"probe": {"rc": 0, "cmd": ["scripts/probe.py", "--old-flag"]}}
+    assert pending_steps([S_A], ledger) == [S_A]
+    assert ledger_entry_for(S_A, ledger) == {}
+
+
+def test_legacy_entry_without_cmd_reruns():
+    """Pre-cmd-ledger entries (no "cmd" key) are likewise no evidence."""
+    ledger = {"probe": {"rc": 0}}
+    assert pending_steps([S_A], ledger) == [S_A]
+
+
+def test_gave_up_parks_only_the_same_cmd():
+    """A step that exhausted attempts under OLD flags must not park its
+    redefined replacement."""
+    parked_same = {"probe": {"rc": -1, "gave_up": True, "cmd": ["scripts/probe.py"]}}
+    assert pending_steps([S_A], parked_same) == []
+    parked_old = {"probe": {"rc": -1, "gave_up": True,
+                            "cmd": ["scripts/probe.py", "--old"]}}
+    assert pending_steps([S_A], parked_old) == [S_A]
+
+
+def test_failed_but_not_gave_up_stays_pending():
+    ledger = {"probe": {"rc": 113, "cmd": ["scripts/probe.py"]}}
+    assert pending_steps([S_A], ledger) == [S_A]
+
+
+def test_step_budget_default_and_override():
+    assert step_budget(S_A, 700.0) == 700.0
+    assert step_budget(S_B, 700.0) == 1700.0
+
+
+def test_pick_steps_validates_range():
+    assert pick_steps(None) == STEPS
+    assert pick_steps("1") == [STEPS[0]]
+    with pytest.raises(SystemExit):
+        pick_steps("0")
+    with pytest.raises(SystemExit):
+        pick_steps(str(len(STEPS) + 1))
