@@ -62,37 +62,46 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     # 28.1k vs flat/matmul 31.9k metrics/s at G=1024), so the r4 candidates
     # are raced on the silicon winner's base (matmul scatter, aos + flat)
     # rather than the CPU-guess base (--scatter indexed) they shipped with.
-    # layouts explicit everywhere: the process default flipped to flat with
-    # the r4 A/B, and an omitted --layout would silently duplicate configs
-    ("profile_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                         "--gs", "1024", "--layout", "aos",
-                         "--sweep", "compact"]),
-    ("profile_flat_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                              "--gs", "1024", "--layout", "flat",
-                              "--sweep", "compact"]),
-    ("profile_fwd_scatter", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                             "--gs", "1024", "--layout", "flat",
-                             "--dendrite", "forward", "--fwd-impl", "scatter"]),
-    ("profile_fwd_matmul", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                            "--gs", "1024", "--layout", "flat",
-                            "--dendrite", "forward", "--fwd-impl", "matmul"]),
-    ("profile_fwd_aos", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                         "--gs", "1024", "--layout", "aos",
-                         "--dendrite", "forward", "--fwd-impl", "matmul"]),
-    # learning cadence (r4 feature): learning measured ~85% of the step, so
-    # learn-every-k projects ~79k/s (k=4) to ~104k/s (k=8); verify the cond
-    # actually skips the learning pass on silicon (a select would not)
+    # Most-valuable-first for a SHORT window (the tunnel has been wedged
+    # for 7h as of this ordering; assume every window may be the last):
+    # 1. bench — the headline artifact, and its ladder already races the
+    #    main candidates (flat / aos / flat+compact / flat+compact+forward)
+    #    at the measured-optimal rung, so it partially subsumes the
+    #    individual profiles;
+    # 2. nab_corpus — the committed-artifact verdict item (minutes on
+    #    silicon; the CPU fallback measured 7 s/tick and was abandoned);
+    # 3. cadence profiles — validate the 100k-projection (plain chunk_step
+    #    compiles, low hang risk);
+    # 4. the compact/fwd profile matrix (the indexed+compact variant hung
+    #    compile for its full 900 s budget once — keep these behind the
+    #    high-value steps);
+    # 5. sweeps and service-shape experiments.
+    # Layouts explicit everywhere: the process default flipped to flat with
+    # the r4 A/B, and an omitted --layout would silently duplicate configs.
+    ("bench", [sys.executable, "bench.py"], 1700.0),
+    ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
     ("profile_cadence4", [sys.executable, "scripts/profile_step.py", "--T", "32",
                           "--gs", "1024", "--layout", "flat",
                           "--learn-every", "4"]),
     ("profile_cadence8", [sys.executable, "scripts/profile_step.py", "--T", "32",
                           "--gs", "1024", "--layout", "flat",
                           "--learn-every", "8"]),
-    # bench early: the headline artifact must not starve behind experiments
-    # if the tunnel window closes (r3 lesson — the whole agenda died queued)
-    ("bench", [sys.executable, "bench.py"], 1700.0),
+    ("profile_flat_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                              "--gs", "1024", "--layout", "flat",
+                              "--sweep", "compact"]),
+    ("profile_compact", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                         "--gs", "1024", "--layout", "aos",
+                         "--sweep", "compact"]),
+    ("profile_fwd_matmul", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                            "--gs", "1024", "--layout", "flat",
+                            "--dendrite", "forward", "--fwd-impl", "matmul"]),
+    ("profile_fwd_scatter", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                             "--gs", "1024", "--layout", "flat",
+                             "--dendrite", "forward", "--fwd-impl", "scatter"]),
+    ("profile_fwd_aos", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                         "--gs", "1024", "--layout", "aos",
+                         "--dendrite", "forward", "--fwd-impl", "matmul"]),
     ("scaling_sweep", [sys.executable, "scripts/scaling_law.py"]),
-    ("nab_corpus", [sys.executable, "scripts/nab_standin_report.py"]),
     ("pipeline_gain", [sys.executable, "scripts/pipeline_gain.py"]),
     # round-4 service-shape experiments (verdict weak #3 / #7); the soak is
     # startup (up to ~300 s compile) + a >= 5 min paced loop by design.
