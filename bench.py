@@ -219,14 +219,28 @@ def _store_lkg(best: dict) -> None:
         log(f"bench: could not store last-known-good: {e}")
 
 
-def _finish(best: dict | None) -> None:
+def _finish(best: dict | None, tunnel_down: bool = False) -> None:
     """Single exit point: persist a fresh result, emit the line (fresh or
     LKG fallback), exit with the emit code (0 fresh / CACHED_EXIT cached /
     1 nothing). Shared by the signal handler and every abort path so their
-    semantics can never drift."""
+    semantics can never drift.
+
+    `tunnel_down=True` (init-failure-streak abort with nothing fresh
+    measured): exit INIT_WATCHDOG_EXIT instead of CACHED_EXIT so harness
+    loops (scripts/hw_watch.py) read the run as a tunnel-down probe — a
+    cached emission caused by a wedged tunnel must not consume retry
+    budget and park the bench step for the round. The stdout JSON still
+    carries "cached": true either way."""
     if best is not None:
         _store_lkg(best)
     code = emit(best)
+    if tunnel_down and best is None:
+        # regardless of whether an LKG line could be emitted (code is
+        # CACHED_EXIT or None): the run produced nothing because the
+        # tunnel was down, and the harness must see exactly that
+        from rtap_tpu.utils.platform import INIT_WATCHDOG_EXIT
+
+        sys.exit(INIT_WATCHDOG_EXIT)
     sys.exit(1 if code is None else code)
 
 
@@ -294,7 +308,7 @@ def main() -> None:
                     # tunnel is hanging, and every further attempt would burn
                     # its full budget the same way — stop the ladder
                     log("bench: backend init hang detected, aborting attempts")
-                    _finish(best)
+                    _finish(best, tunnel_down=True)
                 break  # a timeout is not transient; don't retry, move on
             finally:
                 current_proc[0] = None
@@ -334,18 +348,15 @@ def main() -> None:
                 init_fail_streak += 1
                 if init_fail_streak >= 2:
                     log("bench: backend init failure persisted, aborting attempts")
-                    _finish(best)
+                    _finish(best, tunnel_down=True)
             transient = proc.returncode != 0 and attempt == 0
             log(f"  G={group_size}: attempt failed rc={proc.returncode}"
                 + (", retrying once" if transient else ""))
             if not transient:
                 break
-    if best is not None:
-        _store_lkg(best)
-    code = emit(best)
-    if code is None:
-        raise SystemExit("all bench configurations failed and no last-known-good exists")
-    sys.exit(code)
+    if best is None:
+        log("bench: all configurations failed and no fresh result exists")
+    _finish(best)  # single exit point — semantics shared with every abort path
 
 
 if __name__ == "__main__":

@@ -3,16 +3,16 @@
 The TPU tunnel oscillates (SCALING.md): it can be reachable for minutes and
 then hang backend init for an hour. When it IS up, this script spends the
 window optimally — every step is a subprocess with its own wall budget (a
-hang costs one step, not the session), ordered most-valuable-first:
-
-1. component ablation profile (where does the tick go?)         [matmul]
-2. the same under --scatter indexed  (workspace-movement A/B)
-3. the same under --pallas           (fused dendrite-kernel A/B)
-4. scaling_law G-sweep               (fills SCALING.md's table)
-5. bench.py                          (the headline number)
+hang costs one step, not the session), ordered most-valuable-first. The
+authoritative agenda and its ordering rationale live in the STEPS list
+below (the r3 strategy matrix already measured sits first and is ledgered
+done; bench + nab_corpus lead the remaining r4 agenda — see the comment
+above them). --steps indices are positions in STEPS as printed by --help,
+NOT a stable step id: always check the list after edits.
 
 Logs land in hw_results/<step>.log; a one-line verdict per step prints to
-stderr as it completes. Re-runs skip nothing (fresh measurements overwrite).
+stderr as it completes. Re-runs skip nothing here (fresh measurements
+overwrite); the ledgered harvest loop is scripts/hw_watch.py.
 
 Usage:  python scripts/hw_session.py [--budget-per-step 600] [--steps 1,2,5]
 """

@@ -91,3 +91,34 @@ def test_oom_dominance_skip_logic():
         if g >= oom_at[0] and t >= oom_at[1]
     ]
     assert skipped == [(4096, 64), (2048, 128), (2048, 64)]
+
+
+def test_finish_tunnel_down_exits_init_watchdog(tmp_path, monkeypatch, capsys):
+    """A wedged-tunnel abort with nothing fresh measured must exit
+    INIT_WATCHDOG_EXIT (not CACHED_EXIT): harness loops key their retry
+    budgets on that code, and a dead tunnel must never consume bench's
+    attempts and park the round's headline step (hw_watch.py ledger)."""
+    import pytest
+
+    from rtap_tpu.utils.platform import INIT_WATCHDOG_EXIT
+
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    b = load_bench(tmp_path, monkeypatch, {"value": 38956.1, "measured_at": "x"})
+    with pytest.raises(SystemExit) as e:
+        b._finish(None, tunnel_down=True)
+    assert e.value.code == INIT_WATCHDOG_EXIT
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["cached"] is True  # the emission line survives
+
+
+def test_finish_tunnel_down_with_fresh_best_is_still_fresh(tmp_path, monkeypatch, capsys):
+    """If the tunnel died mid-ladder AFTER a fresh measurement landed, the
+    run IS a fresh result: exit 0, store LKG, no cached flag."""
+    import pytest
+
+    b = load_bench(tmp_path, monkeypatch, None)
+    with pytest.raises(SystemExit) as e:
+        b._finish({"value": 42.0}, tunnel_down=True)
+    assert e.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert "cached" not in out and out["value"] == 42.0
