@@ -91,10 +91,17 @@ def init_backend_or_die(timeout_s: float = 120.0) -> None:
     done.set()
 
 
+def force_cpu_requested(env_var: str = "RTAP_FORCE_CPU") -> bool:
+    """One parser for the force-CPU env convention (""/"0" falsy, anything
+    else truthy). Artifact writers (e.g. the live-soak `forced_cpu` field)
+    must agree with :func:`maybe_force_cpu` about what counts as forced."""
+    return os.environ.get(env_var, "") not in ("", "0")
+
+
 def maybe_force_cpu(env_var: str = "RTAP_FORCE_CPU") -> bool:
     """If ``$RTAP_FORCE_CPU`` is truthy, pin jax to the CPU platform (must be
     called before any jax backend use). Returns whether CPU was forced."""
-    if os.environ.get(env_var, "") not in ("", "0"):
+    if force_cpu_requested(env_var):
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -35,6 +35,12 @@ import time
 
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# the feeder imports rtap_tpu in THIS process; running as `python
+# scripts/live_soak.py` puts scripts/ (not the repo) at sys.path[0]
+sys.path.insert(0, REPO)
+
+from rtap_tpu.utils.platform import force_cpu_requested  # noqa: E402
+
 FEEDER_DIED_EXIT = 5
 
 
@@ -59,10 +65,13 @@ class Feeder:
         self.thread = threading.Thread(target=self._run, daemon=True)
 
     def _run(self) -> None:
-        from rtap_tpu.utils.measure import make_sine_feed
-
         phase = None  # first chunk draws it; passed back for continuity
         try:
+            # inside the try: an import failure (the exact class of bug the
+            # sys.path fix above addresses) must land in self.error, not
+            # kill the thread silently and read as a connection drop
+            from rtap_tpu.utils.measure import make_sine_feed
+
             sock = socket.create_connection(("127.0.0.1", self.port), timeout=5.0)
             # a paced producer should tolerate serve stalling a few ticks
             # (device hiccup) without dying; 30 s of backpressure = fatal
@@ -181,7 +190,12 @@ def main() -> int:
         os.remove(alerts_path)  # large; the count is the committed evidence
     result = {
         "streams": args.streams, "ticks": args.ticks, "cadence_s": args.cadence,
-        "backend": args.backend, "alert_lines": n_alert_lines,
+        "backend": args.backend,
+        # an honest artifact must say WHERE the group path actually ran:
+        # backend="tpu" under RTAP_FORCE_CPU=1 is the JAX group kernels on
+        # the CPU platform (the tunnel-down fallback), not the chip
+        "forced_cpu": force_cpu_requested(),
+        "alert_lines": n_alert_lines,
         "feeder_ticks_pushed": feeder.ticks_pushed,
         "feeder_error": feeder.error, **stats,
     }
