@@ -1,0 +1,138 @@
+"""Native host-runtime pieces (C, loaded via ctypes — no pybind11 in this
+environment). Currently: the JSONL metrics-ingest parser (SURVEY.md C18).
+
+The shared library is compiled on demand from the adjacent .c source with
+the system compiler into ``_build/`` (atomic rename, so concurrent
+processes can race the build safely) and cached until the source changes.
+Callers must treat ImportError/OSError from :func:`load` as "native path
+unavailable" and fall back to pure Python — the service must run (slower)
+on hosts without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "jsonl_parser.c")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD_DIR, "jsonl_parser.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+
+
+def _compile() -> None:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["cc", "-O2", "-shared", "-fPIC", "-std=c99", "-o", tmp, _SRC],
+            check=True, capture_output=True, text=True,
+        )
+        os.replace(tmp, _SO)  # atomic: concurrent builders both win
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load() -> ctypes.CDLL:
+    """The parser library, compiling it first if missing or stale.
+    Raises on any failure (no toolchain, compile error) — callers fall
+    back to the pure-Python parser."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _compile()
+        lib = ctypes.CDLL(_SO)
+        lib.rtap_parser_new.restype = ctypes.c_void_p
+        lib.rtap_parser_new.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32]
+        lib.rtap_parser_clone.restype = ctypes.c_void_p
+        lib.rtap_parser_clone.argtypes = [ctypes.c_void_p]
+        lib.rtap_parser_free_clone.restype = None
+        lib.rtap_parser_free_clone.argtypes = [ctypes.c_void_p]
+        lib.rtap_parser_free_owner.restype = None
+        lib.rtap_parser_free_owner.argtypes = [ctypes.c_void_p]
+        f64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.rtap_parser_feed.restype = ctypes.c_int
+        lib.rtap_parser_feed.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, f32p, f64p, f64p]
+        lib.rtap_parser_flush.restype = None
+        lib.rtap_parser_flush.argtypes = [ctypes.c_void_p, f32p, f64p, f64p]
+        _lib = lib
+        return _lib
+
+
+class NativeJsonlState:
+    """Listener-wide native parse state: the id hash table plus the shared
+    output buffers the C code writes into.
+
+    ``latest`` is the caller's float32 [G] array — feed() updates it in
+    place (the caller must never reallocate it). ``counters`` is
+    [parsed, parse_errors, unknown_ids]; ``ts_buf[0]`` is the running ts
+    maximum. One :class:`ConnParser` per connection carries that
+    connection's partial-line remainder; the caller serializes feed()
+    calls across connections with its own lock.
+    """
+
+    def __init__(self, stream_ids: list[str], latest: np.ndarray):
+        if latest.dtype != np.float32 or not latest.flags.c_contiguous:
+            raise ValueError("latest must be a C-contiguous float32 array")
+        self._lib = load()
+        ids = [sid.encode() for sid in stream_ids]
+        blob = b"".join(ids)
+        lens = (ctypes.c_int32 * len(ids))(*[len(b) for b in ids])
+        self._owner = self._lib.rtap_parser_new(blob, lens, len(ids))
+        if not self._owner:
+            raise MemoryError("rtap_parser_new failed")
+        self.latest = latest
+        self.ts_buf = np.zeros(1, np.int64)
+        self.counters = np.zeros(3, np.int64)
+
+    def new_conn(self) -> "ConnParser":
+        return ConnParser(self)
+
+    def __del__(self):
+        owner = getattr(self, "_owner", None)
+        if owner:
+            self._lib.rtap_parser_free_owner(owner)
+            self._owner = None
+
+
+class ConnParser:
+    """Per-connection parser (owns the partial-line remainder)."""
+
+    def __init__(self, state: NativeJsonlState):
+        self._state = state
+        self._h = state._lib.rtap_parser_clone(state._owner)
+        if not self._h:
+            raise MemoryError("rtap_parser_clone failed")
+
+    def feed(self, data: bytes) -> None:
+        st = self._state
+        st._lib.rtap_parser_feed(self._h, data, len(data),
+                                 st.latest, st.ts_buf, st.counters)
+
+    def flush(self) -> None:
+        st = self._state
+        st._lib.rtap_parser_flush(self._h, st.latest, st.ts_buf, st.counters)
+
+    def close(self) -> None:
+        if self._h:
+            self._state._lib.rtap_parser_free_clone(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()

@@ -71,30 +71,60 @@ class TcpJsonlSource:
     malformed producer must not kill the scoring loop).
     """
 
-    def __init__(self, stream_ids: list[str], host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, stream_ids: list[str], host: str = "127.0.0.1", port: int = 0,
+                 native: bool | None = None):
         self.stream_ids = list(stream_ids)
         self._index = {sid: i for i, sid in enumerate(self.stream_ids)}
         self._latest = np.full(len(self.stream_ids), np.nan, np.float32)
         self._latest_ts = 0
         self._lock = threading.Lock()
-        self.parse_errors = 0
-        self.unknown_ids = 0
+        self._py_parse_errors = 0
+        self._py_unknown_ids = 0
+        # Native C parse path (rtap_tpu/native/jsonl_parser.c): the whole
+        # recv-chunk drain in one locked C call instead of per-record
+        # json.loads + dict lookup + lock — the host core feeding 100k
+        # streams cannot afford microseconds per record. native=None
+        # auto-detects (falls back to Python if the toolchain/build is
+        # unavailable); True requires it; False forces pure Python.
+        self._nstate = None
+        if native is not False:
+            try:
+                from rtap_tpu.native import NativeJsonlState
+
+                self._nstate = NativeJsonlState(self.stream_ids, self._latest)
+            except Exception:
+                if native:
+                    raise
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                if outer._nstate is not None:
+                    conn = outer._nstate.new_conn()
+                    try:
+                        while True:
+                            data = self.connection.recv(65536)
+                            if not data:
+                                break
+                            with outer._lock:
+                                conn.feed(data)
+                        with outer._lock:
+                            conn.flush()  # unterminated final line, like rfile
+                    finally:
+                        conn.close()
+                    return
                 for line in self.rfile:
                     try:
                         rec = json.loads(line)
                         i = outer._index.get(rec["id"])
                         if i is None:
-                            outer.unknown_ids += 1
+                            outer._py_unknown_ids += 1
                             continue
                         with outer._lock:
                             outer._latest[i] = np.float32(rec["value"])
                             outer._latest_ts = max(outer._latest_ts, int(rec.get("ts", 0)))
                     except Exception:
-                        outer.parse_errors += 1
+                        outer._py_parse_errors += 1
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -118,6 +148,26 @@ class TcpJsonlSource:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    @property
+    def parse_errors(self) -> int:
+        n = int(self._nstate.counters[1]) if self._nstate is not None else 0
+        return self._py_parse_errors + n
+
+    @property
+    def unknown_ids(self) -> int:
+        n = int(self._nstate.counters[2]) if self._nstate is not None else 0
+        return self._py_unknown_ids + n
+
+    @property
+    def records_parsed(self) -> int | None:
+        """Successful-record count (native path only; the Python handler
+        does not count successes)."""
+        return int(self._nstate.counters[0]) if self._nstate is not None else None
+
+    @property
+    def native_active(self) -> bool:
+        return self._nstate is not None
+
     def __call__(self, tick: int) -> tuple[np.ndarray, int]:
         """Snapshot AND DRAIN: values reset to NaN after each tick, so a
         producer that stops pushing yields missing samples (NaN) rather than
@@ -126,6 +176,8 @@ class TcpJsonlSource:
         with self._lock:
             values = self._latest.copy()
             self._latest[:] = np.nan
+            if self._nstate is not None:
+                self._latest_ts = max(self._latest_ts, int(self._nstate.ts_buf[0]))
             ts = self._latest_ts or int(time.time())
         return values, ts
 

@@ -46,9 +46,12 @@ def main() -> None:
     args = ap.parse_args()
 
     if args.backend == "tpu":
-        from rtap_tpu.utils.platform import init_backend_or_die
+        from rtap_tpu.utils.platform import enable_compile_cache, init_backend_or_die
 
         init_backend_or_die()  # the tunnel oscillates; die fast
+        # the NAB-preset programs are the repo's biggest compiles (65k-cell
+        # TM); a tunnel window must not re-pay them on every attempt
+        enable_compile_cache(REPO)
 
     from rtap_tpu.data.nab_corpus import NabFile, ensure_standin_corpus, load_corpus
     from rtap_tpu.nab.runner import run_corpus

@@ -1,0 +1,201 @@
+"""Native JSONL ingest parser (rtap_tpu/native/jsonl_parser.c) vs the pure
+Python handler: counter-for-counter, value-for-value parity on the realistic
+record space, plus the C-only mechanics (chunk splits, remainder flush,
+oversized-line resync).
+
+The native path exists because the host core feeding the chip at the 100k
+streams/s north star cannot spend microseconds per record in json.loads
+(SURVEY.md C18, §7 host-feed hard part); parity here is what lets the
+service swap it in by default with the Python path as fallback.
+"""
+
+import json
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from rtap_tpu.service.sources import TcpJsonlSource, send_jsonl
+
+try:
+    from rtap_tpu.native import NativeJsonlState
+
+    _err = None
+except Exception as e:  # no toolchain: the fallback story, not a failure
+    NativeJsonlState = None
+    _err = e
+
+needs_native = pytest.mark.skipif(
+    NativeJsonlState is None, reason=f"native build unavailable: {_err}")
+
+IDS = ["node0000.m0", "node0000.m1", "a", "long." * 10 + "id"]
+
+
+def _state(ids=IDS):
+    latest = np.full(len(ids), np.nan, np.float32)
+    st = NativeJsonlState(ids, latest)
+    return st, latest
+
+
+# ------------------------------------------------------------ direct C API
+
+
+@needs_native
+def test_split_chunks_and_flush():
+    st, latest = _state()
+    c = st.new_conn()
+    c.feed(b'{"id": "node0000.m0", "va')
+    c.feed(b'lue": 2.5, "ts": 7}\n{"id": "a", "value"')
+    c.feed(b': -1}\n{"id": "node0000.m1", "value": 9}')  # no trailing \n
+    assert np.isnan(latest[1])  # unterminated: not yet processed
+    c.flush()                   # EOF processes it, like rfile iteration
+    assert latest[0] == np.float32(2.5)
+    assert latest[1] == np.float32(9)
+    assert latest[2] == np.float32(-1)
+    assert st.ts_buf[0] == 7
+    assert list(st.counters) == [3, 0, 0]
+    c.close()
+
+
+@needs_native
+def test_value_and_ts_coercions_match_python():
+    st, latest = _state()
+    c = st.new_conn()
+    # every coercion np.float32/int accept: quoted numbers, bools,
+    # scientific notation, float ts (truncates), quoted ts digits
+    c.feed(b'{"id": "a", "value": "7.25", "ts": 101.9}\n')
+    assert latest[2] == np.float32(7.25) and st.ts_buf[0] == 101
+    c.feed(b'{"id": "a", "value": true, "ts": "144"}\n')
+    assert latest[2] == np.float32(1.0) and st.ts_buf[0] == 144
+    c.feed(b'{"id": "a", "value": -3e2}\n')
+    assert latest[2] == np.float32(-300.0)
+    c.feed(b'{"id": "a", "value": null}\n')  # np.float32(None) raises
+    assert list(st.counters) == [3, 1, 0]
+    # bad ts on a known id still applies the value first (Python assigns
+    # latest[i] before int(ts) can raise)
+    c.feed(b'{"id": "a", "value": 5, "ts": "xx"}\n')
+    assert latest[2] == np.float32(5.0)
+    assert list(st.counters) == [3, 2, 0]
+    # quoted ts goes through int(str): "101.9" and "1e3" raise in Python
+    # (value still applied); hex never parses as a value
+    c.feed(b'{"id": "a", "value": 6, "ts": "101.9"}\n')
+    assert latest[2] == np.float32(6.0)
+    c.feed(b'{"id": "a", "value": 8, "ts": "1e3"}\n')
+    c.feed(b'{"id": "a", "value": "0x10"}\n')  # np.float32("0x10") raises
+    assert list(st.counters) == [3, 5, 0]
+    assert st.ts_buf[0] == 144  # unchanged by the failed conversions
+    c.feed(b'{"id": "a", "value": 7, "ts": " -12 "}\n')  # int(" -12 ") works
+    assert list(st.counters) == [4, 5, 0]
+    c.close()
+
+
+@needs_native
+def test_counter_semantics_match_python_ordering():
+    st, latest = _state()
+    c = st.new_conn()
+    c.feed(b'{"value": 5}\n')            # no id -> rec["id"] KeyError
+    c.feed(b'{"id": "a"}\n')             # known id, no value -> KeyError
+    c.feed(b'{"id": "zzz"}\n')           # unknown id checked BEFORE value
+    c.feed(b'{"id": 5, "value": 1}\n')   # non-string id -> dict.get miss
+    c.feed(b'garbage\n\n')               # malformed + empty line
+    assert list(st.counters) == [0, 4, 2]
+    # unhashable id: Python's dict.get({...}) raises TypeError -> error,
+    # NOT unknown (scalar non-string ids are hashable and count unknown)
+    c.feed(b'{"id": {"x": 1}, "value": 2}\n{"id": [1], "value": 2}\n')
+    assert list(st.counters) == [0, 6, 2]
+    c.close()
+
+
+@needs_native
+def test_oversized_line_resync():
+    st, latest = _state()
+    c = st.new_conn()
+    big = b'{"id": "a", "value": ' + b"9" * 70000  # > MAX_LINE, no newline yet
+    c.feed(big)
+    c.feed(b'999}\n{"id": "a", "value": 3}\n')
+    assert list(st.counters) == [1, 1, 0]  # oversized -> 1 error, then resync
+    assert latest[2] == np.float32(3.0)
+    c.close()
+
+
+@needs_native
+def test_escaped_strings_and_nested_values():
+    st, latest = _state()
+    c = st.new_conn()
+    # escaped quote inside an irrelevant field; nested object skipped
+    c.feed(b'{"note": "q\\"uoted", "id": "a", "meta": {"x": [1, 2]}, "value": 4}\n')
+    assert latest[2] == np.float32(4.0)
+    assert list(st.counters) == [1, 0, 0]
+    c.close()
+
+
+# ----------------------------------------------------- socket-level parity
+
+
+def _drive(native: bool) -> tuple[np.ndarray, int, int, int]:
+    ids = [f"s{i}" for i in range(8)]
+    recs = []
+    rng = np.random.default_rng(7)
+    for k in range(500):
+        recs.append({"id": ids[int(rng.integers(0, 8))],
+                     "value": float(rng.normal()), "ts": 1700000000 + k})
+    recs.insert(50, {"id": "nope", "value": 1.0})            # unknown
+    recs.insert(90, {"id": ids[0], "value": "not-a-number"})  # parse error
+    # in-order sentinel LAST: seeing its value means every record on this
+    # connection was processed — counters alone are satisfied at record ~91
+    # and would let the drain race the rest of the stream
+    recs.append({"id": ids[7], "value": 424242.0, "ts": 1700009999})
+    src = TcpJsonlSource(ids, native=native)
+    with src:
+        assert src.native_active == native
+        send_jsonl(src.address, recs)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with src._lock:
+                if src._latest[7] == np.float32(424242.0):
+                    break
+            time.sleep(0.02)
+        values, ts = src(0)
+    return values, ts, src.parse_errors, src.unknown_ids
+
+
+@needs_native
+def test_socket_parity_native_vs_python():
+    v_n, ts_n, pe_n, unk_n = _drive(native=True)
+    v_p, ts_p, pe_p, unk_p = _drive(native=False)
+    assert np.array_equal(v_n, v_p, equal_nan=True)
+    assert (ts_n, pe_n, unk_n) == (ts_p, pe_p, unk_p) == (ts_p, 1, 1)
+
+
+@needs_native
+def test_multi_connection_and_drain():
+    ids = ["x", "y"]
+    src = TcpJsonlSource(ids, native=True)
+    with src:
+        send_jsonl(src.address, [{"id": "x", "value": 1.0, "ts": 10}])
+        send_jsonl(src.address, [{"id": "y", "value": 2.0, "ts": 12}])
+        deadline = time.time() + 5
+        while time.time() < deadline and src.records_parsed != 2:
+            time.sleep(0.02)
+        assert src.records_parsed == 2
+        values, ts = src(0)
+        assert values[0] == 1.0 and values[1] == 2.0 and ts == 12
+        # drain: next tick with no pushes is all-NaN, ts sticks
+        values2, ts2 = src(1)
+        assert np.isnan(values2).all() and ts2 == 12
+
+
+def test_python_fallback_forced():
+    src = TcpJsonlSource(["x"], native=False)
+    with src:
+        assert not src.native_active
+        send_jsonl(src.address, [{"id": "x", "value": 3.5, "ts": 9}])
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with src._lock:
+                if not np.isnan(src._latest[0]):
+                    break
+            time.sleep(0.02)
+        values, ts = src(0)
+    assert values[0] == np.float32(3.5) and ts == 9
