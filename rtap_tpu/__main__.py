@@ -64,6 +64,13 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           alert_path=args.alerts)
     finally:
         close()
+    # ingest health belongs in the service artifact: a zero-missed-deadline
+    # line is only evidence if data was flowing and parsing cleanly
+    for attr in ("records_parsed", "parse_errors", "unknown_ids",
+                 "native_active", "poll_failures"):
+        v = getattr(source, attr, None)
+        if v is not None:
+            stats[attr] = v
     print(json.dumps(stats))
     return 0
 

@@ -28,6 +28,7 @@
  */
 
 #include <ctype.h>
+#include <math.h>
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
@@ -338,7 +339,10 @@ static void process_line(Parser *p, const char *s, const char *end,
         return;
     }
     double v;
-    if (!f.has_val || token_to_double(f.val, f.val_len, &v) != 0) {
+    if (f.has_val && !f.val_quoted && f.val_len == 4
+            && memcmp(f.val, "null", 4) == 0) {
+        v = NAN;  /* np.float32(None) is nan, not an error */
+    } else if (!f.has_val || token_to_double(f.val, f.val_len, &v) != 0) {
         counters[COUNTER_PARSE_ERRORS]++;   /* rec["value"]/np.float32 raised */
         return;
     }

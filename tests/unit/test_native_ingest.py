@@ -70,23 +70,28 @@ def test_value_and_ts_coercions_match_python():
     assert latest[2] == np.float32(1.0) and st.ts_buf[0] == 144
     c.feed(b'{"id": "a", "value": -3e2}\n')
     assert latest[2] == np.float32(-300.0)
-    c.feed(b'{"id": "a", "value": null}\n')  # np.float32(None) raises
-    assert list(st.counters) == [3, 1, 0]
+    # np.float32(None) is nan, NOT an error: null values are missing samples
+    c.feed(b'{"id": "a", "value": null}\n')
+    assert np.isnan(latest[2])
+    assert list(st.counters) == [4, 0, 0]
+    # ...but np.float32("null") (quoted) raises
+    c.feed(b'{"id": "a", "value": "null"}\n')
+    assert list(st.counters) == [4, 1, 0]
     # bad ts on a known id still applies the value first (Python assigns
     # latest[i] before int(ts) can raise)
     c.feed(b'{"id": "a", "value": 5, "ts": "xx"}\n')
     assert latest[2] == np.float32(5.0)
-    assert list(st.counters) == [3, 2, 0]
+    assert list(st.counters) == [4, 2, 0]
     # quoted ts goes through int(str): "101.9" and "1e3" raise in Python
     # (value still applied); hex never parses as a value
     c.feed(b'{"id": "a", "value": 6, "ts": "101.9"}\n')
     assert latest[2] == np.float32(6.0)
     c.feed(b'{"id": "a", "value": 8, "ts": "1e3"}\n')
     c.feed(b'{"id": "a", "value": "0x10"}\n')  # np.float32("0x10") raises
-    assert list(st.counters) == [3, 5, 0]
+    assert list(st.counters) == [4, 5, 0]
     assert st.ts_buf[0] == 144  # unchanged by the failed conversions
     c.feed(b'{"id": "a", "value": 7, "ts": " -12 "}\n')  # int(" -12 ") works
-    assert list(st.counters) == [4, 5, 0]
+    assert list(st.counters) == [5, 5, 0]
     c.close()
 
 
@@ -184,6 +189,62 @@ def test_multi_connection_and_drain():
         # drain: next tick with no pushes is all-NaN, ts sticks
         values2, ts2 = src(1)
         assert np.isnan(values2).all() and ts2 == 12
+
+
+def _fuzz_records(seed: int, ids: list[str], n: int) -> list[bytes]:
+    """Randomized realistic-space records: shuffled field order, mixed
+    value/ts types (including the coercible and the erroneous), unknown
+    ids, extra fields, whitespace variation, malformed tails."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        r = rng.random()
+        sid = ids[int(rng.integers(0, len(ids)))] if r < 0.85 else "ghost"
+        value = rng.choice([
+            str(float(rng.normal())), '"7.5"', "true", "false", "null",
+            '"nope"', str(int(rng.integers(-100, 100))), "1e3",
+        ])
+        ts = rng.choice([str(int(rng.integers(1, 10**9))), '"123"',
+                         '"9.5"', "55.7", "null"])
+        fields = [f'"id": "{sid}"', f'"value": {value}', f'"ts": {ts}',
+                  '"extra": {"nested": [1, "x"]}']
+        rng.shuffle(fields)
+        sep = ", " if rng.random() < 0.8 else ","
+        line = "{" + sep.join(fields) + "}"
+        if rng.random() < 0.06:
+            line = line[: int(rng.integers(1, len(line)))]  # malformed tail
+        out.append(line.encode())
+    return out
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_socket_parity_fuzz(seed):
+    """Native and Python paths must agree value-for-value and counter-for-
+    counter across the randomized realistic record space — the evidence
+    behind swapping the native parser in by default."""
+    ids = [f"n{i}" for i in range(6)]
+    lines = _fuzz_records(seed, ids, 400)
+    payload = b"\n".join(lines) + b"\n"
+    sentinel = json.dumps({"id": ids[0], "value": 31337.0}).encode() + b"\n"
+    results = []
+    for native in (True, False):
+        src = TcpJsonlSource(ids, native=native)
+        with src:
+            with socket.create_connection(src.address, timeout=5.0) as s:
+                s.sendall(payload + sentinel)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                with src._lock:
+                    if src._latest[0] == np.float32(31337.0):
+                        break
+                time.sleep(0.01)
+            values, ts = src(0)
+        results.append((values, ts, src.parse_errors, src.unknown_ids))
+    (v_n, ts_n, pe_n, unk_n), (v_p, ts_p, pe_p, unk_p) = results
+    assert np.array_equal(v_n, v_p, equal_nan=True)
+    assert (ts_n, pe_n, unk_n) == (ts_p, pe_p, unk_p)
+    assert pe_n > 0 and unk_n > 0  # the fuzz actually exercised both paths
 
 
 def test_python_fallback_forced():
