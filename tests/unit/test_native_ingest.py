@@ -247,6 +247,54 @@ def test_socket_parity_fuzz(seed):
     assert pe_n > 0 and unk_n > 0  # the fuzz actually exercised both paths
 
 
+@needs_native
+def test_concurrent_producers_stress():
+    """Two live connections pushing interleaved records in tiny odd-sized
+    socket writes: per-connection remainder isolation plus the shared
+    output array under the chunk lock. Every stream must end at its
+    producer's final value and no record may be miscounted."""
+    import threading
+
+    G = 32
+    ids = [f"c{i}" for i in range(G)]
+    src = TcpJsonlSource(ids, native=True)
+    n_each = 400
+
+    def produce(half: int):
+        own = ids[half * (G // 2):(half + 1) * (G // 2)]
+        with socket.create_connection(src.address, timeout=5.0) as s:
+            payload = b"".join(
+                json.dumps({"id": own[k % len(own)],
+                            "value": half * 1000.0 + k,
+                            "ts": 1700000000 + k}).encode() + b"\n"
+                for k in range(n_each)
+            )
+            # deliberately awkward write sizes to force mid-record splits
+            for off in range(0, len(payload), 17):
+                s.sendall(payload[off:off + 17])
+
+    with src:
+        threads = [threading.Thread(target=produce, args=(h,)) for h in (0, 1)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        deadline = time.time() + 10
+        while time.time() < deadline and src.records_parsed < 2 * n_each:
+            time.sleep(0.02)
+        assert src.records_parsed == 2 * n_each
+        assert src.parse_errors == 0 and src.unknown_ids == 0
+        values, ts = src(0)
+    # last value per stream: producer h wrote k = i, i+16, ... for its
+    # stream i; the final write for stream i is the largest such k
+    for half in (0, 1):
+        own = list(range(half * (G // 2), (half + 1) * (G // 2)))
+        for j, g in enumerate(own):
+            last_k = max(k for k in range(n_each) if k % len(own) == j)
+            assert values[g] == np.float32(half * 1000.0 + last_k)
+    assert ts == 1700000000 + n_each - 1
+
+
 def test_python_fallback_forced():
     src = TcpJsonlSource(["x"], native=False)
     with src:
