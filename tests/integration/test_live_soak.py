@@ -39,3 +39,8 @@ def test_live_soak_smoke(tmp_path):
     assert art["feeder_error"] is None
     assert art["ticks"] == 4
     assert "missed_deadlines" in art and "latency_p99_ms" in art
+    # serve merges ingest health into its stats line (records_parsed is
+    # present whenever the native parser is active; counters must be clean)
+    assert art["parse_errors"] == 0 and art["unknown_ids"] == 0
+    if art.get("native_active"):
+        assert art["records_parsed"] > 0
