@@ -40,17 +40,27 @@ def _apply_cadence(cfg, args: argparse.Namespace):
 def _cmd_serve(args: argparse.Namespace) -> int:
     from rtap_tpu.config import cluster_preset, nab_preset
     from rtap_tpu.service.loop import live_loop
-    from rtap_tpu.service.registry import StreamGroup
+    from rtap_tpu.service.registry import StreamGroupRegistry
     from rtap_tpu.service.sources import HttpPollSource, TcpJsonlSource
 
     ids = [s.strip() for s in args.streams.split(",") if s.strip()]
     if not ids:
         print("serve: --streams must name at least one stream id", file=sys.stderr)
         return 2
+    if args.group_size < 1:
+        print("serve: --group-size must be >= 1", file=sys.stderr)
+        return 2
     cfg = nab_preset() if args.preset == "nab" else cluster_preset()
     cfg = _apply_cadence(cfg, args)
-    grp = StreamGroup(cfg, ids, backend=args.backend, threshold=args.threshold,
-                      debounce=args.debounce)
+    # many groups per chip is the at-scale serving shape (throughput peaks
+    # at small G — SCALING.md); capping at len(ids) keeps small serves in
+    # one exactly-sized group with no pad slots
+    grp = StreamGroupRegistry(cfg, group_size=min(args.group_size, len(ids)),
+                              backend=args.backend, threshold=args.threshold,
+                              debounce=args.debounce)
+    for sid in ids:
+        grp.add_stream(sid)
+    grp.finalize()
     if args.http:
         source = HttpPollSource(args.http, ids)
         close = lambda: None  # noqa: E731
@@ -165,6 +175,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--cadence", type=float, default=1.0)
     p.add_argument("--preset", choices=("cluster", "nab"), default="cluster")
     p.add_argument("--backend", default="tpu")
+    p.add_argument("--group-size", type=int, default=1024,
+                   help="streams per device group; len(streams) above this "
+                        "serves as multiple interleaved groups per chip "
+                        "(SCALING.md: throughput peaks at small G)")
     p.add_argument("--threshold", type=float, default=0.5)
     p.add_argument("--debounce", type=int, default=2,
                    help="alert only after this many consecutive ticks at/"

@@ -211,27 +211,67 @@ def replay_streams(
 
 def live_loop(
     source: Callable[[int], tuple[np.ndarray, int]],
-    group: StreamGroup,
+    group: StreamGroup | StreamGroupRegistry,
     n_ticks: int,
     cadence_s: float = 1.0,
     alert_path: str | None = None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
-    score the group, emit alerts; sleep off any time left in the cadence
+    score the group(s), emit alerts; sleep off any time left in the cadence
     budget. Returns throughput stats including missed-deadline count — the
-    real-time health signal for the 1s-cadence north star."""
+    real-time health signal for the 1s-cadence north star.
+
+    Accepts a single :class:`StreamGroup` or a finalized
+    :class:`StreamGroupRegistry`. Measured chip throughput PEAKS at small
+    group sizes (SCALING.md bench G-sweep: nothing amortizes with G), so
+    at-scale serving is many groups per chip, not one giant group: with a
+    registry, each tick dispatches EVERY group before collecting ANY
+    (dispatch_chunk/collect_chunk), so the device queue holds all groups'
+    step programs back to back while the host does per-group likelihood —
+    the interleaved schedule of scripts/multigroup_sched.py as the
+    production serve path. `source` values align with the registry's
+    stream registration order (contiguous per-group slices).
+    """
+    if isinstance(group, StreamGroupRegistry):
+        if group._pending:
+            raise ValueError(
+                "live_loop needs a finalized registry (finalize() seals the "
+                f"last group; {len(group._pending)} streams still pending)")
+        groups = list(group.groups)
+    else:
+        groups = [group]
+    lives = [getattr(g, "n_live", g.G) for g in groups]  # pad slots never emit
+    n_expected = sum(lives)
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
     missed = 0
     latencies = np.empty(n_ticks, np.float64)  # per-tick poll->emit seconds
-    live = getattr(group, "n_live", group.G)  # never emit for registry pad slots
     for k in range(n_ticks):
         t_start = time.perf_counter()
         values, ts = source(k)
-        res = group.tick(values, ts)
-        writer.emit_batch(group.stream_ids[:live], np.full(live, ts), values[:live],
-                          res.raw[:live], res.log_likelihood[:live], res.alerts[:live])
-        counter.add(live)
+        values = np.asarray(values, np.float32)
+        if len(values) != n_expected:
+            raise ValueError(
+                f"source returned {len(values)} values for {n_expected} "
+                "live streams (alignment with registration order is load-"
+                "bearing — a silent mismatch would misroute streams)")
+        handles = []
+        off = 0
+        for grp, live in zip(groups, lives):
+            # trailing field axis preserved: values may be [G] or [G, n_fields]
+            v = np.full((grp.G,) + values.shape[1:], np.nan, np.float32)
+            v[:live] = values[off:off + live]
+            off += live
+            handles.append(grp.dispatch_chunk(
+                v[None, :], np.full((1, grp.G), ts, np.int64)))
+        off = 0
+        for grp, live, h in zip(groups, lives, handles):
+            raw, loglik, alerts = grp.collect_chunk(h)  # [1, G] each
+            writer.emit_batch(grp.stream_ids[:live], np.full(live, ts),
+                              values[off:off + live], raw[0, :live],
+                              loglik[0, :live], alerts[0, :live])
+            counter.add(live)
+            off += live
         elapsed = time.perf_counter() - t_start
         latencies[k] = elapsed
         budget = cadence_s - elapsed
@@ -248,7 +288,8 @@ def live_loop(
         }
         lat["latency_max_ms"] = round(float(latencies.max()) * 1e3, 3)
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
-            "ticks": n_ticks, "cadence_s": cadence_s, **lat, **_occupancy()}
+            "ticks": n_ticks, "cadence_s": cadence_s, "n_groups": len(groups),
+            **lat, **_occupancy()}
 
 
 def _overflow_total(groups) -> int | None:

@@ -1,0 +1,120 @@
+"""Multi-group live serving (service/loop.py live_loop over a registry).
+
+Measured chip throughput peaks at small G (SCALING.md bench G-sweep), so
+at-scale serving is many interleaved groups per chip. These tests pin the
+registry path of live_loop: per-group slicing of the source vector, NaN
+padding of the sealed partial group, dispatch-all-then-collect-all
+ordering, alert emission only for live slots — and bit-exact equivalence
+of a registry group against the same streams served as one standalone
+group (same seed, same feed => same final model state).
+"""
+
+import json
+
+import numpy as np
+
+from rtap_tpu.config import cluster_preset
+from rtap_tpu.service.loop import live_loop
+from rtap_tpu.service.registry import StreamGroup, StreamGroupRegistry
+
+G_TOTAL = 6
+GROUP_SIZE = 4  # -> groups of [4 live, 2 live + 2 pad]
+IDS = [f"s{i}" for i in range(G_TOTAL)]
+N_TICKS = 12
+
+
+def _feed(k: int):
+    rng = np.random.Generator(np.random.Philox(key=(11, k)))
+    return (30 + 5 * rng.random(G_TOTAL)).astype(np.float32), 1_700_000_000 + k
+
+
+def _registry():
+    reg = StreamGroupRegistry(cluster_preset(), group_size=GROUP_SIZE,
+                              backend="tpu")
+    for sid in IDS:
+        reg.add_stream(sid)
+    reg.finalize()
+    return reg
+
+
+def test_registry_live_loop_stats_and_alert_hygiene(tmp_path):
+    reg = _registry()
+    assert [g.n_live for g in reg.groups] == [4, 2]
+    path = str(tmp_path / "alerts.jsonl")
+    stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.01,
+                      alert_path=path)
+    assert stats["scored"] == G_TOTAL * N_TICKS  # live slots only, no pads
+    assert stats["n_groups"] == 2
+    assert stats["ticks"] == N_TICKS
+    for line in open(path):
+        rec = json.loads(line)
+        assert not rec["stream"].startswith("__pad")
+
+
+def test_registry_group_bitexact_vs_standalone():
+    """Group 0 of the registry must evolve bit-identically to a standalone
+    StreamGroup over the same 4 streams and feed (same seed, same kernel
+    path): the multi-group schedule may not perturb the model math."""
+    reg = _registry()
+    live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.01)
+
+    solo = StreamGroup(cluster_preset(), IDS[:GROUP_SIZE], backend="tpu")
+    for k in range(N_TICKS):
+        values, ts = _feed(k)
+        solo.run_chunk(values[None, :GROUP_SIZE],
+                       np.full((1, GROUP_SIZE), ts, np.int64))
+
+    a, b = reg.groups[0].state, solo.state
+    assert set(a) == set(b)
+    for key in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[key]), np.asarray(b[key]), err_msg=key)
+
+
+def test_unfinalized_registry_rejected_loudly():
+    import pytest
+
+    reg = StreamGroupRegistry(cluster_preset(), group_size=GROUP_SIZE,
+                              backend="tpu")
+    for sid in IDS:
+        reg.add_stream(sid)  # 6 streams, group_size 4: 2 left pending
+    with pytest.raises(ValueError, match="finalize"):
+        live_loop(_feed, reg, n_ticks=1, cadence_s=0.01)
+
+
+def test_source_length_mismatch_rejected_loudly():
+    import pytest
+
+    reg = _registry()
+    bad = lambda k: (np.zeros(G_TOTAL - 1, np.float32), 1_700_000_000)  # noqa: E731
+    with pytest.raises(ValueError, match="live streams"):
+        live_loop(bad, reg, n_ticks=1, cadence_s=0.01)
+
+
+def test_multifield_source_through_registry():
+    """[G, n_fields] sources (node_preset multivariate) must survive the
+    padding path — StreamGroup.tick always supported them."""
+    from rtap_tpu.config import node_preset
+
+    reg = StreamGroupRegistry(node_preset(n_metrics=2), group_size=2,
+                              backend="tpu")
+    for sid in ("n0", "n1", "n2"):
+        reg.add_stream(sid)
+    reg.finalize()
+
+    def feed(k):
+        rng = np.random.Generator(np.random.Philox(key=(13, k)))
+        return (30 + rng.random((3, 2))).astype(np.float32), 1_700_000_000 + k
+
+    stats = live_loop(feed, reg, n_ticks=4, cadence_s=0.01)
+    assert stats["scored"] == 3 * 4 and stats["n_groups"] == 2
+
+
+def test_single_group_path_unchanged(tmp_path):
+    """A bare StreamGroup still works through live_loop (the pre-registry
+    API), and emits for every slot."""
+    grp = StreamGroup(cluster_preset(), IDS, backend="tpu")
+    stats = live_loop(_feed, grp, n_ticks=5, cadence_s=0.01,
+                      alert_path=str(tmp_path / "a.jsonl"))
+    assert stats["scored"] == G_TOTAL * 5
+    assert stats["n_groups"] == 1
