@@ -136,6 +136,9 @@ def main() -> int:
     ap.add_argument("--ticks", type=int, default=330)
     ap.add_argument("--cadence", type=float, default=1.0)
     ap.add_argument("--backend", default="tpu")
+    ap.add_argument("--group-size", type=int, default=1024,
+                    help="passed through to serve: streams per device group "
+                         "(multi-group interleaved serving when exceeded)")
     ap.add_argument("--startup-timeout", type=float, default=420.0,
                     help="budget for serve's backend init + first compile")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
@@ -150,6 +153,7 @@ def main() -> int:
         "--ticks", str(args.ticks),
         "--cadence", str(args.cadence),
         "--backend", args.backend,
+        "--group-size", str(args.group_size),
         "--alerts", alerts_path,
     ]
     log(f"starting serve: G={args.streams} ticks={args.ticks} "
@@ -190,7 +194,7 @@ def main() -> int:
         os.remove(alerts_path)  # large; the count is the committed evidence
     result = {
         "streams": args.streams, "ticks": args.ticks, "cadence_s": args.cadence,
-        "backend": args.backend,
+        "backend": args.backend, "group_size": args.group_size,
         # an honest artifact must say WHERE the group path actually ran:
         # backend="tpu" under RTAP_FORCE_CPU=1 is the JAX group kernels on
         # the CPU platform (the tunnel-down fallback), not the chip
