@@ -109,7 +109,17 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     # BENCH_BUDGET_S=1500; its step budget must exceed that or the runner
     # would SIGKILL it before its own SIGTERM-emit path can print the line.
     ("multigroup", [sys.executable, "scripts/multigroup_sched.py"], 1200.0),
-    ("live_soak", [sys.executable, "scripts/live_soak.py"], 1500.0),
+    # the production serve shape landed this round: many small groups per
+    # chip (live_loop over a registry, interleaved dispatch). Soak it at
+    # that shape — 16 x 256 streams at the 1 s-cadence north star — rather
+    # than the single giant group the G-sweep already showed is the wrong
+    # operating point.
+    # budget sized for the 4096-stream shape: startup (<=420 s init +
+    # first-tick compile) + 330 ticks at up to ~4 s/tick of degradation —
+    # the soak must be able to REPORT badly missed deadlines, not get
+    # SIGKILLed by its own runner while measuring them
+    ("live_soak", [sys.executable, "scripts/live_soak.py",
+                   "--streams", "4096", "--group-size", "256"], 2100.0),
 ]
 
 
