@@ -71,7 +71,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         source, close = tcp, tcp.close
     try:
         stats = live_loop(source, grp, n_ticks=args.ticks, cadence_s=args.cadence,
-                          alert_path=args.alerts)
+                          alert_path=args.alerts,
+                          checkpoint_dir=args.checkpoint_dir,
+                          checkpoint_every=args.checkpoint_every)
     finally:
         close()
     # ingest health belongs in the service artifact: a zero-missed-deadline
@@ -184,6 +186,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="alert only after this many consecutive ticks at/"
                         "above threshold (reports/quality_study.json)")
     p.add_argument("--alerts", default=None, help="JSONL alert sink path")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="atomic per-group resume checkpoints; restarting "
+                        "serve with the same dir resumes every group from "
+                        "its recorded tick (service restart survival)")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="checkpoint cadence in ticks (0 = never save; "
+                        "resume-on-start still applies with "
+                        "--checkpoint-dir)")
     p.add_argument("--learn-every", type=int, default=1,
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "

@@ -195,3 +195,31 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
     grp.ticks = int(meta["ticks"])
     grp.n_live = int(meta["n_live"])
     return grp
+
+
+def validate_resume(resumed: StreamGroup, ck_path, grp: StreamGroup) -> None:
+    """Shared resume-safety gate for replay_streams and live_loop: a resumed
+    group silently carries its checkpoint's model config and alerting
+    semantics, so the checkpoint must MATCH what this run would have built —
+    mixing them would blend two semantics in one result. Mismatches are
+    errors, not surprises. Add new load-bearing fields here, once, so both
+    entry points stay in lockstep."""
+    if resumed.stream_ids != grp.stream_ids:
+        raise ValueError(
+            f"checkpoint {ck_path} holds streams {resumed.stream_ids[:3]}... "
+            f"but this group expects {grp.stream_ids[:3]}...; refusing to "
+            "resume")
+    mismatches = [
+        f"{name}: checkpoint={a!r} vs requested={b!r}"
+        for name, a, b in (
+            ("config", resumed.cfg, grp.cfg),
+            ("threshold", resumed.threshold, grp.threshold),
+            ("debounce", resumed.debounce, grp.debounce),
+        )
+        if a != b
+    ]
+    if mismatches:
+        raise ValueError(
+            f"checkpoint {ck_path} disagrees with this run's parameters "
+            f"({'; '.join(mismatches)}); rerun with the checkpointed "
+            "settings or use a fresh checkpoint dir")

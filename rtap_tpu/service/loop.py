@@ -100,35 +100,12 @@ def replay_streams(
 
             ck_path = os.path.join(checkpoint_dir, f"group{gi:04d}")
             if os.path.isdir(ck_path):
-                from rtap_tpu.service.checkpoint import load_group
+                from rtap_tpu.service.checkpoint import load_group, validate_resume
 
                 resumed = load_group(ck_path)
-                if resumed.stream_ids != grp.stream_ids:
-                    raise ValueError(
-                        f"checkpoint {ck_path} holds streams "
-                        f"{resumed.stream_ids[:3]}... but group {gi} expects "
-                        f"{grp.stream_ids[:3]}...; refusing to resume"
-                    )
-                # a resumed group silently carries its checkpoint's alerting
-                # semantics and model config — mixing those with different
-                # current-call parameters would blend two semantics in one
-                # result, so mismatches are errors, not surprises
-                mismatches = [
-                    f"{name}: checkpoint={a!r} vs requested={b!r}"
-                    for name, a, b in (
-                        ("config", resumed.cfg, cfg),
-                        ("threshold", resumed.threshold, threshold),
-                        ("debounce", resumed.debounce, debounce),
-                    )
-                    if a != b
-                ]
-                if mismatches:
-                    raise ValueError(
-                        f"checkpoint {ck_path} disagrees with this call's "
-                        f"parameters ({'; '.join(mismatches)}); rerun with "
-                        "the checkpointed settings or use a fresh "
-                        "checkpoint dir"
-                    )
+                # shared resume-safety gate (stream ids + config + alerting
+                # semantics) — one implementation for replay and live serve
+                validate_resume(resumed, ck_path, grp)
                 if resumed.ticks % chunk_ticks and resumed.ticks < T:
                     raise ValueError(
                         f"checkpoint {ck_path} at tick {resumed.ticks} is not "
@@ -215,6 +192,8 @@ def live_loop(
     n_ticks: int,
     cadence_s: float = 1.0,
     alert_path: str | None = None,
+    checkpoint_dir: str | None = None,
+    checkpoint_every: int = 0,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -231,20 +210,75 @@ def live_loop(
     the interleaved schedule of scripts/multigroup_sched.py as the
     production serve path. `source` values align with the registry's
     stream registration order (contiguous per-group slices).
+
+    Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
+    `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
+    state is saved atomically every k ticks (the per-tick dispatch is
+    depth-1, so nothing is in flight at save time), and a later call with
+    the same dir resumes each group from its recorded tick — same
+    validation as replay_streams (stream ids, config, alerting semantics
+    must match the checkpoint; mismatches are errors, not surprises).
+    Saves run inline, so a checkpoint tick may miss its cadence deadline —
+    pick `checkpoint_every` with that cost in mind (it is visible in
+    `latency_max_ms` and the missed-deadline count). Checkpointing
+    requires a registry (the resumed instances replace `group.groups[i]`,
+    which a bare StreamGroup argument could not observe).
     """
     if isinstance(group, StreamGroupRegistry):
         if group._pending:
             raise ValueError(
                 "live_loop needs a finalized registry (finalize() seals the "
                 f"last group; {len(group._pending)} streams still pending)")
-        groups = list(group.groups)
+        groups = group.groups  # the live list: resume replaces entries in place
     else:
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "live_loop checkpointing needs a StreamGroupRegistry (a bare "
+                "StreamGroup caller could not observe the resumed instances)")
         groups = [group]
+    resumed_from: dict[str, int] = {}
+    if checkpoint_dir is not None:
+        import os
+
+        from rtap_tpu.service.checkpoint import load_group, validate_resume
+
+        for gi, grp in enumerate(groups):
+            ck_path = os.path.join(checkpoint_dir, f"group{gi:04d}")
+            if not os.path.isdir(ck_path):
+                continue
+            resumed = load_group(ck_path, mesh=grp.mesh)
+            validate_resume(resumed, ck_path, grp)
+            resumed.n_live = getattr(grp, "n_live", grp.G)
+            groups[gi] = resumed
+            # the registry's lookup() index must observe the resumed
+            # instance too, not the stale fresh group
+            if isinstance(group, StreamGroupRegistry):
+                for slot in group._slots.values():
+                    if slot.group is grp:
+                        slot.group = resumed
+            resumed_from[f"group{gi}"] = resumed.ticks
+        # A crash between per-group saves leaves a torn set (groups at
+        # different ticks). Live data is NOT tick-indexed (every group
+        # scores whatever arrives now) and groups are fully independent,
+        # so a behind group merely lost a few ticks of learning — resume
+        # anyway, loudly: the skew is warned and exposed in stats.
+        # (replay_streams is different: its feed IS tick-indexed, and it
+        # resumes each group from its own recorded offset.)
+        ticks_seen = {g.ticks for g in groups}
+        if len(ticks_seen) > 1:
+            import sys
+
+            print(f"live_loop: resuming a torn checkpoint set (group ticks "
+                  f"{sorted(ticks_seen)} — a crash landed between per-group "
+                  "saves); behind groups lost that many ticks of learning",
+                  file=sys.stderr, flush=True)
+        resume_tick_skew = (max(ticks_seen) - min(ticks_seen)) if resumed_from else 0
     lives = [getattr(g, "n_live", g.G) for g in groups]  # pad slots never emit
     n_expected = sum(lives)
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
     missed = 0
+    checkpoints_saved = 0
     latencies = np.empty(n_ticks, np.float64)  # per-tick poll->emit seconds
     for k in range(n_ticks):
         t_start = time.perf_counter()
@@ -272,6 +306,9 @@ def live_loop(
                               loglik[0, :live], alerts[0, :live])
             counter.add(live)
             off += live
+        if checkpoint_every and checkpoint_dir and (k + 1) % checkpoint_every == 0:
+            _save_all(groups, checkpoint_dir)
+            checkpoints_saved += 1
         elapsed = time.perf_counter() - t_start
         latencies[k] = elapsed
         budget = cadence_s - elapsed
@@ -279,6 +316,12 @@ def live_loop(
             missed += 1
         elif k + 1 < n_ticks:
             time.sleep(budget)
+    if (checkpoint_every and checkpoint_dir and n_ticks
+            and n_ticks % checkpoint_every != 0):
+        # final state on clean exit, like replay_streams — a resume must
+        # not replay up to checkpoint_every-1 ticks of already-learned data
+        _save_all(groups, checkpoint_dir)
+        checkpoints_saved += 1
     writer.close()
     lat = {}
     if n_ticks > 0:
@@ -287,9 +330,25 @@ def live_loop(
             for p in (50, 90, 99)
         }
         lat["latency_max_ms"] = round(float(latencies.max()) * 1e3, 3)
+    extra = {}
+    if checkpoint_dir is not None:
+        extra["checkpoints_saved"] = checkpoints_saved
+        if resumed_from:
+            extra["resumed_from"] = resumed_from
+            extra["resume_tick_skew"] = resume_tick_skew
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": n_ticks, "cadence_s": cadence_s, "n_groups": len(groups),
-            **lat, **_occupancy()}
+            **extra, **lat, **_occupancy()}
+
+
+def _save_all(groups, checkpoint_dir: str) -> None:
+    """One atomic per-group save per group dir (group{i:04d})."""
+    import os
+
+    from rtap_tpu.service.checkpoint import save_group
+
+    for gi, grp in enumerate(groups):
+        save_group(grp, os.path.join(checkpoint_dir, f"group{gi:04d}"))
 
 
 def _overflow_total(groups) -> int | None:
