@@ -69,12 +69,34 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         host, port = tcp.address
         print(f"serve: listening for JSONL records on {host}:{port}", file=sys.stderr)
         source, close = tcp, tcp.close
+    # orderly shutdown: SIGTERM/SIGINT finish the current tick, save a
+    # final checkpoint (with --checkpoint-dir), and still print the stats
+    # line — an evicted service must not lose state or exit silently
+    import signal
+    import threading
+
+    stop = threading.Event()
+    prev = {}
+
+    def _on_signal(*_):
+        stop.set()
+        # restore the previous handlers so a SECOND signal force-exits —
+        # a tick wedged on the device must not make the process
+        # unkillable except by SIGKILL
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, _on_signal)
     try:
         stats = live_loop(source, grp, n_ticks=args.ticks, cadence_s=args.cadence,
                           alert_path=args.alerts,
                           checkpoint_dir=args.checkpoint_dir,
-                          checkpoint_every=args.checkpoint_every)
+                          checkpoint_every=args.checkpoint_every,
+                          stop_event=stop)
     finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
         close()
     # ingest health belongs in the service artifact: a zero-missed-deadline
     # line is only evidence if data was flowing and parsing cleanly
@@ -191,9 +213,9 @@ def main(argv: list[str] | None = None) -> int:
                         "serve with the same dir resumes every group from "
                         "its recorded tick (service restart survival)")
     p.add_argument("--checkpoint-every", type=int, default=0,
-                   help="checkpoint cadence in ticks (0 = never save; "
-                        "resume-on-start still applies with "
-                        "--checkpoint-dir)")
+                   help="checkpoint cadence in ticks (0 = save only on "
+                        "exit/shutdown; with --checkpoint-dir, resume-on-"
+                        "start always applies)")
     p.add_argument("--learn-every", type=int, default=1,
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "

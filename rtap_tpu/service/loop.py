@@ -194,6 +194,7 @@ def live_loop(
     alert_path: str | None = None,
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
+    stop_event=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -279,8 +280,15 @@ def live_loop(
     counter = ThroughputCounter()
     missed = 0
     checkpoints_saved = 0
+    ticks_run = 0
+    last_saved = 0
     latencies = np.empty(n_ticks, np.float64)  # per-tick poll->emit seconds
     for k in range(n_ticks):
+        # orderly shutdown (SIGTERM -> serve's handler sets the event):
+        # finish cleanly between ticks, save final state, report stats —
+        # an evicted service must not lose since-last-checkpoint learning
+        if stop_event is not None and stop_event.is_set():
+            break
         t_start = time.perf_counter()
         values, ts = source(k)
         values = np.asarray(values, np.float32)
@@ -306,38 +314,47 @@ def live_loop(
                               loglik[0, :live], alerts[0, :live])
             counter.add(live)
             off += live
-        if checkpoint_every and checkpoint_dir and (k + 1) % checkpoint_every == 0:
+        ticks_run = k + 1
+        if checkpoint_every and checkpoint_dir and ticks_run % checkpoint_every == 0:
             _save_all(groups, checkpoint_dir)
             checkpoints_saved += 1
+            last_saved = ticks_run
         elapsed = time.perf_counter() - t_start
         latencies[k] = elapsed
         budget = cadence_s - elapsed
         if budget < 0:
             missed += 1
         elif k + 1 < n_ticks:
-            time.sleep(budget)
-    if (checkpoint_every and checkpoint_dir and n_ticks
-            and n_ticks % checkpoint_every != 0):
-        # final state on clean exit, like replay_streams — a resume must
-        # not replay up to checkpoint_every-1 ticks of already-learned data
+            if stop_event is not None:
+                stop_event.wait(budget)  # a shutdown signal ends the sleep
+            else:
+                time.sleep(budget)
+    if checkpoint_dir and ticks_run > last_saved:
+        # final state on exit (clean or stopped), like replay_streams — a
+        # resume must not lose already-learned ticks. Gated on the dir
+        # alone: checkpoint_every=0 with a dir means "save only on exit"
         _save_all(groups, checkpoint_dir)
         checkpoints_saved += 1
     writer.close()
     lat = {}
-    if n_ticks > 0:
+    if ticks_run > 0:
+        used = latencies[:ticks_run]
         lat = {
-            f"latency_p{p}_ms": round(float(np.percentile(latencies, p)) * 1e3, 3)
+            f"latency_p{p}_ms": round(float(np.percentile(used, p)) * 1e3, 3)
             for p in (50, 90, 99)
         }
-        lat["latency_max_ms"] = round(float(latencies.max()) * 1e3, 3)
+        lat["latency_max_ms"] = round(float(used.max()) * 1e3, 3)
     extra = {}
     if checkpoint_dir is not None:
         extra["checkpoints_saved"] = checkpoints_saved
         if resumed_from:
             extra["resumed_from"] = resumed_from
             extra["resume_tick_skew"] = resume_tick_skew
+    if ticks_run < n_ticks:
+        extra["stopped_early"] = True
+        extra["ticks_requested"] = n_ticks
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
-            "ticks": n_ticks, "cadence_s": cadence_s, "n_groups": len(groups),
+            "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
             **extra, **lat, **_occupancy()}
 
 

@@ -170,6 +170,36 @@ def test_checkpoint_requires_registry(tmp_path):
                   checkpoint_dir=str(tmp_path))
 
 
+def test_graceful_stop_saves_final_state(tmp_path):
+    """An orderly shutdown (stop_event, serve's SIGTERM path) finishes the
+    current tick, saves final state, and reports truncated-but-honest
+    stats instead of dying silently."""
+    import threading
+
+    ck = str(tmp_path / "ck")
+    reg = _registry()
+    stop = threading.Event()
+
+    def feed_then_stop(k):
+        if k == 3:
+            stop.set()  # raised mid-run, e.g. by a signal handler
+        return _feed(k)
+
+    stats = live_loop(feed_then_stop, reg, n_ticks=50, cadence_s=0.01,
+                      checkpoint_dir=ck, checkpoint_every=10,
+                      stop_event=stop)
+    assert stats["stopped_early"] is True
+    assert stats["ticks"] == 4 and stats["ticks_requested"] == 50
+    assert stats["scored"] == G_TOTAL * 4
+    assert stats["checkpoints_saved"] == 1  # the final on-stop save
+
+    # the saved state resumes exactly where the stop landed
+    cont = _registry()
+    stats2 = live_loop(lambda k: _feed(k + 4), cont, n_ticks=1,
+                       cadence_s=0.01, checkpoint_dir=ck)
+    assert stats2["resumed_from"] == {"group0": 4, "group1": 4}
+
+
 def test_single_group_path_unchanged(tmp_path):
     """A bare StreamGroup still works through live_loop (the pre-registry
     API), and emits for every slot."""
