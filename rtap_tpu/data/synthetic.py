@@ -147,6 +147,16 @@ def generate_stream(
     if cfg.n_anomalies > 0:
         # keep injections clear of the likelihood probation region
         lo = int(cfg.length * cfg.inject_after_frac)
+        n_candidates = cfg.length - 50 - lo
+        if n_candidates < cfg.n_anomalies:
+            # same guard as generate_node: a degenerate candidate range would
+            # otherwise surface as an opaque numpy ValueError
+            raise ValueError(
+                f"stream length {cfg.length} too short: the injection range "
+                f"[{lo}, {cfg.length - 50}) has {max(n_candidates, 0)} candidate "
+                f"centers for n_anomalies={cfg.n_anomalies}; lengthen the stream "
+                "or lower inject_after_frac/n_anomalies"
+            )
         centers = np.sort(rng.choice(np.arange(lo, cfg.length - 50), size=cfg.n_anomalies, replace=False))
         for c in centers:
             kind = cfg.kinds[rng.integers(len(cfg.kinds))]
