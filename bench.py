@@ -62,8 +62,20 @@ LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LKG.j
 # flat base; env overrides stay minimal because strat_key (the env tuple) is
 # also the per-strategy OOM-dominance key — a redundant RTAP_TM_LAYOUT=flat
 # would fragment dominance skipping across identical kernels.
+# BENCH_LEARN_EVERY rides the same per-attempt env as the kernel strategies:
+# the learning-cadence schedule (ModelConfig.learn_every, SCALING.md operating
+# curve) measured k=4 at 86k and k=8 at 115k metrics/s/chip on silicon
+# (hw_results/profile_cadence{4,8}.log) — k=8 is the first measured config
+# past the 100k north star on one chip. The cadence rungs measure the mature
+# steady state (cadence from tick 0, as profile_step does): the full-rate
+# maturity window is a per-stream transient, not the steady state a
+# throughput bench describes. The quality trade (f1 0.741 vs 0.853 at k=8)
+# is documented in SCALING.md; the emitted line labels cadence rungs via
+# "modes" so the headline is never mistaken for the full-rate default.
 ATTEMPTS: list[tuple[int, int, dict]] = [
     (256, 64, {}),
+    (1024, 64, {"BENCH_LEARN_EVERY": "8"}),
+    (1024, 64, {"BENCH_LEARN_EVERY": "4"}),
     (256, 64, {"RTAP_TM_LAYOUT": "aos"}),  # r3-default reference rung
     (256, 64, {"RTAP_TM_SWEEP": "compact"}),
     (256, 64, {"RTAP_TM_SWEEP": "compact",
@@ -113,6 +125,16 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
     from rtap_tpu.utils.measure import make_sine_feed, measure_pipelined
 
     cfg = cluster_preset()
+    learn_every = int(os.environ.get("BENCH_LEARN_EVERY", "1"))
+    if learn_every > 1:
+        import dataclasses
+
+        # mature steady state: cadence from tick 0 (learn_full_until stays
+        # 0), the same measurement choice as profile_step --learn-every —
+        # the full-rate maturity window is a transient, and the service
+        # applies it per stream via ModelConfig.with_learn_every
+        cfg = dataclasses.replace(cfg, learn_every=learn_every)
+        log(f"  learning cadence: every {learn_every} ticks (mature steady state)")
     ids = [f"bench{i:06d}" for i in range(group_size)]
     t0 = time.perf_counter()
     grp = StreamGroup(cfg, ids, backend="tpu")
@@ -131,6 +153,8 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
     from rtap_tpu.ops.tm_tpu import layout_mode, scatter_mode, sweep_mode
 
     modes = f"{layout_mode()}/{scatter_mode()}/{sweep_mode()}"
+    if learn_every > 1:
+        modes += f"/learn_every={learn_every}"
     return {"value": value, "G": group_size, "T": chunk_ticks,
             "wall_s": round(dt, 2), "modes": modes}
 
@@ -139,6 +163,13 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
 
 
 _EMITTED: int | None = None  # exit code of the emitted line, once emitted
+
+# Best result from a DEFAULT-config rung (empty env: full-rate learning on
+# the default kernel). The headline takes the ladder max — which a cadence
+# rung normally wins — so the full-rate number rides the emitted line as
+# "full_rate_value": without it, a kernel regression in the default config
+# would be invisible behind the unchanged cadence headline.
+_BEST_FULL: dict | None = None
 
 CACHED_EXIT = 4  # emitted-but-cached: distinct rc so exit-code-only consumers
 # can tell a dead-tunnel LKG fallback from a fresh measurement (the JSON line
@@ -164,6 +195,13 @@ def emit(best: dict | None) -> int | None:
         if best is None:
             return None
     _EMITTED = CACHED_EXIT if extra.get("cached") else 0
+    # carry the winning configuration on the line: a cadence rung's headline
+    # (modes ".../learn_every=k") must never read as the full-rate default
+    for field in ("G", "T", "modes", "full_rate_value"):
+        if best.get(field) is not None:
+            extra.setdefault(field, best[field])
+    if _BEST_FULL is not None:
+        extra.setdefault("full_rate_value", round(_BEST_FULL["value"], 1))
     print(
         json.dumps(
             {
@@ -184,7 +222,9 @@ def _load_lkg() -> tuple[dict | None, dict]:
         with open(LKG_PATH) as f:
             lkg = json.load(f)
         log(f"bench: no fresh result; emitting last-known-good from {lkg.get('measured_at')}")
-        return {"value": float(lkg["value"])}, {
+        return {"value": float(lkg["value"]), "G": lkg.get("G"), "T": lkg.get("T"),
+                "modes": lkg.get("modes"),
+                "full_rate_value": lkg.get("full_rate_value")}, {
             "cached": True,
             "measured_at": lkg.get("measured_at"),
             "cached_reason": "no attempt produced a fresh number this run "
@@ -210,6 +250,8 @@ def _store_lkg(best: dict) -> None:
                     "G": best.get("G"),
                     "T": best.get("T"),
                     "modes": best.get("modes"),
+                    **({"full_rate_value": round(_BEST_FULL["value"], 1)}
+                       if _BEST_FULL is not None else {}),
                     "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 },
                 f,
@@ -267,8 +309,14 @@ def main() -> None:
     # an aos OOM must not veto the flat rungs
     oom_at: dict[tuple, tuple[int, int]] = {}
     init_fail_streak = 0  # consecutive children that died without backend init
+    global _BEST_FULL
     for group_size, chunk_ticks, strategy_env in ATTEMPTS:
-        strat_key = tuple(sorted(strategy_env.items()))
+        # BENCH_LEARN_EVERY changes only the learning cadence, not state
+        # layout or HBM footprint — memory-identical rungs must share one
+        # OOM-dominance key or a frontier OOM re-burns budget per cadence
+        strat_key = tuple(sorted(
+            (k, v) for k, v in strategy_env.items() if k != "BENCH_LEARN_EVERY"
+        ))
         if strat_key in oom_at and group_size >= oom_at[strat_key][0] \
                 and chunk_ticks >= oom_at[strat_key][1]:
             log(f"bench: skipping G={group_size},T={chunk_ticks} "
@@ -338,6 +386,9 @@ def main() -> None:
                 log(f"  G={group_size}: {res['value']:.1f} metrics/s")
                 if best is None or res["value"] > best["value"]:
                     best = res
+                if not strategy_env and (
+                        _BEST_FULL is None or res["value"] > _BEST_FULL["value"]):
+                    _BEST_FULL = res
                 break
             if proc.returncode != 0 and not os.path.exists(marker):
                 # the child died without ever initializing the backend (e.g.

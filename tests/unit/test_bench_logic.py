@@ -79,7 +79,7 @@ def test_store_lkg_guard_and_roundtrip(tmp_path, monkeypatch):
     stored = json.loads((tmp_path / "BENCH_LKG.json").read_text())
     assert stored["value"] == 9.9 and stored["G"] == 1 and "measured_at" in stored
     fallback, extra = b._load_lkg()
-    assert fallback == {"value": 9.9} and extra["cached"] is True
+    assert fallback == {"value": 9.9, "G": 1, "T": 1, "modes": None, "full_rate_value": None} and extra["cached"] is True
 
 
 def test_oom_dominance_skip_logic():
@@ -122,3 +122,32 @@ def test_finish_tunnel_down_with_fresh_best_is_still_fresh(tmp_path, monkeypatch
     assert e.value.code == 0
     out = json.loads(capsys.readouterr().out.strip())
     assert "cached" not in out and out["value"] == 42.0
+
+
+def test_emit_carries_full_rate_alongside_cadence_headline(tmp_path, monkeypatch, capsys):
+    """A cadence rung wins the ladder max, so the full-rate default rung's
+    number must ride the line as full_rate_value — otherwise a default-
+    config regression hides behind an unchanged cadence headline."""
+    b = load_bench(tmp_path, monkeypatch, None)
+    b._BEST_FULL = {"value": 32893.3, "G": 256, "T": 256}
+    assert b.emit({"value": 120345.6, "modes": "flat/matmul/dense/learn_every=8"}) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 120345.6
+    assert out["full_rate_value"] == 32893.3
+    assert out["modes"].endswith("learn_every=8")
+
+
+def test_lkg_roundtrips_full_rate_value(tmp_path, monkeypatch, capsys):
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    b = load_bench(tmp_path, monkeypatch, None)
+    b._BEST_FULL = {"value": 31905.0}
+    b._store_lkg({"value": 115429.0, "G": 1024, "T": 64,
+                  "modes": "flat/matmul/dense/learn_every=8"})
+    stored = json.loads((tmp_path / "BENCH_LKG.json").read_text())
+    assert stored["full_rate_value"] == 31905.0
+    b._BEST_FULL = None  # a later dead-tunnel run has no fresh full-rate
+    fallback, extra = b._load_lkg()
+    assert extra["cached"] is True
+    assert b.emit(None) == b.CACHED_EXIT
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 115429.0 and out["full_rate_value"] == 31905.0
