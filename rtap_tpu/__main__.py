@@ -93,7 +93,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           alert_path=args.alerts,
                           checkpoint_dir=args.checkpoint_dir,
                           checkpoint_every=args.checkpoint_every,
-                          stop_event=stop)
+                          stop_event=stop,
+                          pipeline_depth=args.pipeline_depth)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -220,6 +221,12 @@ def main(argv: list[str] | None = None) -> int:
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "
                         "operating curve; k=1 = full-rate default)")
+    p.add_argument("--pipeline-depth", type=int, default=1,
+                   help="2 = collect tick k after dispatching k+1: hides the "
+                        "per-group device round trip (remote-chip dispatch "
+                        "latency) behind the cadence sleep; alerts lag one "
+                        "cadence (reports/live_soak.json measured the cost "
+                        "of depth 1 at 16 groups)")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("replay", help="synthetic cluster replay at full speed")

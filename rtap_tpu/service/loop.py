@@ -195,11 +195,20 @@ def live_loop(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
     stop_event=None,
+    pipeline_depth: int = 1,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
     budget. Returns throughput stats including missed-deadline count — the
     real-time health signal for the 1s-cadence north star.
+
+    `pipeline_depth=2` overlaps the device round trip with the cadence
+    sleep: tick k's results are collected and emitted after tick k+1 is
+    dispatched, hiding the per-group dispatch+collect latency that
+    dominates single-tick dispatches on a remote chip (the tunnel RTT made
+    the 16x256 production soak miss every 1 s deadline at depth 1 —
+    reports/live_soak.json). Alerts lag one cadence; checkpoint saves
+    drain the pipeline first, so nothing is in flight at save time.
 
     Accepts a single :class:`StreamGroup` or a finalized
     :class:`StreamGroupRegistry`. Measured chip throughput PEAKS at small
@@ -214,8 +223,8 @@ def live_loop(
 
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
-    state is saved atomically every k ticks (the per-tick dispatch is
-    depth-1, so nothing is in flight at save time), and a later call with
+    state is saved atomically every k ticks (the in-flight pipeline is
+    drained before each save, so nothing is in flight), and a later call with
     the same dir resumes each group from its recorded tick — same
     validation as replay_streams (stream ids, config, alerting semantics
     must match the checkpoint; mismatches are errors, not surprises).
@@ -225,6 +234,8 @@ def live_loop(
     requires a registry (the resumed instances replace `group.groups[i]`,
     which a bare StreamGroup argument could not observe).
     """
+    if pipeline_depth < 1:
+        raise ValueError(f"pipeline_depth must be >= 1; got {pipeline_depth}")
     if isinstance(group, StreamGroupRegistry):
         if group._pending:
             raise ValueError(
@@ -283,6 +294,26 @@ def live_loop(
     ticks_run = 0
     last_saved = 0
     latencies = np.empty(n_ticks, np.float64)  # per-tick poll->emit seconds
+
+    def _collect_tick(ts, values, handles):
+        off = 0
+        for grp, live, h in zip(groups, lives, handles):
+            raw, loglik, alerts = grp.collect_chunk(h)  # [1, G] each
+            writer.emit_batch(grp.stream_ids[:live], np.full(live, ts),
+                              values[off:off + live], raw[0, :live],
+                              loglik[0, :live], alerts[0, :live])
+            counter.add(live)
+            off += live
+
+    # Cross-tick pipeline (pipeline_depth=2): collect tick k-1 AFTER
+    # dispatching tick k, so the device round trip — which over the remote-
+    # chip tunnel costs ~65 ms per group per tick and made the 16x256
+    # production soak miss EVERY 1 s deadline (reports/live_soak.json,
+    # p50 1.07 s) — overlaps the cadence sleep instead of the tick budget.
+    # The price is results lagging one tick (alert latency +1 cadence),
+    # stated in the stats via "pipeline_depth". Depth 1 keeps the
+    # dispatch-collect-emit-same-tick behavior.
+    in_flight: deque = deque()
     for k in range(n_ticks):
         # orderly shutdown (SIGTERM -> serve's handler sets the event):
         # finish cleanly between ticks, save final state, report stats —
@@ -306,16 +337,18 @@ def live_loop(
             off += live
             handles.append(grp.dispatch_chunk(
                 v[None, :], np.full((1, grp.G), ts, np.int64)))
-        off = 0
-        for grp, live, h in zip(groups, lives, handles):
-            raw, loglik, alerts = grp.collect_chunk(h)  # [1, G] each
-            writer.emit_batch(grp.stream_ids[:live], np.full(live, ts),
-                              values[off:off + live], raw[0, :live],
-                              loglik[0, :live], alerts[0, :live])
-            counter.add(live)
-            off += live
+        # held across a tick at depth >= 2: a source reusing a preallocated
+        # buffer must not corrupt the emitted values column
+        in_flight.append(
+            (ts, values.copy() if pipeline_depth > 1 else values, handles))
+        while len(in_flight) >= pipeline_depth:
+            _collect_tick(*in_flight.popleft())
         ticks_run = k + 1
         if checkpoint_every and checkpoint_dir and ticks_run % checkpoint_every == 0:
+            # nothing may be in flight at save time: drain the pipeline
+            # first (same rule as replay's drain-before-save)
+            while in_flight:
+                _collect_tick(*in_flight.popleft())
             _save_all(groups, checkpoint_dir)
             checkpoints_saved += 1
             last_saved = ticks_run
@@ -329,6 +362,8 @@ def live_loop(
                 stop_event.wait(budget)  # a shutdown signal ends the sleep
             else:
                 time.sleep(budget)
+    while in_flight:  # drain: every dispatched tick is collected and emitted
+        _collect_tick(*in_flight.popleft())
     if checkpoint_dir and ticks_run > last_saved:
         # final state on exit (clean or stopped), like replay_streams — a
         # resume must not lose already-learned ticks. Gated on the dir
@@ -355,6 +390,7 @@ def live_loop(
         extra["ticks_requested"] = n_ticks
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
+            "pipeline_depth": pipeline_depth,
             **extra, **lat, **_occupancy()}
 
 

@@ -120,6 +120,25 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     # SIGKILLed by its own runner while measuring them
     ("live_soak", [sys.executable, "scripts/live_soak.py",
                    "--streams", "4096", "--group-size", "256"], 2100.0),
+    # The 16x256 soak measured p50 1.07 s/tick — ALL deadlines missed at
+    # the 1 s cadence, ~65 ms per group per tick of dispatch+collect round
+    # trip over the remote-chip tunnel (the chunked multigroup throughput
+    # was flat across decompositions, but live T=1 dispatches are latency-
+    # bound, not bandwidth-bound). These shapes cut the round trips per
+    # tick 4x/16x to isolate the per-dispatch cost from the device step.
+    ("live_soak_g1024", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "4096", "--group-size", "1024",
+                         "--out", "reports/live_soak_g1024.json"], 2100.0),
+    ("live_soak_g4096", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "4096", "--group-size", "4096",
+                         "--out", "reports/live_soak_g4096.json"], 2100.0),
+    # depth-2 serve pipeline: collect tick k after dispatching k+1, hiding
+    # the per-group round trip behind the cadence sleep at the production
+    # 16x256 shape (alerts lag one cadence — the documented trade)
+    ("live_soak_pipelined", [sys.executable, "scripts/live_soak.py",
+                             "--streams", "4096", "--group-size", "256",
+                             "--pipeline-depth", "2",
+                             "--out", "reports/live_soak_pipelined.json"], 2100.0),
 ]
 
 

@@ -139,6 +139,9 @@ def main() -> int:
     ap.add_argument("--group-size", type=int, default=1024,
                     help="passed through to serve: streams per device group "
                          "(multi-group interleaved serving when exceeded)")
+    ap.add_argument("--pipeline-depth", type=int, default=1,
+                    help="passed through to serve: 2 hides the per-group "
+                         "device round trip behind the cadence sleep")
     ap.add_argument("--startup-timeout", type=float, default=420.0,
                     help="budget for serve's backend init + first compile")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
@@ -154,6 +157,7 @@ def main() -> int:
         "--cadence", str(args.cadence),
         "--backend", args.backend,
         "--group-size", str(args.group_size),
+        "--pipeline-depth", str(args.pipeline_depth),
         "--alerts", alerts_path,
     ]
     log(f"starting serve: G={args.streams} ticks={args.ticks} "

@@ -208,3 +208,42 @@ def test_single_group_path_unchanged(tmp_path):
                       alert_path=str(tmp_path / "a.jsonl"))
     assert stats["scored"] == G_TOTAL * 5
     assert stats["n_groups"] == 1
+
+
+def test_pipeline_depth2_bitexact_vs_depth1(tmp_path):
+    """pipeline_depth=2 changes WHEN results are collected (one tick
+    later), never WHAT is computed: alert lines, throughput, and final
+    model state must be bit-identical to depth 1 — including across a
+    mid-run checkpoint save, which drains the pipeline first."""
+    out = {}
+    for depth in (1, 2):
+        reg = _registry()
+        path = str(tmp_path / f"alerts_d{depth}.jsonl")
+        ck = str(tmp_path / f"ck_d{depth}")
+        stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.0,
+                          alert_path=path, checkpoint_dir=ck,
+                          checkpoint_every=5, pipeline_depth=depth)
+        assert stats["pipeline_depth"] == depth
+        assert stats["scored"] == G_TOTAL * N_TICKS
+        import jax
+
+        out[depth] = (open(path).read(),
+                      [jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
+                                              g.state) for g in reg.groups],
+                      stats["checkpoints_saved"])
+    assert out[1][0] == out[2][0]  # identical alert stream, same order
+    for s1, s2 in zip(out[1][1], out[2][1]):
+        l1 = jax.tree_util.tree_leaves(s1)
+        l2 = jax.tree_util.tree_leaves(s2)
+        assert len(l1) == len(l2)
+        for a, b in zip(l1, l2):
+            np.testing.assert_array_equal(a, b)
+    assert out[1][2] == out[2][2]
+
+
+def test_pipeline_depth_validation():
+    import pytest
+
+    reg = _registry()
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, pipeline_depth=0)
