@@ -34,7 +34,8 @@ def _apply_cadence(cfg, args: argparse.Namespace):
     "Learning-cadence operating curve"). Delegates to
     ModelConfig.with_learn_every — the shared policy — so an invalid k
     (0, negative) fails loudly instead of silently running full-rate."""
-    return cfg.with_learn_every(getattr(args, "learn_every", 1))
+    return cfg.with_learn_every(getattr(args, "learn_every", 1),
+                                burst=getattr(args, "learn_burst", 1))
 
 
 def _cmd_serve(args: argparse.Namespace) -> int:
@@ -162,6 +163,8 @@ def _cmd_eval(args: argparse.Namespace) -> int:
         argv += ["--learning-period", str(args.learning_period)]
     if args.learn_every != 1:
         argv += ["--learn-every", str(args.learn_every)]
+    if getattr(args, "learn_burst", 1) != 1:
+        argv += ["--learn-burst", str(args.learn_burst)]
     if args.all_kinds:
         argv.append("--all-kinds")
     if args.out:
@@ -221,6 +224,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "
                         "operating curve; k=1 = full-rate default)")
+    p.add_argument("--learn-burst", type=int, default=1,
+                   help="burst shape of the thinned cadence: B consecutive "
+                        "learn ticks per k*B cycle (same device cost as "
+                        "--learn-every alone; preserves TM sequence "
+                        "adjacency — SCALING.md burst study)")
     p.add_argument("--pipeline-depth", type=int, default=1,
                    help="2 = collect tick k after dispatching k+1: hides the "
                         "per-group device round trip (remote-chip dispatch "
@@ -253,6 +261,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "
                         "operating curve; k=1 = full-rate default)")
+    p.add_argument("--learn-burst", type=int, default=1,
+                   help="burst shape of the thinned cadence: B consecutive "
+                        "learn ticks per k*B cycle (same device cost as "
+                        "--learn-every alone; preserves TM sequence "
+                        "adjacency — SCALING.md burst study)")
     p.set_defaults(fn=_cmd_replay)
 
     p = sub.add_parser("eval", help="fault-injection evaluation -> JSON report")
@@ -273,6 +286,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "
                         "operating curve; k=1 = full-rate default)")
+    p.add_argument("--learn-burst", type=int, default=1,
+                   help="burst shape of the thinned cadence: B consecutive "
+                        "learn ticks per k*B cycle (same device cost as "
+                        "--learn-every alone; preserves TM sequence "
+                        "adjacency — SCALING.md burst study)")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_eval)
 

@@ -289,16 +289,37 @@ class ModelConfig:
     # (eval/fault_eval.py --learn-every).
     learn_every: int = 1
     learn_full_until: int = 0
+    # Burst shape of the thinned cadence: learn `learn_burst` CONSECUTIVE
+    # ticks out of every `learn_every * learn_burst` (same 1/learn_every
+    # average rate and device cost, same scalar clock). burst=1 is the
+    # spread schedule (every k-th tick) — which breaks the temporal
+    # adjacency TM sequence learning feeds on (synapses grow toward the
+    # PREVIOUS tick's winner cells, so isolated learn ticks mostly learn
+    # k-step-apart pairs). Bursts preserve adjacency inside each burst;
+    # quality measured in eval/fault_eval.py --learn-burst.
+    learn_burst: int = 1
 
     def learns_on(self, it):
         """The cadence predicate, shared by the device schedule
         (ops/step.py:_tick, traced jnp scalar) and the host twin
         (HTMModel.run, python int) so the two can never diverge:
         learn when `it` (completed steps) is inside the full-rate maturity
-        window or on the cadence."""
-        return (it < self.learn_full_until) | (it % self.learn_every == 0)
+        window or on the cadence (burst=1: every k-th tick; burst=B: the
+        first B ticks of every k*B-tick cycle)."""
+        return (it < self.learn_full_until) | (
+            it % (self.learn_every * self.learn_burst) < self.learn_burst
+        )
 
-    def with_learn_every(self, k: int, full_until: int | None = None) -> "ModelConfig":
+    @property
+    def cadence_active(self) -> bool:
+        """True when the schedule can ever skip a learn tick — the single
+        gate shared by the device path (ops/step.py) and the host twins
+        (HTMModel.run, registry CPU path), so 'is a cadence configured'
+        can never be answered differently on different paths."""
+        return self.learn_every > 1
+
+    def with_learn_every(self, k: int, full_until: int | None = None,
+                         burst: int = 1) -> "ModelConfig":
         """Cadence config with the standard maturity alignment: full-rate
         learning for the likelihood learning_period (or an explicit
         `full_until`; note this is the Gaussian-fit window, NOT the full
@@ -308,10 +329,10 @@ class ModelConfig:
         exactly this boundary). The single policy shared by the operator CLI and
         the fault eval so quality numbers always describe the config the
         service runs. Invalid k (< 1) fails loudly via validation."""
-        if k == 1 and full_until is None:
+        if k == 1 and full_until is None and burst == 1:
             return self
         return dataclasses.replace(
-            self, learn_every=k,
+            self, learn_every=k, learn_burst=burst,
             learn_full_until=(self.likelihood.learning_period
                               if full_until is None else full_until),
         )
@@ -355,6 +376,17 @@ class ModelConfig:
                 )
         if self.learn_every < 1:
             raise ValueError(f"learn_every must be >= 1; got {self.learn_every}")
+        if self.learn_burst < 1:
+            raise ValueError(f"learn_burst must be >= 1; got {self.learn_burst}")
+        if self.learn_burst > 1 and self.learn_every == 1:
+            # it % (1*B) < B is always true: the operator asked for a burst
+            # cadence that can never thin anything — same loud-failure
+            # policy as an invalid k (a saved config claiming learn_burst=8
+            # at full rate would misrepresent what actually ran)
+            raise ValueError(
+                f"learn_burst={self.learn_burst} requires learn_every > 1 "
+                "(with learn_every=1 the burst schedule never thins learning)"
+            )
         if self.learn_full_until < 0:
             raise ValueError(
                 f"learn_full_until must be >= 0; got {self.learn_full_until}"
@@ -426,6 +458,7 @@ class ModelConfig:
             # pre-cadence checkpoints default to full-rate learning
             learn_every=d.get("learn_every", 1),
             learn_full_until=d.get("learn_full_until", 0),
+            learn_burst=d.get("learn_burst", 1),
         )
 
     @classmethod

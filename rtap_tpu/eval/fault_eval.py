@@ -333,6 +333,11 @@ def main() -> None:
                     help="ticks of full-rate learning before the cadence "
                          "kicks in (default: the likelihood "
                          "learning_period, the Gaussian-fit window)")
+    ap.add_argument("--learn-burst", type=int, default=1,
+                    help="burst shape of the thinned cadence: learn B "
+                         "CONSECUTIVE ticks of every k*B (same average "
+                         "cost as --learn-every alone; preserves the "
+                         "temporal adjacency TM sequence learning needs)")
     ap.add_argument("--out", default=None, help="write the JSON report here")
     args = ap.parse_args()
 
@@ -341,10 +346,12 @@ def main() -> None:
     if args.learning_period is not None:
         lik = dataclasses.replace(lik, learning_period=args.learning_period)
     cfg = dataclasses.replace(base, likelihood=lik)
-    if args.learn_every != 1 or args.learn_full_until is not None:
+    if args.learn_every != 1 or args.learn_full_until is not None \
+            or args.learn_burst != 1:
         # shared policy with the operator CLI (ModelConfig.with_learn_every):
         # invalid k fails loudly; default full-rate window = learning_period
-        cfg = cfg.with_learn_every(args.learn_every, args.learn_full_until)
+        cfg = cfg.with_learn_every(args.learn_every, args.learn_full_until,
+                                   burst=args.learn_burst)
     kinds = ANOMALY_KINDS if args.all_kinds else ("spike", "level_shift", "dropout")
     report = run_fault_eval(
         n_streams=args.streams, length=args.length, kinds=kinds,

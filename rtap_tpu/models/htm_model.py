@@ -110,7 +110,7 @@ class HTMModel:
         """Process one record; returns scores. Mirrors model.run({...})."""
         values = np.atleast_1d(np.asarray(value, np.float32))
 
-        if learn and self.cfg.learn_every > 1:
+        if learn and self.cfg.cadence_active:
             # host-side twin of ops/step.py:_tick's schedule (same clock:
             # tm_iter = completed steps, checkpointed, advances under
             # inference; same predicate: cfg.learns_on) so single-stream

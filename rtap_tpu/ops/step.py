@@ -85,7 +85,7 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
             lambda s1, vv, tt: step_impl(s1, vv, tt, cfg, lrn)
         )(ss, values, ts_unix)
 
-    if not (learn and cfg.learn_every > 1):
+    if not (learn and cfg.cadence_active):
         return step_all(learn)(s)
     tick = s["tm_iter"].reshape(-1)[0]  # completed steps so far (lockstep)
     return jax.lax.cond(cfg.learns_on(tick), step_all(True), step_all(False), s)

@@ -100,7 +100,7 @@ class StreamGroup:
     def _raw_cpu(self, values: np.ndarray, ts: np.ndarray, learn: bool = True):
         from rtap_tpu.models.htm_model import oracle_record_step
 
-        if learn and self.cfg.learn_every > 1:
+        if learn and self.cfg.cadence_active:
             # host twin of the device schedule (ops/step.py:_tick): same
             # clock (tm_iter = completed steps, lockstep across the group),
             # same predicate (cfg.learns_on) — without this the CPU backend

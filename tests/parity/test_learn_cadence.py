@@ -208,3 +208,52 @@ def test_learn_every_one_is_always_learn():
             s_b, jnp.asarray(vals[i][:, None]), jnp.asarray(ts), base, learn=True
         )
         np.testing.assert_array_equal(np.asarray(raw_a), np.asarray(raw_b))
+
+
+@exact_only
+def test_burst_cadence_semantics_and_parity():
+    """learn_burst=B: B CONSECUTIVE learn ticks per k*B cycle — same
+    average rate as the spread schedule, same shared predicate on host
+    and device (HTMModel cpu == tpu backend, record for record)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(cadence_cfg(learn_every=4, learn_full_until=8),
+                              learn_burst=5)
+    # predicate shape: full-rate window, then 5-on/15-off cycles
+    flags = [bool(cfg.learns_on(i)) for i in range(48)]
+    assert all(flags[:8])
+    for i in range(8, 48):
+        assert flags[i] == (i % 20 < 5), i
+    # average rate over whole cycles == 1/learn_every
+    assert sum(flags[20:40]) == 5
+
+    cpu = HTMModel(cfg, seed=3, backend="cpu")
+    tpu = HTMModel(cfg, seed=3, backend="tpu")
+    vals = make_vals(60, 1)
+    for i in range(60):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
+def test_burst_one_is_spread_schedule():
+    """burst=1 must be bit-identical to the original every-k-th predicate."""
+    import dataclasses
+
+    for k, fu in ((1, 0), (4, 20), (8, 0)):
+        cfg = cadence_cfg(learn_every=k, learn_full_until=fu)
+        cfgb = dataclasses.replace(cfg, learn_burst=1)
+        for i in range(100):
+            assert bool(cfg.learns_on(i)) == (i < fu or i % k == 0)
+            assert bool(cfgb.learns_on(i)) == bool(cfg.learns_on(i))
+
+
+def test_burst_without_cadence_fails_loudly():
+    """learn_burst>1 at learn_every=1 can never thin learning — a saved
+    config claiming it would misrepresent what ran; loud-failure policy."""
+    import dataclasses
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="learn_burst"):
+        dataclasses.replace(cadence_cfg(learn_every=1), learn_burst=8)
