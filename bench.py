@@ -74,15 +74,24 @@ LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LKG.j
 # "modes" so the headline is never mistaken for the full-rate default.
 ATTEMPTS: list[tuple[int, int, dict]] = [
     (256, 64, {}),
+    # scaled models (reports/model_size_quality.json, production fault
+    # eval): 128 cols measures BETTER f1 than the preset at half the state
+    # (0.804 vs 0.789); 64 cols holds 0.771 at a QUARTER (141 KB/stream —
+    # analytically ~110k streams/chip u16). With k=2 cadence on top these
+    # are the full-quality-class density stacks toward 100k/chip.
+    (1024, 64, {"BENCH_COLUMNS": "128"}),
+    (1024, 64, {"BENCH_COLUMNS": "128", "BENCH_LEARN_EVERY": "2"}),
+    (1024, 64, {"BENCH_COLUMNS": "64"}),
+    (1024, 64, {"BENCH_COLUMNS": "64", "BENCH_LEARN_EVERY": "2"}),
+    (1024, 64, {"BENCH_COLUMNS": "32"}),  # best measured f1 (0.813) at 1/8 state
     (1024, 64, {"BENCH_LEARN_EVERY": "8"}),
     (1024, 64, {"BENCH_LEARN_EVERY": "4"}),
     (256, 64, {"RTAP_TM_LAYOUT": "aos"}),  # r3-default reference rung
-    (256, 64, {"RTAP_TM_SWEEP": "compact"}),
-    (256, 64, {"RTAP_TM_SWEEP": "compact",
-               "RTAP_TM_DENDRITE": "forward", "RTAP_TM_FWD_IMPL": "matmul"}),
+    # (the r4 compact/forward candidate rungs were retired after the
+    # 2026-08-01 window measured them -58%/-89% — hw_results/bench.log +
+    # the profile postmortems are the committed evidence)
     (256, 256, {}),
     (512, 128, {}),
-    (1024, 64, {"RTAP_TM_SWEEP": "compact"}),
     (2048, 64, {}),
 ]
 
@@ -124,7 +133,17 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
     from rtap_tpu.service.registry import StreamGroup
     from rtap_tpu.utils.measure import make_sine_feed, measure_pipelined
 
-    cfg = cluster_preset()
+    columns = int(os.environ.get("BENCH_COLUMNS", "0"))
+    if columns:
+        # half-size model: measured BETTER f1 than the preset at half the
+        # state (reports/model_size_quality.json) — the bandwidth-bound
+        # kernel should run ~2x; this rung measures that on silicon
+        from rtap_tpu.config import scaled_cluster_preset
+
+        cfg = scaled_cluster_preset(columns)
+        log(f"  scaled preset: {columns} columns")
+    else:
+        cfg = cluster_preset()
     learn_every = int(os.environ.get("BENCH_LEARN_EVERY", "1"))
     if learn_every > 1:
         import dataclasses
@@ -153,6 +172,8 @@ def run_attempt(group_size: int, chunk_ticks: int, measure_chunks: int = 3) -> d
     from rtap_tpu.ops.tm_tpu import layout_mode, scatter_mode, sweep_mode
 
     modes = f"{layout_mode()}/{scatter_mode()}/{sweep_mode()}"
+    if columns:
+        modes += f"/cols={columns}"
     if learn_every > 1:
         modes += f"/learn_every={learn_every}"
     return {"value": value, "G": group_size, "T": chunk_ticks,

@@ -305,9 +305,18 @@ class ModelConfig:
         (HTMModel.run, python int) so the two can never diverge:
         learn when `it` (completed steps) is inside the full-rate maturity
         window or on the cadence (burst=1: every k-th tick; burst=B: the
-        first B ticks of every k*B-tick cycle)."""
+        first B ticks of every k*B-tick cycle, phased so a burst begins
+        the tick the maturity window ends — absolute phasing would freeze
+        learning for up to (k-1)*B ticks right as scoring starts)."""
+        if self.learn_burst == 1:
+            # the original spread schedule, absolute phase (measured
+            # semantics; unchanged by the burst feature)
+            return (it < self.learn_full_until) | (it % self.learn_every == 0)
+        rel = it - self.learn_full_until  # negative inside the window, where
+        # the first clause already grants learning (python/jnp % both give
+        # non-negative results, so the second clause stays well-defined)
         return (it < self.learn_full_until) | (
-            it % (self.learn_every * self.learn_burst) < self.learn_burst
+            rel % (self.learn_every * self.learn_burst) < self.learn_burst
         )
 
     @property
@@ -555,4 +564,44 @@ def cluster_preset(perm_bits: int = 16) -> ModelConfig:
         # landed there.
         likelihood=LikelihoodConfig(mode="streaming", historic_window_size=512,
                                     learning_period=300, estimation_samples=100),
+    )
+
+
+def scaled_cluster_preset(columns: int, perm_bits: int = 16) -> ModelConfig:
+    """Cluster preset rescaled to `columns` SP width at the preset's ~3.9%
+    activation sparsity, the learned-segment geometry tracking the winner
+    count (one sampled winner per active column; activation on half
+    recurring — the preset's measured ratio, see cluster_preset's TMConfig
+    comment).
+
+    Measured at production scale (scripts/model_size_eval.py,
+    reports/model_size_quality.json, 120 x 1500 fault eval): the
+    256-column preset is heavily over-parameterized for node-metric
+    streams — 128 cols scores f1 0.804, 64 cols 0.771, and 32 cols
+    (70.5 KB/stream, 1/8 the state, analytic ~220k streams/chip) 0.813,
+    the best of all measured configs, vs the preset's 0.789. Size
+    reduction preserves quality far better than cadence thinning (the
+    staleness study, SCALING.md). Caveat: synthetic node-metric workload;
+    richer signals may need the width. Silicon throughput: bench
+    BENCH_COLUMNS rungs / profile_half harvest steps."""
+    base = cluster_preset(perm_bits=perm_bits)
+    # round-half-up (not banker's): 64 cols must give k=3, preserving ~the
+    # preset's sparsity; and the activation ratio stays ~half of k — at
+    # banker's k=2 the geometry degenerated to a 2-of-2 perfect-match
+    # requirement, which confounded the first quarter-model measurement
+    k = max(3, int(columns * base.sp.num_active_columns / base.sp.columns + 0.5))
+    if k > base.tm.max_synapses_per_segment:
+        raise ValueError(
+            f"scaled_cluster_preset({columns}) needs new_synapse_count={k} "
+            f"> max_synapses_per_segment={base.tm.max_synapses_per_segment}: "
+            "upscaling past the preset's segment capacity silently truncates "
+            "growth; widen the TM pools explicitly instead"
+        )
+    return dataclasses.replace(
+        base,
+        sp=dataclasses.replace(base.sp, columns=columns, num_active_columns=k),
+        tm=dataclasses.replace(base.tm,
+                               activation_threshold=max(2, k // 2),
+                               min_threshold=max(1, k // 2 - 1),
+                               new_synapse_count=k, col_cap=k),
     )

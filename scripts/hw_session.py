@@ -139,6 +139,19 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                              "--streams", "4096", "--group-size", "256",
                              "--pipeline-depth", "2",
                              "--out", "reports/live_soak_pipelined.json"], 2100.0),
+    # half-size model (scaled_cluster_preset 128 cols): measured BETTER f1
+    # than the preset at half the state (reports/model_size_quality.json);
+    # these measure the bandwidth-bound ~2x on silicon. The bench ladder
+    # also carries the half rungs (BENCH_COLUMNS) for the headline path.
+    ("profile_half", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                      "--gs", "1024", "--layout", "flat",
+                      "--columns", "128"]),
+    ("profile_half_k2", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                         "--gs", "1024", "--layout", "flat",
+                         "--columns", "128", "--learn-every", "2"]),
+    ("profile_eighth", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                        "--gs", "1024", "--layout", "flat",
+                        "--columns", "32"]),
 ]
 
 

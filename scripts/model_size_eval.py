@@ -1,0 +1,109 @@
+"""Detection quality of a half-size model — the deferred density datum.
+
+SCALING.md's HBM-frontier section ends with "single-chip beyond the
+frontier requires shrinking the TM pools (quality trade measured in the
+fault eval) — not promised here". This measures that trade: the cluster
+preset with SP columns halved (256 -> 128, k-winners 10 -> 5 at equal
+~3.9% sparsity; TM per-cell pools unchanged) halves the dominant state
+tensors (~282 KB/stream u16 vs 564), roughly doubling both the
+stream-density frontier and — on a bandwidth-bound kernel — the
+throughput ceiling. The question is what detection quality it costs at
+production scale (120 x 1500, same protocol as reports/fault_eval.json).
+
+    RTAP_FORCE_CPU=1 python scripts/model_size_eval.py \
+        [--out reports/model_size_quality.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
+
+def sized_preset(columns: int, perm_bits: int = 16, learn_every: int = 1):
+    """See rtap_tpu.config.scaled_cluster_preset (promoted there once the
+    quality datum landed; this wrapper adds the cadence composition)."""
+    from rtap_tpu.config import scaled_cluster_preset
+
+    cfg = scaled_cluster_preset(columns, perm_bits=perm_bits)
+    if learn_every > 1:
+        cfg = cfg.with_learn_every(learn_every)
+    return cfg
+
+
+VARIANTS = {
+    "half_128col": lambda: sized_preset(128),
+    "quarter_64col": lambda: sized_preset(64),
+    "half_128col_k2": lambda: sized_preset(128, learn_every=2),
+    "quarter_64col_k2": lambda: sized_preset(64, learn_every=2),
+    "eighth_32col": lambda: sized_preset(32),
+    "sixteenth_16col": lambda: sized_preset(16),
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=120)
+    ap.add_argument("--length", type=int, default=1500)
+    ap.add_argument("--out", default=os.path.join(REPO, "reports",
+                                                  "model_size_quality.json"))
+    ap.add_argument("--variants", default=None,
+                    help=f"comma-separated subset of {sorted(VARIANTS)} "
+                         "(default: all not already in the report)")
+    args = ap.parse_args()
+
+    from rtap_tpu.eval.fault_eval import run_fault_eval
+    from rtap_tpu.models.state import state_nbytes
+
+    results = {}
+    if os.path.exists(args.out):  # merge: re-runs only measure what's asked
+        with open(args.out) as f:
+            results = json.load(f).get("variants", {})
+    if args.variants:
+        picked = args.variants.split(",")
+        bad = set(picked) - set(VARIANTS)
+        if bad:
+            raise SystemExit(f"unknown variants {sorted(bad)}; have {sorted(VARIANTS)}")
+    else:
+        picked = [n for n in VARIANTS if n not in results]
+    for name in picked:
+        cfg = VARIANTS[name]()
+        nbytes = state_nbytes(cfg)["total"]
+        rep = run_fault_eval(n_streams=args.streams, length=args.length,
+                             cfg=cfg, backend="tpu")
+        d = dataclasses.asdict(rep)
+        results[name] = {
+            "bytes_per_stream": int(nbytes),
+            # per-variant: a merged re-run at another scale must not
+            # relabel previously measured entries
+            "protocol": f"{args.streams} x {args.length}, fault_eval defaults",
+            "at_best": d["at_best"],
+            "best_threshold": d.get("best_threshold"),
+            "per_kind": d.get("per_kind"),
+        }
+        print(json.dumps({name: results[name]["at_best"]}), flush=True)
+
+    out = {
+        "baseline_full": {
+            "note": "reports/fault_eval.json (256 cols, 564 KB/stream u16)",
+        },
+        "variants": results,
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -142,6 +142,12 @@ def main():
                     help="learning cadence (ModelConfig.learn_every) with "
                          "learn_full_until=0: measures the cadenced steady "
                          "state (the lax.cond schedule in ops/step.py)")
+    ap.add_argument("--columns", type=int, default=None,
+                    help="rescale the preset to this SP width at equal "
+                         "sparsity (config.scaled_cluster_preset; the "
+                         "half-size 128-col model measured BETTER f1 than "
+                         "the preset at half the state — "
+                         "reports/model_size_quality.json)")
     ap.add_argument("--fanout-cap", type=int, default=None,
                     help="forward-index row width F (default: 384 under "
                          "--dendrite forward — the measured diurnal-workload "
@@ -184,7 +190,13 @@ def main():
         set_fwd_impl(args.fwd_impl)
         log(f"forward-index histogram impl: {args.fwd_impl}")
 
-    cfg = cluster_preset(perm_bits=args.perm_bits)
+    if args.columns:
+        from rtap_tpu.config import scaled_cluster_preset
+
+        cfg = scaled_cluster_preset(args.columns, perm_bits=args.perm_bits)
+        log(f"scaled preset: {args.columns} columns")
+    else:
+        cfg = cluster_preset(perm_bits=args.perm_bits)
     if args.fanout_cap or args.dendrite == "forward":
         import dataclasses
 

@@ -219,13 +219,17 @@ def test_burst_cadence_semantics_and_parity():
 
     cfg = dataclasses.replace(cadence_cfg(learn_every=4, learn_full_until=8),
                               learn_burst=5)
-    # predicate shape: full-rate window, then 5-on/15-off cycles
+    # predicate shape: full-rate window, then 5-on/15-off cycles phased
+    # from the window's END (a burst starts the tick maturity ends —
+    # absolute phasing would freeze learning for up to (k-1)*B ticks
+    # right as scoring begins)
     flags = [bool(cfg.learns_on(i)) for i in range(48)]
     assert all(flags[:8])
     for i in range(8, 48):
-        assert flags[i] == (i % 20 < 5), i
+        assert flags[i] == ((i - 8) % 20 < 5), i
+    assert flags[8]  # the first post-window tick learns
     # average rate over whole cycles == 1/learn_every
-    assert sum(flags[20:40]) == 5
+    assert sum(flags[8:28]) == 5
 
     cpu = HTMModel(cfg, seed=3, backend="cpu")
     tpu = HTMModel(cfg, seed=3, backend="tpu")
