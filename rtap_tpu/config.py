@@ -502,6 +502,60 @@ def nab_preset(min_val: float = 0.0, max_val: float = 100.0) -> ModelConfig:
     )
 
 
+def _round_half_up(x: float) -> int:
+    """Shared by the scaled presets: banker's rounding once produced a
+    degenerate perfect-match segment geometry (see scaled_cluster_preset);
+    both width-scaling paths must round the same way."""
+    return int(x + 0.5)
+
+
+def _guard_segment_capacity(name: str, columns: int, ns: int, cap: int) -> None:
+    if ns > cap:
+        raise ValueError(
+            f"{name}({columns}) needs new_synapse_count={ns} > "
+            f"max_synapses_per_segment={cap}: upscaling past the preset's "
+            "segment capacity silently truncates growth; widen the TM pools "
+            "explicitly instead"
+        )
+
+
+def scaled_nab_preset(columns: int, min_val: float = 0.0,
+                      max_val: float = 100.0) -> ModelConfig:
+    """NAB preset rescaled to `columns` SP width at the preset's ~2%
+    activation sparsity, segment geometry tracking the winner count at the
+    NuPIC Numenta-detector ratios (sample half the winners per learned
+    segment, activate on ~0.65 of the samples, match on ~half — the
+    2048/40/20/13/10 family scaled down, round-half-up like
+    scaled_cluster_preset so small widths keep non-degenerate thresholds).
+
+    Purpose: the model-width study (SCALING.md, scripts/model_size_eval.py)
+    measured the CLUSTER preset heavily oversized on node-metric streams;
+    this preset asks the same question of the NAB-family model on the
+    diverse-profile stand-in corpus (scripts/nab_standin_report.py
+    --columns), where the full-size 2048-column model is the 10.5 s/tick
+    CPU-infeasible config. Cells per column stay at the preset's 32 — width
+    is the measured axis; the cells axis is deliberately unexplored here.
+    """
+    base = nab_preset(min_val, max_val)
+    k = max(4, _round_half_up(columns * base.sp.num_active_columns
+                              / base.sp.columns))
+    ns = max(3, _round_half_up(k * base.tm.new_synapse_count
+                               / base.sp.num_active_columns))
+    _guard_segment_capacity("scaled_nab_preset", columns, ns,
+                            base.tm.max_synapses_per_segment)
+    act = max(2, _round_half_up(ns * base.tm.activation_threshold
+                                / base.tm.new_synapse_count))
+    mn = max(1, min(act, _round_half_up(ns * base.tm.min_threshold
+                                        / base.tm.new_synapse_count)))
+    return dataclasses.replace(
+        base,
+        sp=dataclasses.replace(base.sp, columns=columns, num_active_columns=k),
+        tm=dataclasses.replace(base.tm, activation_threshold=act,
+                               min_threshold=mn, new_synapse_count=ns,
+                               col_cap=k),
+    )
+
+
 def node_preset(n_metrics: int = 3, perm_bits: int = 16) -> ModelConfig:
     """Multivariate per-node model (SURVEY.md §6 benchmark config 4:
     'multivariate per-node cpu/mem/net fused RDSE').
@@ -589,14 +643,10 @@ def scaled_cluster_preset(columns: int, perm_bits: int = 16) -> ModelConfig:
     # preset's sparsity; and the activation ratio stays ~half of k — at
     # banker's k=2 the geometry degenerated to a 2-of-2 perfect-match
     # requirement, which confounded the first quarter-model measurement
-    k = max(3, int(columns * base.sp.num_active_columns / base.sp.columns + 0.5))
-    if k > base.tm.max_synapses_per_segment:
-        raise ValueError(
-            f"scaled_cluster_preset({columns}) needs new_synapse_count={k} "
-            f"> max_synapses_per_segment={base.tm.max_synapses_per_segment}: "
-            "upscaling past the preset's segment capacity silently truncates "
-            "growth; widen the TM pools explicitly instead"
-        )
+    k = max(3, _round_half_up(columns * base.sp.num_active_columns
+                              / base.sp.columns))
+    _guard_segment_capacity("scaled_cluster_preset", columns, k,
+                            base.tm.max_synapses_per_segment)
     return dataclasses.replace(
         base,
         sp=dataclasses.replace(base.sp, columns=columns, num_active_columns=k),

@@ -42,8 +42,23 @@ def main() -> None:
                          "size on a 1-core host — use --rows to shrink)")
     ap.add_argument("--rows", type=int, default=None,
                     help="truncate every file to this many rows (cheap drives)")
-    ap.add_argument("--out", default=os.path.join(REPO, "reports", "nab_standin.json"))
+    ap.add_argument("--columns", type=int, default=None,
+                    help="run the width-scaled NAB model "
+                         "(config.scaled_nab_preset) instead of the full "
+                         "2048-column preset — the model-width study's "
+                         "generalization question, and the config that makes "
+                         "the CPU corpus run feasible (~columns/2048 of the "
+                         "full model's 10.5 s/tick)")
+    ap.add_argument("--out", default=None,
+                    help="default reports/nab_standin.json, or "
+                         "nab_standin_cols<N>.json when --columns is set "
+                         "(the full-size on-device artifact must not be "
+                         "silently overwritten by a scaled run)")
     args = ap.parse_args()
+    if args.out is None:
+        name = (f"nab_standin_cols{args.columns}.json" if args.columns
+                else "nab_standin.json")
+        args.out = os.path.join(REPO, "reports", name)
 
     if args.backend == "tpu":
         from rtap_tpu.utils.platform import enable_compile_cache, init_backend_or_die
@@ -56,6 +71,14 @@ def main() -> None:
     from rtap_tpu.data.nab_corpus import NabFile, ensure_standin_corpus, load_corpus
     from rtap_tpu.nab.runner import run_corpus
 
+    cfg = None
+    if args.columns:
+        from rtap_tpu.config import scaled_nab_preset
+
+        # the runner rescales only the encoder resolution per file on top
+        # of this base (nab/runner._file_range_config), same as full-size
+        cfg = scaled_nab_preset(args.columns)
+
     with tempfile.TemporaryDirectory() as td:
         root = ensure_standin_corpus(td)
         files = load_corpus(root)
@@ -63,12 +86,28 @@ def main() -> None:
             files = [NabFile(f.name, f.timestamps[: args.rows], f.values[: args.rows],
                              f.windows) for f in files]
         t0 = time.time()
-        res = run_corpus(files, backend=args.backend, processes=args.processes)
+        res = run_corpus(files, cfg=cfg, backend=args.backend,
+                         processes=args.processes)
         wall = time.time() - t0
+
+    if args.backend == "tpu":
+        # safe: init_backend_or_die already brought the backend up above
+        import jax
+
+        platform = jax.default_backend()
+    else:
+        # the oracle path is numpy-only; touching jax.default_backend()
+        # here would lazily init the TPU runtime AFTER an hours-long CPU
+        # run (crash risk if the chip is held; provenance mislabel if not)
+        platform = "host-oracle"
+
+    from rtap_tpu.config import nab_preset
 
     report = {
         "corpus": "stand-in (deterministic synthetic, NAB on-disk format)",
         "backend": args.backend,
+        "platform": platform,
+        "columns": (cfg if cfg is not None else nab_preset()).sp.columns,
         "files": [f.name for f in files],
         "records": int(sum(len(f.values) for f in files)),
         "wall_s": round(wall, 1),

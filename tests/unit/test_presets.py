@@ -1,0 +1,79 @@
+"""Scaled-preset geometry: the width-scaling rules that gate the density
+and CPU-feasibility studies (SCALING.md model-width section).
+
+The first quarter-model measurement was confounded by a degenerate
+geometry (banker's rounding gave a 2-of-2 segment-activation
+requirement) — these tests pin the non-degeneracy rules so a future edit
+can't silently reintroduce it.
+"""
+
+import dataclasses
+
+import pytest
+
+from rtap_tpu.config import (
+    cluster_preset,
+    nab_preset,
+    scaled_cluster_preset,
+    scaled_nab_preset,
+)
+
+
+class TestScaledClusterPreset:
+    def test_identity_width_keeps_preset_geometry(self):
+        base, scaled = cluster_preset(), scaled_cluster_preset(256)
+        assert scaled.sp.num_active_columns == base.sp.num_active_columns
+        assert scaled.tm.new_synapse_count == base.tm.new_synapse_count
+
+    @pytest.mark.parametrize("columns", [16, 32, 64, 128])
+    def test_non_degenerate_geometry(self, columns):
+        cfg = scaled_cluster_preset(columns)
+        tm, sp = cfg.tm, cfg.sp
+        # activation must require strictly fewer matches than the segment
+        # samples, else only perfect recurrence ever predicts (the measured
+        # confound); min_threshold must stay a reachable match bar
+        assert 2 <= tm.activation_threshold < tm.new_synapse_count
+        assert 1 <= tm.min_threshold <= tm.activation_threshold
+        assert tm.new_synapse_count <= tm.max_synapses_per_segment
+        assert sp.num_active_columns == tm.col_cap
+        # sparsity stays in the sparse-coding regime (preset is ~3.9%)
+        assert sp.num_active_columns / sp.columns <= 0.20
+
+    def test_upscale_past_segment_capacity_raises(self):
+        with pytest.raises(ValueError, match="segment capacity"):
+            scaled_cluster_preset(1024)
+
+
+class TestScaledNabPreset:
+    def test_identity_width_keeps_preset_geometry(self):
+        base, scaled = nab_preset(), scaled_nab_preset(2048)
+        assert scaled.sp.num_active_columns == base.sp.num_active_columns
+        assert scaled.tm.new_synapse_count == base.tm.new_synapse_count
+        assert scaled.tm.activation_threshold == base.tm.activation_threshold
+        assert scaled.tm.min_threshold == base.tm.min_threshold
+
+    @pytest.mark.parametrize("columns", [128, 256, 512, 1024])
+    def test_non_degenerate_geometry(self, columns):
+        cfg = scaled_nab_preset(columns)
+        tm, sp = cfg.tm, cfg.sp
+        assert 2 <= tm.activation_threshold < tm.new_synapse_count
+        assert 1 <= tm.min_threshold <= tm.activation_threshold
+        assert tm.new_synapse_count <= tm.max_synapses_per_segment
+        assert sp.num_active_columns == tm.col_cap
+        assert sp.num_active_columns / sp.columns <= 0.20
+        # cells axis deliberately unscaled (see docstring)
+        assert tm.cells_per_column == nab_preset().tm.cells_per_column
+
+    def test_winner_ratio_tracks_nupic_family(self):
+        # 512 cols at the preset's ~2% sparsity: 10 winners, 5 sampled,
+        # activate on 3, match on 3 — the 40/20/13/10 family scaled by 1/4
+        cfg = scaled_nab_preset(512)
+        assert cfg.sp.num_active_columns == 10
+        assert cfg.tm.new_synapse_count == 5
+        assert cfg.tm.activation_threshold == 3
+        assert cfg.tm.min_threshold == 3
+
+    def test_validates_as_model_config(self):
+        # dataclasses.replace must not sidestep ModelConfig invariants
+        cfg = scaled_nab_preset(256)
+        assert dataclasses.replace(cfg) == cfg
