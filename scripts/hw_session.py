@@ -152,6 +152,27 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     ("profile_eighth", [sys.executable, "scripts/profile_step.py", "--T", "32",
                         "--gs", "1024", "--layout", "flat",
                         "--columns", "32"]),
+    # width-scaled NAB-family model over the stand-in corpus ON DEVICE
+    # (minutes; the full-size run took 405 s): does the "preset is
+    # oversized" finding generalize to the quality-model family on
+    # diverse profiles? Scores land in reports/nab_standin_cols<N>.json,
+    # never clobbering the full-size artifact.
+    ("nab_cols256", [sys.executable, "scripts/nab_standin_report.py",
+                     "--columns", "256"]),
+    ("nab_cols512", [sys.executable, "scripts/nab_standin_report.py",
+                     "--columns", "512"]),
+    # first two points measured 2048 -> 8.25, 256 -> 27.69 (standard
+    # profile): the width-quality curve on the corpus needs its middle and
+    # lower ends before any preset recommendation is written down
+    ("nab_cols128", [sys.executable, "scripts/nab_standin_report.py",
+                     "--columns", "128"]),
+    ("nab_cols1024", [sys.executable, "scripts/nab_standin_report.py",
+                      "--columns", "1024"]),
+    # small-model big-G: the full preset falls off past G=2048 (HBM-bound);
+    # 64-col state is 1/4 — does the throughput curve stay flat to 8k?
+    ("profile_64_g8192", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                          "--gs", "8192", "--layout", "flat",
+                          "--columns", "64"]),
 ]
 
 

@@ -73,6 +73,12 @@ class TestScaledNabPreset:
         assert cfg.tm.activation_threshold == 3
         assert cfg.tm.min_threshold == 3
 
+    def test_upscale_past_segment_capacity_raises(self):
+        # the guard input is the DERIVED new_synapse_count (k/2), not k:
+        # 4096 cols -> k=80 -> ns=40 > the 32-synapse pool capacity
+        with pytest.raises(ValueError, match="segment capacity"):
+            scaled_nab_preset(4096)
+
     def test_validates_as_model_config(self):
         # dataclasses.replace must not sidestep ModelConfig invariants
         cfg = scaled_nab_preset(256)
