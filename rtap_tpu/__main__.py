@@ -95,7 +95,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           checkpoint_dir=args.checkpoint_dir,
                           checkpoint_every=args.checkpoint_every,
                           stop_event=stop,
-                          pipeline_depth=args.pipeline_depth)
+                          pipeline_depth=args.pipeline_depth,
+                          dispatch_threads=args.dispatch_threads)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -235,6 +236,15 @@ def main(argv: list[str] | None = None) -> int:
                         "latency) behind the cadence sleep; alerts lag one "
                         "cadence (reports/live_soak.json measured the cost "
                         "of depth 1 at 16 groups)")
+    p.add_argument("--dispatch-threads", type=int, default=1,
+                   help="issue per-group dispatch/collect calls from N "
+                        "threads: on links where each dispatch is itself a "
+                        "blocking RPC (remote-chip tunnel, ~65 ms/group), "
+                        "depth-2 pipelining alone cannot help — the round "
+                        "trips must overlap each other "
+                        "(reports/live_soak_pipelined.json measured depth 2 "
+                        "at 16 groups unchanged, p50 1.07 s); output is "
+                        "bit-identical to serial dispatch")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("replay", help="synthetic cluster replay at full speed")

@@ -196,6 +196,7 @@ def live_loop(
     checkpoint_every: int = 0,
     stop_event=None,
     pipeline_depth: int = 1,
+    dispatch_threads: int = 1,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -209,6 +210,19 @@ def live_loop(
     the 16x256 production soak miss every 1 s deadline at depth 1 —
     reports/live_soak.json). Alerts lag one cadence; checkpoint saves
     drain the pipeline first, so nothing is in flight at save time.
+
+    `dispatch_threads=N` issues the per-group dispatch and collect calls
+    from a thread pool instead of serially. Depth 2 alone did NOT fix the
+    16x256 shape over the remote-chip tunnel (p50 stayed 1.07 s —
+    reports/live_soak_pipelined.json): on that link each dispatch_chunk
+    is itself a blocking ~65 ms RPC (transfer + launch), so 16 groups
+    serialize ~1.04 s of round trips per tick no matter when collection
+    happens. Local backends enqueue asynchronously and don't need this.
+    Threading overlaps the RPCs; groups are independent objects (each
+    thread touches exactly one group's state and likelihood ring) and
+    emission stays serial in group order after all collects join, so
+    output is bit-identical to the serial schedule
+    (tests/unit/test_multigroup_serve.py pins it).
 
     Accepts a single :class:`StreamGroup` or a finalized
     :class:`StreamGroupRegistry`. Measured chip throughput PEAKS at small
@@ -236,6 +250,8 @@ def live_loop(
     """
     if pipeline_depth < 1:
         raise ValueError(f"pipeline_depth must be >= 1; got {pipeline_depth}")
+    if dispatch_threads < 1:
+        raise ValueError(f"dispatch_threads must be >= 1; got {dispatch_threads}")
     if isinstance(group, StreamGroupRegistry):
         if group._pending:
             raise ValueError(
@@ -295,15 +311,57 @@ def live_loop(
     last_saved = 0
     latencies = np.empty(n_ticks, np.float64)  # per-tick poll->emit seconds
 
+    # one pool for the whole loop (threads are cheap to keep, expensive to
+    # respawn per tick); None = the serial schedule, bit-identical by test
+    pool = None
+    eff_threads = 1  # effective worker count, reported in stats
+    if dispatch_threads > 1 and len(groups) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        eff_threads = min(dispatch_threads, len(groups))
+        pool = ThreadPoolExecutor(max_workers=eff_threads)
+
     def _collect_tick(ts, values, handles):
+        # collects in parallel (each blocks on its group's device fetch —
+        # the per-group RPC on a remote link), emission strictly serial in
+        # group order so the alert stream is schedule-independent
+        if pool is None:
+            results = [grp.collect_chunk(h) for grp, h in zip(groups, handles)]
+        else:
+            results = list(pool.map(
+                lambda gh: gh[0].collect_chunk(gh[1]), zip(groups, handles)))
         off = 0
-        for grp, live, h in zip(groups, lives, handles):
-            raw, loglik, alerts = grp.collect_chunk(h)  # [1, G] each
+        for grp, live, (raw, loglik, alerts) in zip(groups, lives, results):
             writer.emit_batch(grp.stream_ids[:live], np.full(live, ts),
                               values[off:off + live], raw[0, :live],
                               loglik[0, :live], alerts[0, :live])
             counter.add(live)
             off += live
+
+    warmed = False  # first tick dispatches serially: concurrent cold misses
+    # on step.py's compiled-fn lru_cache are not single-flight, so N pool
+    # threads would each trace+compile the same program (up to Nx the
+    # dominant startup cost over the tunnel); one serial tick warms it
+
+    def _dispatch_all(values, ts):
+        nonlocal warmed
+        staged = []
+        off = 0
+        for grp, live in zip(groups, lives):
+            # trailing field axis preserved: values may be [G] or [G, n_fields]
+            v = np.full((grp.G,) + values.shape[1:], np.nan, np.float32)
+            v[:live] = values[off:off + live]
+            off += live
+            staged.append((grp, v))
+        if pool is None or not warmed:
+            warmed = True
+            return [grp.dispatch_chunk(v[None, :],
+                                       np.full((1, grp.G), ts, np.int64))
+                    for grp, v in staged]
+        return list(pool.map(
+            lambda gv: gv[0].dispatch_chunk(
+                gv[1][None, :], np.full((1, gv[0].G), ts, np.int64)),
+            staged))
 
     # Cross-tick pipeline (pipeline_depth=2): collect tick k-1 AFTER
     # dispatching tick k, so the device round trip — which over the remote-
@@ -314,56 +372,52 @@ def live_loop(
     # stated in the stats via "pipeline_depth". Depth 1 keeps the
     # dispatch-collect-emit-same-tick behavior.
     in_flight: deque = deque()
-    for k in range(n_ticks):
-        # orderly shutdown (SIGTERM -> serve's handler sets the event):
-        # finish cleanly between ticks, save final state, report stats —
-        # an evicted service must not lose since-last-checkpoint learning
-        if stop_event is not None and stop_event.is_set():
-            break
-        t_start = time.perf_counter()
-        values, ts = source(k)
-        values = np.asarray(values, np.float32)
-        if len(values) != n_expected:
-            raise ValueError(
-                f"source returned {len(values)} values for {n_expected} "
-                "live streams (alignment with registration order is load-"
-                "bearing — a silent mismatch would misroute streams)")
-        handles = []
-        off = 0
-        for grp, live in zip(groups, lives):
-            # trailing field axis preserved: values may be [G] or [G, n_fields]
-            v = np.full((grp.G,) + values.shape[1:], np.nan, np.float32)
-            v[:live] = values[off:off + live]
-            off += live
-            handles.append(grp.dispatch_chunk(
-                v[None, :], np.full((1, grp.G), ts, np.int64)))
-        # held across a tick at depth >= 2: a source reusing a preallocated
-        # buffer must not corrupt the emitted values column
-        in_flight.append(
-            (ts, values.copy() if pipeline_depth > 1 else values, handles))
-        while len(in_flight) >= pipeline_depth:
-            _collect_tick(*in_flight.popleft())
-        ticks_run = k + 1
-        if checkpoint_every and checkpoint_dir and ticks_run % checkpoint_every == 0:
-            # nothing may be in flight at save time: drain the pipeline
-            # first (same rule as replay's drain-before-save)
-            while in_flight:
+    try:
+        for k in range(n_ticks):
+            # orderly shutdown (SIGTERM -> serve's handler sets the event):
+            # finish cleanly between ticks, save final state, report stats —
+            # an evicted service must not lose since-last-checkpoint learning
+            if stop_event is not None and stop_event.is_set():
+                break
+            t_start = time.perf_counter()
+            values, ts = source(k)
+            values = np.asarray(values, np.float32)
+            if len(values) != n_expected:
+                raise ValueError(
+                    f"source returned {len(values)} values for {n_expected} "
+                    "live streams (alignment with registration order is load-"
+                    "bearing — a silent mismatch would misroute streams)")
+            handles = _dispatch_all(values, ts)
+            # held across a tick at depth >= 2: a source reusing a
+            # preallocated buffer must not corrupt the emitted values column
+            in_flight.append(
+                (ts, values.copy() if pipeline_depth > 1 else values, handles))
+            while len(in_flight) >= pipeline_depth:
                 _collect_tick(*in_flight.popleft())
-            _save_all(groups, checkpoint_dir)
-            checkpoints_saved += 1
-            last_saved = ticks_run
-        elapsed = time.perf_counter() - t_start
-        latencies[k] = elapsed
-        budget = cadence_s - elapsed
-        if budget < 0:
-            missed += 1
-        elif k + 1 < n_ticks:
-            if stop_event is not None:
-                stop_event.wait(budget)  # a shutdown signal ends the sleep
-            else:
-                time.sleep(budget)
-    while in_flight:  # drain: every dispatched tick is collected and emitted
-        _collect_tick(*in_flight.popleft())
+            ticks_run = k + 1
+            if checkpoint_every and checkpoint_dir and ticks_run % checkpoint_every == 0:
+                # nothing may be in flight at save time: drain the pipeline
+                # first (same rule as replay's drain-before-save)
+                while in_flight:
+                    _collect_tick(*in_flight.popleft())
+                _save_all(groups, checkpoint_dir)
+                checkpoints_saved += 1
+                last_saved = ticks_run
+            elapsed = time.perf_counter() - t_start
+            latencies[k] = elapsed
+            budget = cadence_s - elapsed
+            if budget < 0:
+                missed += 1
+            elif k + 1 < n_ticks:
+                if stop_event is not None:
+                    stop_event.wait(budget)  # a shutdown signal ends the sleep
+                else:
+                    time.sleep(budget)
+        while in_flight:  # drain: every dispatched tick is collected + emitted
+            _collect_tick(*in_flight.popleft())
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=True)
     if checkpoint_dir and ticks_run > last_saved:
         # final state on exit (clean or stopped), like replay_streams — a
         # resume must not lose already-learned ticks. Gated on the dir
@@ -391,6 +445,9 @@ def live_loop(
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
             "pipeline_depth": pipeline_depth,
+            # effective value: 1 when the pool was never created (single
+            # group), so soak reports can't claim threading they didn't get
+            "dispatch_threads": eff_threads,
             **extra, **lat, **_occupancy()}
 
 

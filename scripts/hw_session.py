@@ -173,6 +173,22 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     ("profile_64_g8192", [sys.executable, "scripts/profile_step.py", "--T", "32",
                           "--gs", "8192", "--layout", "flat",
                           "--columns", "64"]),
+    # 32col+k2: projected ~126k/s (learning ~91% of the 32col tick) — the
+    # first config past the north star whose BASE width beats the preset's
+    # quality; the k=2 quality cost is measured by model_size_eval
+    # (eighth_32col_k2 variant) on the CPU host
+    ("profile_eighth_k2", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                           "--gs", "1024", "--layout", "flat",
+                           "--columns", "32", "--learn-every", "2"]),
+    # the 16x256 fix, round 3: depth 2 alone measured NO change (p50
+    # 1.07 s — each dispatch is a blocking ~65 ms tunnel RPC, so 16
+    # groups serialize ~1.04 s/tick regardless of when collection
+    # happens); dispatch_threads=16 overlaps the RPCs. Success = the
+    # production shape holds the 1 s cadence like 4x1024 does.
+    ("live_soak_threads", [sys.executable, "scripts/live_soak.py",
+                           "--streams", "4096", "--group-size", "256",
+                           "--pipeline-depth", "2", "--dispatch-threads", "16",
+                           "--out", "reports/live_soak_threads.json"], 2100.0),
 ]
 
 

@@ -142,6 +142,10 @@ def main() -> int:
     ap.add_argument("--pipeline-depth", type=int, default=1,
                     help="passed through to serve: 2 hides the per-group "
                          "device round trip behind the cadence sleep")
+    ap.add_argument("--dispatch-threads", type=int, default=1,
+                    help="passed through to serve: overlap the per-group "
+                         "blocking dispatch RPCs (the tunnel's ~65 ms/group "
+                         "serial floor that depth 2 alone cannot touch)")
     ap.add_argument("--startup-timeout", type=float, default=420.0,
                     help="budget for serve's backend init + first compile")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
@@ -158,6 +162,7 @@ def main() -> int:
         "--backend", args.backend,
         "--group-size", str(args.group_size),
         "--pipeline-depth", str(args.pipeline_depth),
+        "--dispatch-threads", str(args.dispatch_threads),
         "--alerts", alerts_path,
     ]
     log(f"starting serve: G={args.streams} ticks={args.ticks} "

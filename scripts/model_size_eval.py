@@ -48,6 +48,9 @@ VARIANTS = {
     "quarter_64col_k2": lambda: sized_preset(64, learn_every=2),
     "eighth_32col": lambda: sized_preset(32),
     "sixteenth_16col": lambda: sized_preset(16),
+    # the projected ~126k/s/chip rung (32col learning is ~91% of the tick,
+    # profile_eighth.log): what does k=2 cost the best-f1 width?
+    "eighth_32col_k2": lambda: sized_preset(32, learn_every=2),
 }
 
 

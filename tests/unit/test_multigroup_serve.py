@@ -247,3 +247,45 @@ def test_pipeline_depth_validation():
     reg = _registry()
     with pytest.raises(ValueError, match="pipeline_depth"):
         live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, pipeline_depth=0)
+
+
+def test_dispatch_threads_bitexact_vs_serial(tmp_path):
+    """dispatch_threads=N overlaps the per-group dispatch/collect RPCs
+    (the tunnel's serial ~65 ms/group floor that depth-2 pipelining alone
+    cannot touch — reports/live_soak_pipelined.json); it must never change
+    WHAT is computed: alert stream, order, and final model state are
+    bit-identical to serial dispatch, including across a mid-run
+    checkpoint drain and with depth 2 stacked on top."""
+    import jax
+
+    out = {}
+    for threads in (1, 4):
+        reg = _registry()
+        path = str(tmp_path / f"alerts_t{threads}.jsonl")
+        ck = str(tmp_path / f"ck_t{threads}")
+        stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.0,
+                          alert_path=path, checkpoint_dir=ck,
+                          checkpoint_every=5, pipeline_depth=2,
+                          dispatch_threads=threads)
+        # stats carry the EFFECTIVE worker count (capped at n_groups; 1
+        # when the pool was never created), not the requested flag value
+        assert stats["dispatch_threads"] == min(threads, len(reg.groups))
+        assert stats["scored"] == G_TOTAL * N_TICKS
+        out[threads] = (open(path).read(),
+                        [jax.tree_util.tree_map(
+                            lambda x: np.asarray(x).copy(), g.state)
+                         for g in reg.groups])
+    assert out[1][0] == out[4][0]  # identical alert stream, same order
+    for s1, s2 in zip(out[1][1], out[4][1]):
+        l1, l2 = jax.tree_util.tree_leaves(s1), jax.tree_util.tree_leaves(s2)
+        assert len(l1) == len(l2)
+        for a, b in zip(l1, l2):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_dispatch_threads_validation():
+    import pytest
+
+    reg = _registry()
+    with pytest.raises(ValueError, match="dispatch_threads"):
+        live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, dispatch_threads=0)
