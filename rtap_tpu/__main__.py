@@ -96,7 +96,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           checkpoint_every=args.checkpoint_every,
                           stop_event=stop,
                           pipeline_depth=args.pipeline_depth,
-                          dispatch_threads=args.dispatch_threads)
+                          dispatch_threads=args.dispatch_threads,
+                          learn=not args.freeze)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -134,7 +135,7 @@ def _cmd_replay(args: argparse.Namespace) -> int:
                          threshold=args.threshold, alert_path=args.alerts,
                          checkpoint_dir=args.checkpoint_dir,
                          checkpoint_every=args.checkpoint_every,
-                         debounce=args.debounce)
+                         debounce=args.debounce, learn=not args.freeze)
     print(json.dumps({"streams": len(res.stream_ids), "ticks": len(res.timestamps),
                       **res.throughput}))
     return 0
@@ -245,6 +246,17 @@ def main(argv: list[str] | None = None) -> int:
                         "(reports/live_soak_pipelined.json measured depth 2 "
                         "at 16 groups unchanged, p50 1.07 s); output is "
                         "bit-identical to serial dispatch")
+    p.add_argument("--freeze", action="store_true",
+                   help="inference-only serving (NuPIC disableLearning "
+                        "parity): SP/TM/classifier state is bit-frozen, raw "
+                        "scores and alerts still flow, and the anomaly "
+                        "likelihood keeps adapting (it is the score "
+                        "normalizer, not model state). Skips the learning "
+                        "pass — ~85%% of the fused step on silicon "
+                        "(SCALING.md); pair with --checkpoint-dir to serve "
+                        "a trained model frozen (the dir becomes strictly "
+                        "read-only: frozen serving resumes from it but "
+                        "never writes, so replicas can share it)")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("replay", help="synthetic cluster replay at full speed")
@@ -276,6 +288,10 @@ def main(argv: list[str] | None = None) -> int:
                         "learn ticks per k*B cycle (same device cost as "
                         "--learn-every alone; preserves TM sequence "
                         "adjacency — SCALING.md burst study)")
+    p.add_argument("--freeze", action="store_true",
+                   help="inference-only replay (NuPIC disableLearning "
+                        "parity): no SP/TM/classifier updates; likelihood "
+                        "still adapts")
     p.set_defaults(fn=_cmd_replay)
 
     p = sub.add_parser("eval", help="fault-injection evaluation -> JSON report")

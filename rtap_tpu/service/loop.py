@@ -146,8 +146,9 @@ def replay_streams(
             if len(pending) >= 2:
                 collect(*pending.popleft())
                 chunks_done += 1
-            if ck_path is not None and checkpoint_every and chunks_done and \
-                    chunks_done % checkpoint_every == 0 and pending:
+            if learn and ck_path is not None and checkpoint_every and \
+                    chunks_done and chunks_done % checkpoint_every == 0 \
+                    and pending:
                 # drain before saving: grp.state must correspond exactly to
                 # the last COLLECTED tick or resume would double-step
                 while pending:
@@ -159,10 +160,11 @@ def replay_streams(
         while pending:
             collect(*pending.popleft())
             chunks_done += 1
-        if ck_path is not None and checkpoint_every and grp.ticks >= T:
+        if learn and ck_path is not None and checkpoint_every and grp.ticks >= T:
             from rtap_tpu.service.checkpoint import save_group
 
             save_group(grp, ck_path)  # final state, resumable past the end
+            # (frozen replay never writes — read-only like serve --freeze)
     writer.close()
 
     stats = {**counter.stats(), "alerts": writer.count, **_occupancy()}
@@ -197,11 +199,21 @@ def live_loop(
     stop_event=None,
     pipeline_depth: int = 1,
     dispatch_threads: int = 1,
+    learn: bool = True,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
     budget. Returns throughput stats including missed-deadline count — the
     real-time health signal for the 1s-cadence north star.
+
+    `learn=False` freezes the models (NuPIC `disableLearning()` parity —
+    SURVEY §3.2 OPF model surface): SP/TM/classifier state is
+    bit-identical after any number of frozen ticks, while raw scores and
+    alerts still flow and the anomaly LIKELIHOOD keeps adapting (it is
+    the score normalizer, downstream of the model, exactly as in the
+    reference's likelihood-outside-the-model layering). Frozen inference
+    skips the learning pass, which the silicon ablations put at ~85% of
+    the fused step (~155k metrics/s/chip inference-only — SCALING.md).
 
     `pipeline_depth=2` overlaps the device round trip with the cadence
     sleep: tick k's results are collected and emitted after tick k+1 is
@@ -356,11 +368,13 @@ def live_loop(
         if pool is None or not warmed:
             warmed = True
             return [grp.dispatch_chunk(v[None, :],
-                                       np.full((1, grp.G), ts, np.int64))
+                                       np.full((1, grp.G), ts, np.int64),
+                                       learn=learn)
                     for grp, v in staged]
         return list(pool.map(
             lambda gv: gv[0].dispatch_chunk(
-                gv[1][None, :], np.full((1, gv[0].G), ts, np.int64)),
+                gv[1][None, :], np.full((1, gv[0].G), ts, np.int64),
+                learn=learn),
             staged))
 
     # Cross-tick pipeline (pipeline_depth=2): collect tick k-1 AFTER
@@ -395,7 +409,8 @@ def live_loop(
             while len(in_flight) >= pipeline_depth:
                 _collect_tick(*in_flight.popleft())
             ticks_run = k + 1
-            if checkpoint_every and checkpoint_dir and ticks_run % checkpoint_every == 0:
+            if learn and checkpoint_every and checkpoint_dir \
+                    and ticks_run % checkpoint_every == 0:
                 # nothing may be in flight at save time: drain the pipeline
                 # first (same rule as replay's drain-before-save)
                 while in_flight:
@@ -418,10 +433,14 @@ def live_loop(
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
-    if checkpoint_dir and ticks_run > last_saved:
+    if learn and checkpoint_dir and ticks_run > last_saved:
         # final state on exit (clean or stopped), like replay_streams — a
         # resume must not lose already-learned ticks. Gated on the dir
-        # alone: checkpoint_every=0 with a dir means "save only on exit"
+        # alone: checkpoint_every=0 with a dir means "save only on exit".
+        # Frozen serving (learn=False) never writes: --checkpoint-dir is
+        # read-only there (resume the trained model, mutate nothing) — a
+        # frozen replica must not clobber the golden checkpoint with
+        # advanced tick counters, and two frozen replicas may share a dir
         _save_all(groups, checkpoint_dir)
         checkpoints_saved += 1
     writer.close()
@@ -445,6 +464,7 @@ def live_loop(
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
             "pipeline_depth": pipeline_depth,
+            "learn": learn,
             # effective value: 1 when the pool was never created (single
             # group), so soak reports can't claim threading they didn't get
             "dispatch_threads": eff_threads,

@@ -189,6 +189,30 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                            "--streams", "4096", "--group-size", "256",
                            "--pipeline-depth", "2", "--dispatch-threads", "16",
                            "--out", "reports/live_soak_threads.json"], 2100.0),
+    # the headline's missing quality number: what does k=2 cost the
+    # best-f1 width (0.813 detectable / 0.758 all-kinds at k=1)? At
+    # 64 col, k=2 cost 8.3 points. Runs the 120x1500 protocol on-device.
+    ("eval_32col_k2", [sys.executable, "scripts/model_size_eval.py",
+                       "--variants", "eighth_32col_k2"]),
+    ("eval_32col_k2_allkinds", [sys.executable, "scripts/model_size_eval.py",
+                                "--variants", "eighth_32col_k2",
+                                "--all-kinds"]),
+    # resident-capability frontier at the headline width: 256-col OOMs
+    # between 8k and 16k streams/chip; 32-col state is 1/8, so the
+    # frontier should land ~64k-128k — if >= 100k streams FIT and score
+    # on ONE chip, the "100k-on-one-chip unreachable" r3 verdict flips
+    # on the width axis. profile_step records FAILED per-G and exits 0,
+    # so the OOM probe cannot burn watcher attempts.
+    ("profile_32col_bigg", [sys.executable, "scripts/profile_step.py",
+                            "--T", "32", "--gs", "16384", "32768", "65536",
+                            "98304", "131072", "--layout", "flat",
+                            "--columns", "32"], 1800.0),
+    # absolute ceiling probe: u8 perm domain halves state again
+    # (quality per domain measured in SCALING.md's domain table)
+    ("profile_32col_bigg_u8", [sys.executable, "scripts/profile_step.py",
+                               "--T", "32", "--gs", "131072", "196608",
+                               "262144", "--layout", "flat", "--columns", "32",
+                               "--perm-bits", "8"], 1800.0),
 ]
 
 

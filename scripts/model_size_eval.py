@@ -58,15 +58,30 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--streams", type=int, default=120)
     ap.add_argument("--length", type=int, default=1500)
-    ap.add_argument("--out", default=os.path.join(REPO, "reports",
-                                                  "model_size_quality.json"))
+    ap.add_argument("--all-kinds", action="store_true",
+                    help="include the hard gradual kinds (drift, stuck); "
+                         "results go to reports/model_size_allkinds.json "
+                         "unless --out is given (separate merge file — the "
+                         "two protocols must never mix in one report)")
+    ap.add_argument("--out", default=None,
+                    help="report path (default: reports/"
+                         "model_size_quality.json, or _allkinds variant)")
     ap.add_argument("--variants", default=None,
                     help=f"comma-separated subset of {sorted(VARIANTS)} "
                          "(default: all not already in the report)")
     args = ap.parse_args()
+    if args.out is None:
+        args.out = os.path.join(
+            REPO, "reports",
+            "model_size_allkinds.json" if args.all_kinds
+            else "model_size_quality.json")
 
+    from rtap_tpu.data.synthetic import ANOMALY_KINDS
     from rtap_tpu.eval.fault_eval import run_fault_eval
     from rtap_tpu.models.state import state_nbytes
+
+    kinds = (ANOMALY_KINDS if args.all_kinds
+             else ("spike", "level_shift", "dropout"))
 
     results = {}
     if os.path.exists(args.out):  # merge: re-runs only measure what's asked
@@ -83,13 +98,15 @@ def main() -> int:
         cfg = VARIANTS[name]()
         nbytes = state_nbytes(cfg)["total"]
         rep = run_fault_eval(n_streams=args.streams, length=args.length,
-                             cfg=cfg, backend="tpu")
+                             kinds=kinds, cfg=cfg, backend="tpu")
         d = dataclasses.asdict(rep)
         results[name] = {
             "bytes_per_stream": int(nbytes),
             # per-variant: a merged re-run at another scale must not
             # relabel previously measured entries
-            "protocol": f"{args.streams} x {args.length}, fault_eval defaults",
+            "protocol": f"{args.streams} x {args.length}, "
+                        + ("all kinds" if args.all_kinds
+                           else "fault_eval defaults"),
             "at_best": d["at_best"],
             "best_threshold": d.get("best_threshold"),
             "per_kind": d.get("per_kind"),

@@ -289,3 +289,57 @@ def test_dispatch_threads_validation():
     reg = _registry()
     with pytest.raises(ValueError, match="dispatch_threads"):
         live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, dispatch_threads=0)
+
+
+# What --freeze freezes: the learned tensors. Everything else is temporal
+# context that inference itself evolves (NuPIC TM with learn=False still
+# computes activations and predictions; it just never touches permanences,
+# synapse growth, or duty cycles). seg_pot is dynamic: it is the count of
+# potential synapses whose presynaptic cell fired at the PREVIOUS step —
+# frozen weights x evolving activity (models/state.py).
+FROZEN_KEYS = {"perm", "syn_perm", "presyn", "potential", "boost",
+               "active_duty", "overlap_duty", "seg_last", "tm_overflow",
+               "sp_iter", "enc_bound", "enc_offset", "enc_resolution"}
+DYNAMIC_KEYS = {"active_seg", "matching_seg", "prev_active", "prev_winner",
+                "seg_pot", "tm_iter"}
+
+
+def test_freeze_serves_without_mutating_learned_state(tmp_path):
+    """learn=False (serve --freeze, NuPIC disableLearning parity): every
+    learned tensor (SP permanences/boost/duty cycles, TM synapses/pools)
+    is bit-identical after any number of frozen ticks, while scoring
+    still flows, temporal context still evolves, and the host-side
+    likelihood normalizer keeps adapting."""
+    reg = _registry()
+    # mature the models first: a frozen fresh model only proves zeros
+    live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.0)
+    before = [{k: np.asarray(v).copy() for k, v in g.state.items()}
+              for g in reg.groups]
+    assert FROZEN_KEYS | DYNAMIC_KEYS == set(before[0])  # no key unaccounted
+    lik_records_before = [g.likelihood.records for g in reg.groups]
+
+    path = str(tmp_path / "alerts_frozen.jsonl")
+    ck = tmp_path / "ck_frozen"
+    ck.mkdir()
+    stats = live_loop(lambda k: _feed(k + N_TICKS), reg, n_ticks=N_TICKS,
+                      cadence_s=0.0, alert_path=path, learn=False,
+                      checkpoint_dir=str(ck), checkpoint_every=3)
+    assert stats["learn"] is False
+    assert stats["scored"] == G_TOTAL * N_TICKS  # scoring still flows
+    # frozen serving treats --checkpoint-dir as strictly read-only: no
+    # periodic saves, no exit save (replicas may share a golden dir)
+    assert stats["checkpoints_saved"] == 0
+    assert list(ck.iterdir()) == []
+    # the likelihood normalizer is downstream of the model and must keep
+    # adapting while frozen (documented --freeze semantics)
+    for n0, g in zip(lik_records_before, reg.groups):
+        assert g.likelihood.records == n0 + N_TICKS
+
+    for b, g in zip(before, reg.groups):
+        for key in FROZEN_KEYS:
+            np.testing.assert_array_equal(
+                b[key], np.asarray(g.state[key]), err_msg=key)
+        # the recurrent context must still advance — a frozen model that
+        # stops predicting would score every tick anomalous
+        assert any(not np.array_equal(b[k], np.asarray(g.state[k]))
+                   for k in DYNAMIC_KEYS)
