@@ -38,8 +38,18 @@ def _apply_cadence(cfg, args: argparse.Namespace):
                                 burst=getattr(args, "learn_burst", 1))
 
 
+def _sized_cluster(args: argparse.Namespace):
+    """cluster_preset, optionally width-scaled (--columns: SCALING.md model-
+    width study — per-workload deployment choice; validation lives in
+    scaled_cluster_preset, which rejects degenerate geometries loudly)."""
+    from rtap_tpu.config import cluster_preset, scaled_cluster_preset
+
+    cols = getattr(args, "columns", None)
+    return cluster_preset() if cols is None else scaled_cluster_preset(cols)
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
-    from rtap_tpu.config import cluster_preset, nab_preset
+    from rtap_tpu.config import nab_preset
     from rtap_tpu.service.loop import live_loop
     from rtap_tpu.service.registry import StreamGroupRegistry
     from rtap_tpu.service.sources import HttpPollSource, TcpJsonlSource
@@ -51,7 +61,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     if args.group_size < 1:
         print("serve: --group-size must be >= 1", file=sys.stderr)
         return 2
-    cfg = nab_preset() if args.preset == "nab" else cluster_preset()
+    # (--columns + --preset nab rejected in main() before backend init)
+    cfg = nab_preset() if args.preset == "nab" else _sized_cluster(args)
     cfg = _apply_cadence(cfg, args)
     # many groups per chip is the at-scale serving shape (throughput peaks
     # at small G — SCALING.md); capping at len(ids) keeps small serves in
@@ -114,7 +125,6 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
 
 def _cmd_replay(args: argparse.Namespace) -> int:
-    from rtap_tpu.config import cluster_preset
     from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_cluster
     from rtap_tpu.service.loop import replay_streams
 
@@ -129,7 +139,7 @@ def _cmd_replay(args: argparse.Namespace) -> int:
                                  anomaly_magnitude=args.magnitude,
                                  noise_phi=0.97, noise_scale=0.5)
     streams = generate_cluster(args.nodes, cfg=scfg, seed=args.seed)
-    res = replay_streams(streams, _apply_cadence(cluster_preset(), args),
+    res = replay_streams(streams, _apply_cadence(_sized_cluster(args), args),
                          backend=args.backend,
                          group_size=args.group_size, chunk_ticks=args.chunk_ticks,
                          threshold=args.threshold, alert_path=args.alerts,
@@ -246,6 +256,14 @@ def main(argv: list[str] | None = None) -> int:
                         "(reports/live_soak_pipelined.json measured depth 2 "
                         "at 16 groups unchanged, p50 1.07 s); output is "
                         "bit-identical to serial dispatch")
+    p.add_argument("--columns", type=int, default=None,
+                   help="width-scale the cluster preset's SP to N columns "
+                        "(scaled_cluster_preset: ratio-preserving k-winners/"
+                        "thresholds). The measured density levers: 32 col = "
+                        "best f1 on the node-metric family at 1/8 state and "
+                        "2.26x throughput; with --learn-every 2 it is the "
+                        "135.8k/chip bench headline (SCALING.md model-width "
+                        "study). Default: the conservative 256-col preset")
     p.add_argument("--freeze", action="store_true",
                    help="inference-only serving (NuPIC disableLearning "
                         "parity): SP/TM/classifier state is bit-frozen, raw "
@@ -292,6 +310,8 @@ def main(argv: list[str] | None = None) -> int:
                    help="inference-only replay (NuPIC disableLearning "
                         "parity): no SP/TM/classifier updates; likelihood "
                         "still adapts")
+    p.add_argument("--columns", type=int, default=None,
+                   help="width-scale the cluster preset (see serve --columns)")
     p.set_defaults(fn=_cmd_replay)
 
     p = sub.add_parser("eval", help="fault-injection evaluation -> JSON report")
@@ -328,6 +348,14 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=_cmd_report)
 
     args = ap.parse_args(argv)
+    # cheap flag-consistency checks BEFORE backend init: a usage error must
+    # surface instantly, not after a 120 s wedged-tunnel watchdog
+    if getattr(args, "preset", None) == "nab" and \
+            getattr(args, "columns", None) is not None:
+        print("serve: --columns applies to the cluster preset only "
+              "(the NAB family scales via scaled_nab_preset)",
+              file=sys.stderr)
+        return 2
     if getattr(args, "backend", None) == "tpu":
         # fail in 120s on a wedged tunnel instead of hanging the operator's
         # terminal, and reuse compiled programs across service restarts

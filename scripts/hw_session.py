@@ -213,6 +213,14 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                                "--T", "32", "--gs", "131072", "196608",
                                "262144", "--layout", "flat", "--columns", "32",
                                "--perm-bits", "8"], 1800.0),
+    # quality numbers for the u8-domain capability configs (16 s each on
+    # device at the 120x1500 protocol)
+    ("eval_32col_u8", [sys.executable, "scripts/model_size_eval.py",
+                       "--variants", "eighth_32col_u8,eighth_32col_u8_k2"]),
+    ("eval_32col_u8_allkinds", [sys.executable, "scripts/model_size_eval.py",
+                                "--variants",
+                                "eighth_32col_u8,eighth_32col_u8_k2",
+                                "--all-kinds"]),
 ]
 
 

@@ -146,6 +146,13 @@ def main() -> int:
                     help="passed through to serve: overlap the per-group "
                          "blocking dispatch RPCs (the tunnel's ~65 ms/group "
                          "serial floor that depth 2 alone cannot touch)")
+    ap.add_argument("--columns", type=int, default=None,
+                    help="passed through to serve: width-scaled cluster "
+                         "preset (the density lever; SCALING.md)")
+    ap.add_argument("--learn-every", type=int, default=1,
+                    help="passed through to serve: learning cadence")
+    ap.add_argument("--freeze", action="store_true",
+                    help="passed through to serve: inference-only soak")
     ap.add_argument("--startup-timeout", type=float, default=420.0,
                     help="budget for serve's backend init + first compile")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
@@ -165,6 +172,12 @@ def main() -> int:
         "--dispatch-threads", str(args.dispatch_threads),
         "--alerts", alerts_path,
     ]
+    if args.columns is not None:
+        cmd += ["--columns", str(args.columns)]
+    if args.learn_every != 1:
+        cmd += ["--learn-every", str(args.learn_every)]
+    if args.freeze:
+        cmd += ["--freeze"]
     log(f"starting serve: G={args.streams} ticks={args.ticks} "
         f"cadence={args.cadence}s backend={args.backend}")
     proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
@@ -208,6 +221,9 @@ def main() -> int:
         # backend="tpu" under RTAP_FORCE_CPU=1 is the JAX group kernels on
         # the CPU platform (the tunnel-down fallback), not the chip
         "forced_cpu": force_cpu_requested(),
+        # model config the numbers were measured under — a width-scaled or
+        # cadence-thinned soak must be distinguishable from a default one
+        "columns": args.columns, "learn_every": args.learn_every,
         "alert_lines": n_alert_lines,
         "feeder_ticks_pushed": feeder.ticks_pushed,
         "feeder_error": feeder.error, **stats,

@@ -51,6 +51,11 @@ VARIANTS = {
     # the projected ~126k/s/chip rung (32col learning is ~91% of the tick,
     # profile_eighth.log): what does k=2 cost the best-f1 width?
     "eighth_32col_k2": lambda: sized_preset(32, learn_every=2),
+    # the resident-capability domain (u8 perm halves 32col state again —
+    # the ~quarter-million-streams/chip claim needs its quality number;
+    # the 256col domain study measured u8 acceptable, width may interact)
+    "eighth_32col_u8": lambda: sized_preset(32, perm_bits=8),
+    "eighth_32col_u8_k2": lambda: sized_preset(32, perm_bits=8, learn_every=2),
 }
 
 

@@ -28,6 +28,23 @@ def test_replay_emits_throughput_stats():
     assert out["scored"] == 6 * 900
 
 
+def test_replay_width_scaled_frozen():
+    """--columns selects the width-scaled preset and --freeze runs
+    inference-only, through the real CLI (the density + read-only levers
+    SCALING.md recommends must be reachable by operators)."""
+    p = run_cli("replay", "--nodes", "2", "--length", "100",
+                "--columns", "32", "--freeze", "--backend", "cpu")
+    assert p.returncode == 0, p.stderr[-2000:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["scored"] == 6 * 100
+
+
+def test_serve_rejects_columns_on_nab_preset():
+    p = run_cli("serve", "--streams", "a", "--preset", "nab", "--columns", "32")
+    assert p.returncode == 2
+    assert "cluster preset only" in p.stderr
+
+
 def test_serve_tcp_scores_pushed_records(tmp_path):
     alerts = tmp_path / "alerts.jsonl"
     proc = subprocess.Popen(
