@@ -54,7 +54,19 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     from rtap_tpu.service.registry import StreamGroupRegistry
     from rtap_tpu.service.sources import HttpPollSource, TcpJsonlSource
 
-    ids = [s.strip() for s in args.streams.split(",") if s.strip()]
+    if args.streams.startswith("@"):
+        # @file form: one stream id per line — a 16k-stream fleet's comma
+        # list exceeds the kernel's single-argv limit (MAX_ARG_STRLEN,
+        # observed at the live_soak_16k harvest step)
+        try:
+            with open(args.streams[1:]) as f:
+                ids = [s.strip() for s in f if s.strip()]
+        except OSError as e:
+            print(f"serve: cannot read stream-id file {args.streams[1:]}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        ids = [s.strip() for s in args.streams.split(",") if s.strip()]
     if not ids:
         print("serve: --streams must name at least one stream id", file=sys.stderr)
         return 2
@@ -207,7 +219,10 @@ def main(argv: list[str] | None = None) -> int:
 
     p = sub.add_parser("serve", help="live scoring loop fed by TCP push or HTTP poll")
     p.add_argument("--streams", required=True,
-                   help="comma-separated stream ids to register")
+                   help="comma-separated stream ids to register, or "
+                        "@/path/to/file with one id per line (argv has a "
+                        "~128 KB single-argument limit; fleets above a few "
+                        "thousand streams need the file form)")
     p.add_argument("--http", default=None,
                    help="poll this metrics endpoint each tick (default: TCP listener)")
     p.add_argument("--port", type=int, default=0, help="TCP listen port (0 = ephemeral)")

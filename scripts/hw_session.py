@@ -221,6 +221,22 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                                 "--variants",
                                 "eighth_32col_u8,eighth_32col_u8_k2",
                                 "--all-kinds"]),
+    # live capability at the measured resident frontier: 16k and 32k
+    # streams at 1 s cadence WITH learning on one chip (32col learn
+    # ticks profile 345/769 ms at G=16k/32k; k=2 + depth 2 + threads
+    # hide the rest). Startup pays a big state transfer: raised budget.
+    ("live_soak_16k", [sys.executable, "scripts/live_soak.py",
+                       "--streams", "16384", "--group-size", "4096",
+                       "--columns", "32", "--learn-every", "2",
+                       "--pipeline-depth", "2", "--dispatch-threads", "4",
+                       "--startup-timeout", "900",
+                       "--out", "reports/live_soak_16k.json"], 2400.0),
+    ("live_soak_32k", [sys.executable, "scripts/live_soak.py",
+                       "--streams", "32768", "--group-size", "4096",
+                       "--columns", "32", "--learn-every", "2",
+                       "--pipeline-depth", "2", "--dispatch-threads", "8",
+                       "--startup-timeout", "900",
+                       "--out", "reports/live_soak_32k.json"], 2400.0),
 ]
 
 

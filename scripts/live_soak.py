@@ -160,9 +160,18 @@ def main() -> int:
 
     ids = [f"node{i // 4:04d}.m{i % 4}" for i in range(args.streams)]
     alerts_path = os.path.join(REPO, "reports", "live_soak_alerts.jsonl")
+    # @file form always: a 16k-stream comma list exceeds MAX_ARG_STRLEN
+    # (observed: live_soak_16k step died "Argument list too long").
+    # Per-run temp file: a fixed path would let concurrent soaks swap id
+    # sets under each other mid-startup, and would leave junk in reports/
+    import tempfile
+
+    fd, ids_path = tempfile.mkstemp(prefix="live_soak_ids_", suffix=".txt")
+    with os.fdopen(fd, "w") as f:
+        f.write("\n".join(ids) + "\n")
     cmd = [
         sys.executable, "-m", "rtap_tpu", "serve",
-        "--streams", ",".join(ids),
+        "--streams", "@" + ids_path,
         "--port", "0",
         "--ticks", str(args.ticks),
         "--cadence", str(args.cadence),
@@ -203,6 +212,10 @@ def main() -> int:
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+        try:
+            os.remove(ids_path)
+        except OSError:
+            pass
     if proc.returncode != 0:
         sys.stderr.write("".join(stderr_lines))
         log(f"serve failed rc={proc.returncode}")

@@ -45,10 +45,25 @@ def test_serve_rejects_columns_on_nab_preset():
     assert "cluster preset only" in p.stderr
 
 
+def test_serve_streams_file_form(tmp_path):
+    """--streams @file: fleets beyond a few thousand ids exceed the kernel
+    argv limit (observed at the 16k-stream soak), so the file form is the
+    at-scale registration path. Missing file = instant usage error."""
+    p = run_cli("serve", "--streams", "@" + str(tmp_path / "absent.txt"))
+    assert p.returncode == 2
+    assert "cannot read stream-id file" in p.stderr
+
+
 def test_serve_tcp_scores_pushed_records(tmp_path):
     alerts = tmp_path / "alerts.jsonl"
+    # register via the @file form — the at-scale path (argv has a ~128 KB
+    # single-argument limit): this pins the happy-path file parsing
+    # (strip, skip blanks) through the real serve flow
+    ids_file = tmp_path / "ids.txt"
+    ids_file.write_text("a\n\nb\n")
     proc = subprocess.Popen(
-        [sys.executable, "-m", "rtap_tpu", "serve", "--streams", "a,b",
+        [sys.executable, "-m", "rtap_tpu", "serve",
+         "--streams", "@" + str(ids_file),
          "--ticks", "5", "--cadence", "0.2", "--backend", "cpu", "--port", "0",
          "--alerts", str(alerts)],
         cwd=REPO, env=ENV, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
