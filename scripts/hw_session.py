@@ -237,6 +237,18 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                        "--pipeline-depth", "2", "--dispatch-threads", "8",
                        "--startup-timeout", "900",
                        "--out", "reports/live_soak_32k.json"], 2400.0),
+    # frozen serving at the FULL resident frontier: inference-only ticks
+    # profile ~1/5 of learning, so 65,536 frozen streams should hold 1 s
+    # where learning cannot (1,555 ms/tick). Capability-envelope probe: a
+    # fresh model served frozen measures the serving path, not detection.
+    ("live_soak_64k_frozen", [sys.executable, "scripts/live_soak.py",
+                              "--streams", "65536", "--group-size", "8192",
+                              "--columns", "32", "--freeze",
+                              "--pipeline-depth", "2",
+                              "--dispatch-threads", "8",
+                              "--startup-timeout", "1200",
+                              "--out", "reports/live_soak_64k_frozen.json"],
+     2700.0),
 ]
 
 
