@@ -346,6 +346,26 @@ class ModelConfig:
                               if full_until is None else full_until),
         )
 
+    def with_learning_period(self, learning_period: int) -> "ModelConfig":
+        """Likelihood probation override (the measured precision lever:
+        lp600 is +3 f1 points on the quality study, cost = +5 min warm-up
+        over the preset's 300 at 1 s cadence, 10 min total). Apply BEFORE
+        `with_learn_every`: the cadence's default full-rate window is the
+        learning_period, so the other order silently pins full_until to
+        the old probation — this helper and the CLI both enforce the safe
+        ordering so callers cannot compose them wrong. Re-deriving
+        learn_full_until here keeps an already-cadenced config aligned."""
+        if learning_period < 1:
+            raise ValueError(f"learning_period must be >= 1; got {learning_period}")
+        cfg = dataclasses.replace(self, likelihood=dataclasses.replace(
+            self.likelihood, learning_period=learning_period))
+        if cfg.cadence_active and self.learn_full_until == \
+                self.likelihood.learning_period:
+            # the cadence was using the default maturity boundary: keep it
+            # tied to the (new) probation rather than the stale value
+            cfg = dataclasses.replace(cfg, learn_full_until=learning_period)
+        return cfg
+
     def __post_init__(self) -> None:
         # A col_cap below the SP winner count would silently truncate the
         # kernel's column-compact active set and corrupt dendrite counts (the

@@ -342,10 +342,12 @@ def main() -> None:
     args = ap.parse_args()
 
     base = cluster_preset(**({"perm_bits": args.perm_bits} if args.perm_bits is not None else {}))
-    lik = dataclasses.replace(base.likelihood, mode=args.likelihood)
+    cfg = dataclasses.replace(base, likelihood=dataclasses.replace(
+        base.likelihood, mode=args.likelihood))
     if args.learning_period is not None:
-        lik = dataclasses.replace(lik, learning_period=args.learning_period)
-    cfg = dataclasses.replace(base, likelihood=lik)
+        # shared helper: keeps the cadence's full-rate window aligned and
+        # enforces the replace-before-with_learn_every ordering
+        cfg = cfg.with_learning_period(args.learning_period)
     if args.learn_every != 1 or args.learn_full_until is not None \
             or args.learn_burst != 1:
         # shared policy with the operator CLI (ModelConfig.with_learn_every):

@@ -249,6 +249,16 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                               "--startup-timeout", "1200",
                               "--out", "reports/live_soak_64k_frozen.json"],
      2700.0),
+    # width x probation composition: does the lp600 likelihood lever
+    # (+3 points on the preset) stack with the 32col width (0.813)?
+    ("eval_32col_lp600", [sys.executable, "scripts/model_size_eval.py",
+                          "--variants",
+                          "eighth_32col_lp600,eighth_32col_k2_lp600"]),
+    ("eval_32col_lp600_allkinds", [sys.executable,
+                                   "scripts/model_size_eval.py",
+                                   "--variants",
+                                   "eighth_32col_lp600,eighth_32col_k2_lp600",
+                                   "--all-kinds"]),
 ]
 
 

@@ -30,12 +30,17 @@ from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
 maybe_force_cpu()
 
 
-def sized_preset(columns: int, perm_bits: int = 16, learn_every: int = 1):
+def sized_preset(columns: int, perm_bits: int = 16, learn_every: int = 1,
+                 learning_period: int | None = None):
     """See rtap_tpu.config.scaled_cluster_preset (promoted there once the
-    quality datum landed; this wrapper adds the cadence composition)."""
+    quality datum landed; this wrapper adds the cadence + likelihood-
+    probation compositions — learning_period=600 is the documented
+    precision lever from the quality study)."""
     from rtap_tpu.config import scaled_cluster_preset
 
     cfg = scaled_cluster_preset(columns, perm_bits=perm_bits)
+    if learning_period is not None:
+        cfg = cfg.with_learning_period(learning_period)
     if learn_every > 1:
         cfg = cfg.with_learn_every(learn_every)
     return cfg
@@ -56,6 +61,12 @@ VARIANTS = {
     # the 256col domain study measured u8 acceptable, width may interact)
     "eighth_32col_u8": lambda: sized_preset(32, perm_bits=8),
     "eighth_32col_u8_k2": lambda: sized_preset(32, perm_bits=8, learn_every=2),
+    # width x probation composition: lp600 is the +3-point likelihood
+    # lever on the preset (quality_study streaming 0.789 -> 0.819); does
+    # it stack with the best-f1 width (0.813) and its k=2 point (0.762)?
+    "eighth_32col_lp600": lambda: sized_preset(32, learning_period=600),
+    "eighth_32col_k2_lp600": lambda: sized_preset(32, learn_every=2,
+                                                  learning_period=600),
 }
 
 

@@ -83,3 +83,43 @@ class TestScaledNabPreset:
         # dataclasses.replace must not sidestep ModelConfig invariants
         cfg = scaled_nab_preset(256)
         assert dataclasses.replace(cfg) == cfg
+
+
+class TestWithLearningPeriod:
+    """ModelConfig.with_learning_period — the probation lever (lp600 is
+    the measured +3-point precision option) with cadence-alignment safety:
+    the two composition orders must agree, so callers cannot produce a
+    full-rate window that contradicts the probation."""
+
+    def test_sets_probation(self):
+        from rtap_tpu.config import cluster_preset
+
+        cfg = cluster_preset().with_learning_period(600)
+        assert cfg.likelihood.learning_period == 600
+
+    def test_order_independent_with_cadence(self):
+        from rtap_tpu.config import cluster_preset
+
+        base = cluster_preset()
+        a = base.with_learning_period(600).with_learn_every(2)
+        b = base.with_learn_every(2).with_learning_period(600)
+        assert a == b
+        assert a.learn_full_until == 600  # maturity boundary follows lp
+
+    def test_explicit_full_until_is_preserved(self):
+        from rtap_tpu.config import cluster_preset
+
+        cfg = cluster_preset().with_learn_every(2, full_until=1000)
+        cfg = cfg.with_learning_period(600)
+        # an explicit, non-default boundary is the caller's choice: the
+        # probation change must not silently overwrite it
+        assert cfg.learn_full_until == 1000
+        assert cfg.likelihood.learning_period == 600
+
+    def test_invalid_period_raises(self):
+        import pytest
+
+        from rtap_tpu.config import cluster_preset
+
+        with pytest.raises(ValueError, match="learning_period"):
+            cluster_preset().with_learning_period(0)
