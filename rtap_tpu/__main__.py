@@ -79,17 +79,27 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # many groups per chip is the at-scale serving shape (throughput peaks
     # at small G — SCALING.md); capping at len(ids) keeps small serves in
     # one exactly-sized group with no pad slots
-    grp = StreamGroupRegistry(cfg, group_size=min(args.group_size, len(ids)),
+    if args.auto_register and args.http:
+        print("serve: --auto-register requires the TCP push source (HTTP "
+              "polling only ever asks for known ids)", file=sys.stderr)
+        return 2
+    gsize = min(args.group_size, len(ids))
+    # --auto-register without reserved capacity can only claim group-size
+    # rounding pads; make the elastic intent explicit by default
+    reserve = args.reserve if args.reserve is not None \
+        else (gsize if args.auto_register else 0)
+    grp = StreamGroupRegistry(cfg, group_size=gsize,
                               backend=args.backend, threshold=args.threshold,
                               debounce=args.debounce)
     for sid in ids:
         grp.add_stream(sid)
-    grp.finalize()
+    grp.finalize(reserve=reserve)
     if args.http:
         source = HttpPollSource(args.http, ids)
         close = lambda: None  # noqa: E731
     else:
-        tcp = TcpJsonlSource(ids, port=args.port).start()
+        tcp = TcpJsonlSource(ids, port=args.port,
+                             track_unknown=args.auto_register).start()
         host, port = tcp.address
         print(f"serve: listening for JSONL records on {host}:{port}", file=sys.stderr)
         source, close = tcp, tcp.close
@@ -120,7 +130,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           stop_event=stop,
                           pipeline_depth=args.pipeline_depth,
                           dispatch_threads=args.dispatch_threads,
-                          learn=not args.freeze)
+                          learn=not args.freeze,
+                          auto_register=args.auto_register)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -279,6 +290,18 @@ def main(argv: list[str] | None = None) -> int:
                         "2.26x throughput; with --learn-every 2 it is the "
                         "135.8k/chip bench headline (SCALING.md model-width "
                         "study). Default: the conservative 256-col preset")
+    p.add_argument("--auto-register", action="store_true",
+                   help="lazily create a model for every NEW stream id the "
+                        "TCP listener sees (the reference's per-metric lazy "
+                        "model creation): unknown ids claim free pad slots "
+                        "with a fresh model + their own likelihood "
+                        "probation, no recompile. Capacity = pad slots "
+                        "(--reserve; default one extra group's worth). TCP "
+                        "source only")
+    p.add_argument("--reserve", type=int, default=None,
+                   help="extra claimable pad-slot capacity for post-start "
+                        "registration (rounded up to whole groups; default "
+                        "0, or one group's worth with --auto-register)")
     p.add_argument("--freeze", action="store_true",
                    help="inference-only serving (NuPIC disableLearning "
                         "parity): SP/TM/classifier state is bit-frozen, raw "

@@ -207,6 +207,21 @@ def replicate_state_device(state: dict, group_size: int) -> dict:
     return _broadcast_state(single, group_size)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _set_row_jit(state: dict, fresh: dict, slot: jnp.ndarray) -> dict:
+    return jax.tree_util.tree_map(
+        lambda s, f: s.at[slot].set(f.astype(s.dtype)), state, fresh)
+
+
+def set_state_row(state: dict, fresh: dict, slot: int) -> dict:
+    """Overwrite ONE stream's row of grouped [G, ...] state with a fresh
+    single-stream state (dynamic slot claim — registry.claim_slot). The
+    slot index is a traced argument so claiming different slots reuses one
+    compiled program; the group buffer is donated (no [G, ...] copy)."""
+    return _set_row_jit(state, {k: jnp.asarray(v) for k, v in fresh.items()},
+                        jnp.asarray(slot, jnp.int32))
+
+
 class TpuStepRunner:
     """Holds one stream's device state and steps it record by record.
 

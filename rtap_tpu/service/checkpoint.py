@@ -193,22 +193,47 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
     if "alert_run" in tree:  # pre-debounce checkpoints lack it (zeros then)
         grp._alert_run = np.asarray(tree["alert_run"]).astype(np.int64)
     grp.ticks = int(meta["ticks"])
-    grp.n_live = int(meta["n_live"])
+    # n_live is now derived from stream_ids (pad-prefix count) — the meta
+    # field stays written for inspection/back-compat but is not load-bearing
     return grp
 
 
-def validate_resume(resumed: StreamGroup, ck_path, grp: StreamGroup) -> None:
+def validate_resume(resumed: StreamGroup, ck_path, grp: StreamGroup,
+                    allow_claimed_extras: bool = False) -> None:
     """Shared resume-safety gate for replay_streams and live_loop: a resumed
     group silently carries its checkpoint's model config and alerting
     semantics, so the checkpoint must MATCH what this run would have built —
     mixing them would blend two semantics in one result. Mismatches are
     errors, not surprises. Add new load-bearing fields here, once, so both
-    entry points stay in lockstep."""
-    if resumed.stream_ids != grp.stream_ids:
+    entry points stay in lockstep.
+
+    `allow_claimed_extras` (serve --auto-register): slots this run built as
+    PADS may hold real streams in the checkpoint — they were lazily claimed
+    in the prior run and rightfully resume live (the caller reconciles
+    registry routing). Pad names may differ (released slots get unique
+    names). Every REQUESTED stream must still match its slot exactly."""
+    from rtap_tpu.service.registry import PAD_PREFIX
+
+    if len(resumed.stream_ids) != len(grp.stream_ids):
         raise ValueError(
-            f"checkpoint {ck_path} holds streams {resumed.stream_ids[:3]}... "
-            f"but this group expects {grp.stream_ids[:3]}...; refusing to "
+            f"checkpoint {ck_path} has {len(resumed.stream_ids)} slots but "
+            f"this group was built with {len(grp.stream_ids)}; refusing to "
             "resume")
+    for slot, (ck_id, want_id) in enumerate(
+            zip(resumed.stream_ids, grp.stream_ids)):
+        if ck_id == want_id:
+            continue
+        ck_pad = ck_id.startswith(PAD_PREFIX)
+        want_pad = want_id.startswith(PAD_PREFIX)
+        if ck_pad and want_pad:
+            continue  # pad naming is not load-bearing (released slots)
+        if allow_claimed_extras and want_pad and not ck_pad:
+            continue  # a previously auto-registered stream resumes live
+        raise ValueError(
+            f"checkpoint {ck_path} holds {ck_id!r} at slot {slot} but this "
+            f"group expects {want_id!r}; refusing to resume"
+            + ("" if allow_claimed_extras else
+               " (serve --auto-register resumes lazily claimed extras)"))
     mismatches = [
         f"{name}: checkpoint={a!r} vs requested={b!r}"
         for name, a, b in (

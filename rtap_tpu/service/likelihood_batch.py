@@ -59,6 +59,11 @@ class BatchAnomalyLikelihood:
         self.cfg = cfg
         self.G = int(group_size)
         self.records = 0
+        # per-slot birth record count: slots claimed mid-run (dynamic stream
+        # registration, C19 lazy-creation parity) restart THEIR probation
+        # clock while the group's lockstep cursor keeps running. age of slot
+        # g = records - birth[g]; 0 for original members.
+        self.birth = np.zeros(self.G, np.int64)
         # short moving-average ring [G, w]
         self.recent = np.zeros((self.G, cfg.averaging_window), np.float64)
         self.mean = np.zeros(self.G, np.float64)
@@ -92,12 +97,30 @@ class BatchAnomalyLikelihood:
             # historic window ring [G, W]; cursor/fill shared (lockstep)
             self.scores = np.zeros((self.G, cfg.historic_window_size), np.float64)
 
+    # ---- dynamic membership ----
+    def reset_slot(self, g: int) -> None:
+        """Re-initialize one slot for a stream claimed mid-run: fresh
+        moments/rings and a probation clock starting NOW. Exact in
+        streaming mode (per-stream EMA moments); in window mode the
+        historic ring keeps pre-birth zeros until it refills, biasing the
+        refit for this slot — acceptable for the window QUALITY-comparison
+        mode, and the at-scale serving default is streaming."""
+        self.birth[g] = self.records
+        self.recent[g] = 0.0
+        self.mean[g] = 0.0
+        self.std[g] = 1.0
+        if self.scores is None:
+            self._s0[g] = self._s1[g] = self._s2[g] = 0.0
+        else:
+            self.scores[g] = 0.0
+
     # ---- checkpointing ----
     def state_dict(self) -> dict[str, np.ndarray]:
         d = {
             # 0-d arrays, not numpy scalars: orbax has no TypeHandler for the
             # scalar types (np.bool_/np.int64)
             "records": np.asarray(self.records, np.int64),
+            "birth": self.birth,
             "recent": self.recent,
             "mean": self.mean,
             "std": self.std,
@@ -111,6 +134,10 @@ class BatchAnomalyLikelihood:
 
     def load_state_dict(self, d: dict[str, np.ndarray]) -> None:
         self.records = int(d["records"])
+        # pre-dynamic-membership checkpoints lack birth: zeros (all slots
+        # are founding members) reproduces the old behavior exactly
+        self.birth = (np.asarray(d["birth"], np.int64) if "birth" in d
+                      else np.zeros(self.G, np.int64))
         self.recent = np.asarray(d["recent"], np.float64)
         self.mean = np.asarray(d["mean"], np.float64)
         self.std = np.asarray(d["std"], np.float64)
@@ -167,11 +194,22 @@ class BatchAnomalyLikelihood:
         w = self.cfg.averaging_window
         self.recent[:, self.records % w] = raw
         self.records += 1
-        n_recent = min(self.records, w)
-        if self.records < w:
-            avg = self.recent[:, :n_recent].sum(axis=1) / n_recent
+        if not self.birth.any():
+            # founding-members fast path: bit-identical to the original
+            # lockstep logic (all slots share one age)
+            n_recent = min(self.records, w)
+            if self.records < w:
+                avg = self.recent[:, :n_recent].sum(axis=1) / n_recent
+            else:
+                avg = self.recent.sum(axis=1) / w
         else:
-            avg = self.recent.sum(axis=1) / w
+            # per-slot age: a claimed slot's ring was zeroed at birth, so
+            # the full-ring sum is the sum of its own samples; dividing by
+            # min(age, w) reproduces a fresh stream's moving average
+            # (for birth=0 slots this equals the fast path up to summation
+            # order). Same lockstep cursor, per-slot maturity.
+            age = np.minimum(self.records - self.birth, w)
+            avg = self.recent.sum(axis=1) / np.maximum(age, 1)
 
         if self.cfg.mode == "streaming":
             self._update_streaming(avg)
@@ -185,4 +223,11 @@ class BatchAnomalyLikelihood:
             half = np.full(self.G, 0.5)
             return half, log_likelihood_np(half)
         lik = 1.0 - tail_probability_np((avg - self.mean) / self.std)
+        # per-slot probation: slots claimed mid-run (birth > 0) are pinned
+        # to 0.5 until THEIR OWN age clears the probationary period — a
+        # late-joining stream must not be scored against moments it has
+        # not yet established (same contract as a founding member's)
+        young = (self.records - self.birth) < self.cfg.probationary_period
+        if young.any():
+            lik = np.where(young, 0.5, lik)
         return lik, log_likelihood_np(lik)

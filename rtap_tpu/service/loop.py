@@ -18,7 +18,17 @@ import numpy as np
 from rtap_tpu.config import ModelConfig
 from rtap_tpu.data.synthetic import LabeledStream
 from rtap_tpu.service.alerts import AlertWriter, ThroughputCounter
-from rtap_tpu.service.registry import StreamGroup, StreamGroupRegistry
+from rtap_tpu.service.registry import (
+    PAD_PREFIX,
+    StreamGroup,
+    StreamGroupRegistry,
+    _Slot as _RegistrySlot,
+)
+
+#: bound on remembered rejected-id names under --auto-register (mirrors
+#: TcpJsonlSource.MAX_UNKNOWN_TRACKED: an id-spraying producer must not
+#: grow a long-lived server's memory); the REJECTED COUNT keeps counting
+_MAX_REJECTED_TRACKED = 4096
 
 
 @dataclass
@@ -200,11 +210,22 @@ def live_loop(
     pipeline_depth: int = 1,
     dispatch_threads: int = 1,
     learn: bool = True,
+    auto_register: bool = False,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
     budget. Returns throughput stats including missed-deadline count — the
     real-time health signal for the 1s-cadence north star.
+
+    `auto_register=True` (with a registry + a source exposing
+    `drain_unknown`/`set_ids`, i.e. TcpJsonlSource(track_unknown=True)):
+    unknown stream ids arriving on the wire lazily claim free pad slots —
+    the reference's model-per-new-metric creation (SURVEY.md C19) without
+    recompiling (shapes are static; a claimed slot's model state,
+    likelihood probation, and debounce reset — registry.add_stream).
+    Capacity = pad slots (group-size rounding + `finalize(reserve=)` +
+    released streams); ids beyond capacity are counted in
+    `auto_rejected` and not retried.
 
     `learn=False` freezes the models (NuPIC `disableLearning()` parity —
     SURVEY §3.2 OPF model surface): SP/TM/classifier state is
@@ -287,16 +308,29 @@ def live_loop(
             if not os.path.isdir(ck_path):
                 continue
             resumed = load_group(ck_path, mesh=grp.mesh)
-            validate_resume(resumed, ck_path, grp)
-            resumed.n_live = getattr(grp, "n_live", grp.G)
-            groups[gi] = resumed
+            validate_resume(resumed, ck_path, grp,
+                            allow_claimed_extras=auto_register)
+            groups[gi] = resumed  # n_live derives from the resumed ids
             # the registry's lookup() index must observe the resumed
             # instance too, not the stale fresh group
             if isinstance(group, StreamGroupRegistry):
                 for slot in group._slots.values():
                     if slot.group is grp:
                         slot.group = resumed
+                # streams the PRIOR run auto-registered (live in the
+                # checkpoint, pads in the built group) rejoin the
+                # registry's index so routing emits them and re-arriving
+                # records aren't re-claimed into duplicate slots
+                for si, sid in enumerate(resumed.stream_ids):
+                    if not sid.startswith(PAD_PREFIX) and sid not in group:
+                        group._slots[sid] = _RegistrySlot(resumed, si)
+                        group.version += 1
             resumed_from[f"group{gi}"] = resumed.ticks
+        if isinstance(group, StreamGroupRegistry) and resumed_from \
+                and hasattr(source, "set_ids"):
+            # the source must accept the resumed extras' records and return
+            # values in the (possibly grown) dispatch order
+            source.set_ids(group.dispatch_ids())
         # A crash between per-group saves leaves a torn set (groups at
         # different ticks). Live data is NOT tick-indexed (every group
         # scores whatever arrives now) and groups are fully independent,
@@ -313,8 +347,28 @@ def live_loop(
                   "saves); behind groups lost that many ticks of learning",
                   file=sys.stderr, flush=True)
         resume_tick_skew = (max(ticks_seen) - min(ticks_seen)) if resumed_from else 0
-    lives = [getattr(g, "n_live", g.G) for g in groups]  # pad slots never emit
-    n_expected = sum(lives)
+    reg = group if isinstance(group, StreamGroupRegistry) else None
+
+    # Value/emission routing: per group, the live slot indices, their ids,
+    # and the group's offset into the source value vector. Live slots are a
+    # prefix for a freshly finalized registry, but dynamic membership
+    # (claim/release of pad slots — SURVEY.md C19 lazy creation) makes them
+    # an arbitrary subset, so routing is index-based, not slicing. Rebuilt
+    # only when the registry's membership version changes; each in-flight
+    # pipeline entry carries the routing it was dispatched under.
+    def _build_routing():
+        maps, off = [], 0
+        for g in groups:
+            slots = g.live_slots()
+            maps.append((slots, [g.stream_ids[i] for i in slots], off))
+            off += len(slots)
+        return maps, off
+
+    routing, n_expected = _build_routing()
+    routing_version = reg.version if reg is not None else 0
+    auto_registered = 0
+    auto_rejected_total = 0
+    auto_rejected: set = set()  # bounded de-dup memory, not the count
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
     missed = 0
@@ -333,7 +387,7 @@ def live_loop(
         eff_threads = min(dispatch_threads, len(groups))
         pool = ThreadPoolExecutor(max_workers=eff_threads)
 
-    def _collect_tick(ts, values, handles):
+    def _collect_tick(ts, values, handles, rmaps):
         # collects in parallel (each blocks on its group's device fetch —
         # the per-group RPC on a remote link), emission strictly serial in
         # group order so the alert stream is schedule-independent
@@ -342,28 +396,25 @@ def live_loop(
         else:
             results = list(pool.map(
                 lambda gh: gh[0].collect_chunk(gh[1]), zip(groups, handles)))
-        off = 0
-        for grp, live, (raw, loglik, alerts) in zip(groups, lives, results):
-            writer.emit_batch(grp.stream_ids[:live], np.full(live, ts),
-                              values[off:off + live], raw[0, :live],
-                              loglik[0, :live], alerts[0, :live])
-            counter.add(live)
-            off += live
+        for (slots, ids, off), (raw, loglik, alerts) in zip(rmaps, results):
+            n = len(slots)
+            writer.emit_batch(ids, np.full(n, ts), values[off:off + n],
+                              raw[0, slots], loglik[0, slots],
+                              alerts[0, slots])
+            counter.add(n)
 
     warmed = False  # first tick dispatches serially: concurrent cold misses
     # on step.py's compiled-fn lru_cache are not single-flight, so N pool
     # threads would each trace+compile the same program (up to Nx the
     # dominant startup cost over the tunnel); one serial tick warms it
 
-    def _dispatch_all(values, ts):
+    def _dispatch_all(values, ts, rmaps):
         nonlocal warmed
         staged = []
-        off = 0
-        for grp, live in zip(groups, lives):
+        for grp, (slots, _ids, off) in zip(groups, rmaps):
             # trailing field axis preserved: values may be [G] or [G, n_fields]
             v = np.full((grp.G,) + values.shape[1:], np.nan, np.float32)
-            v[:live] = values[off:off + live]
-            off += live
+            v[slots] = values[off:off + len(slots)]
             staged.append((grp, v))
         if pool is None or not warmed:
             warmed = True
@@ -394,6 +445,46 @@ def live_loop(
             if stop_event is not None and stop_event.is_set():
                 break
             t_start = time.perf_counter()
+            # lazy model creation (serve --auto-register, SURVEY.md C19):
+            # unknown ids the TCP listener saw claim free pad slots. The
+            # pipeline drains first — membership may only change with
+            # nothing in flight (a claimed slot's reset must not race a
+            # dispatched-but-uncollected tick's emission routing).
+            if auto_register and reg is not None \
+                    and hasattr(source, "drain_unknown"):
+                # filter ids that registered meanwhile (records arriving
+                # between a drain and set_ids re-enter the unknown set) and
+                # pad-prefixed ids (one malicious "__pad0" record must not
+                # crash the server via claim_slot's reserved-prefix guard)
+                fresh = [s for s in source.drain_unknown()
+                         if s not in auto_rejected and s not in reg
+                         and not s.startswith(PAD_PREFIX)]
+                if fresh:
+                    claimed = False
+                    for sid in fresh:
+                        if reg.free_slots == 0:
+                            # remembered, not retried (capacity is static
+                            # until a release) — bounded: an id-spraying
+                            # producer must not grow host memory (the same
+                            # threat MAX_UNKNOWN_TRACKED guards)
+                            auto_rejected_total += 1
+                            if len(auto_rejected) < _MAX_REJECTED_TRACKED:
+                                auto_rejected.add(sid)
+                            continue
+                        if not claimed:
+                            # membership may only change with nothing in
+                            # flight (a claimed slot's reset must not race
+                            # an uncollected tick's emission routing)
+                            while in_flight:
+                                _collect_tick(*in_flight.popleft())
+                            claimed = True
+                        reg.add_stream(sid)
+                        auto_registered += 1
+                    if claimed:
+                        source.set_ids(reg.dispatch_ids())
+            if reg is not None and reg.version != routing_version:
+                routing, n_expected = _build_routing()
+                routing_version = reg.version
             values, ts = source(k)
             values = np.asarray(values, np.float32)
             if len(values) != n_expected:
@@ -401,11 +492,12 @@ def live_loop(
                     f"source returned {len(values)} values for {n_expected} "
                     "live streams (alignment with registration order is load-"
                     "bearing — a silent mismatch would misroute streams)")
-            handles = _dispatch_all(values, ts)
+            handles = _dispatch_all(values, ts, routing)
             # held across a tick at depth >= 2: a source reusing a
             # preallocated buffer must not corrupt the emitted values column
             in_flight.append(
-                (ts, values.copy() if pipeline_depth > 1 else values, handles))
+                (ts, values.copy() if pipeline_depth > 1 else values, handles,
+                 routing))
             while len(in_flight) >= pipeline_depth:
                 _collect_tick(*in_flight.popleft())
             ticks_run = k + 1
@@ -465,6 +557,8 @@ def live_loop(
             "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
             "pipeline_depth": pipeline_depth,
             "learn": learn,
+            **({"auto_registered": auto_registered,
+                "auto_rejected": auto_rejected_total} if auto_register else {}),
             # effective value: 1 when the pool was never created (single
             # group), so soak reports can't claim threading they didn't get
             "dispatch_threads": eff_threads,

@@ -33,8 +33,21 @@ class TickResult:
     prediction: np.ndarray | None = None  # [G] f32, when the classifier is on
 
 
+PAD_PREFIX = "__pad"
+
+
 class StreamGroup:
-    """G lockstep streams sharing one compiled device step (or one oracle loop)."""
+    """G lockstep streams sharing one compiled device step (or one oracle loop).
+
+    Slots whose id starts with ``__pad`` are capacity, not streams: they are
+    fed NaN, never emitted, and can be CLAIMED mid-run by a new stream
+    (:meth:`claim_slot` — the reference's lazy model creation, SURVEY.md C19)
+    or returned by a departing one (:meth:`release_slot`). Claiming resets
+    the slot's model state, likelihood moments + probation clock, and
+    debounce counter, so a claimed slot is indistinguishable from a fresh
+    model; the group's compiled program never changes (shapes are static —
+    membership is data, not topology).
+    """
 
     def __init__(
         self,
@@ -51,6 +64,7 @@ class StreamGroup:
         self.cfg = cfg
         self.stream_ids = list(stream_ids)
         self.G = len(self.stream_ids)
+        self.seed = seed  # claim_slot re-inits a slot exactly as creation did
         self.backend = backend
         self.threshold = threshold
         # alert debouncing (SURVEY.md C20; round-4 quality study): a stream
@@ -96,6 +110,92 @@ class StreamGroup:
                 self._classifiers = [
                     SDRClassifierOracle(s, cfg.classifier) for s in self._states
                 ]
+
+    # ---- dynamic membership (slots are static, streams are data) ----
+    @property
+    def n_live(self) -> int:
+        return self.G - sum(
+            1 for s in self.stream_ids if s.startswith(PAD_PREFIX))
+
+    def live_slots(self) -> np.ndarray:
+        """Slot indices holding real streams, ascending. For a group built
+        without pads this is arange(G); emission and value routing index
+        with it so pad/released slots never surface."""
+        return np.array(
+            [i for i, s in enumerate(self.stream_ids)
+             if not s.startswith(PAD_PREFIX)], np.int64)
+
+    def free_slot_count(self) -> int:
+        return self.G - self.n_live
+
+    def claim_slot(self, stream_id: str) -> int:
+        """Assign `stream_id` to a pad slot mid-run -> slot index.
+
+        The slot's model state is re-initialized exactly as group creation
+        initialized it (same config, same per-group seed), its likelihood
+        moments and probation clock restart, and its debounce counter
+        clears — a claimed slot behaves bit-for-bit like a stream that was
+        registered into a fresh group (pinned by
+        tests/unit/test_dynamic_streams.py). The compiled program is
+        untouched: shapes are static, membership is data.
+        """
+        if stream_id.startswith(PAD_PREFIX):
+            raise ValueError(f"stream id may not start with {PAD_PREFIX!r}")
+        if stream_id in self.stream_ids:
+            raise KeyError(f"duplicate stream id {stream_id!r}")
+        if self.mesh is not None:
+            raise ValueError(
+                "dynamic stream registration is not supported on meshed "
+                "groups: resetting one slot of sharded state would gather "
+                "it; register before finalize or serve unmeshed groups")
+        slot = next((i for i, s in enumerate(self.stream_ids)
+                     if s.startswith(PAD_PREFIX)), None)
+        if slot is None:
+            raise RuntimeError(
+                f"group is full ({self.G} live streams); capacity comes "
+                "from pad slots (group-size rounding or released streams)")
+        self._reset_slot_state(slot)
+        self.stream_ids[slot] = stream_id
+        return slot
+
+    def release_slot(self, stream_id: str) -> int:
+        """Return a stream's slot to pad capacity -> freed slot index.
+
+        The slot stops being fed and emitted immediately; its state stays
+        in place (harmlessly ticking on NaN) until a future claim resets
+        it. The id becomes available for re-registration elsewhere."""
+        try:
+            slot = self.stream_ids.index(stream_id)
+        except ValueError:
+            raise KeyError(f"unknown stream id {stream_id!r}") from None
+        # unique pad name: a plain __pad<i> could collide with creation pads
+        self.stream_ids[slot] = f"{PAD_PREFIX}!released{slot}"
+        self._alert_run[slot] = 0
+        return slot
+
+    def _reset_slot_state(self, slot: int) -> None:
+        from rtap_tpu.models.state import init_state
+
+        fresh = init_state(self.cfg, self.seed)
+        if self.backend == "tpu":
+            from rtap_tpu.ops.step import set_state_row
+
+            # match the live tree's structure (forward-index mode carries
+            # derived fwd_* leaves that init_state also builds)
+            self.state = set_state_row(
+                self.state, {k: fresh[k] for k in self.state}, slot)
+        else:
+            from rtap_tpu.models.oracle.temporal_memory import TMOracle
+
+            self._states[slot] = fresh
+            self._tms[slot] = TMOracle(fresh, self.cfg.tm)
+            if self._classifiers is not None:
+                from rtap_tpu.models.oracle.classifier import SDRClassifierOracle
+
+                self._classifiers[slot] = SDRClassifierOracle(
+                    fresh, self.cfg.classifier)
+        self.likelihood.reset_slot(slot)
+        self._alert_run[slot] = 0
 
     def _raw_cpu(self, values: np.ndarray, ts: np.ndarray, learn: bool = True):
         from rtap_tpu.models.htm_model import oracle_record_step
@@ -300,13 +400,48 @@ class StreamGroupRegistry:
         self.groups: list[StreamGroup] = []
         self._slots: dict[str, _Slot] = {}
         self._pending: list[str] = []
+        self._finalized = False
+        # bumped on every post-finalize membership change; live_loop watches
+        # it to rebuild value/emission routing without re-deriving per tick
+        self.version = 0
 
     def add_stream(self, stream_id: str) -> None:
+        """Register a stream. Before :meth:`finalize`: buffered into the
+        next group (the bulk path). After: the stream CLAIMS a free pad
+        slot in the first group with capacity — the reference's lazy
+        model-per-stream creation (SURVEY.md C19), with no recompile
+        (shapes are static). Raises RuntimeError when every slot is live;
+        capacity comes from group-size rounding, `reserve` slots, or
+        released streams."""
         if stream_id in self._slots or stream_id in self._pending:
             raise KeyError(f"duplicate stream id {stream_id!r}")
+        if self._finalized:
+            for grp in self.groups:
+                if grp.free_slot_count():
+                    slot = grp.claim_slot(stream_id)
+                    self._slots[stream_id] = _Slot(grp, slot)
+                    self.version += 1
+                    return
+            raise RuntimeError(
+                f"registry at capacity ({len(self._slots)} live streams, 0 "
+                "free slots): pre-provision with reserve= or release "
+                "departed streams")
         self._pending.append(stream_id)
         if len(self._pending) == self.group_size:
             self._seal()
+
+    def remove_stream(self, stream_id: str) -> None:
+        """Release a departed stream's slot back to pad capacity: it stops
+        being fed and emitted next tick, and the slot becomes claimable by
+        a future add_stream (which resets its state). Post-finalize only —
+        before finalize just don't add it."""
+        if not self._finalized:
+            raise RuntimeError("remove_stream is a post-finalize operation")
+        s = self._slots.pop(stream_id, None)
+        if s is None:
+            raise KeyError(f"unknown stream id {stream_id!r}")
+        s.group.release_slot(stream_id)
+        self.version += 1
 
     def _seal(self) -> None:
         if not self._pending:
@@ -319,19 +454,53 @@ class StreamGroupRegistry:
             backend=self.backend, threshold=self.threshold, mesh=self.mesh,
             debounce=self.debounce,
         )
-        grp.n_live = len(ids)
         for i, sid in enumerate(ids):
             self._slots[sid] = _Slot(grp, i)
         self.groups.append(grp)
         self._pending = []
 
-    def finalize(self) -> None:
-        """Seal the last partially-filled group (call once ingestion is known)."""
+    def finalize(self, reserve: int = 0) -> None:
+        """Seal the last partially-filled group (call once ingestion is
+        known). `reserve` adds that many extra pad slots of claimable
+        capacity for post-finalize registration (rounded up to whole
+        groups of `group_size`; each reserve group is all-pad until
+        streams claim into it)."""
+        if reserve < 0:
+            raise ValueError(f"reserve must be >= 0; got {reserve}")
+        # account pads the natural rounding already leaves in the last group
+        rounding_pads = (-len(self._pending)) % self.group_size \
+            if self._pending else 0
         self._seal()
+        extra = max(0, reserve - rounding_pads)
+        for _ in range((extra + self.group_size - 1) // self.group_size):
+            self._seal_all_pad()
+        self._finalized = True
+
+    def _seal_all_pad(self) -> None:
+        """Append one all-pad reserve group (claimable capacity)."""
+        grp = StreamGroup(
+            self.cfg,
+            [f"{PAD_PREFIX}{i}" for i in range(self.group_size)],
+            seed=self.seed + len(self.groups), backend=self.backend,
+            threshold=self.threshold, mesh=self.mesh, debounce=self.debounce,
+        )
+        self.groups.append(grp)
 
     def lookup(self, stream_id: str) -> tuple[StreamGroup, int]:
         s = self._slots[stream_id]
         return s.group, s.index
+
+    def __contains__(self, stream_id: str) -> bool:
+        return stream_id in self._slots or stream_id in self._pending
+
+    def dispatch_ids(self) -> list[str]:
+        """Live stream ids in (group, slot) order — the value-vector order
+        live_loop's routing and every source snapshot must follow."""
+        return [g.stream_ids[i] for g in self.groups for i in g.live_slots()]
+
+    @property
+    def free_slots(self) -> int:
+        return sum(g.free_slot_count() for g in self.groups)
 
     @property
     def n_streams(self) -> int:

@@ -71,8 +71,12 @@ class TcpJsonlSource:
     malformed producer must not kill the scoring loop).
     """
 
+    #: bound on remembered unknown-id NAMES (track_unknown mode): a
+    #: misbehaving producer spraying random ids must not grow host memory
+    MAX_UNKNOWN_TRACKED = 4096
+
     def __init__(self, stream_ids: list[str], host: str = "127.0.0.1", port: int = 0,
-                 native: bool | None = None):
+                 native: bool | None = None, track_unknown: bool = False):
         self.stream_ids = list(stream_ids)
         self._index = {sid: i for i, sid in enumerate(self.stream_ids)}
         self._latest = np.full(len(self.stream_ids), np.nan, np.float32)
@@ -80,6 +84,19 @@ class TcpJsonlSource:
         self._lock = threading.Lock()
         self._py_parse_errors = 0
         self._py_unknown_ids = 0
+        # track_unknown: remember the NAMES of unknown ids so serve
+        # --auto-register can lazily create models for them (SURVEY.md C19).
+        # Forces the Python parse path — the C parser counts unknowns but
+        # cannot capture names; 186k rec/s measured is still ~3x the 65k
+        # single-chip stream frontier at 1 s cadence.
+        self._track_unknown = bool(track_unknown)
+        if track_unknown:
+            if native:
+                raise ValueError(
+                    "track_unknown requires the Python parse path "
+                    "(native=True cannot capture unknown-id names)")
+            native = False
+        self._unknown_seen: set[str] = set()
         # Native C parse path (rtap_tpu/native/jsonl_parser.c): the whole
         # recv-chunk drain in one locked C call instead of per-record
         # json.loads + dict lookup + lock — the host core feeding 100k
@@ -116,13 +133,25 @@ class TcpJsonlSource:
                 for line in self.rfile:
                     try:
                         rec = json.loads(line)
-                        i = outer._index.get(rec["id"])
-                        if i is None:
-                            outer._py_unknown_ids += 1
-                            continue
+                        sid = rec["id"]
+                        value = np.float32(rec["value"])
+                        ts = int(rec.get("ts", 0))
+                        # index resolved under the SAME lock as the write:
+                        # set_ids swaps (_index, _latest) together, and an
+                        # index from the old mapping must never address the
+                        # new array (it would misroute the sample)
                         with outer._lock:
-                            outer._latest[i] = np.float32(rec["value"])
-                            outer._latest_ts = max(outer._latest_ts, int(rec.get("ts", 0)))
+                            i = outer._index.get(sid)
+                            if i is None:
+                                outer._py_unknown_ids += 1
+                                if outer._track_unknown and \
+                                        isinstance(sid, str) and \
+                                        len(outer._unknown_seen) < \
+                                        outer.MAX_UNKNOWN_TRACKED:
+                                    outer._unknown_seen.add(sid)
+                                continue
+                            outer._latest[i] = value
+                            outer._latest_ts = max(outer._latest_ts, ts)
                     except Exception:
                         outer._py_parse_errors += 1
 
@@ -167,6 +196,36 @@ class TcpJsonlSource:
     @property
     def native_active(self) -> bool:
         return self._nstate is not None
+
+    # ---- dynamic membership (serve --auto-register) ----
+    def drain_unknown(self) -> list[str]:
+        """Pop the unknown-id names seen since the last drain (sorted for
+        deterministic registration order). Empty unless track_unknown."""
+        with self._lock:
+            seen = sorted(self._unknown_seen)
+            self._unknown_seen.clear()
+        return seen
+
+    def set_ids(self, stream_ids: list[str]) -> None:
+        """Replace the accepted id set (registry membership changed).
+
+        Latest values carry over BY ID — a retained stream must not lose
+        the sample that arrived this tick — and new ids start at NaN. The
+        snapshot order is the caller's (= the registry's dispatch order:
+        live_loop routes values positionally)."""
+        if self._nstate is not None:
+            raise RuntimeError(
+                "set_ids requires the Python parse path (construct with "
+                "track_unknown=True / native=False)")
+        with self._lock:
+            latest = np.full(len(stream_ids), np.nan, np.float32)
+            for j, sid in enumerate(stream_ids):
+                i = self._index.get(sid)
+                if i is not None:
+                    latest[j] = self._latest[i]
+            self.stream_ids = list(stream_ids)
+            self._index = {sid: i for i, sid in enumerate(self.stream_ids)}
+            self._latest = latest
 
     def __call__(self, tick: int) -> tuple[np.ndarray, int]:
         """Snapshot AND DRAIN: values reset to NaN after each tick, so a

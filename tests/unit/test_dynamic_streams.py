@@ -1,0 +1,289 @@
+"""Dynamic stream membership (SURVEY.md C19 lazy model creation): pad
+slots are claimable capacity — a stream added after finalize gets a fresh
+model, its own likelihood probation, and a cleared debounce counter, with
+no recompile; a released stream stops being fed and emitted and its slot
+becomes claimable again. The claimed-slot contract: indistinguishable from
+a stream that was registered into a fresh group (streaming likelihood mode,
+the at-scale serving default)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import cluster_preset
+from rtap_tpu.service.likelihood_batch import BatchAnomalyLikelihood
+from rtap_tpu.service.loop import live_loop
+from rtap_tpu.service.registry import StreamGroup, StreamGroupRegistry
+
+CFG = cluster_preset()
+
+
+def _registry(n=6, group_size=4, reserve=0):
+    reg = StreamGroupRegistry(CFG, group_size=group_size, backend="tpu")
+    for i in range(n):
+        reg.add_stream(f"s{i}")
+    reg.finalize(reserve=reserve)
+    return reg
+
+
+def _feed_fn(ids_fn):
+    def feed(k):
+        rng = np.random.Generator(np.random.Philox(key=(3, k)))
+        n = len(ids_fn())
+        return (30 + 5 * rng.random(n)).astype(np.float32), 1_700_000_000 + k
+    return feed
+
+
+class TestLikelihoodBirth:
+    def test_reset_slot_restarts_probation(self):
+        import dataclasses
+
+        lcfg = dataclasses.replace(CFG.likelihood, mode="streaming")
+        lik = BatchAnomalyLikelihood(lcfg, 4)
+        prob = lcfg.probationary_period
+        rng = np.random.default_rng(0)
+        for _ in range(prob + 5):
+            out, _ = lik.update(rng.random(4) * 0.1)
+        assert (out != 0.5).all()  # everyone mature
+        lik.reset_slot(2)
+        out, _ = lik.update(rng.random(4) * 0.1)
+        assert out[2] == 0.5  # reborn slot back in probation
+        assert (out[[0, 1, 3]] != 0.5).all()  # others unaffected
+        # ...and it matures again after ITS OWN probation
+        for _ in range(prob):
+            out, _ = lik.update(rng.random(4) * 0.1)
+        assert out[2] != 0.5
+
+    def test_claimed_slot_matches_fresh_stream(self):
+        """The composed contract: a slot reset at group-record N then fed
+        values v_1..v_M produces the same likelihoods as a fresh
+        single-stream instance fed v_1..v_M (streaming mode)."""
+        import dataclasses
+
+        lcfg = dataclasses.replace(CFG.likelihood, mode="streaming")
+        grp = BatchAnomalyLikelihood(lcfg, 3)
+        rng = np.random.default_rng(7)
+        for _ in range(50):
+            grp.update(rng.random(3) * 0.1)
+        grp.reset_slot(1)
+
+        solo = BatchAnomalyLikelihood(lcfg, 1)
+        n = lcfg.probationary_period + 40
+        vals = rng.random((n, 3)) * 0.1
+        for t in range(n):
+            g_out, g_log = grp.update(vals[t])
+            s_out, s_log = solo.update(vals[t, 1:2])
+            np.testing.assert_allclose(g_out[1], s_out[0], rtol=1e-12,
+                                       err_msg=f"tick {t}")
+
+    def test_checkpoint_roundtrip_preserves_birth(self):
+        import dataclasses
+
+        lcfg = dataclasses.replace(CFG.likelihood, mode="streaming")
+        lik = BatchAnomalyLikelihood(lcfg, 2)
+        for _ in range(10):
+            lik.update(np.array([0.1, 0.2]))
+        lik.reset_slot(0)
+        d = lik.state_dict()
+        fresh = BatchAnomalyLikelihood(lcfg, 2)
+        fresh.load_state_dict(d)
+        assert fresh.birth[0] == 10 and fresh.birth[1] == 0
+
+    def test_legacy_checkpoint_defaults_birth_to_zero(self):
+        lik = BatchAnomalyLikelihood(CFG.likelihood, 2)
+        d = lik.state_dict()
+        d.pop("birth")
+        fresh = BatchAnomalyLikelihood(CFG.likelihood, 2)
+        fresh.load_state_dict(d)
+        assert (fresh.birth == 0).all()
+
+
+class TestSlotClaims:
+    def test_claim_resets_model_state_to_fresh(self):
+        """Model state of a claimed slot must equal a brand-new group's
+        (bit-exact on the CPU test platform: same config, same seed)."""
+        reg = _registry()  # groups: [4 live, 2 live + 2 pad]
+        feed = _feed_fn(lambda: range(6))
+        live_loop(feed, reg, n_ticks=6, cadence_s=0.0)
+        grp = reg.groups[1]
+        slot = grp.claim_slot("late")
+        assert slot == 2  # first pad slot
+        fresh = StreamGroup(CFG, ["late"], seed=grp.seed, backend="tpu")
+        for a, b in zip(
+            (np.asarray(v) for _, v in sorted(grp.state.items())),
+            (np.asarray(v) for _, v in sorted(fresh.state.items())),
+        ):
+            np.testing.assert_array_equal(a[slot], b[0])
+
+    def test_release_then_claim_reuses_slot(self):
+        reg = _registry()
+        grp0, idx = reg.lookup("s1")
+        reg.remove_stream("s1")
+        assert "s1" not in [grp0.stream_ids[i] for i in grp0.live_slots()]
+        reg.add_stream("replacement")
+        grp, slot = reg.lookup("replacement")
+        assert (grp, slot) == (grp0, idx)  # first free slot = the released one
+        assert reg.free_slots == 2  # the two original pads remain
+
+    def test_capacity_exhaustion_raises(self):
+        reg = _registry(n=4, group_size=4)  # no pads at all
+        with pytest.raises(RuntimeError, match="capacity"):
+            reg.add_stream("overflow")
+
+    def test_reserve_adds_claimable_groups(self):
+        reg = _registry(n=4, group_size=4, reserve=4)
+        assert len(reg.groups) == 2 and reg.free_slots == 4
+        for i in range(4):
+            reg.add_stream(f"extra{i}")
+        assert reg.free_slots == 0
+        assert reg.n_streams == 8
+
+    def test_duplicate_and_pad_ids_rejected(self):
+        reg = _registry()
+        with pytest.raises(KeyError):
+            reg.add_stream("s0")
+        with pytest.raises(ValueError, match="__pad"):
+            reg.groups[1].claim_slot("__pad_evil")
+
+
+class TestLiveLoopDynamic:
+    def test_removed_stream_stops_emitting_and_added_starts(self, tmp_path):
+        reg = _registry()
+        path = str(tmp_path / "alerts.jsonl")
+        ids = ["s%d" % i for i in range(6)]
+
+        def feed(k):
+            rng = np.random.Generator(np.random.Philox(key=(5, k)))
+            return (30 + 5 * rng.random(len(ids))).astype(np.float32), k
+
+        stats = live_loop(feed, reg, n_ticks=4, cadence_s=0.0, alert_path=path)
+        assert stats["scored"] == 6 * 4
+
+        reg.remove_stream("s2")
+        ids.remove("s2")
+        stats = live_loop(feed, reg, n_ticks=4, cadence_s=0.0)
+        assert stats["scored"] == 5 * 4
+
+        reg.add_stream("late")
+        # dispatch order: group 0 live slots (incl. reclaimed slot 2),
+        # then group 1 — the registry defines it
+        ids[:] = reg.dispatch_ids()
+        assert "late" in ids
+        stats = live_loop(feed, reg, n_ticks=4, cadence_s=0.0)
+        assert stats["scored"] == 6 * 4
+
+    @staticmethod
+    def _run_with_feeder(reg, records_fn, n_ticks, known_ids,
+                         checkpoint_dir=None):
+        """live_loop over a REAL TcpJsonlSource (the object is the source,
+        as serve passes it — auto-register needs its drain_unknown/set_ids
+        surface) with a producer thread pushing records_fn(k) each tick."""
+        import threading
+        import time
+
+        from rtap_tpu.service.sources import TcpJsonlSource, send_jsonl
+
+        src = TcpJsonlSource(known_ids, port=0, track_unknown=True).start()
+        stop = threading.Event()
+
+        def produce():
+            k = 0
+            while not stop.is_set():
+                try:
+                    send_jsonl(src.address, records_fn(k))
+                except OSError:
+                    pass
+                k += 1
+                time.sleep(0.02)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            stats = live_loop(src, reg, n_ticks=n_ticks, cadence_s=0.1,
+                              auto_register=True,
+                              checkpoint_dir=checkpoint_dir)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            src.close()
+        return stats
+
+    def test_auto_register_over_real_socket(self):
+        reg = _registry(n=2, group_size=2, reserve=2)
+        stats = self._run_with_feeder(
+            reg,
+            lambda k: [{"id": "s0", "value": 30.0, "ts": k},
+                       {"id": "s1", "value": 31.0, "ts": k},
+                       {"id": "newcomer", "value": 32.0, "ts": k}],
+            n_ticks=8, known_ids=["s0", "s1"])
+        assert stats["auto_registered"] == 1
+        assert stats["auto_rejected"] == 0
+        assert reg.n_streams == 3
+        reg.lookup("newcomer")  # registered and routable
+        # it scored every tick after its registration tick
+        assert stats["scored"] > 2 * 8
+
+    def test_auto_register_capacity_rejection(self):
+        reg = _registry(n=2, group_size=2)  # zero free slots
+        stats = self._run_with_feeder(
+            reg,
+            lambda k: [{"id": "s0", "value": 30.0, "ts": k},
+                       {"id": "nope", "value": 1.0, "ts": k}],
+            n_ticks=6, known_ids=["s0", "s1"])
+        assert stats["auto_registered"] == 0
+        assert stats["auto_rejected"] == 1
+        assert reg.n_streams == 2
+
+
+class TestLiveLoopDynamicResume:
+    def test_auto_registered_stream_survives_restart(self, tmp_path):
+        """serve --auto-register --checkpoint-dir crash/restart story: a
+        stream lazily claimed in run 1 must resume LIVE in run 2 (which
+        was built from the original --streams list only), keep its slot,
+        and not be re-claimed into a duplicate when its records keep
+        arriving."""
+        ck = str(tmp_path / "ck")
+
+        reg1 = _registry(n=2, group_size=2, reserve=2)
+        stats1 = TestLiveLoopDynamic._run_with_feeder(
+            reg1,
+            lambda k: [{"id": "s0", "value": 30.0, "ts": k},
+                       {"id": "s1", "value": 31.0, "ts": k},
+                       {"id": "newcomer", "value": 32.0, "ts": k}],
+            n_ticks=8, known_ids=["s0", "s1"], checkpoint_dir=ck)
+        assert stats1["auto_registered"] == 1
+        grp1, slot1 = reg1.lookup("newcomer")
+
+        reg2 = _registry(n=2, group_size=2, reserve=2)  # original list only
+        stats2 = TestLiveLoopDynamic._run_with_feeder(
+            reg2,
+            lambda k: [{"id": "s0", "value": 33.0, "ts": k},
+                       {"id": "s1", "value": 34.0, "ts": k},
+                       {"id": "newcomer", "value": 35.0, "ts": k}],
+            n_ticks=6, known_ids=["s0", "s1"], checkpoint_dir=ck)
+        # resumed live from the checkpoint, NOT re-registered
+        assert stats2["auto_registered"] == 0
+        assert "resumed_from" in stats2
+        grp2, slot2 = reg2.lookup("newcomer")
+        assert slot2 == slot1  # same slot, carried by the checkpoint
+        assert stats2["scored"] == 3 * stats2["ticks"]  # all three emit
+
+
+class TestCheckpointDynamic:
+    def test_membership_survives_save_load(self, tmp_path):
+        from rtap_tpu.service.checkpoint import load_group, save_group
+
+        reg = _registry()
+        feed = _feed_fn(lambda: range(6))
+        live_loop(feed, reg, n_ticks=4, cadence_s=0.0)
+        grp = reg.groups[1]
+        grp.claim_slot("late")
+        path = tmp_path / "ck"
+        save_group(grp, path)
+        resumed = load_group(path)
+        assert resumed.stream_ids == grp.stream_ids
+        assert resumed.n_live == 3
+        np.testing.assert_array_equal(resumed.live_slots(), grp.live_slots())
+        np.testing.assert_array_equal(resumed.likelihood.birth,
+                                      grp.likelihood.birth)
