@@ -259,6 +259,9 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                                    "--variants",
                                    "eighth_32col_lp600,eighth_32col_k2_lp600",
                                    "--all-kinds"]),
+    # dynamic slot claim on the real chip: set_state_row's donated
+    # .at[slot].set against grouped TPU state + scoring continuity
+    ("dynamic_claim", [sys.executable, "scripts/dynamic_claim_probe.py"]),
 ]
 
 
