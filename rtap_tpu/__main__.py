@@ -131,7 +131,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           pipeline_depth=args.pipeline_depth,
                           dispatch_threads=args.dispatch_threads,
                           learn=not args.freeze,
-                          auto_register=args.auto_register)
+                          auto_register=args.auto_register,
+                          auto_release_after=args.auto_release_after)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -302,6 +303,14 @@ def main(argv: list[str] | None = None) -> int:
                    help="extra claimable pad-slot capacity for post-start "
                         "registration (rounded up to whole groups; default "
                         "0, or one group's worth with --auto-register)")
+    p.add_argument("--auto-release-after", type=int, default=0,
+                   help="release a stream's slot after N consecutive silent "
+                        "(no-record) ticks — elastic shrink for churning "
+                        "clusters; the slot becomes claimable again and a "
+                        "returning stream re-registers as a new model. "
+                        "Pick N well above ordinary outages: NaN semantics "
+                        "keep scoring through gaps, release discards the "
+                        "learned context. 0 = never (default)")
     p.add_argument("--freeze", action="store_true",
                    help="inference-only serving (NuPIC disableLearning "
                         "parity): SP/TM/classifier state is bit-frozen, raw "

@@ -211,6 +211,7 @@ def live_loop(
     dispatch_threads: int = 1,
     learn: bool = True,
     auto_register: bool = False,
+    auto_release_after: int = 0,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -226,6 +227,21 @@ def live_loop(
     Capacity = pad slots (group-size rounding + `finalize(reserve=)` +
     released streams); ids beyond capacity are counted in
     `auto_rejected` and not retried.
+
+    `auto_release_after=N` (registry only) is the elastic shrink: a
+    stream silent (all-NaN) for N consecutive ticks releases its slot
+    back to claimable capacity — a churning monitored cluster (nodes
+    leaving) must not exhaust slots. Releases defer to the next tick's
+    membership block under the same drain-first rule as claims; a
+    released stream that pushes again re-registers as a NEW model (with
+    auto_register — a release also clears the rejected-id memory so
+    leave-then-join churn converges). N must comfortably exceed ordinary
+    outage lengths: the NaN missing-sample semantics deliberately keep
+    scoring through gaps, and release discards the model's learned
+    context. Source contract under shrink: TcpJsonlSource adapts via
+    `set_ids`; a custom callable must size its vector to the registry's
+    CURRENT `dispatch_ids()` each tick (a fixed-length callable fails
+    the length check loudly on the tick after a release).
 
     `learn=False` freezes the models (NuPIC `disableLearning()` parity —
     SURVEY §3.2 OPF model surface): SP/TM/classifier state is
@@ -369,6 +385,14 @@ def live_loop(
     auto_registered = 0
     auto_rejected_total = 0
     auto_rejected: set = set()  # bounded de-dup memory, not the count
+    auto_released = 0
+    silent_ticks: dict = {}  # sid -> consecutive all-NaN ticks
+    release_pending: set = set()
+    if auto_release_after < 0:
+        raise ValueError(
+            f"auto_release_after must be >= 0; got {auto_release_after}")
+    if auto_release_after and reg is None:
+        raise ValueError("auto_release_after needs a StreamGroupRegistry")
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
     missed = 0
@@ -482,6 +506,28 @@ def live_loop(
                         auto_registered += 1
                     if claimed:
                         source.set_ids(reg.dispatch_ids())
+            # elastic shrink (serve --auto-release-after): streams silent
+            # for N consecutive ticks release their slots back to claimable
+            # capacity — a churning monitored cluster (nodes leaving) must
+            # not exhaust slots. A released stream that pushes again
+            # re-registers as a NEW model (correct lazy semantics: the old
+            # temporal context is stale by then anyway). Processed at the
+            # top of the tick, like claims, under the same drain rule.
+            if release_pending:
+                while in_flight:
+                    _collect_tick(*in_flight.popleft())
+                for sid in release_pending:
+                    if sid in reg:
+                        reg.remove_stream(sid)
+                        silent_ticks.pop(sid, None)
+                        auto_released += 1
+                release_pending.clear()
+                # capacity changed: previously rejected ids deserve a
+                # retry (their records will re-surface as unknown) — a
+                # leave-then-join churn must converge, not blacklist
+                auto_rejected.clear()
+                if hasattr(source, "set_ids"):
+                    source.set_ids(reg.dispatch_ids())
             if reg is not None and reg.version != routing_version:
                 routing, n_expected = _build_routing()
                 routing_version = reg.version
@@ -492,6 +538,22 @@ def live_loop(
                     f"source returned {len(values)} values for {n_expected} "
                     "live streams (alignment with registration order is load-"
                     "bearing — a silent mismatch would misroute streams)")
+            if auto_release_after:
+                # consecutive-silence accounting over THIS tick's values;
+                # releases defer to the next tick's membership block (this
+                # tick's value vector still matches the current routing)
+                nan = np.isnan(values)
+                nan_mask = nan if nan.ndim == 1 else \
+                    nan.reshape(len(values), -1).all(axis=1)
+                for slots, ids, off in routing:
+                    for j, sid in enumerate(ids):
+                        if nan_mask[off + j]:
+                            n = silent_ticks.get(sid, 0) + 1
+                            silent_ticks[sid] = n
+                            if n >= auto_release_after:
+                                release_pending.add(sid)
+                        else:
+                            silent_ticks.pop(sid, None)
             handles = _dispatch_all(values, ts, routing)
             # held across a tick at depth >= 2: a source reusing a
             # preallocated buffer must not corrupt the emitted values column
@@ -559,6 +621,7 @@ def live_loop(
             "learn": learn,
             **({"auto_registered": auto_registered,
                 "auto_rejected": auto_rejected_total} if auto_register else {}),
+            **({"auto_released": auto_released} if auto_release_after else {}),
             # effective value: 1 when the pool was never created (single
             # group), so soak reports can't claim threading they didn't get
             "dispatch_threads": eff_threads,

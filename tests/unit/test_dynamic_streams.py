@@ -175,7 +175,7 @@ class TestLiveLoopDynamic:
 
     @staticmethod
     def _run_with_feeder(reg, records_fn, n_ticks, known_ids,
-                         checkpoint_dir=None):
+                         checkpoint_dir=None, auto_release_after=0):
         """live_loop over a REAL TcpJsonlSource (the object is the source,
         as serve passes it — auto-register needs its drain_unknown/set_ids
         surface) with a producer thread pushing records_fn(k) each tick."""
@@ -202,7 +202,8 @@ class TestLiveLoopDynamic:
         try:
             stats = live_loop(src, reg, n_ticks=n_ticks, cadence_s=0.1,
                               auto_register=True,
-                              checkpoint_dir=checkpoint_dir)
+                              checkpoint_dir=checkpoint_dir,
+                              auto_release_after=auto_release_after)
         finally:
             stop.set()
             t.join(timeout=5)
@@ -234,6 +235,74 @@ class TestLiveLoopDynamic:
         assert stats["auto_registered"] == 0
         assert stats["auto_rejected"] == 1
         assert reg.n_streams == 2
+
+
+class TestAutoRelease:
+    def test_silent_stream_releases_slot(self):
+        """A stream all-NaN for N consecutive ticks is released: its slot
+        returns to claimable capacity and it stops being emitted."""
+        reg = _registry(n=4, group_size=4)  # full group, no pads
+        assert reg.free_slots == 0
+
+        def feed(k):
+            vals = np.full(len(reg.dispatch_ids()), 30.0, np.float32)
+            if "s3" in reg.dispatch_ids() and k >= 2:
+                vals[reg.dispatch_ids().index("s3")] = np.nan
+            return vals, k
+
+        stats = live_loop(feed, reg, n_ticks=10, cadence_s=0.0,
+                          auto_release_after=3)
+        assert stats["auto_released"] == 1
+        assert "s3" not in reg
+        assert reg.free_slots == 1
+        # released at tick 5's membership block (silent ticks 2,3,4):
+        # 4 streams x 5 ticks + 3 streams x 5 ticks
+        assert stats["scored"] == 4 * 5 + 3 * 5
+
+    def test_gap_shorter_than_threshold_survives(self):
+        reg = _registry(n=2, group_size=2)
+
+        def feed(k):
+            vals = np.full(2, 30.0, np.float32)
+            if 2 <= k < 4:  # a 2-tick outage, threshold 3
+                vals[1] = np.nan
+            return vals, k
+
+        stats = live_loop(feed, reg, n_ticks=8, cadence_s=0.0,
+                          auto_release_after=3)
+        assert stats["auto_released"] == 0
+        assert "s1" in reg
+
+    def test_churn_cycle_release_then_reregister(self):
+        """The full elastic loop over a real socket: a stream goes silent,
+        its slot releases, it pushes again, auto-register claims it a
+        FRESH model in the freed slot."""
+        reg = _registry(n=2, group_size=2)  # zero spare capacity
+
+        # event-driven phases (no wall-clock coupling): s1 pushes until
+        # the feeder has warmed it up, goes silent, and resumes as soon as
+        # the RELEASE is observed in registry state — so the return phase
+        # always happens, however slow the host
+        released_seen = {"v": False}
+
+        def records(k):
+            if "s1" not in reg:
+                released_seen["v"] = True
+            recs = [{"id": "s0", "value": 30.0, "ts": k}]
+            if released_seen["v"]:
+                recs.append({"id": "s1", "value": 32.0, "ts": k})
+            elif k < 10:
+                recs.append({"id": "s1", "value": 31.0, "ts": k})
+            return recs
+
+        stats = TestLiveLoopDynamic._run_with_feeder(
+            reg, records, n_ticks=50, known_ids=["s0", "s1"],
+            auto_release_after=4)
+        assert stats["auto_released"] == 1
+        assert stats["auto_registered"] == 1  # re-claimed after returning
+        assert "s1" in reg  # back, as a fresh model in the freed slot
+        grp, slot = reg.lookup("s1")
+        assert grp.likelihood.birth[slot] > 0  # probation restarted
 
 
 class TestLiveLoopDynamicResume:
