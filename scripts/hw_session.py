@@ -262,6 +262,15 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     # dynamic slot claim on the real chip: set_state_row's donated
     # .at[slot].set against grouped TPU state + scoring continuity
     ("dynamic_claim", [sys.executable, "scripts/dynamic_claim_probe.py"]),
+    # elastic churn under deadline at production scale: does a mid-soak
+    # claim/release (drain-first membership rule + on-device row reset)
+    # cost missed ticks? ~16 rotations over the 330-tick soak.
+    ("live_soak_churn", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "4096", "--group-size", "1024",
+                         "--columns", "32", "--learn-every", "2",
+                         "--pipeline-depth", "2", "--dispatch-threads", "4",
+                         "--churn-every", "20", "--startup-timeout", "900",
+                         "--out", "reports/live_soak_churn.json"], 2400.0),
 ]
 
 

@@ -55,10 +55,17 @@ class Feeder:
     silently — the soak artifact must say whether data was actually flowing.
     """
 
-    def __init__(self, port: int, ids: list[str], cadence_s: float):
+    def __init__(self, port: int, ids: list[str], cadence_s: float,
+                 churn_every: int = 0):
         self.port = port
-        self.ids = ids
+        self.ids = list(ids)
         self.cadence_s = cadence_s
+        # elastic churn (validates serve --auto-register/--auto-release-
+        # after under deadline): every N pushed ticks, stop feeding one
+        # original stream (it will be auto-released) and start feeding a
+        # brand-new id (it will be auto-registered into freed capacity)
+        self.churn_every = int(churn_every)
+        self.churned = 0
         self.stop = threading.Event()
         self.ticks_pushed = 0
         self.error: str | None = None
@@ -95,6 +102,14 @@ class Feeder:
                 f.write(("\n".join(lines) + "\n").encode())
                 f.flush()
                 self.ticks_pushed += 1
+                if self.churn_every and \
+                        self.ticks_pushed % self.churn_every == 0:
+                    # rotate: drop the oldest still-original id, add a new
+                    # one (values keep coming from the same feed column, so
+                    # the signal stays realistic for the claimed model)
+                    self.ids[self.churned % len(self.ids)] = \
+                        f"churn{self.churned:04d}.m0"
+                    self.churned += 1
                 budget = self.cadence_s - (time.perf_counter() - t_start)
                 if budget > 0:
                     self.stop.wait(budget)
@@ -153,6 +168,12 @@ def main() -> int:
                     help="passed through to serve: learning cadence")
     ap.add_argument("--freeze", action="store_true",
                     help="passed through to serve: inference-only soak")
+    ap.add_argument("--churn-every", type=int, default=0,
+                    help="elastic-churn soak: every N feeder ticks, rotate "
+                         "one stream id (old goes silent -> auto-released; "
+                         "new appears -> auto-registered). Enables serve "
+                         "--auto-register and --auto-release-after "
+                         "(2x churn interval) automatically")
     ap.add_argument("--startup-timeout", type=float, default=420.0,
                     help="budget for serve's backend init + first compile")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
@@ -187,6 +208,9 @@ def main() -> int:
         cmd += ["--learn-every", str(args.learn_every)]
     if args.freeze:
         cmd += ["--freeze"]
+    if args.churn_every:
+        cmd += ["--auto-register",
+                "--auto-release-after", str(2 * args.churn_every)]
     log(f"starting serve: G={args.streams} ticks={args.ticks} "
         f"cadence={args.cadence}s backend={args.backend}")
     proc = subprocess.Popen(cmd, cwd=REPO, stdout=subprocess.PIPE,
@@ -200,7 +224,8 @@ def main() -> int:
     feeder = None
     try:
         port = wait_for_listener(proc, stderr_lines, args.startup_timeout)
-        feeder = Feeder(port, ids, args.cadence)
+        feeder = Feeder(port, ids, args.cadence,
+                        churn_every=args.churn_every)
         feeder.thread.start()
         log(f"feeder attached on port {port}; soaking...")
         out = proc.stdout.read()  # EOF = serve exited; drain thread owns stderr
@@ -237,6 +262,7 @@ def main() -> int:
         # model config the numbers were measured under — a width-scaled or
         # cadence-thinned soak must be distinguishable from a default one
         "columns": args.columns, "learn_every": args.learn_every,
+        "churn_every": args.churn_every, "ids_churned": feeder.churned,
         "alert_lines": n_alert_lines,
         "feeder_ticks_pushed": feeder.ticks_pushed,
         "feeder_error": feeder.error, **stats,
