@@ -134,12 +134,16 @@ class TcpJsonlSource:
                     try:
                         rec = json.loads(line)
                         sid = rec["id"]
-                        value = np.float32(rec["value"])
-                        ts = int(rec.get("ts", 0))
                         # index resolved under the SAME lock as the write:
                         # set_ids swaps (_index, _latest) together, and an
                         # index from the old mapping must never address the
-                        # new array (it would misroute the sample)
+                        # new array (it would misroute the sample). Effect
+                        # ORDER is pinned by the native-parity fuzz: the
+                        # unknown check precedes value conversion (bad value
+                        # on an unknown id = unknown, not parse error), and
+                        # the value write precedes ts conversion (bad ts
+                        # counts a parse error but KEEPS the value) — the C
+                        # parser implements the same order.
                         with outer._lock:
                             i = outer._index.get(sid)
                             if i is None:
@@ -150,8 +154,9 @@ class TcpJsonlSource:
                                         outer.MAX_UNKNOWN_TRACKED:
                                     outer._unknown_seen.add(sid)
                                 continue
-                            outer._latest[i] = value
-                            outer._latest_ts = max(outer._latest_ts, ts)
+                            outer._latest[i] = np.float32(rec["value"])
+                            outer._latest_ts = max(outer._latest_ts,
+                                                   int(rec.get("ts", 0)))
                     except Exception:
                         outer._py_parse_errors += 1
 
