@@ -100,11 +100,13 @@ class BatchAnomalyLikelihood:
     # ---- dynamic membership ----
     def reset_slot(self, g: int) -> None:
         """Re-initialize one slot for a stream claimed mid-run: fresh
-        moments/rings and a probation clock starting NOW. Exact in
-        streaming mode (per-stream EMA moments); in window mode the
-        historic ring keeps pre-birth zeros until it refills, biasing the
-        refit for this slot — acceptable for the window QUALITY-comparison
-        mode, and the at-scale serving default is streaming."""
+        moments/rings and a probation clock starting NOW. Streaming mode
+        reproduces a fresh stream's outputs exactly (per-stream EMA
+        moments); window mode masks the slot's pre-birth ring entries out
+        of its Gaussian refit (`_refit_window`), so its distribution is
+        fit from its OWN scores only — refit *times* stay on the group's
+        lockstep clock, the one (documented) difference from a standalone
+        fresh stream."""
         self.birth[g] = self.records
         self.recent[g] = 0.0
         self.mean[g] = 0.0
@@ -174,8 +176,36 @@ class BatchAnomalyLikelihood:
             averaged = csum[:, w:] - csum[:, :-w]
         else:
             averaged = scores
-        self.mean = averaged.mean(axis=1)
-        self.std = np.maximum(averaged.std(axis=1), 1e-6)
+        if not self.birth.any():
+            # founding-members fast path, bit-identical to the original
+            self.mean = averaged.mean(axis=1)
+            self.std = np.maximum(averaged.std(axis=1), 1e-6)
+            self.have_distribution = True
+            return
+        # per-slot masking for claimed slots: chronological entries before
+        # a slot's birth are reset zeros, and the slot's FIRST
+        # learning_period own scores are its untrained model's learning
+        # transient (near-1.0 raws) — the oracle excludes exactly that
+        # window for a fresh stream ("would inflate sigma"), so the
+        # claimed slot must too. An averaged entry is valid iff its whole
+        # w-window lies at/after birth + learning_period. For founding
+        # members (birth 0) this reduces to <= 0 — identical to the
+        # global still_buffered trim, hence the fast path above. Slots
+        # with <2 valid entries keep their previous (reset: 0/1) moments —
+        # the young mask pins them to 0.5 through probation anyway.
+        chrono_start = self.records - n + still_buffered
+        p = np.maximum(
+            self.birth + self.cfg.learning_period - chrono_start, 0)
+        idx = np.arange(averaged.shape[1])[None, :]
+        valid = idx >= p[:, None]
+        cnt = valid.sum(axis=1)
+        safe = np.maximum(cnt, 1)
+        mean_new = (averaged * valid).sum(axis=1) / safe
+        var = (((averaged - mean_new[:, None]) ** 2) * valid).sum(axis=1) / safe
+        std_new = np.maximum(np.sqrt(var), 1e-6)
+        ok = cnt >= 2
+        self.mean = np.where(ok, mean_new, self.mean)
+        self.std = np.where(ok, std_new, self.std)
         self.have_distribution = True
 
     def _update_streaming(self, avg: np.ndarray) -> None:

@@ -137,17 +137,16 @@ class StreamGroup:
         clears — a claimed slot behaves bit-for-bit like a stream that was
         registered into a fresh group (pinned by
         tests/unit/test_dynamic_streams.py). The compiled program is
-        untouched: shapes are static, membership is data.
+        untouched: shapes are static, membership is data. Works on meshed
+        groups too: the donated .at[slot].set lowers to a shard-local
+        predicated update under GSPMD (the slot lives on exactly one
+        shard), sharding preserved — tests/scale/test_sharded.py pins
+        bit-exactness vs the single-device claim.
         """
         if stream_id.startswith(PAD_PREFIX):
             raise ValueError(f"stream id may not start with {PAD_PREFIX!r}")
         if stream_id in self.stream_ids:
             raise KeyError(f"duplicate stream id {stream_id!r}")
-        if self.mesh is not None:
-            raise ValueError(
-                "dynamic stream registration is not supported on meshed "
-                "groups: resetting one slot of sharded state would gather "
-                "it; register before finalize or serve unmeshed groups")
         slot = next((i for i, s in enumerate(self.stream_ids)
                      if s.startswith(PAD_PREFIX)), None)
         if slot is None:

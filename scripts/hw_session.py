@@ -271,6 +271,16 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                          "--pipeline-depth", "2", "--dispatch-threads", "4",
                          "--churn-every", "20", "--startup-timeout", "900",
                          "--out", "reports/live_soak_churn.json"], 2400.0),
+    # sustained stability: 30 minutes of continuous churn at the
+    # production shape — memory leaks, counter drift, or slow latency
+    # creep would surface here, not in a 5-minute soak
+    ("live_soak_30min", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "4096", "--group-size", "1024",
+                         "--columns", "32", "--learn-every", "2",
+                         "--pipeline-depth", "2", "--dispatch-threads", "4",
+                         "--churn-every", "30", "--ticks", "1800",
+                         "--startup-timeout", "900",
+                         "--out", "reports/live_soak_30min.json"], 3300.0),
 ]
 
 

@@ -138,3 +138,37 @@ def test_shard_state_rejects_indivisible():
     mesh = make_stream_mesh(8)
     with pytest.raises(ValueError, match="not divisible"):
         shard_state(replicate_state(init_state(cfg, 0), 12), mesh)
+
+
+def test_dynamic_claim_on_meshed_group():
+    """Dynamic slot claims work on sharded groups (elastic fleets on the
+    multi-chip path): the claimed slot's row reset is bit-identical to the
+    single-device claim, sharding survives the donated update, and scoring
+    continues bit-equal across the mesh boundary."""
+    cfg = cluster_preset()
+    G, T = 16, 12
+    ids = [f"s{i}" for i in range(G - 2)] + ["__pad0", "__pad1"]
+    mesh = make_stream_mesh(8)
+    plain = StreamGroup(cfg, ids, backend="tpu")
+    sharded = StreamGroup(cfg, ids, backend="tpu", mesh=mesh)
+    vals = _vals(T, G, seed=13)
+    ts = (1_700_000_000 + np.arange(T)[:, None] + np.zeros((1, G))).astype(np.int64)
+    plain.run_chunk(vals, ts)
+    sharded.run_chunk(vals, ts)
+
+    sp = plain.claim_slot("late")
+    ss = sharded.claim_slot("late")
+    assert sp == ss == G - 2
+    for key in plain.state:
+        np.testing.assert_array_equal(
+            np.asarray(plain.state[key]), np.asarray(sharded.state[key]),
+            err_msg=key)
+    # sharding preserved through the donated row update
+    assert len(sharded.state["perm"].sharding.device_set) == 8
+
+    vals2 = _vals(T, G, seed=14)
+    ts2 = ts + T
+    r_p, ll_p, _ = plain.run_chunk(vals2, ts2)
+    r_s, ll_s, _ = sharded.run_chunk(vals2, ts2)
+    np.testing.assert_array_equal(r_p, r_s)
+    np.testing.assert_array_equal(ll_p, ll_s)

@@ -77,6 +77,40 @@ class TestLikelihoodBirth:
             np.testing.assert_allclose(g_out[1], s_out[0], rtol=1e-12,
                                        err_msg=f"tick {t}")
 
+    def test_window_mode_refit_excludes_pre_birth_zeros(self):
+        """Window mode: a claimed slot's Gaussian must be fit from its OWN
+        scores only — the reset zeros in the chronologically-pre-birth ring
+        positions may not drag its mean toward 0 (they would make every
+        normal score look anomalous... or nothing, depending on sign)."""
+        import dataclasses
+
+        # probationary_period derives: learning_period + estimation = 60
+        lcfg = dataclasses.replace(
+            CFG.likelihood, mode="window", learning_period=40,
+            estimation_samples=20, reestimation_period=10,
+            historic_window_size=200)
+        rng = np.random.default_rng(11)
+
+        grp = BatchAnomalyLikelihood(lcfg, 2)
+        # slot 0 and 1 identical until the reset
+        for _ in range(100):
+            v = rng.random() * 0.1 + 0.45
+            grp.update(np.array([v, v]))
+        grp.reset_slot(1)
+        # slot 1's fresh model emits a learning TRANSIENT (near-1.0 raws)
+        # for its first learning_period ticks — the oracle excludes that
+        # window for a fresh stream and the claimed slot must too
+        for t in range(140):
+            v = rng.random() * 0.1 + 0.45
+            v1 = 0.95 + rng.random() * 0.05 if t < lcfg.learning_period else v
+            grp.update(np.array([v, v1]))
+        # slot 1's distribution must reflect only its ~0.5-level mature
+        # scores, like slot 0's: pre-birth ZEROS would drag its mean far
+        # down, the learning transient would drag it far up and inflate
+        # sigma — either way muting real anomalies for the late joiner
+        assert abs(grp.mean[1] - grp.mean[0]) < 0.05, (grp.mean, grp.std)
+        assert grp.std[1] < 0.2, grp.std
+
     def test_checkpoint_roundtrip_preserves_birth(self):
         import dataclasses
 
