@@ -66,11 +66,18 @@ def load() -> ctypes.CDLL:
         lib.rtap_parser_free_owner.argtypes = [ctypes.c_void_p]
         f64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.rtap_parser_set_table.restype = ctypes.c_int
+        lib.rtap_parser_set_table.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32]
         lib.rtap_parser_feed.restype = ctypes.c_int
         lib.rtap_parser_feed.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, f32p, f64p, f64p]
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, f32p, f64p, f64p,
+            u8p, f64p, ctypes.c_long]
         lib.rtap_parser_flush.restype = None
-        lib.rtap_parser_flush.argtypes = [ctypes.c_void_p, f32p, f64p, f64p]
+        lib.rtap_parser_flush.argtypes = [
+            ctypes.c_void_p, f32p, f64p, f64p, u8p, f64p, ctypes.c_long]
         _lib = lib
         return _lib
 
@@ -87,7 +94,12 @@ class NativeJsonlState:
     calls across connections with its own lock.
     """
 
-    def __init__(self, stream_ids: list[str], latest: np.ndarray):
+    #: unknown-name capture buffer ("id\n" entries; full = drop, Python
+    #: dedups and the id re-surfaces next tick)
+    UNKNOWN_BUF_BYTES = 1 << 16
+
+    def __init__(self, stream_ids: list[str], latest: np.ndarray,
+                 track_unknown: bool = False):
         if latest.dtype != np.float32 or not latest.flags.c_contiguous:
             raise ValueError("latest must be a C-contiguous float32 array")
         self._lib = load()
@@ -100,9 +112,50 @@ class NativeJsonlState:
         self.latest = latest
         self.ts_buf = np.zeros(1, np.int64)
         self.counters = np.zeros(3, np.int64)
+        self.unk_buf = np.zeros(self.UNKNOWN_BUF_BYTES, np.uint8)
+        # cap 0 disables capture in C (no memcpy on the hot locked path
+        # when nothing will ever drain the buffer)
+        self.unk_cap = self.UNKNOWN_BUF_BYTES if track_unknown else 0
+        self.unk_cur = np.zeros(1, np.int64)
 
     def new_conn(self) -> "ConnParser":
         return ConnParser(self)
+
+    def set_table(self, stream_ids: list[str], latest: np.ndarray) -> None:
+        """Swap the id table + output array (registry membership changed).
+        The caller must hold the listener lock that serializes feed() —
+        every per-connection parser observes the new table on its next
+        line via the shared indirection; partial-line state survives."""
+        if latest.dtype != np.float32 or not latest.flags.c_contiguous:
+            raise ValueError("latest must be a C-contiguous float32 array")
+        ids = [sid.encode() for sid in stream_ids]
+        blob = b"".join(ids)
+        lens = (ctypes.c_int32 * len(ids))(*[len(b) for b in ids])
+        if self._lib.rtap_parser_set_table(self._owner, blob, lens, len(ids)):
+            raise MemoryError("rtap_parser_set_table failed")
+        self.latest = latest
+
+    def drain_unknown_names(self) -> list[str]:
+        """Pop captured unknown-id names (caller holds the listener lock).
+
+        Strict UTF-8: invalid-byte ids are dropped — a name that cannot
+        round-trip to its wire bytes would register a permanently
+        valueless model (the C side already skips escaped ids for the
+        same must-match-json.loads reason)."""
+        n = int(self.unk_cur[0])
+        if n == 0:
+            return []
+        raw = bytes(self.unk_buf[:n])
+        self.unk_cur[0] = 0
+        out = []
+        for s in raw.split(b"\n"):
+            if not s:
+                continue
+            try:
+                out.append(s.decode("utf-8"))
+            except UnicodeDecodeError:
+                pass
+        return out
 
     def __del__(self):
         owner = getattr(self, "_owner", None)
@@ -123,11 +176,13 @@ class ConnParser:
     def feed(self, data: bytes) -> None:
         st = self._state
         st._lib.rtap_parser_feed(self._h, data, len(data),
-                                 st.latest, st.ts_buf, st.counters)
+                                 st.latest, st.ts_buf, st.counters,
+                                 st.unk_buf, st.unk_cur, st.unk_cap)
 
     def flush(self) -> None:
         st = self._state
-        st._lib.rtap_parser_flush(self._h, st.latest, st.ts_buf, st.counters)
+        st._lib.rtap_parser_flush(self._h, st.latest, st.ts_buf, st.counters,
+                                  st.unk_buf, st.unk_cur, st.unk_cap)
 
     def close(self) -> None:
         if self._h:

@@ -118,34 +118,61 @@ static int32_t table_get(const Table *t, const char *key, long len) {
 /* ---------------------------------------------------------------- parser */
 
 typedef struct {
-    Table *table;
+    Table **table_ref;   /* shared indirection: the owner can swap the
+                            table (dynamic membership, set_ids) and every
+                            per-connection clone observes the new one on
+                            its next line — the caller's listener lock
+                            serializes feeds against the swap */
+    int owns_ref;
     char rem[MAX_LINE];  /* partial trailing line from the previous chunk */
     long rem_len;
     int rem_overflow;    /* current line exceeded MAX_LINE: swallow to \n */
 } Parser;
 
-Parser *rtap_parser_new(const char *ids_blob, const int32_t *id_lens, int32_t n_ids) {
-    Parser *p = (Parser *)calloc(1, sizeof(Parser));
-    if (!p) return NULL;
-    p->table = table_new(n_ids > 0 ? n_ids : 1);
-    if (!p->table) { free(p); return NULL; }
+static Table *build_table(const char *ids_blob, const int32_t *id_lens,
+                          int32_t n_ids) {
+    Table *t = table_new(n_ids > 0 ? n_ids : 1);
+    if (!t) return NULL;
     const char *cur = ids_blob;
     for (int32_t i = 0; i < n_ids; i++) {
-        if (table_put(p->table, cur, id_lens[i], i) != 0) {
-            table_free(p->table);
-            free(p);
+        if (table_put(t, cur, id_lens[i], i) != 0) {
+            table_free(t);
             return NULL;
         }
         cur += id_lens[i];
     }
+    return t;
+}
+
+Parser *rtap_parser_new(const char *ids_blob, const int32_t *id_lens, int32_t n_ids) {
+    Parser *p = (Parser *)calloc(1, sizeof(Parser));
+    if (!p) return NULL;
+    p->table_ref = (Table **)calloc(1, sizeof(Table *));
+    if (!p->table_ref) { free(p); return NULL; }
+    *p->table_ref = build_table(ids_blob, id_lens, n_ids);
+    if (!*p->table_ref) { free(p->table_ref); free(p); return NULL; }
+    p->owns_ref = 1;
     return p;
+}
+
+/* Swap the owner's id table (registry membership changed). The caller must
+ * hold the same lock that serializes feed()/flush() — no parser may be
+ * mid-line-batch during the swap. Returns 0, -1 on allocation failure
+ * (the old table stays in place). */
+int rtap_parser_set_table(Parser *owner, const char *ids_blob,
+                          const int32_t *id_lens, int32_t n_ids) {
+    Table *fresh = build_table(ids_blob, id_lens, n_ids);
+    if (!fresh) return -1;
+    table_free(*owner->table_ref);
+    *owner->table_ref = fresh;
+    return 0;
 }
 
 /* Share one listener-wide table across per-connection parsers. */
 Parser *rtap_parser_clone(const Parser *src) {
     Parser *p = (Parser *)calloc(1, sizeof(Parser));
     if (!p) return NULL;
-    p->table = src->table;   /* borrowed: free only via rtap_parser_free_owner */
+    p->table_ref = src->table_ref;   /* borrowed: freed only by the owner */
     return p;
 }
 
@@ -153,7 +180,10 @@ void rtap_parser_free_clone(Parser *p) { free(p); }
 
 void rtap_parser_free_owner(Parser *p) {
     if (!p) return;
-    table_free(p->table);
+    if (p->owns_ref) {
+        table_free(*p->table_ref);
+        free(p->table_ref);
+    }
     free(p);
 }
 
@@ -311,7 +341,8 @@ static int quoted_ts_to_int(const char *s, long n, int64_t *out) {
  * conversion, like `_index.get(rec["id"])` runs before np.float32);
  * known id with unconvertible value -> parse_errors. */
 static void process_line(Parser *p, const char *s, const char *end,
-                         float *latest, int64_t *ts_max, int64_t *counters) {
+                         float *latest, int64_t *ts_max, int64_t *counters,
+                         char *unk_buf, int64_t *unk_cur, long unk_cap) {
     /* blank lines: Python json.loads("") raises -> parse_error; but a
      * bare "\n" between records is produced by no real producer — treat
      * whitespace-only lines as Python does (error) for parity. */
@@ -331,11 +362,28 @@ static void process_line(Parser *p, const char *s, const char *end,
     }
     int32_t idx = -1;
     if (f.has_id == 1)
-        idx = table_get(p->table, f.id, f.id_len);
+        idx = table_get(*p->table_ref, f.id, f.id_len);
     if (idx < 0) {
         /* _index.get(...) is None -> unknown BEFORE value conversion: a
          * valueless record with an unknown id counts unknown, not error */
         counters[COUNTER_UNKNOWN_IDS]++;
+        /* track_unknown (serve --auto-register): capture the NAME as
+         * "id\n" into the caller's bounded buffer; full buffer (or
+         * unk_cap 0 = tracking off) = drop (the Python side dedups and
+         * re-sees the id next tick). Only string ids (a numeric id can
+         * never be registered) and only ids WITHOUT escapes: a captured
+         * name must equal what json.loads would produce, and this
+         * scanner matches raw bytes — an escaped id ('café') would
+         * register under its wire spelling and then dead-letter on the
+         * Python fallback path. Python-side strict-UTF-8 decode rejects
+         * the invalid-bytes case for the same reason. */
+        if (unk_buf != NULL && f.has_id == 1 &&
+                memchr(f.id, '\\', (size_t)f.id_len) == NULL &&
+                *unk_cur + f.id_len + 1 <= unk_cap) {
+            memcpy(unk_buf + *unk_cur, f.id, (size_t)f.id_len);
+            unk_buf[*unk_cur + f.id_len] = '\n';
+            *unk_cur += f.id_len + 1;
+        }
         return;
     }
     double v;
@@ -371,7 +419,9 @@ static void process_line(Parser *p, const char *s, const char *end,
 
 /* Connection EOF: Python's rfile iteration yields a final line even
  * without a trailing newline — process the remainder the same way. */
-void rtap_parser_flush(Parser *p, float *latest, int64_t *ts_max, int64_t *counters) {
+void rtap_parser_flush(Parser *p, float *latest, int64_t *ts_max,
+                       int64_t *counters, char *unk_buf, int64_t *unk_cur,
+                       long unk_cap) {
     if (p->rem_overflow) {
         counters[COUNTER_PARSE_ERRORS]++;
         p->rem_overflow = 0;
@@ -379,7 +429,8 @@ void rtap_parser_flush(Parser *p, float *latest, int64_t *ts_max, int64_t *count
         return;
     }
     if (p->rem_len > 0) {
-        process_line(p, p->rem, p->rem + p->rem_len, latest, ts_max, counters);
+        process_line(p, p->rem, p->rem + p->rem_len, latest, ts_max,
+                     counters, unk_buf, unk_cur, unk_cap);
         p->rem_len = 0;
     }
 }
@@ -389,7 +440,8 @@ void rtap_parser_flush(Parser *p, float *latest, int64_t *ts_max, int64_t *count
  * internal error (never raises mid-stream; malformed data only bumps
  * counters). */
 int rtap_parser_feed(Parser *p, const char *buf, long n,
-                     float *latest, int64_t *ts_max, int64_t *counters) {
+                     float *latest, int64_t *ts_max, int64_t *counters,
+                     char *unk_buf, int64_t *unk_cur, long unk_cap) {
     long i = 0;
     while (i < n) {
         const char *nl = (const char *)memchr(buf + i, '\n', (size_t)(n - i));
@@ -417,13 +469,15 @@ int rtap_parser_feed(Parser *p, const char *buf, long n,
             } else {
                 memcpy(p->rem + p->rem_len, buf + i, (size_t)tail);
                 p->rem_len += tail;
-                process_line(p, p->rem, p->rem + p->rem_len, latest, ts_max, counters);
+                process_line(p, p->rem, p->rem + p->rem_len, latest, ts_max,
+                             counters, unk_buf, unk_cur, unk_cap);
                 p->rem_len = 0;
             }
         } else if (line_end > i) {   /* skip empty lines like rfile iteration? no:
                                         a lone "\n" yields the line "\n" in Python,
                                         whose json.loads fails -> parse_error */
-            process_line(p, buf + i, buf + line_end, latest, ts_max, counters);
+            process_line(p, buf + i, buf + line_end, latest, ts_max,
+                         counters, unk_buf, unk_cur, unk_cap);
         } else {
             counters[COUNTER_PARSE_ERRORS]++;   /* empty line between \n\n */
         }

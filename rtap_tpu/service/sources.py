@@ -85,17 +85,11 @@ class TcpJsonlSource:
         self._py_parse_errors = 0
         self._py_unknown_ids = 0
         # track_unknown: remember the NAMES of unknown ids so serve
-        # --auto-register can lazily create models for them (SURVEY.md C19).
-        # Forces the Python parse path — the C parser counts unknowns but
-        # cannot capture names; 186k rec/s measured is still ~3x the 65k
-        # single-chip stream frontier at 1 s cadence.
+        # --auto-register can lazily create models for them (SURVEY.md
+        # C19). Both parse paths capture names: the C parser appends them
+        # to a bounded buffer drained each tick, the Python handler adds
+        # them to the bounded set below.
         self._track_unknown = bool(track_unknown)
-        if track_unknown:
-            if native:
-                raise ValueError(
-                    "track_unknown requires the Python parse path "
-                    "(native=True cannot capture unknown-id names)")
-            native = False
         self._unknown_seen: set[str] = set()
         # Native C parse path (rtap_tpu/native/jsonl_parser.c): the whole
         # recv-chunk drain in one locked C call instead of per-record
@@ -108,7 +102,9 @@ class TcpJsonlSource:
             try:
                 from rtap_tpu.native import NativeJsonlState
 
-                self._nstate = NativeJsonlState(self.stream_ids, self._latest)
+                self._nstate = NativeJsonlState(
+                    self.stream_ids, self._latest,
+                    track_unknown=self._track_unknown)
             except Exception:
                 if native:
                     raise
@@ -206,7 +202,13 @@ class TcpJsonlSource:
     def drain_unknown(self) -> list[str]:
         """Pop the unknown-id names seen since the last drain (sorted for
         deterministic registration order). Empty unless track_unknown."""
+        if not self._track_unknown:
+            return []
         with self._lock:
+            if self._nstate is not None:
+                for sid in self._nstate.drain_unknown_names():
+                    if len(self._unknown_seen) < self.MAX_UNKNOWN_TRACKED:
+                        self._unknown_seen.add(sid)
             seen = sorted(self._unknown_seen)
             self._unknown_seen.clear()
         return seen
@@ -217,17 +219,18 @@ class TcpJsonlSource:
         Latest values carry over BY ID — a retained stream must not lose
         the sample that arrived this tick — and new ids start at NaN. The
         snapshot order is the caller's (= the registry's dispatch order:
-        live_loop routes values positionally)."""
-        if self._nstate is not None:
-            raise RuntimeError(
-                "set_ids requires the Python parse path (construct with "
-                "track_unknown=True / native=False)")
+        live_loop routes values positionally). Works on both parse paths:
+        the native table swaps under the same lock that serializes
+        feed(), so per-connection parsers keep their partial-line state
+        and observe the new table on their next line."""
         with self._lock:
             latest = np.full(len(stream_ids), np.nan, np.float32)
             for j, sid in enumerate(stream_ids):
                 i = self._index.get(sid)
                 if i is not None:
                     latest[j] = self._latest[i]
+            if self._nstate is not None:
+                self._nstate.set_table(stream_ids, latest)
             self.stream_ids = list(stream_ids)
             self._index = {sid: i for i, sid in enumerate(self.stream_ids)}
             self._latest = latest

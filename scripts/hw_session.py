@@ -281,6 +281,13 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                          "--churn-every", "30", "--ticks", "1800",
                          "--startup-timeout", "900",
                          "--out", "reports/live_soak_30min.json"], 3300.0),
+    # disambiguate the >65k resident wall: u16 fails at 98304; if u8 at
+    # 81920/98304 also fails, the wall is purely G-structural in the
+    # remote compiler (no state-size component)
+    ("profile_32col_u8_mid", [sys.executable, "scripts/profile_step.py",
+                              "--T", "32", "--gs", "81920", "98304",
+                              "--layout", "flat", "--columns", "32",
+                              "--perm-bits", "8"], 1800.0),
 ]
 
 

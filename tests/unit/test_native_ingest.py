@@ -308,3 +308,70 @@ def test_python_fallback_forced():
             time.sleep(0.02)
         values, ts = src(0)
     assert values[0] == np.float32(3.5) and ts == 9
+
+
+@needs_native
+def test_native_unknown_name_capture():
+    """track_unknown on the NATIVE path: the C parser captures unknown-id
+    names into the bounded buffer and drain_unknown returns them — serve
+    --auto-register no longer needs the Python parse path."""
+    src = TcpJsonlSource(["a", "b"], port=0, native=True,
+                         track_unknown=True).start()
+    try:
+        assert src.native_active
+        # the escaped id rides raw: wire bytes 'café' — capture must
+        # SKIP it (a name registered under its wire spelling would
+        # dead-letter on the Python fallback path, which json-decodes)
+        with socket.create_connection(src.address, timeout=5.0) as s:
+            s.sendall(b'{"id": "caf\\u00e9", "value": 0.5}\n')
+        send_jsonl(src.address, [
+            {"id": "a", "value": 1.0},
+            {"id": "newcomer.x", "value": 2.0},
+            {"id": "newcomer.y", "value": 3.0},
+            {"id": "newcomer.x", "value": 4.0},  # dup: set dedups
+            {"id": 123, "value": 5.0},           # numeric id: counted, not captured
+        ])
+        # both connections' handlers are async: wait for ALL 5 unknown
+        # RECORDS (escaped café, x twice, y, numeric 123 — hashable miss
+        # like dict.get(5)) before draining the captured names
+        deadline = time.time() + 5
+        while time.time() < deadline and src.unknown_ids < 5:
+            time.sleep(0.02)
+        assert src.unknown_ids == 5
+        # only the 2 distinct plain string NAMES are capturable
+        assert src.drain_unknown() == ["newcomer.x", "newcomer.y"]
+        assert src.drain_unknown() == []  # drained
+    finally:
+        src.close()
+
+
+@needs_native
+def test_native_set_ids_swaps_table_mid_connection():
+    """set_ids on the native path: the owner's table swap propagates to a
+    per-connection parser mid-stream (shared indirection), partial-line
+    state survives, and retained ids keep their latest value by id."""
+    src = TcpJsonlSource(["a", "b"], port=0, native=True,
+                         track_unknown=True).start()
+    try:
+        with socket.create_connection(src.address, timeout=5.0) as s:
+            s.sendall(b'{"id": "a", "value": 7.0}\n{"id": "c", "value"')
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                with src._lock:
+                    if src._latest[0] == np.float32(7.0):
+                        break
+                time.sleep(0.02)
+            # membership change while the connection holds a partial line
+            src.set_ids(["c", "a"])  # new id first: order is the caller's
+            s.sendall(b": 9.0}\n")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            with src._lock:
+                if src._latest[0] == np.float32(9.0):
+                    break
+            time.sleep(0.02)
+        values, _ = src(0)
+        assert values[0] == np.float32(9.0)   # c: completed after the swap
+        assert values[1] == np.float32(7.0)   # a: carried over BY ID
+    finally:
+        src.close()
