@@ -79,10 +79,6 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # many groups per chip is the at-scale serving shape (throughput peaks
     # at small G — SCALING.md); capping at len(ids) keeps small serves in
     # one exactly-sized group with no pad slots
-    if args.auto_register and args.http:
-        print("serve: --auto-register requires the TCP push source (HTTP "
-              "polling only ever asks for known ids)", file=sys.stderr)
-        return 2
     gsize = min(args.group_size, len(ids))
     # --auto-register without reserved capacity can only claim group-size
     # rounding pads; make the elastic intent explicit by default
@@ -95,7 +91,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         grp.add_stream(sid)
     grp.finalize(reserve=reserve)
     if args.http:
-        source = HttpPollSource(args.http, ids)
+        source = HttpPollSource(args.http, ids,
+                                track_unknown=args.auto_register)
         close = lambda: None  # noqa: E731
     else:
         tcp = TcpJsonlSource(ids, port=args.port,
@@ -292,13 +289,15 @@ def main(argv: list[str] | None = None) -> int:
                         "135.8k/chip bench headline (SCALING.md model-width "
                         "study). Default: the conservative 256-col preset")
     p.add_argument("--auto-register", action="store_true",
-                   help="lazily create a model for every NEW stream id the "
-                        "TCP listener sees (the reference's per-metric lazy "
-                        "model creation): unknown ids claim free pad slots "
-                        "with a fresh model + their own likelihood "
-                        "probation, no recompile. Capacity = pad slots "
-                        "(--reserve; default one extra group's worth). TCP "
-                        "source only")
+                   help="lazily create a model for every NEW stream id "
+                        "seen on the wire — TCP records with unknown ids, "
+                        "or unregistered metric KEYS in the HTTP poll "
+                        "payload (the reference's per-metric lazy model "
+                        "creation / exporter discovery): each claims a "
+                        "free pad slot with a fresh model + its own "
+                        "likelihood probation, no recompile. Capacity = "
+                        "pad slots (--reserve; default one extra group's "
+                        "worth)")
     p.add_argument("--reserve", type=int, default=None,
                    help="extra claimable pad-slot capacity for post-start "
                         "registration (rounded up to whole groups; default "

@@ -37,13 +37,27 @@ class HttpPollSource:
     a live service must keep scoring the healthy streams when one exporter
     times out, not stall the whole group (the reference's collector has the
     same per-poll timeout shape).
+
+    `track_unknown=True` (serve --auto-register over HTTP): metric KEYS in
+    the poll payload that are not registered stream ids are remembered as
+    discovery candidates — the reference's collector discovers a node's
+    metrics from what the exporter reports, exactly this shape. Bounded
+    like the TCP listener's capture (an exporter spraying keys must not
+    grow host memory).
     """
 
-    def __init__(self, url: str, stream_ids: list[str], timeout_s: float = 0.5):
+    #: same bound as TcpJsonlSource.MAX_UNKNOWN_TRACKED
+    MAX_UNKNOWN_TRACKED = 4096
+
+    def __init__(self, url: str, stream_ids: list[str], timeout_s: float = 0.5,
+                 track_unknown: bool = False):
         self.url = url
         self.stream_ids = list(stream_ids)
+        self._known = set(self.stream_ids)
         self.timeout_s = timeout_s
         self.poll_failures = 0
+        self._track_unknown = bool(track_unknown)
+        self._unknown_seen: set[str] = set()
 
     def __call__(self, tick: int) -> tuple[np.ndarray, int]:
         values = np.full(len(self.stream_ids), np.nan, np.float32)
@@ -55,11 +69,47 @@ class HttpPollSource:
             ts = int(payload.get("ts", ts))
             for i, sid in enumerate(self.stream_ids):
                 v = metrics.get(sid)
-                if v is not None:
+                if v is None:
+                    continue
+                try:
                     values[i] = np.float32(v)
+                except (TypeError, ValueError):
+                    # one unconvertible metric (a version string, say) is
+                    # THAT stream's missing sample, not a poll failure —
+                    # the rest of the vector must still fill
+                    pass
+            if self._track_unknown and isinstance(metrics, dict):
+                for key, v in metrics.items():
+                    if not isinstance(key, str) or key in self._known:
+                        continue
+                    # discovery candidates must carry a usable numeric
+                    # value: a string/null metric would claim a pad slot
+                    # for a stream that can never score (and previously
+                    # poison later polls)
+                    try:
+                        float(v)
+                    except (TypeError, ValueError):
+                        continue
+                    if len(self._unknown_seen) < self.MAX_UNKNOWN_TRACKED:
+                        self._unknown_seen.add(key)
         except Exception:
             self.poll_failures += 1
         return values, ts
+
+    # ---- dynamic membership (serve --auto-register) ----
+    def drain_unknown(self) -> list[str]:
+        """Pop unregistered metric keys seen in polls since the last drain
+        (sorted for deterministic registration order)."""
+        seen = sorted(self._unknown_seen)
+        self._unknown_seen.clear()
+        return seen
+
+    def set_ids(self, stream_ids: list[str]) -> None:
+        """Adopt the registry's (possibly grown/shrunk) dispatch order.
+        Polling is stateless per tick — no value carry-over needed; the
+        next poll simply fills the new vector by id."""
+        self.stream_ids = list(stream_ids)
+        self._known = set(self.stream_ids)
 
 
 class TcpJsonlSource:

@@ -109,3 +109,59 @@ def test_tcp_jsonl_source_live_loop(group):
         assert np.isnan(values).all()
         stats = live_loop(src, group, n_ticks=5, cadence_s=0.1)
         assert stats["ticks"] == 5 and stats["scored"] == 5 * G
+
+
+class _DiscoveringExporter(BaseHTTPRequestHandler):
+    """Exporter that starts reporting a NEW metric key mid-run — the
+    reference's collector discovers a node's metrics from what the
+    exporter reports (serve --auto-register over HTTP)."""
+
+    polls = 0
+
+    def do_GET(self):
+        _DiscoveringExporter.polls += 1
+        # version string and null: present every poll, must NEVER be
+        # registered (no usable numeric value) nor poison the fill
+        metrics = {"h0.cpu": 35.0, "h0.mem": 52.0,
+                   "h0.version": "1.2.3-rc4", "h0.ghost": None}
+        if _DiscoveringExporter.polls >= 3:
+            metrics["h0.net"] = 12.0  # appears mid-run
+        body = json.dumps({"ts": int(time.time()), "metrics": metrics}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_http_poll_discovers_new_metric():
+    from rtap_tpu.service.loop import live_loop
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    _DiscoveringExporter.polls = 0
+    server = HTTPServer(("127.0.0.1", 0), _DiscoveringExporter)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/metrics"
+        src = HttpPollSource(url, ["h0.cpu", "h0.mem"], timeout_s=1.0,
+                             track_unknown=True)
+        reg = StreamGroupRegistry(cluster_preset(), group_size=2,
+                                  backend="tpu")
+        for sid in ("h0.cpu", "h0.mem"):
+            reg.add_stream(sid)
+        reg.finalize(reserve=2)
+        stats = live_loop(src, reg, n_ticks=8, cadence_s=0.05,
+                          auto_register=True)
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert stats["auto_registered"] == 1
+    assert "h0.net" in reg
+    assert "h0.version" not in reg and "h0.ghost" not in reg
+    # the discovered stream scored from the tick after registration,
+    # and the string/null metrics never broke the numeric fills
+    assert stats["scored"] > 2 * 8
+    assert stats.get("poll_failures", 0) == 0
