@@ -402,6 +402,13 @@ def main(argv: list[str] | None = None) -> int:
               "(the NAB family scales via scaled_nab_preset)",
               file=sys.stderr)
         return 2
+    if getattr(args, "freeze", False) and getattr(args, "auto_register", False):
+        print("serve: --freeze with --auto-register would claim fresh "
+              "models that can never learn — a lazily registered stream "
+              "would score garbage forever. Register streams in a "
+              "learning serve, then freeze; or serve frozen with a fixed "
+              "fleet", file=sys.stderr)
+        return 2
     if getattr(args, "backend", None) == "tpu":
         # fail in 120s on a wedged tunnel instead of hanging the operator's
         # terminal, and reuse compiled programs across service restarts

@@ -233,7 +233,8 @@ def validate_resume(resumed: StreamGroup, ck_path, grp: StreamGroup,
             f"checkpoint {ck_path} holds {ck_id!r} at slot {slot} but this "
             f"group expects {want_id!r}; refusing to resume"
             + ("" if allow_claimed_extras else
-               " (serve --auto-register resumes lazily claimed extras)"))
+               " (lazily claimed extras resume under serve"
+               " --auto-register, or frozen via serve --freeze)"))
     mismatches = [
         f"{name}: checkpoint={a!r} vs requested={b!r}"
         for name, a, b in (

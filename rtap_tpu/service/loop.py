@@ -324,8 +324,13 @@ def live_loop(
             if not os.path.isdir(ck_path):
                 continue
             resumed = load_group(ck_path, mesh=grp.mesh)
+            # claimed extras resume when this run could have claimed them
+            # (auto_register) OR when it serves frozen: an elastically-
+            # learned fleet must be servable read-only from its own
+            # checkpoint (--freeze forbids NEW claims — the footgun — but
+            # not reading streams a prior learning run registered)
             validate_resume(resumed, ck_path, grp,
-                            allow_claimed_extras=auto_register)
+                            allow_claimed_extras=auto_register or not learn)
             groups[gi] = resumed  # n_live derives from the resumed ids
             # the registry's lookup() index must observe the resumed
             # instance too, not the stale fresh group
@@ -342,6 +347,25 @@ def live_loop(
                         group._slots[sid] = _RegistrySlot(resumed, si)
                         group.version += 1
             resumed_from[f"group{gi}"] = resumed.ticks
+        # a checkpoint group BEYOND the built topology must not be
+        # silently dropped: a run resumed with a smaller --reserve than
+        # the one that learned (e.g. register-then-freeze without
+        # repeating --reserve) would lose every stream living in the
+        # extra groups — loudly demand a matching topology instead
+        import re as _re
+
+        stray = sorted(
+            d for d in os.listdir(checkpoint_dir)
+            if _re.fullmatch(r"group\d{4}", d)
+            and int(d[5:]) >= len(groups)
+            and os.path.isdir(os.path.join(checkpoint_dir, d))
+        ) if os.path.isdir(checkpoint_dir) else []
+        if stray:
+            raise ValueError(
+                f"checkpoint dir {checkpoint_dir} holds {stray} beyond this "
+                f"run's {len(groups)} group(s): the prior run had more "
+                "claimable capacity. Rerun with the same --reserve/"
+                "--group-size so every checkpointed stream resumes")
         if isinstance(group, StreamGroupRegistry) and resumed_from \
                 and hasattr(source, "set_ids"):
             # the source must accept the resumed extras' records and return

@@ -45,6 +45,15 @@ def test_serve_rejects_columns_on_nab_preset():
     assert "cluster preset only" in p.stderr
 
 
+def test_serve_rejects_freeze_with_auto_register():
+    """A frozen elastic serve is a footgun: lazily claimed models would
+    never learn and score garbage forever — rejected instantly (before
+    backend init), like the other flag-consistency gates."""
+    p = run_cli("serve", "--streams", "a", "--freeze", "--auto-register")
+    assert p.returncode == 2
+    assert "can never learn" in p.stderr
+
+
 def test_serve_streams_file_form(tmp_path):
     """--streams @file: fleets beyond a few thousand ids exceed the kernel
     argv limit (observed at the 16k-stream soak), so the file form is the

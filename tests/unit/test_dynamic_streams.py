@@ -373,6 +373,39 @@ class TestLiveLoopDynamicResume:
         assert stats2["scored"] == 3 * stats2["ticks"]  # all three emit
 
 
+    def test_elastic_fleet_serves_frozen_from_its_checkpoint(self, tmp_path):
+        """The register-then-freeze workflow: a fleet that auto-registered
+        streams while learning must be servable READ-ONLY from its own
+        checkpoint — frozen resume accepts the claimed extras (claiming
+        NEW streams while frozen stays forbidden at the CLI)."""
+        ck = str(tmp_path / "ck")
+        reg1 = _registry(n=2, group_size=2, reserve=2)
+        stats1 = TestLiveLoopDynamic._run_with_feeder(
+            reg1,
+            lambda k: [{"id": "s0", "value": 30.0, "ts": k},
+                       {"id": "s1", "value": 31.0, "ts": k},
+                       {"id": "newcomer", "value": 32.0, "ts": k}],
+            n_ticks=8, known_ids=["s0", "s1"], checkpoint_dir=ck)
+        assert stats1["auto_registered"] == 1
+
+        reg2 = _registry(n=2, group_size=2, reserve=2)
+        # frozen, no auto_register: resume must adopt the extras (the
+        # source only feeds NaN here — missing samples still score)
+        from rtap_tpu.service.sources import TcpJsonlSource
+
+        src = TcpJsonlSource(["s0", "s1"], port=0, track_unknown=True).start()
+        try:
+            stats2 = live_loop(src, reg2, n_ticks=5, cadence_s=0.0,
+                               learn=False, checkpoint_dir=ck)
+        finally:
+            src.close()
+        assert stats2["learn"] is False
+        assert "resumed_from" in stats2
+        assert "newcomer" in reg2  # adopted from the checkpoint, read-only
+        assert stats2["scored"] == 3 * 5
+        assert stats2["checkpoints_saved"] == 0  # frozen = dir untouched
+
+
 class TestCheckpointDynamic:
     def test_membership_survives_save_load(self, tmp_path):
         from rtap_tpu.service.checkpoint import load_group, save_group
