@@ -103,6 +103,7 @@ def replay_streams(
 
     # streams were added in order, so group i owns the contiguous slice
     # ids[i*group_size : i*group_size + n_live], at slots 0..n_live-1
+    groups_with_work = 0  # groups that will replay at least one tick
     for gi, grp in enumerate(reg.groups):
         ck_path = None
         if checkpoint_dir is not None:
@@ -124,6 +125,12 @@ def replay_streams(
                     )
                 grp = reg.groups[gi] = resumed
                 resumed_from[f"group{gi}"] = grp.ticks
+        if grp.ticks < T:
+            groups_with_work += 1
+        # a group resumed AT the end replays zero ticks (all-NaN rows) by
+        # design: its scores belong to the earlier run. That is only valid
+        # while some OTHER group still has work — the all-complete case is
+        # guarded after this loop.
         lo = gi * group_size
         live = grp.n_live
         sids = ids[lo : lo + live]
@@ -176,6 +183,17 @@ def replay_streams(
             save_group(grp, ck_path)  # final state, resumable past the end
             # (frozen replay never writes — read-only like serve --freeze)
     writer.close()
+    if resumed_from and not groups_with_work:
+        # every group's checkpoint is already at tick >= T: the whole replay
+        # silently scored ZERO ticks and would return all-NaN (frozen or
+        # learning alike). Resume exists to continue interrupted runs;
+        # re-scoring a corpus through a trained model is serve --freeze.
+        raise ValueError(
+            f"checkpoint dir {checkpoint_dir} resumes every group at tick >= "
+            f"replay length {T}: nothing left to replay. To re-score this "
+            "corpus through the trained model, serve it with --freeze; to "
+            "keep learning, replay a longer stream or a fresh checkpoint dir."
+        )
 
     stats = {**counter.stats(), "alerts": writer.count, **_occupancy()}
     overflow = _overflow_total(reg.groups)
@@ -302,10 +320,17 @@ def live_loop(
     if dispatch_threads < 1:
         raise ValueError(f"dispatch_threads must be >= 1; got {dispatch_threads}")
     if isinstance(group, StreamGroupRegistry):
-        if group._pending:
+        # _pending empty is NOT finalized: a stream count that is an exact
+        # multiple of group_size seals its last group with nothing pending,
+        # yet post-finalize membership (claims, releases, version bumps)
+        # still requires finalize() — an elastic loop on an unfinalized
+        # registry would buffer claims into _pending, invisible to this
+        # loop's groups snapshot
+        if group._pending or not group._finalized:
             raise ValueError(
                 "live_loop needs a finalized registry (finalize() seals the "
-                f"last group; {len(group._pending)} streams still pending)")
+                f"last group; {len(group._pending)} streams pending, "
+                f"finalized={group._finalized})")
         groups = group.groups  # the live list: resume replaces entries in place
     else:
         if checkpoint_dir is not None:
@@ -356,7 +381,7 @@ def live_loop(
 
         stray = sorted(
             d for d in os.listdir(checkpoint_dir)
-            if _re.fullmatch(r"group\d{4}", d)
+            if _re.fullmatch(r"group\d{4,}", d)
             and int(d[5:]) >= len(groups)
             and os.path.isdir(os.path.join(checkpoint_dir, d))
         ) if os.path.isdir(checkpoint_dir) else []

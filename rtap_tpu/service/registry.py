@@ -412,6 +412,11 @@ class StreamGroupRegistry:
         (shapes are static). Raises RuntimeError when every slot is live;
         capacity comes from group-size rounding, `reserve` slots, or
         released streams."""
+        if stream_id.startswith(PAD_PREFIX):
+            # same guard claim_slot enforces: a pad-prefixed id on the bulk
+            # path would silently read as pad capacity (never emitted, its
+            # slot re-claimable) — two index entries, one slot
+            raise ValueError(f"stream id may not start with {PAD_PREFIX!r}")
         if stream_id in self._slots or stream_id in self._pending:
             raise KeyError(f"duplicate stream id {stream_id!r}")
         if self._finalized:

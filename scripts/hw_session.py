@@ -288,6 +288,29 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                               "--T", "32", "--gs", "81920", "98304",
                               "--layout", "flat", "--columns", "32",
                               "--perm-bits", "8"], 1800.0),
+    # ---------------- round 5 ----------------
+    # Pallas re-race at the HEADLINE width (verdict r4 item 8): the dendrite
+    # kernel lost at 256-col/aos (24.3k vs 31.9k); arithmetic intensity at
+    # 32-col/flat is different. A/B at the exact headline config (k=2) and
+    # its full-rate base.
+    ("r5_pallas_32col_k2", [sys.executable, "scripts/profile_step.py",
+                            "--T", "32", "--gs", "1024", "--layout", "flat",
+                            "--columns", "32", "--learn-every", "2",
+                            "--pallas"]),
+    ("r5_pallas_32col", [sys.executable, "scripts/profile_step.py",
+                         "--T", "32", "--gs", "1024", "--layout", "flat",
+                         "--columns", "32", "--pallas"]),
+    # The >65k wall is per-program workspace, which scales with G AND the
+    # scan chunk T (verdict r4 item 2: "smaller scan T at scale"). If T=8
+    # compiles at 98304 where T=32 500s, the wall is the T-scaled feed/
+    # workspace, and single-program residency extends toward 100k. T=8 at
+    # 65536 calibrates the T-cost at a known-good G first.
+    ("r5_T8_65k", [sys.executable, "scripts/profile_step.py",
+                   "--T", "8", "--gs", "65536", "--layout", "flat",
+                   "--columns", "32"], 1500.0),
+    ("r5_T8_98k", [sys.executable, "scripts/profile_step.py",
+                   "--T", "8", "--gs", "98304", "131072", "--layout", "flat",
+                   "--columns", "32"], 1800.0),
 ]
 
 

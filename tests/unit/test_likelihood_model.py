@@ -165,3 +165,30 @@ def test_window_ring_memory_guard(monkeypatch, caplog):
     with caplog.at_level(logging.WARNING):
         BatchAnomalyLikelihood(small, 4)  # tiny ring: silent
     assert not caplog.records
+
+
+def test_vector_erfc_matches_libm():
+    """The vectorized Cody erfc (the G=100k production path —
+    reports/likelihood_100k.json) must track math.erfc to ~1e-15 relative
+    everywhere erfc is representable, including the branch joins at
+    0.46875 and 4.0 and the negative reflection."""
+    import math
+
+    from rtap_tpu.service.likelihood_batch import erfc_np
+
+    xs = np.concatenate([
+        np.linspace(-26.0, 26.0, 200_001),
+        np.linspace(0.46874, 0.46876, 2001),   # branch-1/2 join
+        np.linspace(3.9999, 4.0001, 2001),     # branch-2/3 join
+        np.array([0.0, -0.0, 1e-300, -1e-300, 0.46875, 4.0, 26.0, -26.0]),
+    ])
+    ref = np.array([math.erfc(float(v)) for v in xs])
+    got = erfc_np(xs)
+    ok = ref != 0.0
+    rel = np.abs(got[ok] - ref[ok]) / np.abs(ref[ok])
+    assert rel.max() < 5e-15, rel.max()
+    # extreme tails: exact saturation must match (Q=0 / Q=2 semantics)
+    assert erfc_np(np.array([40.0]))[0] == 0.0
+    assert erfc_np(np.array([-40.0]))[0] == 2.0
+    # empty input must not crash the subset-evaluation paths
+    assert erfc_np(np.empty(0)).shape == (0,)

@@ -188,3 +188,91 @@ def test_replay_learn_false_runs():
     cfg = cluster_preset()
     res = replay_streams(streams, cfg, backend="tpu", chunk_ticks=40, learn=False)
     assert res.raw.shape == (120, 2) and np.isfinite(res.raw).all()
+
+
+# ---- advisor-finding guards (round 5) ----
+
+
+def test_bulk_add_rejects_pad_prefix():
+    """A pad-prefixed id on the PRE-finalize bulk path must fail like
+    claim_slot's guard: buffered, it would silently read as pad capacity
+    (never emitted) and its slot could later be double-claimed."""
+    reg = StreamGroupRegistry(cluster_preset(), group_size=4, backend="tpu")
+    with pytest.raises(ValueError, match="__pad"):
+        reg.add_stream("__pad_evil")
+
+
+def test_live_loop_rejects_unfinalized_registry():
+    """Exact-multiple stream counts leave _pending empty WITHOUT finalize();
+    live_loop must still refuse — post-finalize membership (claims/releases)
+    on an unfinalized registry buffers into _pending, invisible to the
+    loop's groups snapshot."""
+    reg = StreamGroupRegistry(cluster_preset(), group_size=2, backend="tpu")
+    reg.add_stream("a")
+    reg.add_stream("b")  # seals the group: _pending is empty, not finalized
+    assert not reg._pending and not reg._finalized
+
+    def source(k):
+        return np.zeros(2, np.float32), 1_700_000_000 + k
+
+    with pytest.raises(ValueError, match="finalize"):
+        live_loop(source, reg, n_ticks=1, cadence_s=0.01)
+
+
+def test_stray_checkpoint_guard_matches_long_group_names(tmp_path):
+    """group indices >= 10000 are saved as 'group10000' (5 digits); the
+    stray-topology scan must catch them too, not just \\d{4}."""
+    import os
+
+    reg = StreamGroupRegistry(cluster_preset(), group_size=2, backend="tpu")
+    reg.add_stream("a")
+    reg.finalize()
+    os.makedirs(tmp_path / "group10000")
+
+    def source(k):
+        return np.zeros(1, np.float32), 1_700_000_000 + k
+
+    with pytest.raises(ValueError, match="beyond this"):
+        live_loop(source, reg, n_ticks=1, cadence_s=0.01,
+                  checkpoint_dir=str(tmp_path), checkpoint_every=1)
+
+
+def test_frozen_replay_from_completed_checkpoint_errors(tmp_path):
+    """Resuming a COMPLETED run's final checkpoint (frozen or learning)
+    would silently score zero ticks; it must error and point at
+    serve --freeze."""
+    scfg = SyntheticStreamConfig(length=64, cadence_s=1.0, n_anomalies=0)
+    streams = generate_cluster(1, metrics=("cpu",), cfg=scfg, seed=6)
+    cfg = cluster_preset()
+    ck = str(tmp_path / "ck")
+    replay_streams(streams, cfg, backend="tpu", chunk_ticks=32,
+                   checkpoint_dir=ck, checkpoint_every=1)
+    with pytest.raises(ValueError, match="nothing left to replay"):
+        replay_streams(streams, cfg, backend="tpu", chunk_ticks=32,
+                       checkpoint_dir=ck, learn=False)
+    # same silent no-op exists for a LEARNING replay resumed at the end
+    with pytest.raises(ValueError, match="nothing left to replay"):
+        replay_streams(streams, cfg, backend="tpu", chunk_ticks=32,
+                       checkpoint_dir=ck)
+
+
+def test_partial_multigroup_resume_still_works(tmp_path):
+    """The all-complete guard must NOT break crash recovery when only SOME
+    groups finished: a completed group skips (all-NaN rows, prior-run
+    semantics) while the interrupted group replays to the end."""
+    import shutil
+
+    scfg = SyntheticStreamConfig(length=64, cadence_s=1.0, n_anomalies=0)
+    streams = generate_cluster(2, metrics=("cpu",), cfg=scfg, seed=6)
+    cfg = cluster_preset()
+    ck = str(tmp_path / "ck")
+    replay_streams(streams, cfg, backend="tpu", group_size=1, chunk_ticks=32,
+                   checkpoint_dir=ck, checkpoint_every=1)
+    # simulate a crash that lost group1's checkpoint: group0 is complete,
+    # group1 must restart from scratch — the replay must run, not raise
+    shutil.rmtree(tmp_path / "ck" / "group0001")
+    res = replay_streams(streams, cfg, backend="tpu", group_size=1,
+                         chunk_ticks=32, checkpoint_dir=ck)
+    assert np.isnan(res.raw[:, 0]).all()      # completed group: prior run's
+    assert np.isfinite(res.raw[:, 1]).all()   # interrupted group: rescored
+    assert res.throughput["resumed_from"] == {"group0": 64}
