@@ -88,6 +88,11 @@ ATTEMPTS: list[tuple[int, int, dict]] = [
     # projects ~126k/s — the first rung past the north star whose base
     # config BEATS the preset's quality (k=2 cost measured separately)
     (1024, 64, {"BENCH_COLUMNS": "32", "BENCH_LEARN_EVERY": "2"}),
+    # k=4 at the density width: the 100k-live cadence candidate (r5 soak
+    # ladder). Quality measured, not assumed: held-out family 0.3945 vs
+    # k2's 0.4002 (reports/heldout_eval.json); diurnal-family number in
+    # reports/model_size_quality.json (eighth_32col_k4)
+    (1024, 64, {"BENCH_COLUMNS": "32", "BENCH_LEARN_EVERY": "4"}),
     (1024, 64, {"BENCH_LEARN_EVERY": "8"}),
     (1024, 64, {"BENCH_LEARN_EVERY": "4"}),
     (256, 64, {"RTAP_TM_LAYOUT": "aos"}),  # r3-default reference rung

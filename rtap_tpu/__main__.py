@@ -25,6 +25,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 from rtap_tpu.utils.platform import maybe_force_cpu
 
@@ -35,6 +36,8 @@ def _apply_cadence(cfg, args: argparse.Namespace):
     ModelConfig.with_learn_every — the shared policy — so an invalid k
     (0, negative) fails loudly instead of silently running full-rate."""
     return cfg.with_learn_every(getattr(args, "learn_every", 1),
+                                full_until=getattr(args, "learn_full_until",
+                                                   None),
                                 burst=getattr(args, "learn_burst", 1))
 
 
@@ -86,7 +89,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         else (gsize if args.auto_register else 0)
     grp = StreamGroupRegistry(cfg, group_size=gsize,
                               backend=args.backend, threshold=args.threshold,
-                              debounce=args.debounce)
+                              debounce=args.debounce,
+                              stagger_learn=args.stagger_learn)
     for sid in ids:
         grp.add_stream(sid)
     grp.finalize(reserve=reserve)
@@ -129,7 +133,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           dispatch_threads=args.dispatch_threads,
                           learn=not args.freeze,
                           auto_register=args.auto_register,
-                          auto_release_after=args.auto_release_after)
+                          auto_release_after=args.auto_release_after,
+                          micro_chunk=args.micro_chunk,
+                          chunk_stagger=args.chunk_stagger)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -205,6 +211,60 @@ def _cmd_eval(args: argparse.Namespace) -> int:
     return _with_argv(argv, fault_eval.main)
 
 
+def _cmd_nab(args: argparse.Namespace) -> int:
+    """BASELINE configs 1-2 as one mechanical command (SURVEY.md §6): load a
+    NAB-layout corpus, run the detector family over every file, sweep the
+    threshold exhaustively, report normalized per-profile scores."""
+    import json as _json
+
+    from rtap_tpu.data.nab_corpus import NAB_CORPUS_ENV, NabFile, load_corpus
+    from rtap_tpu.nab.runner import run_corpus
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = args.corpus or os.environ.get(NAB_CORPUS_ENV) \
+        or os.path.join(repo, "data", "nab")
+    if not os.path.isfile(os.path.join(root, "labels", "combined_windows.json")):
+        print(f"nab: no corpus at {root} (need data/**/*.csv + labels/"
+              "combined_windows.json). Pass --corpus, set "
+              f"${NAB_CORPUS_ENV}, or regenerate the stand-in: "
+              "python -c 'from rtap_tpu.data.nab_corpus import "
+              "ensure_standin_corpus; ensure_standin_corpus(\"data/nab\")'",
+              file=sys.stderr)
+        return 2
+    files = load_corpus(root, subset=args.subset)
+    if not files:
+        print(f"nab: corpus at {root} matched no files "
+              f"(subset={args.subset!r})", file=sys.stderr)
+        return 2
+    if args.rows:
+        files = [NabFile(f.name, f.timestamps[: args.rows],
+                         f.values[: args.rows], f.windows) for f in files]
+    cfg = None
+    if args.columns:
+        from rtap_tpu.config import scaled_nab_preset
+
+        cfg = scaled_nab_preset(args.columns)
+    t0 = time.time()
+    res = run_corpus(files, cfg=cfg, backend=args.backend)
+    wall = time.time() - t0
+    scores = {prof: {"threshold": round(thr, 4), "score": round(score, 2)}
+              for prof, (thr, score) in res.scores.items()}
+    report = {
+        "corpus_root": os.path.abspath(root),
+        "backend": args.backend,
+        "files": [f.name for f in files],
+        "records": int(sum(len(f.values) for f in files)),
+        "wall_s": round(wall, 1),
+        "scores": scores,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            _json.dump(report, f, indent=2)
+    print(_json.dumps(scores))
+    return 0
+
+
 def _cmd_report(args: argparse.Namespace) -> int:
     import os
     import runpy
@@ -265,12 +325,40 @@ def main(argv: list[str] | None = None) -> int:
                         "learn ticks per k*B cycle (same device cost as "
                         "--learn-every alone; preserves TM sequence "
                         "adjacency — SCALING.md burst study)")
+    p.add_argument("--learn-full-until", type=int, default=None,
+                   help="ticks of full-rate learning before the cadence "
+                        "thins (default: the likelihood learning_period — "
+                        "the quality-correct bring-up window). 0 measures "
+                        "the mature steady state (profile/bench semantics); "
+                        "production fleets onboarding gradually never pay "
+                        "the whole window at once")
+    p.add_argument("--chunk-stagger", action="store_true",
+                   help="with --micro-chunk M: rotate chunk boundaries "
+                        "across groups (group i flushes at ticks == i mod "
+                        "M) so each tick dispatches ~1/M of the fleet "
+                        "instead of spiking the whole fleet's chunk work "
+                        "onto every M-th tick. Incompatible with "
+                        "--auto-register/--auto-release-after/"
+                        "--checkpoint-every")
+    p.add_argument("--stagger-learn", action="store_true",
+                   help="stagger the learning-cadence phase across groups "
+                        "(group i learns on ticks == i mod k): spreads the "
+                        "fleet's learning load evenly over ticks instead of "
+                        "spiking every k-th tick — the 100k-streams-per-chip "
+                        "serving shape (SCALING.md)")
     p.add_argument("--pipeline-depth", type=int, default=1,
                    help="2 = collect tick k after dispatching k+1: hides the "
                         "per-group device round trip (remote-chip dispatch "
                         "latency) behind the cadence sleep; alerts lag one "
                         "cadence (reports/live_soak.json measured the cost "
                         "of depth 1 at 16 groups)")
+    p.add_argument("--micro-chunk", type=int, default=1,
+                   help="batch M consecutive ticks into one device dispatch "
+                        "per group: divides the per-program invocation floor "
+                        "(~12 ms on the tunnel runtime — the 100k-soak "
+                        "binder) by M, at <= (depth*M - 1) ticks of alert "
+                        "staleness. The 100k-streams-per-chip cadence lever "
+                        "(SCALING.md round 5)")
     p.add_argument("--dispatch-threads", type=int, default=1,
                    help="issue per-group dispatch/collect calls from N "
                         "threads: on links where each dispatch is itself a "
@@ -385,6 +473,30 @@ def main(argv: list[str] | None = None) -> int:
                         "adjacency — SCALING.md burst study)")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_eval)
+
+    p = sub.add_parser(
+        "nab",
+        help="NAB corpus run: detect -> threshold sweep -> normalized score")
+    p.add_argument("--corpus", default=None,
+                   help="NAB-layout corpus root (data/**/*.csv + labels/"
+                        "combined_windows.json). Default: $RTAP_NAB_CORPUS, "
+                        "else the committed stand-in at <repo>/data/nab. "
+                        "Point this at the real NAB checkout the moment one "
+                        "is available — the run is mechanical (SURVEY.md §6 "
+                        "blocker drill)")
+    p.add_argument("--subset", default=None,
+                   help="relative-path prefix filter, e.g. realAWSCloudwatch")
+    p.add_argument("--backend", default="tpu", choices=("tpu", "cpu"),
+                   help="tpu = all files as one vmapped device group; cpu = "
+                        "per-file oracle (slow at full width)")
+    p.add_argument("--columns", type=int, default=None,
+                   help="width-scaled NAB model (scaled_nab_preset) instead "
+                        "of the 2048-column preset")
+    p.add_argument("--rows", type=int, default=None,
+                   help="truncate files to this many rows (cheap drives)")
+    p.add_argument("--out", default=None, help="report JSON path (default: "
+                                               "print scores only)")
+    p.set_defaults(fn=_cmd_nab)
 
     p = sub.add_parser("report", help="matplotlib overlays (metric/likelihood/alerts)")
     p.add_argument("--out-dir", default="reports")

@@ -298,23 +298,35 @@ class ModelConfig:
     # k-step-apart pairs). Bursts preserve adjacency inside each burst;
     # quality measured in eval/fault_eval.py --learn-burst.
     learn_burst: int = 1
+    # Cadence phase offset: group i of a many-group deployment learns on
+    # ticks where (it - learn_phase) % learn_every == 0. With every group
+    # at phase 0, ALL groups learn on the same ticks — the per-tick device
+    # compute spikes to the full-fleet learning cost on learn ticks and
+    # idles on the rest, and at 100k streams the spike alone exceeds the
+    # 1 s cadence. Staggering phases (registry stagger_learn) spreads the
+    # fleet's learning load evenly across ticks; per-group semantics are
+    # identical up to a <learn_every-tick shift of its schedule.
+    learn_phase: int = 0
 
     def learns_on(self, it):
         """The cadence predicate, shared by the device schedule
         (ops/step.py:_tick, traced jnp scalar) and the host twin
         (HTMModel.run, python int) so the two can never diverge:
         learn when `it` (completed steps) is inside the full-rate maturity
-        window or on the cadence (burst=1: every k-th tick; burst=B: the
-        first B ticks of every k*B-tick cycle, phased so a burst begins
-        the tick the maturity window ends — absolute phasing would freeze
-        learning for up to (k-1)*B ticks right as scoring starts)."""
+        window or on the cadence (burst=1: every k-th tick shifted by
+        learn_phase; burst=B: the first B ticks of every k*B-tick cycle,
+        phased so a burst begins the tick the maturity window ends —
+        absolute phasing would freeze learning for up to (k-1)*B ticks
+        right as scoring starts — then shifted by learn_phase)."""
         if self.learn_burst == 1:
-            # the original spread schedule, absolute phase (measured
-            # semantics; unchanged by the burst feature)
-            return (it < self.learn_full_until) | (it % self.learn_every == 0)
-        rel = it - self.learn_full_until  # negative inside the window, where
-        # the first clause already grants learning (python/jnp % both give
-        # non-negative results, so the second clause stays well-defined)
+            # the original spread schedule (measured semantics; unchanged
+            # by the burst/phase features at phase 0)
+            return (it < self.learn_full_until) | (
+                (it - self.learn_phase) % self.learn_every == 0)
+        rel = it - self.learn_full_until - self.learn_phase
+        # negative inside the window, where the first clause already grants
+        # learning (python/jnp % both give non-negative results, so the
+        # second clause stays well-defined)
         return (it < self.learn_full_until) | (
             rel % (self.learn_every * self.learn_burst) < self.learn_burst
         )
@@ -420,6 +432,16 @@ class ModelConfig:
             raise ValueError(
                 f"learn_full_until must be >= 0; got {self.learn_full_until}"
             )
+        cycle = self.learn_every * self.learn_burst
+        if not 0 <= self.learn_phase < cycle:
+            # a phase outside the cadence cycle silently aliases; demand
+            # the canonical value so saved configs read unambiguously.
+            # The cycle is k ticks for the spread schedule and k*B for
+            # bursts (a burst-mode stagger offsets whole B-tick bursts)
+            raise ValueError(
+                f"learn_phase must be in [0, learn_every*learn_burst="
+                f"{cycle}); got {self.learn_phase}"
+            )
         if self.sp.columns * self.tm.cells_per_column >= 1 << 24:
             # The kernel round-trips presynaptic cell ids through f32 one-hot
             # matmuls; ids >= 2^24 would lose bits silently.
@@ -488,6 +510,7 @@ class ModelConfig:
             learn_every=d.get("learn_every", 1),
             learn_full_until=d.get("learn_full_until", 0),
             learn_burst=d.get("learn_burst", 1),
+            learn_phase=d.get("learn_phase", 0),
         )
 
     @classmethod

@@ -200,6 +200,7 @@ def run_fault_eval(
     seed: int = 11,
     chunk_ticks: int = 256,
     default_debounce: int = 2,
+    family: str = "diurnal",
 ) -> FaultEvalReport:
     """Generate a kind-labeled cluster, replay it, sweep the detection
     threshold (NAB methodology), and score the alerts.
@@ -225,7 +226,7 @@ def run_fault_eval(
     scfg = SyntheticStreamConfig(
         length=length, cadence_s=1.0, n_anomalies=2, kinds=kinds,
         anomaly_magnitude=magnitude, noise_phi=0.97, noise_scale=0.5,
-        inject_after_frac=frac,
+        inject_after_frac=frac, family=family,
     )
     streams = [
         generate_stream(
@@ -303,6 +304,13 @@ def main() -> None:
     ap.add_argument("--streams", type=int, default=120)
     ap.add_argument("--length", type=int, default=1500)
     ap.add_argument("--magnitude", type=float, default=6.0)
+    ap.add_argument("--family", choices=("diurnal", "heldout"),
+                    default="diurnal",
+                    help="signal family: 'heldout' is the external-"
+                         "validation world (heavy-tailed bursty noise, "
+                         "trend, unlabeled regime switches) no config was "
+                         "tuned on")
+    ap.add_argument("--seed", type=int, default=11)
     ap.add_argument("--all-kinds", action="store_true",
                     help="include the hard gradual kinds (drift, stuck)")
     ap.add_argument("--backend", default="tpu")
@@ -359,6 +367,7 @@ def main() -> None:
         n_streams=args.streams, length=args.length, kinds=kinds,
         magnitude=args.magnitude, cfg=cfg, backend=args.backend,
         default_threshold=args.threshold, default_debounce=args.debounce,
+        seed=args.seed, family=args.family,
     )
     print(report.to_json())
     if args.out:

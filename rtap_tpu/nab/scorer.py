@@ -152,21 +152,88 @@ def score_corpus(
 def optimize_threshold(
     per_file: list[tuple[np.ndarray, np.ndarray, list[tuple[int, int]]]],
     profile: CostProfile,
-    max_candidates: int = 200,
+    max_candidates: int | None = None,
 ) -> tuple[float, float]:
-    """Sweep candidate thresholds (quantiles of the pooled score distribution,
-    as in NAB's exhaustive sweeper) -> (best_threshold, best_normalized_score)."""
-    pooled = np.concatenate([s for s, _, _ in per_file]) if per_file else np.array([0.5])
-    qs = np.unique(np.quantile(pooled, np.linspace(0.0, 1.0, max_candidates)))
-    candidates = np.unique(np.concatenate([qs, [0.5, 0.9, 0.99, 1.0, 1.1]]))
+    """EXHAUSTIVE threshold sweep over every distinct anomaly score (NAB's
+    sweeper semantics) -> (best_threshold, best_normalized_score).
+
+    Implemented as one descending-score incremental pass, O(n log n) over
+    the pooled corpus instead of O(n) full re-scores per candidate: walking
+    thresholds downward only ever ADDS detections, so each row contributes
+    a precomputable delta — an FP row its (static) sigmoid cost, a window
+    row an upgrade of its window's credit (windows never overlap in NAB,
+    so the earliest active row in a window is also the max-credit one, and
+    a window's first activation also cancels its FN cost). Equivalence
+    with the direct per-threshold scorer is property-tested against
+    `score_corpus` on randomized corpora
+    (tests/unit/test_nab_scorer_examples.py).
+
+    `max_candidates` is accepted for backward compatibility and ignored:
+    the sweep is always exhaustive (the r4 verdict flagged the previous
+    ~200-quantile approximation as silent scoring drift vs NAB).
+    """
+    del max_candidates
     prepped, perfect, null = _prepare(per_file, profile)
-    best_t, best_s = 1.1, -np.inf
-    for t in candidates:
-        if perfect == null:
-            s = 0.0
-        else:
-            raw = sum(_score_spans(sc >= t, spans, profile) for sc, spans in prepped)
-            s = 100.0 * (raw - null) / (perfect - null)
+    n_windows = sum(len(spans) for _, spans in prepped)
+
+    # flatten: for each post-probation row, (score, window_key or None,
+    # contribution). Window rows carry their credit; FP rows their cost.
+    rows: list[tuple[float, int, float]] = []  # (score, kind/window id, value)
+    FP = -1  # kind marker for non-window rows
+    wid = 0
+    for scores, spans in prepped:
+        prob = probation_rows(len(scores))
+        file_wids = list(range(wid, wid + len(spans)))
+        wid += len(spans)
+        # NaN scores can never satisfy `score >= t` in the direct scorer,
+        # so they are excluded from the walk the same way
+        for i in np.nonzero(~np.isnan(scores))[0]:
+            if i < prob:
+                continue
+            placed = False
+            for w_local, (l, r) in enumerate(spans):
+                if l <= i <= r:
+                    width = max(r - l, 1)
+                    credit = profile.tp_weight * scaled_sigmoid((i - r) / width)
+                    rows.append((float(scores[i]), file_wids[w_local], credit))
+                    placed = True
+                    break
+            if not placed:
+                prev = [(l, r) for (l, r) in spans if r < i]
+                if prev:
+                    l, r = prev[-1]
+                    width = max(r - l, 1)
+                    cost = profile.fp_weight * scaled_sigmoid((i - r) / width)
+                else:
+                    cost = -profile.fp_weight
+                rows.append((float(scores[i]), FP, cost))
+
+    if perfect == null:
+        return 1.1, 0.0
+
+    def normalize(raw: float) -> float:
+        return 100.0 * (raw - null) / (perfect - null)
+
+    # descending-score walk; snapshot after each distinct score value
+    rows.sort(key=lambda t: -t[0])
+    running = -profile.fn_weight * n_windows  # nothing detected
+    best_t, best_s = 1.1, normalize(running)
+    window_credit: dict[int, float] = {}
+    i = 0
+    while i < len(rows):
+        v = rows[i][0]
+        while i < len(rows) and rows[i][0] == v:
+            _, kind, val = rows[i]
+            if kind == FP:
+                running += val
+            elif kind not in window_credit:
+                window_credit[kind] = val
+                running += profile.fn_weight + val  # cancel FN, add credit
+            elif val > window_credit[kind]:
+                running += val - window_credit[kind]
+                window_credit[kind] = val
+            i += 1
+        s = normalize(running)
         if s > best_s:
-            best_t, best_s = float(t), s
+            best_t, best_s = v, s
     return best_t, best_s

@@ -230,6 +230,8 @@ def live_loop(
     learn: bool = True,
     auto_register: bool = False,
     auto_release_after: int = 0,
+    micro_chunk: int = 1,
+    chunk_stagger: bool = False,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -291,6 +293,20 @@ def live_loop(
     output is bit-identical to the serial schedule
     (tests/unit/test_multigroup_serve.py pins it).
 
+    `micro_chunk=M` batches M consecutive ticks into ONE device dispatch
+    per group (the chunked scan path, T=M). The 100k-soak forensics
+    (reports/live_soak_100k_t48.json and SCALING.md round 5) measured a
+    ~12 ms device-side invocation floor PER PROGRAM on the tunnel-attached
+    runtime — at 100 groups that alone is 1.2 s/tick, unfixable by
+    threads (48 threads moved nothing) or cadence (k=4 moved nothing).
+    Micro-chunking divides the program count by M; the price is alert
+    latency: a record is scored up to (M-1) ticks after arrival, plus the
+    usual (pipeline_depth-1) chunks of collect lag — total staleness
+    <= (pipeline_depth*M - 1) ticks. Deadlines stay per-tick: boundary
+    ticks carry the whole chunk's dispatch+collect inside one cadence
+    budget. Membership changes, routing rebuilds, and checkpoints happen
+    only at chunk boundaries (nothing buffered, nothing in flight).
+
     Accepts a single :class:`StreamGroup` or a finalized
     :class:`StreamGroupRegistry`. Measured chip throughput PEAKS at small
     group sizes (SCALING.md bench G-sweep: nothing amortizes with G), so
@@ -317,6 +333,21 @@ def live_loop(
     """
     if pipeline_depth < 1:
         raise ValueError(f"pipeline_depth must be >= 1; got {pipeline_depth}")
+    if micro_chunk < 1:
+        raise ValueError(f"micro_chunk must be >= 1; got {micro_chunk}")
+    if chunk_stagger:
+        if micro_chunk < 2:
+            raise ValueError("chunk_stagger needs micro_chunk >= 2")
+        if auto_register or auto_release_after or checkpoint_every:
+            # rotating per-class boundaries never reach a global
+            # nothing-buffered instant mid-run, so membership changes and
+            # periodic saves have no safe point; the final-save-on-exit
+            # path (checkpoint_dir with checkpoint_every=0) still works
+            raise ValueError(
+                "chunk_stagger is incompatible with auto_register/"
+                "auto_release_after/checkpoint_every (no global chunk "
+                "boundary mid-run); use plain micro_chunk for elastic or "
+                "periodically-checkpointed serving")
     if dispatch_threads < 1:
         raise ValueError(f"dispatch_threads must be >= 1; got {dispatch_threads}")
     if isinstance(group, StreamGroupRegistry):
@@ -449,6 +480,12 @@ def live_loop(
     ticks_run = 0
     last_saved = 0
     latencies = np.empty(n_ticks, np.float64)  # per-tick poll->emit seconds
+    # per-phase accounting (100k-soak forensics: the tick period pinned at
+    # ~1.4 s independent of stream count AND group count — the breakdown
+    # names the binding phase instead of guessing). Wall seconds summed
+    # over the run; reported per tick in stats["phase_ms_per_tick"].
+    phase_s = {"source": 0.0, "membership": 0.0, "dispatch": 0.0,
+               "collect": 0.0, "emit": 0.0, "checkpoint": 0.0}
 
     # one pool for the whole loop (threads are cheap to keep, expensive to
     # respawn per tick); None = the serial schedule, bit-identical by test
@@ -460,45 +497,61 @@ def live_loop(
         eff_threads = min(dispatch_threads, len(groups))
         pool = ThreadPoolExecutor(max_workers=eff_threads)
 
-    def _collect_tick(ts, values, handles, rmaps):
+    def _collect_tick(ts_rows, value_rows, handles, rmaps, idx=None):
         # collects in parallel (each blocks on its group's device fetch —
         # the per-group RPC on a remote link), emission strictly serial in
-        # group order so the alert stream is schedule-independent
+        # group order so the alert stream is schedule-independent. `idx`
+        # restricts to a subset of groups (chunk_stagger phase classes).
+        sel = range(len(groups)) if idx is None else idx
+        t0 = time.perf_counter()
+        pairs = [(groups[i], h) for i, h in zip(sel, handles)]
         if pool is None:
-            results = [grp.collect_chunk(h) for grp, h in zip(groups, handles)]
+            results = [grp.collect_chunk(h) for grp, h in pairs]
         else:
             results = list(pool.map(
-                lambda gh: gh[0].collect_chunk(gh[1]), zip(groups, handles)))
-        for (slots, ids, off), (raw, loglik, alerts) in zip(rmaps, results):
+                lambda gh: gh[0].collect_chunk(gh[1]), pairs))
+        t1 = time.perf_counter()
+        phase_s["collect"] += t1 - t0
+        for gi, (raw, loglik, alerts) in zip(sel, results):
+            slots, ids, off = rmaps[gi]
             n = len(slots)
-            writer.emit_batch(ids, np.full(n, ts), values[off:off + n],
-                              raw[0, slots], loglik[0, slots],
-                              alerts[0, slots])
-            counter.add(n)
+            for i, (ts, values) in enumerate(zip(ts_rows, value_rows)):
+                writer.emit_batch(ids, np.full(n, ts), values[off:off + n],
+                                  raw[i, slots], loglik[i, slots],
+                                  alerts[i, slots])
+                counter.add(n)
+        phase_s["emit"] += time.perf_counter() - t1
 
-    warmed = False  # first tick dispatches serially: concurrent cold misses
-    # on step.py's compiled-fn lru_cache are not single-flight, so N pool
+    warmed: set = set()  # chunk lengths (T) already dispatched once: the
+    # first dispatch of each T runs serially — concurrent cold misses on
+    # step.py's compiled-fn lru_cache are not single-flight, so N pool
     # threads would each trace+compile the same program (up to Nx the
-    # dominant startup cost over the tunnel); one serial tick warms it
+    # dominant startup cost over the tunnel). chunk_stagger's ramp-in
+    # dispatches T=1..M chunks, each a distinct program, so warm-up is
+    # per-T, not once
 
-    def _dispatch_all(values, ts, rmaps):
+    def _dispatch_all(value_rows, ts_rows, rmaps, idx=None):
         nonlocal warmed
+        sel = range(len(groups)) if idx is None else idx
+        m = len(value_rows)
         staged = []
-        for grp, (slots, _ids, off) in zip(groups, rmaps):
+        for gi in sel:
+            grp = groups[gi]
+            slots, _ids, off = rmaps[gi]
             # trailing field axis preserved: values may be [G] or [G, n_fields]
-            v = np.full((grp.G,) + values.shape[1:], np.nan, np.float32)
-            v[slots] = values[off:off + len(slots)]
-            staged.append((grp, v))
-        if pool is None or not warmed:
-            warmed = True
-            return [grp.dispatch_chunk(v[None, :],
-                                       np.full((1, grp.G), ts, np.int64),
-                                       learn=learn)
-                    for grp, v in staged]
+            v = np.full((m, grp.G) + value_rows[0].shape[1:], np.nan,
+                        np.float32)
+            for i, row in enumerate(value_rows):
+                v[i, slots] = row[off:off + len(slots)]
+            t = np.repeat(np.asarray(ts_rows, np.int64)[:, None], grp.G,
+                          axis=1)
+            staged.append((grp, v, t))
+        if pool is None or m not in warmed:
+            warmed.add(m)
+            return [grp.dispatch_chunk(v, t, learn=learn)
+                    for grp, v, t in staged]
         return list(pool.map(
-            lambda gv: gv[0].dispatch_chunk(
-                gv[1][None, :], np.full((1, gv[0].G), ts, np.int64),
-                learn=learn),
+            lambda gvt: gvt[0].dispatch_chunk(gvt[1], gvt[2], learn=learn),
             staged))
 
     # Cross-tick pipeline (pipeline_depth=2): collect tick k-1 AFTER
@@ -509,7 +562,40 @@ def live_loop(
     # The price is results lagging one tick (alert latency +1 cadence),
     # stated in the stats via "pipeline_depth". Depth 1 keeps the
     # dispatch-collect-emit-same-tick behavior.
-    in_flight: deque = deque()
+    # chunk_stagger: group i belongs to phase class i mod M; each class
+    # keeps its own buffer + pipeline and flushes on ITS boundary (class
+    # c's first chunk is c+1 rows, then every M) — so each tick dispatches
+    # ~1/M of the fleet instead of the whole fleet every M-th tick,
+    # leveling the boundary-tick spike the plain micro_chunk path carries
+    # (r5 steady soak: 2.8 s of chunk work on one tick = a guaranteed
+    # miss). Plain mode is the single class 0.
+    n_classes = micro_chunk if chunk_stagger else 1
+    class_idx = [
+        [i for i in range(len(groups)) if i % n_classes == c]
+        for c in range(n_classes)
+    ]
+    in_flights: list[deque] = [deque() for _ in range(n_classes)]
+    chunk_bufs: list[list] = [[] for _ in range(n_classes)]
+    first_flush_done = [False] * n_classes
+
+    def _drain_all():
+        for c in range(n_classes):
+            while in_flights[c]:
+                _collect_tick(*in_flights[c].popleft())
+
+    def _flush_class(c):
+        vrows = [b[0] for b in chunk_bufs[c]]
+        tsrows = [b[1] for b in chunk_bufs[c]]
+        chunk_bufs[c].clear()
+        first_flush_done[c] = True
+        if not class_idx[c]:
+            return  # more classes than groups: nothing to dispatch
+        now = time.perf_counter()
+        handles = _dispatch_all(vrows, tsrows, routing, class_idx[c])
+        phase_s["dispatch"] += time.perf_counter() - now
+        in_flights[c].append((tsrows, vrows, handles, routing, class_idx[c]))
+        while len(in_flights[c]) >= pipeline_depth:
+            _collect_tick(*in_flights[c].popleft())
     try:
         for k in range(n_ticks):
             # orderly shutdown (SIGTERM -> serve's handler sets the event):
@@ -518,12 +604,18 @@ def live_loop(
             if stop_event is not None and stop_event.is_set():
                 break
             t_start = time.perf_counter()
+            t_phase = t_start
+            # membership booking excludes collect/emit seconds its drains
+            # accrue (those book into their own phases; double-counting
+            # would mis-name the binding phase — the instrumentation's job)
+            ce_tick0 = phase_s["collect"] + phase_s["emit"]
             # lazy model creation (serve --auto-register, SURVEY.md C19):
             # unknown ids the TCP listener saw claim free pad slots. The
             # pipeline drains first — membership may only change with
             # nothing in flight (a claimed slot's reset must not race a
             # dispatched-but-uncollected tick's emission routing).
             if auto_register and reg is not None \
+                    and not any(chunk_bufs) \
                     and hasattr(source, "drain_unknown"):
                 # filter ids that registered meanwhile (records arriving
                 # between a drain and set_ids re-enter the unknown set) and
@@ -548,8 +640,7 @@ def live_loop(
                             # membership may only change with nothing in
                             # flight (a claimed slot's reset must not race
                             # an uncollected tick's emission routing)
-                            while in_flight:
-                                _collect_tick(*in_flight.popleft())
+                            _drain_all()
                             claimed = True
                         reg.add_stream(sid)
                         auto_registered += 1
@@ -562,9 +653,8 @@ def live_loop(
             # re-registers as a NEW model (correct lazy semantics: the old
             # temporal context is stale by then anyway). Processed at the
             # top of the tick, like claims, under the same drain rule.
-            if release_pending:
-                while in_flight:
-                    _collect_tick(*in_flight.popleft())
+            if release_pending and not any(chunk_bufs):
+                _drain_all()
                 for sid in release_pending:
                     if sid in reg:
                         reg.remove_stream(sid)
@@ -577,10 +667,17 @@ def live_loop(
                 auto_rejected.clear()
                 if hasattr(source, "set_ids"):
                     source.set_ids(reg.dispatch_ids())
-            if reg is not None and reg.version != routing_version:
+            if reg is not None and reg.version != routing_version \
+                    and not any(chunk_bufs):
+                # routing changes only at chunk boundaries: buffered rows
+                # were polled under the old routing and must dispatch with it
                 routing, n_expected = _build_routing()
                 routing_version = reg.version
+            now = time.perf_counter()
+            phase_s["membership"] += (now - t_phase) - (
+                phase_s["collect"] + phase_s["emit"] - ce_tick0)
             values, ts = source(k)
+            phase_s["source"] += time.perf_counter() - now
             values = np.asarray(values, np.float32)
             if len(values) != n_expected:
                 raise ValueError(
@@ -603,22 +700,35 @@ def live_loop(
                                 release_pending.add(sid)
                         else:
                             silent_ticks.pop(sid, None)
-            handles = _dispatch_all(values, ts, routing)
-            # held across a tick at depth >= 2: a source reusing a
-            # preallocated buffer must not corrupt the emitted values column
-            in_flight.append(
-                (ts, values.copy() if pipeline_depth > 1 else values, handles,
-                 routing))
-            while len(in_flight) >= pipeline_depth:
-                _collect_tick(*in_flight.popleft())
+            # held across ticks (micro_chunk) and across collects
+            # (depth >= 2): a source reusing a preallocated buffer must not
+            # corrupt the emitted values column
+            row = (values.copy() if pipeline_depth > 1 or micro_chunk > 1
+                   else values, ts)
+            for c in range(n_classes):
+                chunk_bufs[c].append(row)
+                # staggered first flush at c+1 rows tiles class boundaries
+                # across ticks; afterwards every class flushes at M rows
+                target = micro_chunk if (first_flush_done[c]
+                                         or not chunk_stagger) else c + 1
+                if len(chunk_bufs[c]) >= target or k + 1 == n_ticks:
+                    _flush_class(c)
             ticks_run = k + 1
             if learn and checkpoint_every and checkpoint_dir \
-                    and ticks_run % checkpoint_every == 0:
+                    and not any(chunk_bufs) \
+                    and ticks_run - last_saved >= checkpoint_every:
                 # nothing may be in flight at save time: drain the pipeline
-                # first (same rule as replay's drain-before-save)
-                while in_flight:
-                    _collect_tick(*in_flight.popleft())
+                # first (same rule as replay's drain-before-save). The
+                # trigger is due-since-last-save, not a modulus: with
+                # micro_chunk > 1 boundaries land only at multiples of M,
+                # and `ticks_run % checkpoint_every == 0` would silently
+                # degrade the cadence to lcm(M, checkpoint_every)
+                now = time.perf_counter()
+                ce0 = phase_s["collect"] + phase_s["emit"]
+                _drain_all()
                 _save_all(groups, checkpoint_dir)
+                phase_s["checkpoint"] += (time.perf_counter() - now) - (
+                    phase_s["collect"] + phase_s["emit"] - ce0)
                 checkpoints_saved += 1
                 last_saved = ticks_run
             elapsed = time.perf_counter() - t_start
@@ -631,8 +741,11 @@ def live_loop(
                     stop_event.wait(budget)  # a shutdown signal ends the sleep
                 else:
                     time.sleep(budget)
-        while in_flight:  # drain: every dispatched tick is collected + emitted
-            _collect_tick(*in_flight.popleft())
+        for c in range(n_classes):
+            if chunk_bufs[c]:
+                # early stop mid-chunk: score what was ingested
+                _flush_class(c)
+        _drain_all()  # every dispatched tick is collected + emitted
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
@@ -664,9 +777,13 @@ def live_loop(
     if ticks_run < n_ticks:
         extra["stopped_early"] = True
         extra["ticks_requested"] = n_ticks
+    if ticks_run > 0:
+        extra["phase_ms_per_tick"] = {
+            k: round(v / ticks_run * 1e3, 2) for k, v in phase_s.items()}
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
-            "pipeline_depth": pipeline_depth,
+            "pipeline_depth": pipeline_depth, "micro_chunk": micro_chunk,
+            "chunk_stagger": chunk_stagger,
             "learn": learn,
             **({"auto_registered": auto_registered,
                 "auto_rejected": auto_rejected_total} if auto_register else {}),

@@ -388,8 +388,19 @@ class StreamGroupRegistry:
         threshold: float = 0.5,
         mesh=None,
         debounce: int = 1,
+        stagger_learn: bool = False,
     ):
         self.cfg = cfg
+        # Stagger the learning-cadence phase across groups (group i learns
+        # on ticks where (it - i % learn_every) % learn_every == 0): with
+        # every group at phase 0 the whole fleet learns on the SAME ticks,
+        # so per-tick device compute spikes to the full-fleet learning cost
+        # and idles in between — at 100k streams the spike alone exceeds
+        # the 1 s cadence that the AVERAGE load fits comfortably.
+        # Per-group semantics are identical up to a <k-tick schedule shift;
+        # phases derive deterministically from the group index, so a
+        # resumed registry rebuilt with the same flags reproduces them.
+        self.stagger_learn = bool(stagger_learn) and cfg.learn_every > 1
         self.group_size = int(group_size)
         self.backend = backend
         self.seed = seed
@@ -454,7 +465,8 @@ class StreamGroupRegistry:
         # pad to the fixed group size so every group compiles to one program
         padded = ids + [f"__pad{i}" for i in range(self.group_size - len(ids))]
         grp = StreamGroup(
-            self.cfg, padded, seed=self.seed + len(self.groups),
+            self._group_cfg(len(self.groups)), padded,
+            seed=self.seed + len(self.groups),
             backend=self.backend, threshold=self.threshold, mesh=self.mesh,
             debounce=self.debounce,
         )
@@ -480,10 +492,27 @@ class StreamGroupRegistry:
             self._seal_all_pad()
         self._finalized = True
 
+    def _group_cfg(self, gi: int) -> ModelConfig:
+        """The config group `gi` is built with: the registry cfg, cadence
+        phase-shifted by gi when stagger_learn is on (at most learn_every
+        distinct compiled programs fleet-wide — the phase is a static
+        config field). With learn_burst=B the schedule's cycle is k*B
+        ticks and a useful stagger offsets whole B-tick bursts: phase
+        (gi mod k) * B puts exactly 1/k of the groups in their burst on
+        any post-maturity tick — the same leveling the spread schedule
+        gets from gi mod k."""
+        if not self.stagger_learn:
+            return self.cfg
+        import dataclasses
+
+        return dataclasses.replace(
+            self.cfg,
+            learn_phase=(gi % self.cfg.learn_every) * self.cfg.learn_burst)
+
     def _seal_all_pad(self) -> None:
         """Append one all-pad reserve group (claimable capacity)."""
         grp = StreamGroup(
-            self.cfg,
+            self._group_cfg(len(self.groups)),
             [f"{PAD_PREFIX}{i}" for i in range(self.group_size)],
             seed=self.seed + len(self.groups), backend=self.backend,
             threshold=self.threshold, mesh=self.mesh, debounce=self.debounce,

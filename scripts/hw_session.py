@@ -311,6 +311,211 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     ("r5_T8_98k", [sys.executable, "scripts/profile_step.py",
                    "--T", "8", "--gs", "98304", "131072", "--layout", "flat",
                    "--columns", "32"], 1800.0),
+    # THE round-5 flagship (verdict item 2): 100k streams LIVE LEARNING at
+    # 1 s on ONE chip. The >65k single-program wall is G-structural
+    # (r5_T8_98k: T=8 still compile-500s), so the route is many small
+    # groups — the shape live serving already prefers (SCALING.md: compute
+    # throughput PEAKS at G~1024; the 32k soak at 8x4096 held p50 67 ms
+    # with 15x headroom). 100x1024 at 32col/k=2: average device compute
+    # ~102400/136k = 0.75 s/tick, spread evenly by --stagger-learn so no
+    # single tick carries the whole fleet's learning spike; 16 threads
+    # overlap the ~65 ms/group dispatch RPCs.
+    ("r5_soak_100k", [sys.executable, "scripts/live_soak.py",
+                      "--streams", "102400", "--group-size", "1024",
+                      "--columns", "32", "--learn-every", "2",
+                      "--stagger-learn", "--pipeline-depth", "2",
+                      "--dispatch-threads", "16",
+                      "--startup-timeout", "1800",
+                      "--out", "reports/live_soak_100k.json"], 4200.0),
+    # 65,536 LEARNING live (r4 only demonstrated 65k frozen / 32k learning):
+    # the intermediate capability rung, and the control for the 100-group
+    # RPC-overhead question (16 groups here).
+    ("r5_soak_64k_learn", [sys.executable, "scripts/live_soak.py",
+                           "--streams", "65536", "--group-size", "4096",
+                           "--columns", "32", "--learn-every", "2",
+                           "--stagger-learn", "--pipeline-depth", "2",
+                           "--dispatch-threads", "8",
+                           "--startup-timeout", "1500",
+                           "--out", "reports/live_soak_64k_learn.json"], 3600.0),
+    # alternate 100k shape (25x4096): fewer, bigger dispatches — wins if
+    # the 100-group RPC wall dominates, loses if the per-G compute falloff
+    # (47k/s at G=16384 vs 74k at G=1024, k=1) dominates.
+    ("r5_soak_100k_g4096", [sys.executable, "scripts/live_soak.py",
+                            "--streams", "102400", "--group-size", "4096",
+                            "--columns", "32", "--learn-every", "2",
+                            "--stagger-learn", "--pipeline-depth", "2",
+                            "--dispatch-threads", "8",
+                            "--startup-timeout", "1800",
+                            "--out", "reports/live_soak_100k_g4096.json"],
+     4200.0),
+    # pinned full-rate trend rung (verdict item 4): novel vs repeated feed
+    # at the full preset, G=256/T=64 — explains r3 38,956 -> r4 32,904
+    ("r5_trend_rung", [sys.executable, "scripts/trend_rung.py"], 1500.0),
+    # roofline/MFU accounting (verdict item 3): XLA cost_analysis of the
+    # TPU-lowered step vs chip peaks vs the committed measured times
+    ("r5_roofline", [sys.executable, "scripts/roofline.py"], 1800.0),
+    # held-out external validation of the width ladder (verdict item 1):
+    # 7 variants x 3 seeds x 3 magnitudes, all 5 kinds, 120x1500 each.
+    # Incremental-merge into reports/heldout_eval.json — a window drop
+    # resumes where it left off.
+    ("r5_heldout_eval", [sys.executable, "scripts/heldout_eval.py"], 5400.0),
+    # 100k-soak forensics: the tick period pinned at ~1.4 s at BOTH
+    # 100x1024/102k and 16x4096/64k (and 2.19 s at 25x4096/102k) — the
+    # instrumented live_loop now reports phase_ms_per_tick
+    # (source/dispatch/collect/emit); this rerun names the binding phase.
+    ("r5_soak_64k_phase", [sys.executable, "scripts/live_soak.py",
+                           "--streams", "65536", "--group-size", "4096",
+                           "--columns", "32", "--learn-every", "2",
+                           "--stagger-learn", "--pipeline-depth", "2",
+                           "--dispatch-threads", "8",
+                           "--startup-timeout", "1500",
+                           "--out", "reports/live_soak_64k_phase.json"],
+     3600.0),
+    # the cadence ladder's hold candidate: k=4 halves the per-tick device
+    # compute vs k=2 (learning is ~9x an inference tick at 32col) — at
+    # 100x1024 the projection is ~0.8 s/tick. Quality cost measured by
+    # r5_eval_k4/r5_heldout_eval, never assumed.
+    ("r5_soak_100k_k4", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "102400", "--group-size", "1024",
+                         "--columns", "32", "--learn-every", "4",
+                         "--stagger-learn", "--pipeline-depth", "2",
+                         "--dispatch-threads", "16",
+                         "--startup-timeout", "1800",
+                         "--out", "reports/live_soak_100k_k4.json"], 4200.0),
+    # diurnal-family quality for the cadence ladder (same protocol as the
+    # committed model_size artifacts; heldout covers the other family)
+    ("r5_eval_k4", [sys.executable, "scripts/model_size_eval.py",
+                    "--variants", "eighth_32col_k3,eighth_32col_k4"]),
+    ("r5_eval_k4_allkinds", [sys.executable, "scripts/model_size_eval.py",
+                             "--variants", "eighth_32col_k3,eighth_32col_k4",
+                             "--all-kinds"]),
+    # fresh headline for the round (stores BENCH_LKG; the driver also runs
+    # bench.py itself at round end)
+    ("r5_bench", [sys.executable, "bench.py"], 1700.0),
+    # 100k cadence, round 3 of forensics: k=4 changed NOTHING (p50 1392 vs
+    # 1398 ms) — at 100x1024 the binder is ~200 blocking ~70 ms RPCs/tick
+    # 16-way overlapped (~0.9 s wall), not device compute. RPC waits
+    # release the GIL; 48 threads project the RPC wall to ~0.3 s. k=2
+    # first (the better-quality operating point).
+    ("r5_soak_100k_t48", [sys.executable, "scripts/live_soak.py",
+                          "--streams", "102400", "--group-size", "1024",
+                          "--columns", "32", "--learn-every", "2",
+                          "--stagger-learn", "--pipeline-depth", "2",
+                          "--dispatch-threads", "48",
+                          "--startup-timeout", "1800",
+                          "--out", "reports/live_soak_100k_t48.json"],
+     4200.0),
+    ("r5_soak_100k_k4_t48", [sys.executable, "scripts/live_soak.py",
+                             "--streams", "102400", "--group-size", "1024",
+                             "--columns", "32", "--learn-every", "4",
+                             "--stagger-learn", "--pipeline-depth", "2",
+                             "--dispatch-threads", "48",
+                             "--startup-timeout", "1800",
+                             "--out",
+                             "reports/live_soak_100k_k4_t48.json"], 4200.0),
+    # Micro-chunk ladder: the per-program invocation floor (~6-12 ms,
+    # thread- and cadence-invariant — r5 forensics) divides by M when M
+    # ticks ride one dispatch (live_loop micro_chunk; bit-exact vs
+    # per-tick by test). Price: <= (2M-1) ticks alert staleness at depth
+    # 2. k=2 kept where possible (better quality: heldout 0.4002 vs k4
+    # 0.3945, diurnal 0.762 vs 0.739).
+    ("r5_soak_100k_m2", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "102400", "--group-size", "1024",
+                         "--columns", "32", "--learn-every", "2",
+                         "--stagger-learn", "--micro-chunk", "2",
+                         "--pipeline-depth", "2", "--dispatch-threads", "16",
+                         "--startup-timeout", "1800",
+                         "--out", "reports/live_soak_100k_m2.json"], 4200.0),
+    ("r5_soak_100k_m4", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "102400", "--group-size", "1024",
+                         "--columns", "32", "--learn-every", "2",
+                         "--stagger-learn", "--micro-chunk", "4",
+                         "--pipeline-depth", "2", "--dispatch-threads", "16",
+                         "--startup-timeout", "1800",
+                         "--out", "reports/live_soak_100k_m4.json"], 4200.0),
+    ("r5_soak_100k_k4_m4", [sys.executable, "scripts/live_soak.py",
+                            "--streams", "102400", "--group-size", "1024",
+                            "--columns", "32", "--learn-every", "4",
+                            "--stagger-learn", "--micro-chunk", "4",
+                            "--pipeline-depth", "2",
+                            "--dispatch-threads", "16",
+                            "--startup-timeout", "1800",
+                            "--out",
+                            "reports/live_soak_100k_k4_m4.json"], 4200.0),
+    # THE steady-state capability soaks. Every soak above unknowingly ran
+    # the 300-tick FULL-RATE maturity window over 91% of its 330 ticks
+    # (serve's with_learn_every default) — which is why k/threads/m never
+    # moved the needle. --learn-full-until 0 measures the mature fleet
+    # (profile/bench semantics; production onboards gradually and never
+    # pays the whole window at once). k4+m4 projects ~0.65 s/tick; k2+m4
+    # ~1.0 s (marginal, better quality) — measure both.
+    ("r5_soak_100k_steady_k4m4", [sys.executable, "scripts/live_soak.py",
+                                  "--streams", "102400", "--group-size",
+                                  "1024", "--columns", "32",
+                                  "--learn-every", "4", "--learn-full-until",
+                                  "0", "--stagger-learn", "--micro-chunk",
+                                  "4", "--pipeline-depth", "2",
+                                  "--dispatch-threads", "16",
+                                  "--startup-timeout", "1800",
+                                  "--out",
+                                  "reports/live_soak_100k_steady_k4m4.json"],
+     4200.0),
+    ("r5_soak_100k_steady_k2m4", [sys.executable, "scripts/live_soak.py",
+                                  "--streams", "102400", "--group-size",
+                                  "1024", "--columns", "32",
+                                  "--learn-every", "2", "--learn-full-until",
+                                  "0", "--stagger-learn", "--micro-chunk",
+                                  "4", "--pipeline-depth", "2",
+                                  "--dispatch-threads", "16",
+                                  "--startup-timeout", "1800",
+                                  "--out",
+                                  "reports/live_soak_100k_steady_k2m4.json"],
+     4200.0),
+    # THE capability soak: chunk_stagger levels the boundary spike (the
+    # steady k4m4 run was sustainable at ~0.7 s/tick average but carried
+    # 2.8 s of chunk work on every 4th tick = 83 guaranteed misses). With
+    # rotated boundaries each tick carries ~25 groups' dispatch+collect —
+    # projection ~0.7 s/tick EVERY tick. Bit-exact vs plain serving by
+    # test (tests/unit/test_multigroup_serve.py).
+    ("r5_soak_100k_final", [sys.executable, "scripts/live_soak.py",
+                            "--streams", "102400", "--group-size", "1024",
+                            "--columns", "32", "--learn-every", "4",
+                            "--learn-full-until", "0", "--stagger-learn",
+                            "--micro-chunk", "4", "--chunk-stagger",
+                            "--pipeline-depth", "2",
+                            "--dispatch-threads", "16",
+                            "--startup-timeout", "1800",
+                            "--out", "reports/live_soak_100k_final.json"],
+     4200.0),
+    # quality-better operating point at the same per-tick budget: k=3
+    # (diurnal f1 0.7499 vs k4's 0.7389) with m=6 boundaries
+    ("r5_soak_100k_final_k3m6", [sys.executable, "scripts/live_soak.py",
+                                 "--streams", "102400", "--group-size",
+                                 "1024", "--columns", "32",
+                                 "--learn-every", "3", "--learn-full-until",
+                                 "0", "--stagger-learn", "--micro-chunk",
+                                 "6", "--chunk-stagger",
+                                 "--pipeline-depth", "2",
+                                 "--dispatch-threads", "16",
+                                 "--startup-timeout", "1800",
+                                 "--out",
+                                 "reports/live_soak_100k_k3m6.json"],
+     4200.0),
+    # lifecycle honesty: 900 ticks under the DEFAULT maturity window —
+    # the cold-start fleet pays ~300 full-rate ticks (misses expected),
+    # then the cadenced steady state must hold; production onboards
+    # gradually and never pays the whole window at once
+    ("r5_soak_100k_lifecycle", [sys.executable, "scripts/live_soak.py",
+                                "--streams", "102400", "--group-size",
+                                "1024", "--columns", "32",
+                                "--learn-every", "4", "--stagger-learn",
+                                "--micro-chunk", "4", "--chunk-stagger",
+                                "--ticks", "900", "--pipeline-depth", "2",
+                                "--dispatch-threads", "16",
+                                "--startup-timeout", "1800",
+                                "--out",
+                                "reports/live_soak_100k_lifecycle.json"],
+     3600.0),
 ]
 
 

@@ -65,6 +65,11 @@ VARIANTS = {
     # lever on the preset (quality_study streaming 0.789 -> 0.819); does
     # it stack with the best-f1 width (0.813) and its k=2 point (0.762)?
     "eighth_32col_lp600": lambda: sized_preset(32, learning_period=600),
+    # the 100k-live cadence ladder (r5 soaks): k=2 misses the 1 s cadence
+    # at 100x1024 (p50 1.4 s); k=3/k=4 are the candidate operating points,
+    # so their quality must be measured, not assumed
+    "eighth_32col_k3": lambda: sized_preset(32, learn_every=3),
+    "eighth_32col_k4": lambda: sized_preset(32, learn_every=4),
     "eighth_32col_k2_lp600": lambda: sized_preset(32, learn_every=2,
                                                   learning_period=600),
 }

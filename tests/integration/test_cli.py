@@ -123,3 +123,28 @@ def test_serve_tcp_scores_pushed_records(tmp_path):
     stats = json.loads(out.strip().splitlines()[-1])
     assert stats["ticks"] == 5 and stats["scored"] == 10
     assert "latency_p50_ms" in stats
+
+
+def test_nab_command_end_to_end(tmp_path):
+    """`python -m rtap_tpu nab` — the SURVEY §6 drop-in drill: run the
+    committed NAB-layout stand-in corpus (truncated + width-scaled for CPU
+    cost) end to end, scores for all three profiles, report JSON written.
+    Pointing --corpus at a real NAB checkout is the identical invocation."""
+    out = tmp_path / "nab.json"
+    p = run_cli("nab", "--rows", "600", "--columns", "64",
+                "--subset", "realAWSCloudwatch",
+                "--out", str(out), timeout=900)
+    assert p.returncode == 0, p.stderr[-2000:]
+    scores = json.loads(p.stdout.strip().splitlines()[-1])
+    assert set(scores) == {"standard", "reward_low_FP", "reward_low_FN"}
+    rep = json.loads(out.read_text())
+    assert rep["records"] == 600 * 6  # six realAWSCloudwatch files
+    assert rep["files"][0].startswith("realAWSCloudwatch/")
+    for prof in scores.values():
+        assert -200.0 <= prof["score"] <= 100.0
+
+
+def test_nab_command_missing_corpus_fails_loudly(tmp_path):
+    p = run_cli("nab", "--corpus", str(tmp_path / "nowhere"))
+    assert p.returncode == 2
+    assert "combined_windows.json" in p.stderr

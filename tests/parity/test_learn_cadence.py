@@ -261,3 +261,122 @@ def test_burst_without_cadence_fails_loudly():
 
     with _pytest.raises(ValueError, match="learn_burst"):
         dataclasses.replace(cadence_cfg(learn_every=1), learn_burst=8)
+
+
+def test_learn_phase_predicate_and_parity():
+    """learn_phase=p shifts the spread schedule by p ticks (the many-group
+    load-stagger — SCALING.md 100k serving shape); host and device agree
+    record for record, and the burst schedule shifts identically."""
+    import dataclasses
+
+    cfg = dataclasses.replace(cadence_cfg(learn_every=4, learn_full_until=8),
+                              learn_phase=2)
+    flags = [bool(cfg.learns_on(i)) for i in range(40)]
+    assert all(flags[:8])  # maturity window unaffected by phase
+    for i in range(8, 40):
+        assert flags[i] == (i % 4 == 2), i
+
+    bcfg = dataclasses.replace(cfg, learn_burst=3)
+    bflags = [bool(bcfg.learns_on(i)) for i in range(60)]
+    assert all(bflags[:8])
+    for i in range(8, 60):
+        assert bflags[i] == ((i - 8 - 2) % 12 < 3), i
+
+    cpu = HTMModel(cfg, seed=3, backend="cpu")
+    tpu = HTMModel(cfg, seed=3, backend="tpu")
+    vals = make_vals(40, 1)
+    for i in range(40):
+        r_cpu = cpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        r_tpu = tpu.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
+        assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
+
+
+def test_learn_phase_zero_is_unchanged_and_bounds_enforced():
+    import dataclasses
+
+    for k, fu in ((1, 0), (4, 20)):
+        cfg = cadence_cfg(learn_every=k, learn_full_until=fu)
+        cfgp = dataclasses.replace(cfg, learn_phase=0)
+        for i in range(60):
+            assert bool(cfgp.learns_on(i)) == bool(cfg.learns_on(i))
+    with pytest.raises(ValueError, match="learn_phase"):
+        dataclasses.replace(cadence_cfg(learn_every=4), learn_phase=4)
+    with pytest.raises(ValueError, match="learn_phase"):
+        dataclasses.replace(cadence_cfg(learn_every=1), learn_phase=1)
+    with pytest.raises(ValueError, match="learn_phase"):
+        dataclasses.replace(cadence_cfg(learn_every=4), learn_phase=-1)
+
+
+def test_registry_stagger_assigns_phases_and_shifts_learning():
+    """stagger_learn: group i gets learn_phase i%k; a staggered group's
+    device state is bit-identical to an unstaggered group run with the
+    same explicitly-phased config (the stagger is pure config plumbing)."""
+    import dataclasses
+
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    cfg = cadence_cfg(learn_every=2, learn_full_until=0)
+    reg = StreamGroupRegistry(cfg, group_size=2, backend="tpu",
+                              stagger_learn=True)
+    for i in range(6):
+        reg.add_stream(f"s{i}")
+    reg.finalize(reserve=2)  # one extra all-pad group: staggered too
+    phases = [g.cfg.learn_phase for g in reg.groups]
+    assert phases == [0, 1, 0, 1]
+
+    # behavioral check: the phase-1 group does NOT learn on tick 0
+    vals = make_vals(4, 2)
+    ref_cfg = dataclasses.replace(cfg, learn_phase=1)
+    from rtap_tpu.service.registry import StreamGroup
+
+    ref = StreamGroup(ref_cfg, ["s2", "s3"], seed=reg.groups[1].seed,
+                      backend="tpu")
+    got = reg.groups[1]
+    for i in range(4):
+        ref.tick(vals[i], 1_700_000_000 + i)
+        got.tick(vals[i], 1_700_000_000 + i)
+    import jax as _jax
+
+    a = _jax.device_get(ref.state)
+    b = _jax.device_get(got.state)
+    for k in ("perm", "presyn", "syn_perm", "tm_iter"):
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+def test_stagger_off_or_fullrate_is_inert():
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    cfg = cadence_cfg(learn_every=1, learn_full_until=0)
+    reg = StreamGroupRegistry(cfg, group_size=2, backend="tpu",
+                              stagger_learn=True)  # k=1: nothing to stagger
+    for i in range(4):
+        reg.add_stream(f"s{i}")
+    reg.finalize()
+    assert [g.cfg.learn_phase for g in reg.groups] == [0, 0]
+    assert not reg.stagger_learn
+
+
+def test_stagger_with_burst_levels_learning_load():
+    """stagger_learn x learn_burst: phases offset whole B-tick bursts
+    ((gi mod k) * B), so every post-maturity tick carries exactly 1/k of
+    the fleet's learning — the spike-leveling the flag exists for (a
+    [0, k) phase would leave most of the k*B cycle unstaggered)."""
+    import dataclasses
+
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    cfg = dataclasses.replace(
+        cadence_cfg(learn_every=4, learn_full_until=0), learn_burst=3)
+    reg = StreamGroupRegistry(cfg, group_size=1, backend="tpu",
+                              stagger_learn=True)
+    for i in range(8):
+        reg.add_stream(f"s{i}")
+    reg.finalize()
+    assert [g.cfg.learn_phase for g in reg.groups] == [0, 3, 6, 9, 0, 3, 6, 9]
+    # per-tick learning-group count is flat at n_groups/k
+    for it in range(48):
+        learning = sum(bool(g.cfg.learns_on(it)) for g in reg.groups)
+        assert learning == 2, (it, learning)
+    # and burst structure survives per group: 3 consecutive on, 9 off
+    flags = [bool(reg.groups[1].cfg.learns_on(i)) for i in range(24)]
+    assert flags[3:6] == [True] * 3 and sum(flags[:12]) == 3

@@ -1,0 +1,71 @@
+"""Held-out signal family (data/synthetic.py family="heldout") — the
+external-validation world for the model-width quality claims (r4 verdict
+"what's weak" #1). Pins: determinism, fault labeling parity with the tuned
+family, genuinely different statistics (heavy tails), and that the tuned-on
+"diurnal" family is bit-identical to before the family switch existed."""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_stream
+
+HELD = SyntheticStreamConfig(length=1500, cadence_s=1.0, n_anomalies=2,
+                             anomaly_magnitude=6.0, noise_phi=0.97,
+                             noise_scale=0.5, inject_after_frac=0.4,
+                             family="heldout")
+
+
+def _kurt(x: np.ndarray) -> float:
+    x = np.asarray(x, np.float64)
+    x = x - x.mean()
+    return float((x**4).mean() / (x**2).mean() ** 2 - 3)
+
+
+def test_heldout_deterministic_and_labeled():
+    a = generate_stream("node00001.cpu", HELD, seed=11)
+    b = generate_stream("node00001.cpu", HELD, seed=11)
+    np.testing.assert_array_equal(a.values, b.values)
+    assert np.isfinite(a.values).all()
+    assert len(a.windows) == 2 and len(a.events) == 2
+    assert all(e.kind in ("spike", "level_shift", "drift", "stuck", "dropout")
+               for e in a.events)
+    c = generate_stream("node00001.cpu", HELD, seed=23)
+    assert not np.array_equal(a.values, c.values)
+
+
+def test_heldout_heavier_tails_than_diurnal():
+    """The family must be a genuinely different world: per-tick deltas carry
+    Student-t/burst tails (excess kurtosis far above the tuned family's
+    near-Gaussian AR(1))."""
+    import dataclasses
+
+    held = generate_stream(
+        "node00001.cpu", dataclasses.replace(HELD, n_anomalies=0), seed=11)
+    diurnal = generate_stream(
+        "node00001.cpu",
+        dataclasses.replace(HELD, n_anomalies=0, family="diurnal"), seed=11)
+    assert _kurt(np.diff(held.values)) > 5 * max(
+        _kurt(np.diff(diurnal.values)), 1.0)
+
+
+def test_diurnal_family_bit_identical_golden():
+    """The default family's draw order is the regeneration contract for
+    every committed artifact: pin a golden slice."""
+    cfg = SyntheticStreamConfig(length=64, cadence_s=1.0, n_anomalies=0,
+                                noise_phi=0.9)
+    s = generate_stream("golden.cpu", cfg, seed=3)
+    # golden values recorded at the family-switch commit (identical draw
+    # order to the pre-switch generator)
+    assert s.values[:4].tolist() == pytest.approx(
+        [47.27411651611328, 48.14616012573242,
+         47.246849060058594, 45.97713851928711], abs=0.0)
+    assert float(s.values.astype(np.float64).sum()) == pytest.approx(
+        2959.4722633361816, abs=1e-9)
+
+
+def test_unknown_family_rejected():
+    import dataclasses
+
+    with pytest.raises(ValueError, match="family"):
+        generate_stream(
+            "x.cpu", dataclasses.replace(HELD, family="nope"), seed=1)

@@ -343,3 +343,119 @@ def test_freeze_serves_without_mutating_learned_state(tmp_path):
         # stops predicting would score every tick anomalous
         assert any(not np.array_equal(b[k], np.asarray(g.state[k]))
                    for k in DYNAMIC_KEYS)
+
+
+def test_micro_chunk_bitexact_vs_per_tick(tmp_path):
+    """micro_chunk=M batches M ticks into one dispatch (the per-program-
+    floor amortizer, SCALING.md round 5): alert lines, throughput, and
+    final model state must be bit-identical to per-tick dispatch — the
+    chunked scan IS the same program the per-tick path runs, including a
+    non-divisible tail (N_TICKS=12, M=5 -> chunks 5+5+2) and composed
+    with depth 2 + threads."""
+    import jax
+
+    out = {}
+    for m in (1, 5):
+        reg = _registry()
+        path = str(tmp_path / f"alerts_m{m}.jsonl")
+        stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.0,
+                          alert_path=path, pipeline_depth=2,
+                          dispatch_threads=2, micro_chunk=m)
+        assert stats["micro_chunk"] == m
+        assert stats["scored"] == G_TOTAL * N_TICKS
+        out[m] = (open(path).read(),
+                  [jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
+                                          g.state) for g in reg.groups])
+    assert out[1][0] == out[5][0]  # identical alert stream, same order
+    for s1, s2 in zip(out[1][1], out[5][1]):
+        for a, b in zip(jax.tree_util.tree_leaves(s1),
+                        jax.tree_util.tree_leaves(s2)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_micro_chunk_validation_and_stagger_stats():
+    import pytest
+
+    reg = _registry()
+    with pytest.raises(ValueError, match="micro_chunk"):
+        live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, micro_chunk=0)
+
+
+def test_micro_chunk_early_stop_flushes_buffer(tmp_path):
+    """A stop_event landing mid-chunk must still score the buffered ticks
+    (nothing ingested is silently dropped)."""
+    import threading
+
+    reg = _registry()
+    stop = threading.Event()
+    calls = [0]
+
+    def feed(k):
+        calls[0] += 1
+        if calls[0] == 8:  # mid-chunk for M=5 (ticks 6..8 buffered)
+            stop.set()
+        return _feed(k)
+
+    path = str(tmp_path / "alerts_stop.jsonl")
+    stats = live_loop(feed, reg, n_ticks=N_TICKS, cadence_s=0.0,
+                      alert_path=path, micro_chunk=5, stop_event=stop)
+    # stop is checked at the TOP of the next tick: 8 ticks were polled,
+    # all 8 must be scored (5 in the first chunk, 3 flushed)
+    assert stats["ticks"] == 8
+    assert stats["scored"] == G_TOTAL * 8
+
+
+def test_chunk_stagger_content_equal_and_state_bitexact(tmp_path):
+    """chunk_stagger rotates WHEN each group's chunk dispatches, never WHAT
+    any group computes: final model state must be bit-identical to plain
+    per-tick serving, and the alert stream must contain exactly the same
+    lines (order differs across groups by design — per stream it is still
+    chronological)."""
+    import jax
+
+    out = {}
+    for mode in ("plain", "stagger"):
+        reg = _registry()
+        path = str(tmp_path / f"alerts_{mode}.jsonl")
+        kw = dict(micro_chunk=3, chunk_stagger=True) if mode == "stagger" \
+            else {}
+        stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.0,
+                          alert_path=path, pipeline_depth=2,
+                          dispatch_threads=2, **kw)
+        assert stats["scored"] == G_TOTAL * N_TICKS
+        out[mode] = (sorted(open(path).read().splitlines()),
+                     [jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
+                                             g.state) for g in reg.groups])
+    assert out["plain"][0] == out["stagger"][0]
+    for s1, s2 in zip(out["plain"][1], out["stagger"][1]):
+        for a, b in zip(jax.tree_util.tree_leaves(s1),
+                        jax.tree_util.tree_leaves(s2)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_chunk_stagger_validation():
+    import pytest
+
+    reg = _registry()
+    with pytest.raises(ValueError, match="micro_chunk >= 2"):
+        live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, chunk_stagger=True)
+    with pytest.raises(ValueError, match="incompatible"):
+        live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, micro_chunk=2,
+                  chunk_stagger=True, auto_register=True)
+    with pytest.raises(ValueError, match="incompatible"):
+        live_loop(_feed, reg, n_ticks=2, cadence_s=0.0, micro_chunk=2,
+                  chunk_stagger=True, checkpoint_every=2,
+                  checkpoint_dir="/tmp/nope")
+
+
+def test_micro_chunk_checkpoint_cadence_not_degraded(tmp_path):
+    """checkpoint_every that is no multiple of micro_chunk must still save
+    at every first boundary PAST due (due-since-last-save trigger), not at
+    lcm(M, checkpoint_every): M=4, every=3 over 12 ticks -> saves at
+    boundaries 4, 8, 12 (three), where the old modulus rule saved only at
+    tick 12."""
+    reg = _registry()
+    ck = str(tmp_path / "ck")
+    stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.0,
+                      checkpoint_dir=ck, checkpoint_every=3, micro_chunk=4)
+    assert stats["checkpoints_saved"] == 3

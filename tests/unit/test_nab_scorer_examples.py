@@ -1,0 +1,206 @@
+"""NAB scorer worked examples — exact hand-computed values (r4 verdict #5).
+
+The scorer previously carried only endpoint/property tests (null=0,
+perfect=100); silent drift in the sigmoid weighting, FP decay, probation
+trim, or threshold sweep would have transferred into any corpus number.
+These tests pin the published scoring definition with values derived
+INDEPENDENTLY in the test body (explicit exp() formulas, not calls back
+into the scorer), covering the NAB paper's canonical cases: TP at window
+start (+0.9866 before weighting), late TP, second-detection-ignored,
+FP-before-any-window (flat -1), FP decay after a window (-0.9866 at one
+window-width), FN cost per profile, probation trim, multi-window files,
+and the exhaustive threshold sweep's equivalence with direct re-scoring.
+
+Scoring definition per SURVEY.md C23/§3.4 (the NAB paper "Evaluating
+Real-Time Anomaly Detection Algorithms" + nab/sweeper.py semantics).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from rtap_tpu.nab.scorer import (
+    PROFILES,
+    optimize_threshold,
+    probation_rows,
+    scaled_sigmoid,
+    score_corpus,
+    score_file,
+)
+
+# independent derivation of NAB's scaled sigmoid: 2/(1+e^(5x)) - 1
+def _sig(x: float) -> float:
+    return 2.0 / (1.0 + math.exp(5.0 * x)) - 1.0
+
+
+STD = PROFILES["standard"]
+LOW_FP = PROFILES["reward_low_FP"]
+LOW_FN = PROFILES["reward_low_FN"]
+
+T = np.arange(100, dtype=np.int64)  # 100 rows at 1 s cadence
+WIN = [(40, 49)]  # rows 40..49 inclusive, width (r - l) = 9
+
+
+def det(*rows: int) -> np.ndarray:
+    d = np.zeros(100, bool)
+    for r in rows:
+        d[r] = True
+    return d
+
+
+class TestWorkedExamples:
+    def test_probation_is_15_percent_capped(self):
+        assert probation_rows(100) == 15
+        assert probation_rows(5000) == 750
+        assert probation_rows(20_000) == 750  # cap at 5000 rows
+
+    def test_tp_at_window_start(self):
+        # rel = (40-49)/9 = -1 -> sigma(-1) = 2/(1+e^-5)-1 = 0.98661...
+        expect = _sig(-5.0 / 5.0 * 5.0 / 5.0 * 5.0)  # keep explicit below
+        expect = _sig(-1.0)
+        assert expect == pytest.approx(0.9866142981514305, abs=1e-12)
+        assert score_file(det(40), T, WIN, STD) == pytest.approx(expect, abs=1e-12)
+
+    def test_tp_at_window_end_scores_zero(self):
+        # rel = 0 -> sigma(0) = 0
+        assert score_file(det(49), T, WIN, STD) == pytest.approx(0.0, abs=1e-12)
+
+    def test_late_tp_partial_credit(self):
+        # row 47: rel = (47-49)/9 = -2/9 -> sigma(-2/9)
+        expect = _sig(-2.0 / 9.0)
+        assert expect == pytest.approx(0.5046723977218568, abs=1e-12)
+        assert score_file(det(47), T, WIN, STD) == pytest.approx(expect, abs=1e-12)
+
+    def test_second_detection_in_window_ignored(self):
+        # rows 41 and 45: only the FIRST (41) is credited
+        expect = _sig((41 - 49) / 9.0)
+        assert score_file(det(41, 45), T, WIN, STD) == pytest.approx(
+            expect, abs=1e-12
+        )
+
+    def test_miss_costs_fn_weight_per_profile(self):
+        assert score_file(det(), T, WIN, STD) == pytest.approx(-1.0)
+        assert score_file(det(), T, WIN, LOW_FN) == pytest.approx(-2.0)
+
+    def test_fp_before_any_window_is_flat_minus_one(self):
+        # row 20 precedes the window: flat -1 * fp_weight, plus the FN
+        assert score_file(det(20), T, WIN, STD) == pytest.approx(-0.11 - 1.0)
+        assert score_file(det(20), T, WIN, LOW_FP) == pytest.approx(-0.22 - 1.0)
+
+    def test_fp_after_window_sigmoid_decay(self):
+        # row 58: rel = (58-49)/9 = +1 -> sigma(1) = -0.98661...
+        expect = 0.11 * _sig(1.0) - 1.0  # decayed FP + missed window
+        assert score_file(det(58), T, WIN, STD) == pytest.approx(expect, abs=1e-12)
+
+    def test_fp_far_after_window_saturates_at_minus_one(self):
+        # row 77: rel = (77-49)/9 = 3.11 > 3 -> flat -1
+        assert score_file(det(77), T, WIN, STD) == pytest.approx(0.11 * -1.0 - 1.0)
+
+    def test_probation_detection_ignored(self):
+        # row 14 is inside the 15-row probation: contributes nothing
+        assert score_file(det(14), T, WIN, STD) == pytest.approx(-1.0)  # FN only
+
+    def test_multi_window_file(self):
+        wins = [(20, 29), (60, 69)]
+        # detect only the second window at its start; first window missed
+        expect = _sig(-1.0) - 1.0
+        assert score_file(det(60), T, wins, STD) == pytest.approx(expect, abs=1e-12)
+        # detect both at start
+        assert score_file(det(20, 60), T, wins, STD) == pytest.approx(
+            2 * _sig(-1.0), abs=1e-12
+        )
+
+    def test_tp_and_fp_combined(self):
+        # TP at 40 plus an FP at 18 (post-probation, before any window)
+        expect = _sig(-1.0) - 0.11
+        assert score_file(det(40, 18), T, WIN, STD) == pytest.approx(
+            expect, abs=1e-12
+        )
+
+    def test_scaled_sigmoid_reference_points(self):
+        assert scaled_sigmoid(-1.0) == pytest.approx(_sig(-1.0), abs=1e-15)
+        assert scaled_sigmoid(0.0) == 0.0
+        assert scaled_sigmoid(1.0) == pytest.approx(_sig(1.0), abs=1e-15)
+        assert scaled_sigmoid(3.01) == -1.0  # hard floor beyond 3 widths
+
+
+class TestNormalizedCorpus:
+    def _scores(self, rows, n=100):
+        s = np.zeros(n)
+        for r in rows:
+            s[r] = 1.0
+        return s
+
+    def test_perfect_and_null_endpoints(self):
+        per_file = [
+            (self._scores([40]), T, WIN),
+            (self._scores([20, 60]), T, [(20, 29), (60, 69)]),
+        ]
+        assert score_corpus(per_file, 0.5, STD) == pytest.approx(100.0)
+        assert score_corpus(per_file, 1.1, STD) == pytest.approx(0.0)
+
+    def test_hand_computed_mid_corpus_score(self):
+        # file 1: TP at window end (raw 0); file 2: miss (-1) + flat FP
+        # (-0.11) at row 18 — post-probation, before the window
+        per_file = [
+            (self._scores([49]), T, WIN),
+            (self._scores([18]), T, [(60, 69)]),
+        ]
+        raw = 0.0 + (-1.0 - 0.11)
+        perfect = 2 * _sig(-1.0)
+        null = -2.0
+        expect = 100.0 * (raw - null) / (perfect - null)
+        assert score_corpus(per_file, 0.5, STD) == pytest.approx(expect, abs=1e-9)
+
+
+class TestExhaustiveSweep:
+    def test_sweep_finds_isolated_optimum_quantiles_would_miss(self):
+        # one window; the ONLY good threshold is a single high score value
+        # carried by the in-window row, while 5000 low-score FP rows pull
+        # every low threshold deep negative. A ~200-quantile sweep of this
+        # distribution can skip the isolated optimum; exhaustive cannot.
+        n = 5000
+        ts = np.arange(n, dtype=np.int64)
+        scores = np.random.default_rng(0).uniform(0.0, 0.90, n)
+        wins = [(4000, 4099)]
+        scores[4000] = 0.977731  # unique, not on any quantile grid
+        hi = scores.max()
+        per_file = [(scores, ts, wins)]
+        t, s = optimize_threshold(per_file, STD)
+        assert t == pytest.approx(0.977731)
+        assert s == pytest.approx(100.0)
+        # direct confirmation at the found threshold
+        assert score_corpus(per_file, t, STD) == pytest.approx(s, abs=1e-9)
+        assert score_corpus(per_file, hi + 1e-6, STD) == pytest.approx(0.0)
+
+    @pytest.mark.parametrize("profile", ["standard", "reward_low_FP",
+                                         "reward_low_FN"])
+    def test_incremental_sweep_equals_direct_rescoring(self, profile):
+        """Property: for randomized corpora, the O(n log n) incremental
+        sweep returns exactly max over distinct thresholds of the direct
+        scorer, for every profile."""
+        rng = np.random.default_rng(7)
+        prof = PROFILES[profile]
+        for trial in range(8):
+            files = []
+            for _ in range(rng.integers(1, 4)):
+                n = int(rng.integers(60, 220))
+                ts = np.arange(n, dtype=np.int64)
+                scores = np.round(rng.uniform(0, 1, n), 2)  # force ties
+                wins = []
+                lo = 20
+                while lo + 12 < n and rng.random() < 0.7:
+                    hi = lo + int(rng.integers(3, 10))
+                    wins.append((lo, hi))
+                    lo = hi + int(rng.integers(8, 25))
+                files.append((scores, ts, wins))
+            t_fast, s_fast = optimize_threshold(files, prof)
+            cands = np.unique(np.concatenate([f[0] for f in files] + [[1.1]]))
+            direct = [(score_corpus(files, float(c), prof), float(c))
+                      for c in cands]
+            s_best, _ = max(direct)
+            assert s_fast == pytest.approx(s_best, abs=1e-9), (trial, profile)
+            assert score_corpus(files, t_fast, prof) == pytest.approx(
+                s_fast, abs=1e-9
+            ), (trial, profile)
