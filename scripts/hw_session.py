@@ -501,6 +501,21 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                                  "--out",
                                  "reports/live_soak_100k_k3m6.json"],
      4200.0),
+    # f32 permanence domain at the headline width (roofline follow-up):
+    # the u16 storage the presets default to charges decode/encode
+    # conversion passes over the largest pools EVERY tick; f32 skips them
+    # at ~1.4x the state (still ~10 GB at 100k streams — fits). If it
+    # wins, it is a free throughput bump at reference-faithful semantics.
+    ("r5_f32_32col", [sys.executable, "scripts/profile_step.py",
+                      "--T", "32", "--gs", "1024", "--layout", "flat",
+                      "--columns", "32", "--perm-bits", "0"]),
+    ("r5_f32_32col_k4", [sys.executable, "scripts/profile_step.py",
+                         "--T", "32", "--gs", "1024", "--layout", "flat",
+                         "--columns", "32", "--perm-bits", "0",
+                         "--learn-every", "4"]),
+    ("r5_f32_preset", [sys.executable, "scripts/profile_step.py",
+                       "--T", "32", "--gs", "1024", "--layout", "flat",
+                       "--perm-bits", "0"]),
     # lifecycle honesty: 900 ticks under the DEFAULT maturity window —
     # the cold-start fleet pays ~300 full-rate ticks (misses expected),
     # then the cadenced steady state must hold; production onboards
