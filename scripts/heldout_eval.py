@@ -43,6 +43,7 @@ VARIANTS = {
     "eighth_32col": (32, 1),
     "eighth_32col_k2": (32, 2),  # the throughput-headline config
     "eighth_32col_k4": (32, 4),  # the 100k-live cadence candidate
+    "eighth_32col_k3": (32, 3),  # the better-quality 100k operating point
 }
 
 
