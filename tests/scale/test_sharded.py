@@ -172,3 +172,61 @@ def test_dynamic_claim_on_meshed_group():
     r_s, ll_s, _ = sharded.run_chunk(vals2, ts2)
     np.testing.assert_array_equal(r_p, r_s)
     np.testing.assert_array_equal(ll_p, ll_s)
+
+
+def test_live_serving_stack_over_mesh_bitexact():
+    """The full round-5 serving stack (stagger_learn + micro_chunk +
+    chunk_stagger + threaded dispatch, live_loop) over a MESHED registry
+    must produce bit-identical output to the same stack unmeshed — the
+    100k-per-chip serving shape composes with the v5e-8 scale-out axis
+    unchanged (SURVEY.md §2.3: shard, then serve exactly the same way)."""
+    import dataclasses
+    import tempfile
+
+    from rtap_tpu.config import LikelihoodConfig
+    from rtap_tpu.service.loop import live_loop
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    # a 15-tick fresh model cannot alert discriminatively (the TM knows
+    # nothing yet); a floor threshold makes every emitted log-likelihood
+    # cross it, so the alert file carries REAL per-stream values through
+    # the full emission path — the comparison is content-bearing, not two
+    # empty files
+    cfg = dataclasses.replace(
+        cluster_preset(), learn_every=2,
+        likelihood=LikelihoodConfig(mode="streaming", learning_period=4,
+                                    estimation_samples=4,
+                                    averaging_window=3))
+    n, gsize, ticks = 12, 8, 15
+
+    def _feed(k):
+        rng = np.random.Generator(np.random.Philox(key=(31, k)))
+        v = (40 + 6 * rng.random(n)).astype(np.float32)
+        if k >= 9:
+            v[::3] += 70.0
+        return v, 1_700_000_000 + k
+
+    out = {}
+    for mode in ("plain", "mesh"):
+        mesh = make_stream_mesh(8) if mode == "mesh" else None
+        reg = StreamGroupRegistry(cfg, group_size=gsize, backend="tpu",
+                                  mesh=mesh, stagger_learn=True,
+                                  threshold=0.01)
+        for i in range(n):
+            reg.add_stream(f"s{i}")
+        reg.finalize()
+        with tempfile.NamedTemporaryFile("r", suffix=".jsonl") as f:
+            stats = live_loop(_feed, reg, n_ticks=ticks, cadence_s=0.0,
+                              alert_path=f.name, pipeline_depth=2,
+                              dispatch_threads=2, micro_chunk=3,
+                              chunk_stagger=True)
+            lines = sorted(f.read().splitlines())
+        assert stats["scored"] == n * ticks
+        assert stats["alerts"] > 0, "emission comparison must be non-vacuous"
+        final = [jax.device_get(g.state) for g in reg.groups]
+        out[mode] = (lines, final)
+    assert out["plain"][0] == out["mesh"][0]
+    for s1, s2 in zip(out["plain"][1], out["mesh"][1]):
+        for key in s1:
+            np.testing.assert_array_equal(
+                np.asarray(s1[key]), np.asarray(s2[key]), err_msg=key)
