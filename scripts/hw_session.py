@@ -533,6 +533,19 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                    "--columns", "256"]),
     ("r5_nab512", [sys.executable, "scripts/nab_standin_report.py",
                    "--columns", "512"]),
+    # endurance at the flagship point: 30 MINUTES of 102,400 live
+    # learning streams at the k3/m6 steady state — leaks, drift, or
+    # latency creep would surface here, not in a 5.5-minute soak
+    ("r5_soak_100k_30min", [sys.executable, "scripts/live_soak.py",
+                            "--streams", "102400", "--group-size", "1024",
+                            "--columns", "32", "--learn-every", "3",
+                            "--learn-full-until", "0", "--stagger-learn",
+                            "--micro-chunk", "6", "--chunk-stagger",
+                            "--ticks", "1800", "--pipeline-depth", "2",
+                            "--dispatch-threads", "16",
+                            "--startup-timeout", "1800",
+                            "--out",
+                            "reports/live_soak_100k_30min.json"], 4500.0),
     # lifecycle honesty: 900 ticks under the DEFAULT maturity window —
     # the cold-start fleet pays ~300 full-rate ticks (misses expected),
     # then the cadenced steady state must hold; production onboards

@@ -209,7 +209,8 @@ class TestLiveLoopDynamic:
 
     @staticmethod
     def _run_with_feeder(reg, records_fn, n_ticks, known_ids,
-                         checkpoint_dir=None, auto_release_after=0):
+                         checkpoint_dir=None, auto_release_after=0,
+                         micro_chunk=1):
         """live_loop over a REAL TcpJsonlSource (the object is the source,
         as serve passes it — auto-register needs its drain_unknown/set_ids
         surface) with a producer thread pushing records_fn(k) each tick."""
@@ -237,7 +238,8 @@ class TestLiveLoopDynamic:
             stats = live_loop(src, reg, n_ticks=n_ticks, cadence_s=0.1,
                               auto_register=True,
                               checkpoint_dir=checkpoint_dir,
-                              auto_release_after=auto_release_after)
+                              auto_release_after=auto_release_after,
+                              micro_chunk=micro_chunk)
         finally:
             stop.set()
             t.join(timeout=5)
@@ -258,6 +260,24 @@ class TestLiveLoopDynamic:
         reg.lookup("newcomer")  # registered and routable
         # it scored every tick after its registration tick
         assert stats["scored"] > 2 * 8
+
+    def test_auto_register_composes_with_micro_chunk(self):
+        """Plain micro_chunk + auto_register: claims land only at chunk
+        boundaries (the drain-first rule generalized to the buffered
+        path); a newcomer appearing mid-chunk registers at the next
+        boundary and scores from there on."""
+        reg = _registry(n=2, group_size=2, reserve=2)
+        stats = self._run_with_feeder(
+            reg,
+            lambda k: [{"id": "s0", "value": 30.0, "ts": k},
+                       {"id": "s1", "value": 31.0, "ts": k},
+                       {"id": "newcomer", "value": 32.0, "ts": k}],
+            n_ticks=12, known_ids=["s0", "s1"], micro_chunk=4)
+        assert stats["micro_chunk"] == 4
+        assert stats["auto_registered"] == 1
+        reg.lookup("newcomer")
+        # registered at a boundary tick; scored for >= one full chunk
+        assert stats["scored"] >= 2 * 12 + 4
 
     def test_auto_register_capacity_rejection(self):
         reg = _registry(n=2, group_size=2)  # zero free slots
