@@ -546,6 +546,19 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                             "--startup-timeout", "1800",
                             "--out",
                             "reports/live_soak_100k_30min.json"], 4500.0),
+    # the quality-tier live point: 128col measured the BEST held-out f1
+    # (0.4447 vs preset 0.4033); this soak backs docs/DEPLOYMENT.md's
+    # 128col row with a live capability artifact at 16k streams
+    ("r5_soak_16k_128col", [sys.executable, "scripts/live_soak.py",
+                            "--streams", "16384", "--group-size", "1024",
+                            "--columns", "128", "--learn-every", "2",
+                            "--learn-full-until", "0", "--stagger-learn",
+                            "--micro-chunk", "4", "--chunk-stagger",
+                            "--pipeline-depth", "2",
+                            "--dispatch-threads", "16",
+                            "--startup-timeout", "1500",
+                            "--out",
+                            "reports/live_soak_16k_128col.json"], 3000.0),
     # lifecycle honesty: 900 ticks under the DEFAULT maturity window —
     # the cold-start fleet pays ~300 full-rate ticks (misses expected),
     # then the cadenced steady state must hold; production onboards
