@@ -337,9 +337,9 @@ def main(argv: list[str] | None = None) -> int:
                         "across groups (group i flushes at ticks == i mod "
                         "M) so each tick dispatches ~1/M of the fleet "
                         "instead of spiking the whole fleet's chunk work "
-                        "onto every M-th tick. Incompatible with "
-                        "--auto-register/--auto-release-after/"
-                        "--checkpoint-every")
+                        "onto every M-th tick. Elastic membership and "
+                        "periodic checkpoints force a one-tick boundary "
+                        "realignment when they fire")
     p.add_argument("--stagger-learn", action="store_true",
                    help="stagger the learning-cadence phase across groups "
                         "(group i learns on ticks == i mod k): spreads the "

@@ -304,8 +304,12 @@ def live_loop(
     usual (pipeline_depth-1) chunks of collect lag — total staleness
     <= (pipeline_depth*M - 1) ticks. Deadlines stay per-tick: boundary
     ticks carry the whole chunk's dispatch+collect inside one cadence
-    budget. Membership changes, routing rebuilds, and checkpoints happen
-    only at chunk boundaries (nothing buffered, nothing in flight).
+    budget. Membership changes, routing rebuilds, and periodic
+    checkpoints FORCE a chunk boundary (partial buffers flush, the
+    pipeline drains, staggered boundaries re-ramp): claims/releases and
+    saves compose with any chunking at the cost of one spiky tick per
+    batch — right for churn at tens-of-seconds cadence, wrong for
+    per-tick churn (drop micro_chunk there).
 
     Accepts a single :class:`StreamGroup` or a finalized
     :class:`StreamGroupRegistry`. Measured chip throughput PEAKS at small
@@ -335,19 +339,8 @@ def live_loop(
         raise ValueError(f"pipeline_depth must be >= 1; got {pipeline_depth}")
     if micro_chunk < 1:
         raise ValueError(f"micro_chunk must be >= 1; got {micro_chunk}")
-    if chunk_stagger:
-        if micro_chunk < 2:
-            raise ValueError("chunk_stagger needs micro_chunk >= 2")
-        if auto_register or auto_release_after or checkpoint_every:
-            # rotating per-class boundaries never reach a global
-            # nothing-buffered instant mid-run, so membership changes and
-            # periodic saves have no safe point; the final-save-on-exit
-            # path (checkpoint_dir with checkpoint_every=0) still works
-            raise ValueError(
-                "chunk_stagger is incompatible with auto_register/"
-                "auto_release_after/checkpoint_every (no global chunk "
-                "boundary mid-run); use plain micro_chunk for elastic or "
-                "periodically-checkpointed serving")
+    if chunk_stagger and micro_chunk < 2:
+        raise ValueError("chunk_stagger needs micro_chunk >= 2")
     if dispatch_threads < 1:
         raise ValueError(f"dispatch_threads must be >= 1; got {dispatch_threads}")
     if isinstance(group, StreamGroupRegistry):
@@ -583,6 +576,29 @@ def live_loop(
             while in_flights[c]:
                 _collect_tick(*in_flights[c].popleft())
 
+    def _align_boundaries():
+        """Force a global nothing-buffered, nothing-in-flight instant.
+
+        Rotating per-class boundaries never reach one naturally, but
+        membership changes and periodic checkpoints need it (claims
+        resize the source vector and reroute emission; saves must match
+        the last collected tick). Flush every class's partial buffer,
+        drain, and reset the ramp so boundaries re-stagger. Under
+        chunk_stagger the partial sizes 1..M are the programs the ramp-in
+        already compiled (warm); plain micro_chunk callers reach here
+        only with empty buffers (membership defers to a natural boundary
+        — a forced partial flush would cold-compile a never-seen chunk
+        size mid-tick). Cost: one spiky tick per membership/checkpoint
+        batch — fine for churn at tens-of-seconds cadence, wrong for
+        per-tick churn."""
+        for c in range(n_classes):
+            if chunk_bufs[c]:
+                _flush_class(c)
+        _drain_all()
+        if chunk_stagger:
+            for c in range(n_classes):
+                first_flush_done[c] = False
+
     def _flush_class(c):
         vrows = [b[0] for b in chunk_bufs[c]]
         tsrows = [b[1] for b in chunk_bufs[c]]
@@ -605,17 +621,19 @@ def live_loop(
                 break
             t_start = time.perf_counter()
             t_phase = t_start
-            # membership booking excludes collect/emit seconds its drains
-            # accrue (those book into their own phases; double-counting
-            # would mis-name the binding phase — the instrumentation's job)
-            ce_tick0 = phase_s["collect"] + phase_s["emit"]
+            # membership booking excludes collect/emit/dispatch seconds
+            # its drains and forced flushes accrue (those book into their
+            # own phases; double-counting would mis-name the binding
+            # phase — the instrumentation's job)
+            ce_tick0 = (phase_s["collect"] + phase_s["emit"]
+                        + phase_s["dispatch"])
             # lazy model creation (serve --auto-register, SURVEY.md C19):
             # unknown ids the TCP listener saw claim free pad slots. The
             # pipeline drains first — membership may only change with
             # nothing in flight (a claimed slot's reset must not race a
             # dispatched-but-uncollected tick's emission routing).
             if auto_register and reg is not None \
-                    and not any(chunk_bufs) \
+                    and (not any(chunk_bufs) or chunk_stagger) \
                     and hasattr(source, "drain_unknown"):
                 # filter ids that registered meanwhile (records arriving
                 # between a drain and set_ids re-enter the unknown set) and
@@ -637,10 +655,12 @@ def live_loop(
                                 auto_rejected.add(sid)
                             continue
                         if not claimed:
-                            # membership may only change with nothing in
-                            # flight (a claimed slot's reset must not race
-                            # an uncollected tick's emission routing)
-                            _drain_all()
+                            # membership may only change with nothing
+                            # buffered or in flight (a claimed slot's
+                            # reset must not race an uncollected tick's
+                            # emission routing, and buffered rows carry
+                            # the OLD vector length)
+                            _align_boundaries()
                             claimed = True
                         reg.add_stream(sid)
                         auto_registered += 1
@@ -653,8 +673,8 @@ def live_loop(
             # re-registers as a NEW model (correct lazy semantics: the old
             # temporal context is stale by then anyway). Processed at the
             # top of the tick, like claims, under the same drain rule.
-            if release_pending and not any(chunk_bufs):
-                _drain_all()
+            if release_pending and (not any(chunk_bufs) or chunk_stagger):
+                _align_boundaries()
                 for sid in release_pending:
                     if sid in reg:
                         reg.remove_stream(sid)
@@ -668,14 +688,20 @@ def live_loop(
                 if hasattr(source, "set_ids"):
                     source.set_ids(reg.dispatch_ids())
             if reg is not None and reg.version != routing_version \
-                    and not any(chunk_bufs):
-                # routing changes only at chunk boundaries: buffered rows
-                # were polled under the old routing and must dispatch with it
+                    and (not any(chunk_bufs) or chunk_stagger):
+                # a version bump outside the blocks above (external claim/
+                # release between ticks) still needs the aligned instant:
+                # buffered rows were polled under the old routing. Plain
+                # micro_chunk waits for a natural boundary (a forced
+                # partial flush would cold-compile a never-seen chunk size
+                # mid-tick); stagger's ramp-in already compiled 1..M
+                _align_boundaries()
                 routing, n_expected = _build_routing()
                 routing_version = reg.version
             now = time.perf_counter()
             phase_s["membership"] += (now - t_phase) - (
-                phase_s["collect"] + phase_s["emit"] - ce_tick0)
+                phase_s["collect"] + phase_s["emit"] + phase_s["dispatch"]
+                - ce_tick0)
             values, ts = source(k)
             phase_s["source"] += time.perf_counter() - now
             values = np.asarray(values, np.float32)
@@ -715,7 +741,7 @@ def live_loop(
                     _flush_class(c)
             ticks_run = k + 1
             if learn and checkpoint_every and checkpoint_dir \
-                    and not any(chunk_bufs) \
+                    and (not any(chunk_bufs) or chunk_stagger) \
                     and ticks_run - last_saved >= checkpoint_every:
                 # nothing may be in flight at save time: drain the pipeline
                 # first (same rule as replay's drain-before-save). The
@@ -724,11 +750,13 @@ def live_loop(
                 # and `ticks_run % checkpoint_every == 0` would silently
                 # degrade the cadence to lcm(M, checkpoint_every)
                 now = time.perf_counter()
-                ce0 = phase_s["collect"] + phase_s["emit"]
-                _drain_all()
+                ce0 = (phase_s["collect"] + phase_s["emit"]
+                       + phase_s["dispatch"])
+                _align_boundaries()
                 _save_all(groups, checkpoint_dir)
                 phase_s["checkpoint"] += (time.perf_counter() - now) - (
-                    phase_s["collect"] + phase_s["emit"] - ce0)
+                    phase_s["collect"] + phase_s["emit"]
+                    + phase_s["dispatch"] - ce0)
                 checkpoints_saved += 1
                 last_saved = ticks_run
             elapsed = time.perf_counter() - t_start

@@ -210,7 +210,7 @@ class TestLiveLoopDynamic:
     @staticmethod
     def _run_with_feeder(reg, records_fn, n_ticks, known_ids,
                          checkpoint_dir=None, auto_release_after=0,
-                         micro_chunk=1):
+                         micro_chunk=1, chunk_stagger=False):
         """live_loop over a REAL TcpJsonlSource (the object is the source,
         as serve passes it — auto-register needs its drain_unknown/set_ids
         surface) with a producer thread pushing records_fn(k) each tick."""
@@ -239,7 +239,8 @@ class TestLiveLoopDynamic:
                               auto_register=True,
                               checkpoint_dir=checkpoint_dir,
                               auto_release_after=auto_release_after,
-                              micro_chunk=micro_chunk)
+                              micro_chunk=micro_chunk,
+                              chunk_stagger=chunk_stagger)
         finally:
             stop.set()
             t.join(timeout=5)
@@ -278,6 +279,43 @@ class TestLiveLoopDynamic:
         reg.lookup("newcomer")
         # registered at a boundary tick; scored for >= one full chunk
         assert stats["scored"] >= 2 * 12 + 4
+
+    def test_auto_register_composes_with_chunk_stagger(self):
+        """Elastic membership under ROTATED chunk boundaries: a claim
+        forces a one-tick boundary realignment (partial flush + drain +
+        re-ramp) instead of being forbidden — the 100k serving shape
+        stays elastic."""
+        reg = _registry(n=2, group_size=2, reserve=2)
+        stats = self._run_with_feeder(
+            reg,
+            lambda k: [{"id": "s0", "value": 30.0, "ts": k},
+                       {"id": "s1", "value": 31.0, "ts": k},
+                       {"id": "newcomer", "value": 32.0, "ts": k}],
+            n_ticks=12, known_ids=["s0", "s1"], micro_chunk=3,
+            chunk_stagger=True)
+        assert stats["chunk_stagger"] is True
+        assert stats["auto_registered"] == 1
+        reg.lookup("newcomer")
+        assert stats["scored"] >= 2 * 12 + 3
+
+    def test_auto_release_composes_with_chunk_stagger(self):
+        """The release path under rotated boundaries: a stream going
+        silent mid-soak is released through the same forced boundary
+        realignment as claims, with buffered old-length rows flushed
+        first."""
+        reg = _registry(n=3, group_size=3)
+        stats = self._run_with_feeder(
+            reg,
+            lambda k: ([{"id": "s0", "value": 30.0, "ts": k},
+                        {"id": "s1", "value": 31.0, "ts": k}]
+                       + ([{"id": "s2", "value": 32.0, "ts": k}]
+                          if k < 3 else [])),
+            n_ticks=16, known_ids=["s0", "s1", "s2"],
+            auto_release_after=4, micro_chunk=3, chunk_stagger=True)
+        assert stats["chunk_stagger"] is True
+        assert stats["auto_released"] == 1
+        assert "s2" not in reg
+        assert stats["scored"] >= 2 * 16  # survivors scored every tick
 
     def test_auto_register_capacity_rejection(self):
         reg = _registry(n=2, group_size=2)  # zero free slots
