@@ -559,6 +559,20 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                             "--startup-timeout", "1500",
                             "--out",
                             "reports/live_soak_16k_128col.json"], 3000.0),
+    # capstone: elastic churn AT the flagship scale — 102,400 streams
+    # with a stream rotating out (auto-released) and a new id in
+    # (auto-registered) every 30 s, under the full serving stack
+    # (k3/m6/chunk-stagger; membership forces warm boundary realignments)
+    ("r5_soak_100k_churn", [sys.executable, "scripts/live_soak.py",
+                            "--streams", "102400", "--group-size", "1024",
+                            "--columns", "32", "--learn-every", "3",
+                            "--learn-full-until", "0", "--stagger-learn",
+                            "--micro-chunk", "6", "--chunk-stagger",
+                            "--churn-every", "30", "--pipeline-depth", "2",
+                            "--dispatch-threads", "16",
+                            "--startup-timeout", "1800",
+                            "--out",
+                            "reports/live_soak_100k_churn.json"], 4200.0),
     # lifecycle honesty: 900 ticks under the DEFAULT maturity window —
     # the cold-start fleet pays ~300 full-rate ticks (misses expected),
     # then the cadenced steady state must hold; production onboards
