@@ -573,6 +573,10 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                             "--startup-timeout", "1800",
                             "--out",
                             "reports/live_soak_100k_churn.json"], 4200.0),
+    # tighter error bars on the held-out verdict: two more seeds over the
+    # full variant ladder (merge-incremental; ~5 s/cell on device)
+    ("r5_heldout_seeds2", [sys.executable, "scripts/heldout_eval.py",
+                           "--seeds", "59,71"], 2400.0),
     # lifecycle honesty: 900 ticks under the DEFAULT maturity window —
     # the cold-start fleet pays ~300 full-rate ticks (misses expected),
     # then the cadenced steady state must hold; production onboards
