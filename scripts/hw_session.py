@@ -577,6 +577,18 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     # full variant ladder (merge-incremental; ~5 s/cell on device)
     ("r5_heldout_seeds2", [sys.executable, "scripts/heldout_eval.py",
                            "--seeds", "59,71"], 2400.0),
+    # the hour-long flagship run: 3600 ticks of 102,400 live learning
+    # streams at the k3/m6 point — 368M+ metrics in one unbroken serve
+    ("r5_soak_100k_1h", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "102400", "--group-size", "1024",
+                         "--columns", "32", "--learn-every", "3",
+                         "--learn-full-until", "0", "--stagger-learn",
+                         "--micro-chunk", "6", "--chunk-stagger",
+                         "--ticks", "3600", "--pipeline-depth", "2",
+                         "--dispatch-threads", "16",
+                         "--startup-timeout", "1800",
+                         "--out",
+                         "reports/live_soak_100k_1h.json"], 6600.0),
     # lifecycle honesty: 900 ticks under the DEFAULT maturity window —
     # the cold-start fleet pays ~300 full-rate ticks (misses expected),
     # then the cadenced steady state must hold; production onboards
