@@ -440,8 +440,27 @@ def main() -> None:
     _finish(best)  # single exit point — semantics shared with every abort path
 
 
+def run_obs_bench() -> None:
+    """`bench.py --obs-bench`: the telemetry-overhead self-benchmark.
+
+    The obs subsystem instruments every serve-path tick; its acceptance bar
+    is <= 1% of the tick budget (docs/TELEMETRY.md). Prints one JSON line
+    with per-op costs and the projected per-tick fraction at 1 s cadence;
+    exits 1 if the bar is blown (so CI/harness runs fail loudly).
+    """
+    from rtap_tpu.obs.selfbench import measure
+
+    res = measure()
+    res["pass_1pct_budget"] = res["per_tick_overhead_frac"] <= 0.01
+    print(json.dumps({"metric": "obs_overhead", **res}), flush=True)
+    if not res["pass_1pct_budget"]:
+        sys.exit(1)
+
+
 if __name__ == "__main__":
-    if len(sys.argv) >= 2 and sys.argv[1] == "--attempt":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--obs-bench":
+        run_obs_bench()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--attempt":
         g, t = int(sys.argv[2]), int(sys.argv[3])
         try:
             print(json.dumps(run_attempt(g, t)), flush=True)

@@ -104,6 +104,18 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         host, port = tcp.address
         print(f"serve: listening for JSONL records on {host}:{port}", file=sys.stderr)
         source, close = tcp, tcp.close
+    # telemetry exposition (rtap_tpu.obs): a localhost /metrics endpoint for
+    # scrapers, and/or a JSONL snapshot file for the no-network hw sessions
+    # (--obs-snapshot; $RTAP_OBS_SNAPSHOT is the session runner's default)
+    from rtap_tpu.obs import ExpositionServer, default_snapshot_path, write_snapshot
+
+    obs_server = None
+    if args.obs_port is not None:
+        obs_server = ExpositionServer(port=args.obs_port).start()
+        ohost, oport = obs_server.address
+        print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
+              file=sys.stderr)
+    obs_snapshot = args.obs_snapshot or default_snapshot_path()
     # orderly shutdown: SIGTERM/SIGINT finish the current tick, save a
     # final checkpoint (with --checkpoint-dir), and still print the stats
     # line — an evicted service must not lose state or exit silently
@@ -140,6 +152,18 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
         close()
+        if obs_server is not None:
+            obs_server.close()
+        if obs_snapshot:
+            # final registry snapshot even on an error path: a soak that
+            # died mid-run must still leave its telemetry on disk. Best
+            # effort — an unwritable path must not mask the loop's own
+            # exception (or fail an otherwise-complete run).
+            try:
+                write_snapshot(obs_snapshot)
+            except OSError as e:
+                print(f"serve: obs snapshot write failed: {e}",
+                      file=sys.stderr)
     # ingest health belongs in the service artifact: a zero-missed-deadline
     # line is only evidence if data was flowing and parsing cleanly
     for attr in ("records_parsed", "parse_errors", "unknown_ids",
@@ -398,6 +422,15 @@ def main(argv: list[str] | None = None) -> int:
                         "Pick N well above ordinary outages: NaN semantics "
                         "keep scoring through gaps, release discards the "
                         "learned context. 0 = never (default)")
+    p.add_argument("--obs-port", type=int, default=None,
+                   help="serve the telemetry registry over localhost HTTP "
+                        "(GET /metrics = Prometheus v0 text, GET /snapshot "
+                        "= JSON); 0 binds an ephemeral port, default: no "
+                        "endpoint")
+    p.add_argument("--obs-snapshot", default=None,
+                   help="append one JSONL telemetry snapshot line to this "
+                        "file on exit (default: $RTAP_OBS_SNAPSHOT if set "
+                        "— the no-network hw-session surface)")
     p.add_argument("--freeze", action="store_true",
                    help="inference-only serving (NuPIC disableLearning "
                         "parity): SP/TM/classifier state is bit-frozen, raw "

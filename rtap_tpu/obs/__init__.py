@@ -1,0 +1,45 @@
+"""rtap_tpu.obs — first-class telemetry for the serve stack.
+
+One process-wide :class:`TelemetryRegistry` of counters, gauges, and
+fixed-bucket latency histograms (obs/metrics.py); Prometheus-v0 text and
+JSONL-snapshot exposition over localhost HTTP or to a file
+(obs/expo.py); and a tick watchdog that turns deadline misses, source
+starvation, and checkpoint stalls into counters + structured JSONL
+events (obs/watchdog.py). The serve hot paths (service/loop.py,
+service/alerts.py, service/sources.py, service/checkpoint.py) emit
+through this seam; docs/TELEMETRY.md catalogs every metric.
+"""
+
+from rtap_tpu.obs.expo import (
+    ExpositionServer,
+    default_snapshot_path,
+    read_last_snapshot,
+    render_prometheus,
+    summarize_snapshot,
+    write_snapshot,
+)
+from rtap_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    TelemetryRegistry,
+    get_registry,
+    log_buckets,
+)
+from rtap_tpu.obs.watchdog import TickWatchdog
+
+__all__ = [
+    "Counter",
+    "ExpositionServer",
+    "Gauge",
+    "Histogram",
+    "TelemetryRegistry",
+    "TickWatchdog",
+    "default_snapshot_path",
+    "get_registry",
+    "log_buckets",
+    "read_last_snapshot",
+    "render_prometheus",
+    "summarize_snapshot",
+    "write_snapshot",
+]

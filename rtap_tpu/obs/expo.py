@@ -1,0 +1,224 @@
+"""Telemetry exposition: Prometheus v0 text + JSONL snapshots + HTTP server.
+
+Two consumption shapes, one registry (obs/metrics.py):
+
+- **Pull**: :class:`ExpositionServer` serves ``GET /metrics`` (Prometheus
+  text format 0.0.4) and ``GET /snapshot`` (one JSON object) from a
+  background thread on a localhost TCP port — the same ephemeral-port,
+  ``.address``, context-manager style as the serve path's TcpJsonlSource.
+- **File**: :func:`write_snapshot` appends one JSON line per call — the
+  no-network surface for hw sessions (the tunnel host has no scrape
+  infrastructure; scripts/hw_session.py points children at a per-step
+  snapshot path via ``RTAP_OBS_SNAPSHOT`` and reads the last line back
+  instead of scraping stdout).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from rtap_tpu.obs.metrics import TelemetryRegistry, get_registry
+
+__all__ = [
+    "ExpositionServer",
+    "default_snapshot_path",
+    "read_last_snapshot",
+    "render_prometheus",
+    "summarize_snapshot",
+    "write_snapshot",
+]
+
+#: children inherit this from a session runner (scripts/hw_session.py): the
+#: default file the final snapshot lands in when no explicit path is given
+SNAPSHOT_ENV = "RTAP_OBS_SNAPSHOT"
+
+
+def default_snapshot_path() -> str | None:
+    return os.environ.get(SNAPSHOT_ENV) or None
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integral floats render as integers."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _labelstr(labels: dict[str, str], extra: tuple[str, str] | None = None) -> str:
+    items = sorted(labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", r"\\").replace('"', r"\""))
+        for k, v in items
+    )
+    return "{%s}" % body
+
+
+def render_prometheus(registry: TelemetryRegistry | None = None) -> str:
+    """The registry as Prometheus text exposition format 0.0.4.
+
+    Counters/gauges are one sample per (name, labels); histograms expand to
+    the standard cumulative ``_bucket{le=...}`` series plus ``_sum`` and
+    ``_count``. Families (shared name, distinct labels) share one
+    HELP/TYPE header.
+    """
+    registry = registry or get_registry()
+    lines: list[str] = []
+    seen_header: set[str] = set()
+    for inst in registry.collect():
+        if inst.name not in seen_header:
+            seen_header.add(inst.name)
+            help_text = registry.help_for(inst.name)
+            if help_text:
+                lines.append("# HELP %s %s" % (
+                    inst.name,
+                    help_text.replace("\\", r"\\").replace("\n", r"\n")))
+            lines.append("# TYPE %s %s" % (inst.name, inst.kind))
+        if inst.kind == "histogram":
+            merged = inst._merged()
+            cum = 0
+            for edge, c in zip(inst.edges, merged.counts):
+                cum += int(c)
+                lines.append("%s_bucket%s %s" % (
+                    inst.name, _labelstr(inst.labels, ("le", _fmt(edge))),
+                    cum))
+            total = cum + int(merged.counts[-1])
+            lines.append("%s_bucket%s %s" % (
+                inst.name, _labelstr(inst.labels, ("le", "+Inf")), total))
+            lines.append("%s_sum%s %s" % (
+                inst.name, _labelstr(inst.labels), _fmt(merged.sum)))
+            lines.append("%s_count%s %s" % (
+                inst.name, _labelstr(inst.labels), total))
+        else:
+            lines.append("%s%s %s" % (
+                inst.name, _labelstr(inst.labels), _fmt(inst.value)))
+    return "\n".join(lines) + "\n"
+
+
+def write_snapshot(path: str | None = None,
+                   registry: TelemetryRegistry | None = None) -> dict | None:
+    """Append one JSON snapshot line to `path` (default: $RTAP_OBS_SNAPSHOT;
+    no-op returning None when neither is set). Returns the snapshot dict."""
+    path = path or default_snapshot_path()
+    if not path:
+        return None
+    snap = (registry or get_registry()).snapshot()
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(snap) + "\n")
+    return snap
+
+
+def read_last_snapshot(path: str) -> dict | None:
+    """Last parseable snapshot line of a JSONL snapshot file (None when the
+    file is missing/empty — callers treat absence as 'step emitted none')."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            snap = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(snap, dict) and "metrics" in snap:
+            return snap
+    return None
+
+
+def summarize_snapshot(snap: dict) -> dict:
+    """Flatten a snapshot into a compact {metric_key: scalar-ish} dict for
+    artifacts and one-line verdicts: counters/gauges -> value; histograms ->
+    {count, sum, mean, max}. Label sets fold into the key as k=v pairs."""
+    out: dict = {}
+    for m in snap.get("metrics", []):
+        key = m["name"]
+        labels = m.get("labels") or {}
+        if labels:
+            key += "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+        v = m["value"]
+        if isinstance(v, dict):  # histogram
+            count = int(v.get("count", 0))
+            s = float(v.get("sum", 0.0))
+            h = {"count": count, "sum": round(s, 6)}
+            if count:
+                h["mean"] = round(s / count, 6)
+                if "max" in v:
+                    h["max"] = round(float(v["max"]), 6)
+            out[key] = h
+        else:
+            out[key] = v
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "rtap-obs/0"
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = render_prometheus(self.server.registry).encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/snapshot":
+            body = (json.dumps(self.server.registry.snapshot()) + "\n").encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes must not spam the serve stderr
+        pass
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class ExpositionServer:
+    """Localhost telemetry endpoint on a background daemon thread.
+
+    ``port=0`` binds ephemeral (the serve/TCP path's orphan-proof style);
+    the bound address is ``.address``. Start/stop via context manager or
+    ``start()``/``close()``. Scrape ``/metrics`` for Prometheus text,
+    ``/snapshot`` for the JSON snapshot.
+    """
+
+    def __init__(self, registry: TelemetryRegistry | None = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry or get_registry()
+        self._server = _Server((host, port), _Handler)
+        self._server.registry = self.registry
+        self.address = self._server.server_address  # (host, bound port)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "ExpositionServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "ExpositionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -16,15 +16,29 @@ from typing import IO
 
 import numpy as np
 
+from rtap_tpu.obs import get_registry
+
 
 class AlertWriter:
     """JSONL alert sink. One line per (stream, tick) whose score crosses the
-    threshold; `None` path writes nowhere but still counts."""
+    threshold; `None` path writes nowhere but still counts. Structured
+    watchdog events (`emit_event`) share the stream, discriminated by their
+    "event" key — one file tells the whole incident story in order."""
 
     def __init__(self, path: str | None = None):
         self.path = path
         self._fh: IO[str] | None = open(path, "a") if path else None
         self.count = 0
+        obs = get_registry()
+        self._obs_alerts = obs.counter(
+            "rtap_obs_alerts_total", "alert lines emitted (threshold "
+            "crossings that survived debounce)")
+        self._obs_events = obs.counter(
+            "rtap_obs_alert_stream_events_total",
+            "structured watchdog/ops events written to the alert stream")
+        self._obs_emit = obs.histogram(
+            "rtap_obs_alert_emit_seconds",
+            "wall seconds per emit_batch call (JSONL format + write + flush)")
 
     def emit_batch(
         self,
@@ -36,8 +50,11 @@ class AlertWriter:
         alerts: np.ndarray,
     ) -> int:
         """Write one JSONL line per alerting stream; returns alert count."""
+        t0 = time.perf_counter()
         idx = np.nonzero(alerts)[0]
         self.count += idx.size
+        if idx.size:
+            self._obs_alerts.inc(int(idx.size))
         if self._fh is not None and idx.size:
             ts = np.broadcast_to(np.asarray(ts), alerts.shape)
             for g in idx:
@@ -54,7 +71,23 @@ class AlertWriter:
                     + "\n"
                 )
             self._fh.flush()
+        self._obs_emit.observe(time.perf_counter() - t0)
         return int(idx.size)
+
+    def emit_event(self, event: dict) -> None:
+        """Write one structured event line (watchdog missed_tick /
+        source_starved / checkpoint_stall, membership changes, ...). Events
+        must carry an "event" key so downstream consumers can split them
+        from alert records on the shared stream. Serialization hoists that
+        key first regardless of the caller's dict order: line consumers
+        (live_soak's counter, the bitexactness tests' filter) split on the
+        literal prefix '{"event"' without parsing every line."""
+        if "event" not in event:
+            raise ValueError(f"structured events need an 'event' key: {event}")
+        self._obs_events.inc()
+        if self._fh is not None:
+            self._fh.write(json.dumps({"event": event["event"], **event}) + "\n")
+            self._fh.flush()
 
     def close(self) -> None:
         if self._fh is not None:

@@ -13,12 +13,14 @@ from __future__ import annotations
 
 import json
 import shutil
+import time
 import uuid
 from pathlib import Path
 
 import numpy as np
 
 from rtap_tpu.config import ModelConfig
+from rtap_tpu.obs import get_registry
 from rtap_tpu.service.registry import StreamGroup
 
 
@@ -32,6 +34,9 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
     """
     import jax
     import orbax.checkpoint as ocp
+
+    obs = get_registry()
+    t_save = time.perf_counter()
 
     path = Path(path).absolute()
     # the forward synapse index (fwd_*) is derived state: never stored —
@@ -100,6 +105,11 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
             shutil.rmtree(stale, ignore_errors=True)
     for stale in path.parent.glob(f".{path.name}.old-*"):
         shutil.rmtree(stale, ignore_errors=True)
+    obs.counter("rtap_obs_checkpoint_saves_total",
+                "atomic per-group checkpoint saves that fully landed").inc()
+    obs.histogram("rtap_obs_checkpoint_save_seconds",
+                  "wall seconds per group save (state fetch + orbax write + "
+                  "swap)").observe(time.perf_counter() - t_save)
 
 
 def _recover_residue(path: Path) -> Path:
@@ -195,6 +205,9 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
     grp.ticks = int(meta["ticks"])
     # n_live is now derived from stream_ids (pad-prefix count) — the meta
     # field stays written for inspection/back-compat but is not load-bearing
+    get_registry().counter(
+        "rtap_obs_checkpoint_loads_total",
+        "group checkpoints restored (service/replay resume)").inc()
     return grp
 
 

@@ -17,6 +17,7 @@ import numpy as np
 
 from rtap_tpu.config import ModelConfig
 from rtap_tpu.data.synthetic import LabeledStream
+from rtap_tpu.obs import TickWatchdog, get_registry
 from rtap_tpu.service.alerts import AlertWriter, ThroughputCounter
 from rtap_tpu.service.registry import (
     PAD_PREFIX,
@@ -29,6 +30,17 @@ from rtap_tpu.service.registry import (
 #: TcpJsonlSource.MAX_UNKNOWN_TRACKED: an id-spraying producer must not
 #: grow a long-lived server's memory); the REJECTED COUNT keeps counting
 _MAX_REJECTED_TRACKED = 4096
+
+#: the tick phases the loop accounts wall seconds to; one
+#: rtap_obs_phase_seconds histogram per phase (docs/TELEMETRY.md)
+_PHASES = ("source", "membership", "dispatch", "collect", "emit", "checkpoint")
+
+
+def _scored_counter():
+    return get_registry().counter(
+        "rtap_obs_scored_total",
+        "anomaly-scored (stream, tick) samples emitted — the north-star "
+        "metrics counter (live + replay)")
 
 
 @dataclass
@@ -99,6 +111,10 @@ def replay_streams(
     preds = np.full((T, n), np.nan, np.float32) if cfg.classifier.enabled else None
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
+    obs_scored = _scored_counter()
+    obs_replay_ticks = get_registry().counter(
+        "rtap_obs_replay_group_ticks_total",
+        "group-ticks collected by replay_streams (sums over groups)")
     resumed_from: dict[str, int] = {}
 
     # streams were added in order, so group i owns the contiguous slice
@@ -149,6 +165,8 @@ def replay_streams(
             if preds is not None:
                 preds[t0:t1, lo : lo + live] = grp.last_predictions[:, :live]
             counter.add((t1 - t0) * live)
+            obs_scored.inc((t1 - t0) * live)
+            obs_replay_ticks.inc(t1 - t0)
             for i in range(t0, t1):
                 writer.emit_batch(sids, gt[i, :live], gv[i, :live],
                                   r[i - t0, :live], ll[i - t0, :live], al[i - t0, :live])
@@ -429,12 +447,12 @@ def live_loop(
         # resumes each group from its own recorded offset.)
         ticks_seen = {g.ticks for g in groups}
         if len(ticks_seen) > 1:
-            import sys
+            import logging
 
-            print(f"live_loop: resuming a torn checkpoint set (group ticks "
-                  f"{sorted(ticks_seen)} — a crash landed between per-group "
-                  "saves); behind groups lost that many ticks of learning",
-                  file=sys.stderr, flush=True)
+            logging.getLogger(__name__).warning(
+                "live_loop: resuming a torn checkpoint set (group ticks %s "
+                "— a crash landed between per-group saves); behind groups "
+                "lost that many ticks of learning", sorted(ticks_seen))
         resume_tick_skew = (max(ticks_seen) - min(ticks_seen)) if resumed_from else 0
     reg = group if isinstance(group, StreamGroupRegistry) else None
 
@@ -455,6 +473,37 @@ def live_loop(
 
     routing, n_expected = _build_routing()
     routing_version = reg.version if reg is not None else 0
+    # --- telemetry (rtap_tpu.obs): every hot-path observation below goes
+    # through instruments cached here — creation is the cold path, emission
+    # is lock-free per-thread cells (docs/TELEMETRY.md catalogs the names)
+    obs = get_registry()
+    obs_ticks = obs.counter(
+        "rtap_obs_ticks_total", "live_loop ticks completed")
+    obs_scored = _scored_counter()
+    obs_tick_seconds = obs.histogram(
+        "rtap_obs_tick_seconds",
+        "per-tick host wall seconds (poll -> emit, excl. cadence sleep)")
+    obs_phase = {
+        p: obs.histogram(
+            "rtap_obs_phase_seconds",
+            "per-tick wall seconds by loop phase", phase=p)
+        for p in _PHASES
+    }
+    obs_streams = obs.gauge(
+        "rtap_obs_streams_active",
+        "live (non-pad) stream slots currently routed")
+    obs_streams.set(n_expected)
+    obs_rebuilds = obs.counter(
+        "rtap_obs_routing_rebuilds_total",
+        "emission-routing rebuilds after membership version bumps")
+    obs_warm_compiles = obs.counter(
+        "rtap_obs_warm_compiles_total",
+        "cold (chunk length, group config) programs dispatched serially "
+        "to keep compiles single-flight")
+    obs_dup_avoided = obs.counter(
+        "rtap_obs_dup_compiles_avoided_total",
+        "cold programs the pre-(m, config) warm-up keying would have "
+        "compiled concurrently in N pool threads (ADVICE r5)")
     auto_registered = 0
     auto_rejected_total = 0
     auto_rejected: set = set()  # bounded de-dup memory, not the count
@@ -468,6 +517,10 @@ def live_loop(
         raise ValueError("auto_release_after needs a StreamGroupRegistry")
     writer = AlertWriter(alert_path)
     counter = ThroughputCounter()
+    # deadline/starvation/stall events -> registry counters + structured
+    # JSONL lines on the alert stream (obs/watchdog.py)
+    watchdog = TickWatchdog(cadence_s, registry=obs,
+                            event_sink=writer.emit_event)
     missed = 0
     checkpoints_saved = 0
     ticks_run = 0
@@ -505,6 +558,7 @@ def live_loop(
                 lambda gh: gh[0].collect_chunk(gh[1]), pairs))
         t1 = time.perf_counter()
         phase_s["collect"] += t1 - t0
+        scored = 0
         for gi, (raw, loglik, alerts) in zip(sel, results):
             slots, ids, off = rmaps[gi]
             n = len(slots)
@@ -513,18 +567,26 @@ def live_loop(
                                   raw[i, slots], loglik[i, slots],
                                   alerts[i, slots])
                 counter.add(n)
+                scored += n
+        obs_scored.inc(scored)
         phase_s["emit"] += time.perf_counter() - t1
 
-    warmed: set = set()  # chunk lengths (T) already dispatched once: the
-    # first dispatch of each T runs serially — concurrent cold misses on
-    # step.py's compiled-fn lru_cache are not single-flight, so N pool
-    # threads would each trace+compile the same program (up to Nx the
-    # dominant startup cost over the tunnel). chunk_stagger's ramp-in
-    # dispatches T=1..M chunks, each a distinct program, so warm-up is
-    # per-T, not once
+    warmed: set = set()  # (chunk length m, group config) programs already
+    # dispatched once: the first dispatch of each PROGRAM runs serially —
+    # concurrent cold misses on step.py's compiled-fn lru_cache are not
+    # single-flight, so N pool threads would each trace+compile the same
+    # program (up to Nx the dominant startup cost over the tunnel).
+    # Programs are cached per ModelConfig, and stagger_learn gives groups
+    # DISTINCT learn_phase configs — keying by m alone (the pre-r5-ADVICE
+    # heuristic) let a later phase class's first flush at an already-seen m
+    # cold-compile concurrently in every pool thread. chunk_stagger's
+    # ramp-in dispatches m=1..M chunks, each a distinct program, so warm-up
+    # is per (m, config), never once.
+    seen_m: set = set()  # what the old m-only heuristic would have warmed:
+    # a cold program at an already-seen m is exactly a duplicate compile
+    # the old keying would NOT have serialized — counted as avoided
 
     def _dispatch_all(value_rows, ts_rows, rmaps, idx=None):
-        nonlocal warmed
         sel = range(len(groups)) if idx is None else idx
         m = len(value_rows)
         staged = []
@@ -539,13 +601,38 @@ def live_loop(
             t = np.repeat(np.asarray(ts_rows, np.int64)[:, None], grp.G,
                           axis=1)
             staged.append((grp, v, t))
-        if pool is None or m not in warmed:
-            warmed.add(m)
+        if pool is None:
+            for grp, _v, _t in staged:
+                if (m, grp.cfg) not in warmed:
+                    warmed.add((m, grp.cfg))
+                    obs_warm_compiles.inc()
+            seen_m.add(m)
             return [grp.dispatch_chunk(v, t, learn=learn)
                     for grp, v, t in staged]
-        return list(pool.map(
-            lambda gvt: gvt[0].dispatch_chunk(gvt[1], gvt[2], learn=learn),
-            staged))
+        # pooled path: dispatch each COLD (m, config) program serially once
+        # (the dispatch call blocks through trace+compile, so the cache is
+        # warm before any thread can race it); same-program and warm groups
+        # overlap in the pool as before
+        handles: list = [None] * len(staged)
+        pooled: list[int] = []
+        for j, (grp, v, t) in enumerate(staged):
+            key = (m, grp.cfg)
+            if key not in warmed:
+                warmed.add(key)
+                obs_warm_compiles.inc()
+                if m in seen_m:
+                    obs_dup_avoided.inc()
+                handles[j] = grp.dispatch_chunk(v, t, learn=learn)
+            else:
+                pooled.append(j)
+        seen_m.add(m)
+        if pooled:
+            for j, h in zip(pooled, pool.map(
+                    lambda j: staged[j][0].dispatch_chunk(
+                        staged[j][1], staged[j][2], learn=learn),
+                    pooled)):
+                handles[j] = h
+        return handles
 
     # Cross-tick pipeline (pipeline_depth=2): collect tick k-1 AFTER
     # dispatching tick k, so the device round trip — which over the remote-
@@ -585,12 +672,14 @@ def live_loop(
         the last collected tick). Flush every class's partial buffer,
         drain, and reset the ramp so boundaries re-stagger. Under
         chunk_stagger the partial sizes 1..M are the programs the ramp-in
-        already compiled (warm); plain micro_chunk callers reach here
-        only with empty buffers (membership defers to a natural boundary
-        — a forced partial flush would cold-compile a never-seen chunk
-        size mid-tick). Cost: one spiky tick per membership/checkpoint
-        batch — fine for churn at tens-of-seconds cadence, wrong for
-        per-tick churn."""
+        already compiled (warm); plain micro_chunk callers normally reach
+        here with empty buffers (in-loop membership defers to a natural
+        boundary), EXCEPT an out-of-band registry version bump, which
+        forces a partial flush — a one-off cold compile of that chunk
+        size, single-flighted by the (m, config) warm-up keying — rather
+        than dying on the source-length check (ADVICE r5). Cost: one
+        spiky tick per membership/checkpoint batch — fine for churn at
+        tens-of-seconds cadence, wrong for per-tick churn."""
         for c in range(n_classes):
             if chunk_bufs[c]:
                 _flush_class(c)
@@ -621,6 +710,9 @@ def live_loop(
                 break
             t_start = time.perf_counter()
             t_phase = t_start
+            phase_tick0 = dict(phase_s)  # per-tick deltas feed the per-
+            # phase histograms at tick end (cumulative sums stay the
+            # source of truth for the membership-exclusion arithmetic)
             # membership booking excludes collect/emit/dispatch seconds
             # its drains and forced flushes accrue (those book into their
             # own phases; double-counting would mis-name the binding
@@ -687,17 +779,21 @@ def live_loop(
                 auto_rejected.clear()
                 if hasattr(source, "set_ids"):
                     source.set_ids(reg.dispatch_ids())
-            if reg is not None and reg.version != routing_version \
-                    and (not any(chunk_bufs) or chunk_stagger):
+            if reg is not None and reg.version != routing_version:
                 # a version bump outside the blocks above (external claim/
                 # release between ticks) still needs the aligned instant:
                 # buffered rows were polled under the old routing. Plain
-                # micro_chunk waits for a natural boundary (a forced
-                # partial flush would cold-compile a never-seen chunk size
-                # mid-tick); stagger's ramp-in already compiled 1..M
+                # micro_chunk FORCES a partial flush here (ADVICE r5:
+                # deferring to a natural boundary let an external actor
+                # resize the source mid-chunk and die on the length check
+                # next tick) — the one-off cold compile of the partial
+                # chunk size is accepted and single-flighted by the
+                # (m, config) warm-up keying above.
                 _align_boundaries()
                 routing, n_expected = _build_routing()
                 routing_version = reg.version
+                obs_rebuilds.inc()
+                obs_streams.set(n_expected)
             now = time.perf_counter()
             phase_s["membership"] += (now - t_phase) - (
                 phase_s["collect"] + phase_s["emit"] + phase_s["dispatch"]
@@ -705,6 +801,7 @@ def live_loop(
             values, ts = source(k)
             phase_s["source"] += time.perf_counter() - now
             values = np.asarray(values, np.float32)
+            watchdog.observe_source(k, values)
             if len(values) != n_expected:
                 raise ValueError(
                     f"source returned {len(values)} values for {n_expected} "
@@ -752,17 +849,23 @@ def live_loop(
                 now = time.perf_counter()
                 ce0 = (phase_s["collect"] + phase_s["emit"]
                        + phase_s["dispatch"])
+                ck0 = phase_s["checkpoint"]
                 _align_boundaries()
                 _save_all(groups, checkpoint_dir)
                 phase_s["checkpoint"] += (time.perf_counter() - now) - (
                     phase_s["collect"] + phase_s["emit"]
                     + phase_s["dispatch"] - ce0)
+                watchdog.observe_checkpoint(k, phase_s["checkpoint"] - ck0)
                 checkpoints_saved += 1
                 last_saved = ticks_run
             elapsed = time.perf_counter() - t_start
             latencies[k] = elapsed
+            obs_ticks.inc()
+            obs_tick_seconds.observe(elapsed)
+            for p in _PHASES:
+                obs_phase[p].observe(phase_s[p] - phase_tick0[p])
             budget = cadence_s - elapsed
-            if budget < 0:
+            if watchdog.observe_tick(k, elapsed):
                 missed += 1
             elif k + 1 < n_ticks:
                 if stop_event is not None:

@@ -29,6 +29,8 @@ import urllib.request
 
 import numpy as np
 
+from rtap_tpu.obs import get_registry
+
 
 class HttpPollSource:
     """Poll an HTTP metrics endpoint once per tick.
@@ -58,6 +60,10 @@ class HttpPollSource:
         self.poll_failures = 0
         self._track_unknown = bool(track_unknown)
         self._unknown_seen: set[str] = set()
+        self._obs_poll_failures = get_registry().counter(
+            "rtap_obs_source_poll_failures_total",
+            "HTTP metric polls that failed or timed out (whole-vector NaN "
+            "ticks)")
 
     def __call__(self, tick: int) -> tuple[np.ndarray, int]:
         values = np.full(len(self.stream_ids), np.nan, np.float32)
@@ -94,6 +100,7 @@ class HttpPollSource:
                         self._unknown_seen.add(key)
         except Exception:
             self.poll_failures += 1
+            self._obs_poll_failures.inc()
         return values, ts
 
     # ---- dynamic membership (serve --auto-register) ----
@@ -141,6 +148,22 @@ class TcpJsonlSource:
         # them to the bounded set below.
         self._track_unknown = bool(track_unknown)
         self._unknown_seen: set[str] = set()
+        # ingest health mirrored into the telemetry registry once per tick
+        # (the delta sync in __call__): the parse tallies live in C/handler
+        # state for per-record cheapness; _obs_synced remembers how much of
+        # this instance's tally already landed in the global counters
+        obs = get_registry()
+        self._obs_synced = {"pe": 0, "uk": 0, "rec": 0}
+        self._obs_parse_errors = obs.counter(
+            "rtap_obs_ingest_parse_errors_total",
+            "malformed JSONL records dropped by the TCP listener")
+        self._obs_unknown_ids = obs.counter(
+            "rtap_obs_ingest_unknown_ids_total",
+            "records for unregistered stream ids (claim candidates under "
+            "--auto-register, otherwise dropped)")
+        self._obs_records = obs.counter(
+            "rtap_obs_ingest_records_total",
+            "successfully parsed ingest records (native parser only)")
         # Native C parse path (rtap_tpu/native/jsonl_parser.c): the whole
         # recv-chunk drain in one locked C call instead of per-record
         # json.loads + dict lookup + lock — the host core feeding 100k
@@ -296,6 +319,26 @@ class TcpJsonlSource:
             if self._nstate is not None:
                 self._latest_ts = max(self._latest_ts, int(self._nstate.ts_buf[0]))
             ts = self._latest_ts or int(time.time())
+        # once-per-tick delta sync of THIS instance's ingest tallies into
+        # the process-global registry counters (outside the lock: reads +
+        # obs-cell increments only). Per-instance deltas, never a raise-
+        # to-total sync against the global counter's current value: the
+        # registry counter outlives any one source, so two sources over a
+        # process lifetime (reconnect, tests) must SUM, and a replacement
+        # source's from-zero tally must not be masked by its predecessor's.
+        # Each tally is read ONCE into a local — the handler thread keeps
+        # bumping it, and an inc/store pair reading twice would drop any
+        # increments landing between the reads.
+        pe = self.parse_errors
+        self._obs_parse_errors.inc(max(0, pe - self._obs_synced["pe"]))
+        self._obs_synced["pe"] = pe
+        uk = self.unknown_ids
+        self._obs_unknown_ids.inc(max(0, uk - self._obs_synced["uk"]))
+        self._obs_synced["uk"] = uk
+        if self._nstate is not None:
+            n = self.records_parsed
+            self._obs_records.inc(max(0, n - self._obs_synced["rec"]))
+            self._obs_synced["rec"] = n
         return values, ts
 
 

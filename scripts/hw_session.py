@@ -27,6 +27,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "hw_results")
+# obs_tail imports rtap_tpu.obs in THIS process; running as `python
+# scripts/hw_session.py` puts scripts/ (not the repo) at sys.path[0]
+sys.path.insert(0, REPO)
 
 
 def log(msg: str) -> None:
@@ -627,6 +630,15 @@ def pick_steps(spec: str | None) -> list[tuple]:
     return picked
 
 
+def obs_snapshot_path(name: str) -> str:
+    """Per-step telemetry snapshot sink (rtap_tpu.obs JSONL). Children
+    inherit it via $RTAP_OBS_SNAPSHOT: serve writes its final registry
+    snapshot there (directly, or through live_soak's pass-through), so the
+    session ledger reads structured tick/deadline facts instead of
+    scraping stdout lines out of the step log."""
+    return os.path.join(OUT, f"{name}.obs.jsonl")
+
+
 def run_step(name: str, cmd: list[str], budget: float) -> int:
     """One step attempt; stdout+stderr -> hw_results/<name>.log (overwrite).
 
@@ -638,9 +650,15 @@ def run_step(name: str, cmd: list[str], budget: float) -> int:
     import signal
 
     path = os.path.join(OUT, f"{name}.log")
+    snap = obs_snapshot_path(name)
+    try:
+        os.remove(snap)  # fresh run, fresh telemetry (matches the log overwrite)
+    except OSError:
+        pass
     with open(path, "w") as f:
         proc = subprocess.Popen(cmd, cwd=REPO, stdout=f, stderr=subprocess.STDOUT,
-                                start_new_session=True)
+                                start_new_session=True,
+                                env={**os.environ, "RTAP_OBS_SNAPSHOT": snap})
         try:
             return proc.wait(timeout=budget)
         except subprocess.TimeoutExpired:
@@ -663,6 +681,31 @@ def log_tail(name: str, limit: int = 140) -> str:
         return ""
 
 
+def obs_tail(name: str) -> str:
+    """Compact telemetry verdict from the step's obs snapshot (empty when
+    the step emitted none — profiles and evals don't run the serve loop)."""
+    from rtap_tpu.obs import read_last_snapshot, summarize_snapshot
+
+    snap = read_last_snapshot(obs_snapshot_path(name))
+    if snap is None:
+        return ""
+    s = summarize_snapshot(snap)
+    parts = []
+    for key, label in (("rtap_obs_ticks_total", "ticks"),
+                       ("rtap_obs_missed_ticks_total", "missed"),
+                       ("rtap_obs_scored_total", "scored"),
+                       ("rtap_obs_alerts_total", "alerts"),
+                       ("rtap_obs_routing_rebuilds_total", "rebuilds")):
+        v = s.get(key)
+        if v:
+            parts.append(f"{label}={int(v)}")
+    tick = s.get("rtap_obs_tick_seconds") or {}
+    if tick.get("count"):
+        parts.append(f"tick_mean={tick['mean'] * 1e3:.1f}ms"
+                     f" tick_max={tick['max'] * 1e3:.0f}ms")
+    return " ".join(parts)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--budget-per-step", type=float, default=600.0)
@@ -680,6 +723,9 @@ def main() -> None:
         rc = run_step(name, cmd, budget)
         dt = time.monotonic() - t0
         log(f"step {name}: rc={rc} in {dt:.0f}s — {log_tail(name)}")
+        obs = obs_tail(name)
+        if obs:
+            log(f"step {name}: obs {obs}")
 
 
 if __name__ == "__main__":

@@ -78,6 +78,8 @@ class Feeder:
             # inside the try: an import failure (the exact class of bug the
             # sys.path fix above addresses) must land in self.error, not
             # kill the thread silently and read as a connection drop
+            import numpy as np
+
             from rtap_tpu.utils.measure import make_sine_feed
 
             sock = socket.create_connection(("127.0.0.1", self.port), timeout=5.0)
@@ -104,8 +106,19 @@ class Feeder:
                 if prefixes is None or len(prefixes) != len(self.ids):
                     prefixes = [f'{{"id": "{sid}", "value": ' for sid in self.ids]
                 suffix = f', "ts": {ts}}}\n'
-                lines = [p + repr(v) + suffix for p, v in
-                         zip(prefixes, chunk[0].astype(float).tolist())]
+                if np.isfinite(chunk).all():
+                    lines = [p + repr(v) + suffix for p, v in
+                             zip(prefixes, chunk[0].astype(float).tolist())]
+                else:
+                    # ADVICE r5: repr() on a non-finite float emits bare
+                    # 'nan'/'inf', which json.loads rejects — the fast path
+                    # is only parse-identical for finite values. json.dumps
+                    # serializes the odd non-finite row as NaN/Infinity
+                    # (accepted by the Python consumer path) instead of
+                    # silently corrupting the record stream.
+                    lines = [json.dumps({"id": sid, "value": v, "ts": ts}) + "\n"
+                             for sid, v in
+                             zip(self.ids, chunk[0].astype(float).tolist())]
                 if self.ticks_pushed == 0:
                     rec = json.loads(lines[0])
                     assert rec == {"id": self.ids[0],
@@ -204,7 +217,20 @@ def main() -> int:
     ap.add_argument("--startup-timeout", type=float, default=420.0,
                     help="budget for serve's backend init + first compile")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
+    ap.add_argument("--obs-snapshot", default=None,
+                    help="telemetry snapshot JSONL the serve child writes "
+                         "and this script reads back into the artifact "
+                         "(default: $RTAP_OBS_SNAPSHOT, else <out>.obs.jsonl)")
     args = ap.parse_args()
+    obs_snapshot = args.obs_snapshot \
+        or os.environ.get("RTAP_OBS_SNAPSHOT") \
+        or args.out + ".obs.jsonl"
+    # fresh run, fresh telemetry: a stale snapshot line from an earlier
+    # attempt must never be read back as this run's evidence
+    try:
+        os.remove(obs_snapshot)
+    except OSError:
+        pass
 
     ids = [f"node{i // 4:04d}.m{i % 4}" for i in range(args.streams)]
     alerts_path = os.path.join(REPO, "reports", "live_soak_alerts.jsonl")
@@ -228,6 +254,7 @@ def main() -> int:
         "--pipeline-depth", str(args.pipeline_depth),
         "--dispatch-threads", str(args.dispatch_threads),
         "--alerts", alerts_path,
+        "--obs-snapshot", obs_snapshot,
     ]
     if args.columns is not None:
         cmd += ["--columns", str(args.columns)]
@@ -282,10 +309,27 @@ def main() -> int:
         raise SystemExit(proc.returncode)  # keep INIT_WATCHDOG_EXIT intact
 
     stats = json.loads(out.strip().splitlines()[-1])
+    # the serve child's telemetry registry, read from its snapshot file
+    # rather than scraped out of stdout/stderr: the obs seam (rtap_tpu.obs)
+    # is the structured surface for tick/phase/deadline accounting
+    from rtap_tpu.obs import read_last_snapshot, summarize_snapshot
+
+    snap = read_last_snapshot(obs_snapshot)
+    obs_summary = summarize_snapshot(snap) if snap else None
+    if obs_summary is None:
+        log(f"warning: serve left no telemetry snapshot at {obs_snapshot}")
     n_alert_lines = 0
+    n_event_lines = 0
     if os.path.exists(alerts_path):
         with open(alerts_path) as f:
-            n_alert_lines = sum(1 for _ in f)
+            for line in f:
+                # watchdog events share the alert stream; json.dumps puts
+                # their discriminating "event" key first, so this split is
+                # exact without parsing a potentially huge file
+                if line.startswith('{"event"'):
+                    n_event_lines += 1
+                else:
+                    n_alert_lines += 1
         os.remove(alerts_path)  # large; the count is the committed evidence
     result = {
         "streams": args.streams, "ticks": args.ticks, "cadence_s": args.cadence,
@@ -303,8 +347,10 @@ def main() -> int:
         "chunk_stagger": args.chunk_stagger,
         "churn_every": args.churn_every, "ids_churned": feeder.churned,
         "alert_lines": n_alert_lines,
+        "event_lines": n_event_lines,
         "feeder_ticks_pushed": feeder.ticks_pushed,
         "feeder_error": feeder.error, **stats,
+        "obs": obs_summary,
     }
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)

@@ -1,0 +1,142 @@
+"""The obs subsystem wired through the real serve stack (ISSUE 1
+acceptance): a short CPU replay under live_loop must expose non-zero
+rtap_obs_ticks_total and per-phase rtap_obs_phase_seconds histograms via
+BOTH the JSONL snapshot and the Prometheus text endpoint — and the
+ADVICE-r5 mid-chunk membership fix must survive an out-of-band registry
+bump + source resize in plain micro_chunk mode.
+
+The registry is process-wide (other tests may have run serve paths in this
+process), so every assertion here is on DELTAS around this test's run.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from rtap_tpu.config import cluster_preset
+from rtap_tpu.obs import (
+    ExpositionServer,
+    get_registry,
+    read_last_snapshot,
+    summarize_snapshot,
+    write_snapshot,
+)
+from rtap_tpu.service.loop import live_loop
+from rtap_tpu.service.registry import StreamGroupRegistry
+
+G_TOTAL = 6
+GROUP_SIZE = 4
+N_TICKS = 8
+
+
+def _registry():
+    reg = StreamGroupRegistry(cluster_preset(), group_size=GROUP_SIZE,
+                              backend="tpu")
+    for i in range(G_TOTAL):
+        reg.add_stream(f"s{i}")
+    reg.finalize()
+    return reg
+
+
+def _feed(k):
+    rng = np.random.Generator(np.random.Philox(key=(23, k)))
+    return (30 + 5 * rng.random(G_TOTAL)).astype(np.float32), 1_700_000_000 + k
+
+
+def _summary():
+    return summarize_snapshot(get_registry().snapshot())
+
+
+def test_live_loop_populates_registry_snapshot_and_endpoint(tmp_path):
+    before = _summary()
+    stats = live_loop(_feed, _registry(), n_ticks=N_TICKS, cadence_s=0.01)
+    assert stats["ticks"] == N_TICKS
+
+    # ---- JSONL snapshot surface
+    snap_path = str(tmp_path / "obs.jsonl")
+    write_snapshot(snap_path)
+    snap = read_last_snapshot(snap_path)
+    assert snap is not None
+    s = summarize_snapshot(snap)
+    assert s["rtap_obs_ticks_total"] - before.get("rtap_obs_ticks_total", 0) \
+        == N_TICKS
+    assert s["rtap_obs_scored_total"] - before.get("rtap_obs_scored_total", 0) \
+        == N_TICKS * G_TOTAL
+    assert s["rtap_obs_streams_active"] == G_TOTAL
+    for phase in ("source", "membership", "dispatch", "collect", "emit",
+                  "checkpoint"):
+        key = "rtap_obs_phase_seconds{phase=%s}" % phase
+        prev = before.get(key) or {"count": 0}
+        assert s[key]["count"] - prev["count"] == N_TICKS, (phase, s[key])
+    # the phases that always do real work must have accumulated wall time
+    assert s["rtap_obs_phase_seconds{phase=dispatch}"]["sum"] > 0
+    assert s["rtap_obs_tick_seconds"]["count"] >= N_TICKS
+
+    # ---- Prometheus text endpoint surface
+    with ExpositionServer() as srv:
+        host, port = srv.address
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10).read().decode()
+        http_snap = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/snapshot", timeout=10).read())
+    assert "# TYPE rtap_obs_ticks_total counter" in body
+    assert "# TYPE rtap_obs_phase_seconds histogram" in body
+    ticks_line = [l for l in body.splitlines()
+                  if l.startswith("rtap_obs_ticks_total ")]
+    assert ticks_line and float(ticks_line[0].split()[-1]) >= N_TICKS
+    assert 'rtap_obs_phase_seconds_bucket{phase="dispatch",le="+Inf"}' in body
+    assert summarize_snapshot(http_snap)["rtap_obs_ticks_total"] \
+        == s["rtap_obs_ticks_total"]
+
+
+def test_watchdog_missed_ticks_flow_into_registry_and_alert_stream(tmp_path):
+    """Sub-ms cadence on a compiling CPU backend misses its first deadline
+    by construction: the miss must land in rtap_obs_missed_ticks_total AND
+    as a structured missed_tick event line on the alert JSONL stream."""
+    before = _summary()
+    alerts = tmp_path / "alerts.jsonl"
+    stats = live_loop(_feed, _registry(), n_ticks=4, cadence_s=1e-4,
+                      alert_path=str(alerts))
+    assert stats["missed_deadlines"] >= 1
+    after = _summary()
+    assert after["rtap_obs_missed_ticks_total"] \
+        - before.get("rtap_obs_missed_ticks_total", 0) \
+        == stats["missed_deadlines"]
+    events = [json.loads(l) for l in alerts.read_text().splitlines()
+              if "event" in json.loads(l)]
+    missed = [e for e in events if e["event"] == "missed_tick"]
+    assert len(missed) == stats["missed_deadlines"]
+    assert all(e["elapsed_s"] > e["cadence_s"] for e in missed)
+
+
+def test_external_membership_bump_mid_chunk_plain_micro_chunk():
+    """ADVICE r5 (loop.py:690): an out-of-band registry claim + source
+    resize observed with buffered rows in PLAIN micro_chunk mode used to
+    defer the routing rebuild to the next natural boundary and die on the
+    source-length check. The loop must now force a partial flush, rebuild
+    routing, and keep serving — counted in rtap_obs_routing_rebuilds_total."""
+    before = _summary()
+    reg = _registry()  # group 1 holds 2 pad slots: claimable capacity
+    n_ticks = 6
+
+    def feed(k):
+        ids = reg.dispatch_ids()
+        if k == 1:
+            # external actor: claims a slot mid-chunk (micro_chunk=3 means
+            # rows for ticks 0..1 sit buffered when tick 2's membership
+            # check observes the bump) and resizes the NEXT poll's vector
+            reg.add_stream("late")
+        rng = np.random.Generator(np.random.Philox(key=(29, k)))
+        return (30 + 5 * rng.random(len(ids))).astype(np.float32), \
+            1_700_000_000 + k
+
+    stats = live_loop(feed, reg, n_ticks=n_ticks, cadence_s=0.01,
+                      micro_chunk=3)
+    assert stats["ticks"] == n_ticks
+    # ticks 0-1 scored 6 streams, ticks 2+ scored 7 (the claimed one)
+    assert stats["scored"] == 2 * G_TOTAL + (n_ticks - 2) * (G_TOTAL + 1)
+    after = _summary()
+    assert after["rtap_obs_routing_rebuilds_total"] \
+        - before.get("rtap_obs_routing_rebuilds_total", 0) >= 1
+    assert after["rtap_obs_streams_active"] == G_TOTAL + 1

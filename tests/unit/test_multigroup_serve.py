@@ -37,6 +37,16 @@ def _registry():
     return reg
 
 
+def _alert_records(path):
+    """The ALERT records of a shared alert/event stream file. Watchdog
+    events (rtap_tpu.obs; json.dumps puts their discriminating "event" key
+    first) carry wall-clock measurements, so they are legitimately
+    nondeterministic across otherwise bit-identical runs — bitexactness is
+    a contract on the alert stream, not on latency telemetry."""
+    with open(path) as f:
+        return "".join(l for l in f if not l.startswith('{"event"'))
+
+
 def test_registry_live_loop_stats_and_alert_hygiene(tmp_path):
     reg = _registry()
     assert [g.n_live for g in reg.groups] == [4, 2]
@@ -48,6 +58,11 @@ def test_registry_live_loop_stats_and_alert_hygiene(tmp_path):
     assert stats["ticks"] == N_TICKS
     for line in open(path):
         rec = json.loads(line)
+        if "event" in rec:
+            # watchdog events (rtap_tpu.obs) share the alert stream,
+            # discriminated by their "event" key — never alert-shaped
+            assert "stream" not in rec
+            continue
         assert not rec["stream"].startswith("__pad")
 
 
@@ -227,7 +242,7 @@ def test_pipeline_depth2_bitexact_vs_depth1(tmp_path):
         assert stats["scored"] == G_TOTAL * N_TICKS
         import jax
 
-        out[depth] = (open(path).read(),
+        out[depth] = (_alert_records(path),
                       [jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
                                               g.state) for g in reg.groups],
                       stats["checkpoints_saved"])
@@ -271,7 +286,7 @@ def test_dispatch_threads_bitexact_vs_serial(tmp_path):
         # when the pool was never created), not the requested flag value
         assert stats["dispatch_threads"] == min(threads, len(reg.groups))
         assert stats["scored"] == G_TOTAL * N_TICKS
-        out[threads] = (open(path).read(),
+        out[threads] = (_alert_records(path),
                         [jax.tree_util.tree_map(
                             lambda x: np.asarray(x).copy(), g.state)
                          for g in reg.groups])
@@ -363,7 +378,7 @@ def test_micro_chunk_bitexact_vs_per_tick(tmp_path):
                           dispatch_threads=2, micro_chunk=m)
         assert stats["micro_chunk"] == m
         assert stats["scored"] == G_TOTAL * N_TICKS
-        out[m] = (open(path).read(),
+        out[m] = (_alert_records(path),
                   [jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
                                           g.state) for g in reg.groups])
     assert out[1][0] == out[5][0]  # identical alert stream, same order
@@ -423,7 +438,7 @@ def test_chunk_stagger_content_equal_and_state_bitexact(tmp_path):
                           alert_path=path, pipeline_depth=2,
                           dispatch_threads=2, **kw)
         assert stats["scored"] == G_TOTAL * N_TICKS
-        out[mode] = (sorted(open(path).read().splitlines()),
+        out[mode] = (sorted(_alert_records(path).splitlines()),
                      [jax.tree_util.tree_map(lambda x: np.asarray(x).copy(),
                                              g.state) for g in reg.groups])
     assert out["plain"][0] == out["stagger"][0]

@@ -1,0 +1,40 @@
+"""scripts/check_static.sh rides tier-1: compileall over rtap_tpu plus the
+no-bare-print gate for rtap_tpu/service/ (telemetry goes through
+rtap_tpu.obs, never ad-hoc stdout lines the harness would have to scrape)."""
+
+import glob
+import os
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_check_static_passes():
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO, "scripts", "check_static.sh")],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "check_static: OK" in proc.stdout
+
+
+def test_print_gate_actually_bites():
+    """The grep gate must fail on a real bare print( — guard the guard
+    (a pattern typo could silently let prints back into the service layer)."""
+    victim = os.path.join(REPO, "rtap_tpu", "service", "_gate_canary.py")
+    with open(victim, "w") as f:
+        f.write('print("scraped-stdout telemetry")\n')
+    try:
+        proc = subprocess.run(
+            ["bash", os.path.join(REPO, "scripts", "check_static.sh")],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+        )
+    finally:
+        os.remove(victim)
+        # the script's compileall step byte-compiles the canary before the
+        # grep gate fails — drop the orphaned pyc too, not just the source
+        for pyc in glob.glob(os.path.join(
+                REPO, "rtap_tpu", "service", "__pycache__", "_gate_canary*")):
+            os.remove(pyc)
+    assert proc.returncode != 0
+    assert "_gate_canary" in proc.stdout + proc.stderr
