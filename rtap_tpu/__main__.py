@@ -76,6 +76,34 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     if args.group_size < 1:
         print("serve: --group-size must be >= 1", file=sys.stderr)
         return 2
+    # resilience wiring (docs/RESILIENCE.md): scripted fault injection and
+    # the load-shedding ladder are operator opt-ins; quarantine itself is
+    # always on (a faulted group must never take down the fleet). Parsed
+    # BEFORE any source/registry construction: a bad spec is a usage
+    # error, not a half-started serve with a listener to clean up.
+    chaos = None
+    if args.chaos_spec:
+        from rtap_tpu.resilience import ChaosEngine, ChaosSpec
+
+        try:
+            chaos = ChaosEngine(ChaosSpec.from_file(args.chaos_spec))
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"serve: bad --chaos-spec {args.chaos_spec}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"serve: chaos spec loaded ({len(chaos.spec.faults)} faults, "
+              f"digest {chaos.spec.digest()})", file=sys.stderr)
+    degradation = None
+    if args.degrade:
+        from rtap_tpu.resilience import DegradationController
+
+        try:
+            degradation = DegradationController(
+                degrade_after=args.degrade_after,
+                recover_after=args.degrade_recover_after)
+        except ValueError as e:
+            print(f"serve: bad --degrade parameters: {e}", file=sys.stderr)
+            return 2
     # (--columns + --preset nab rejected in main() before backend init)
     cfg = nab_preset() if args.preset == "nab" else _sized_cluster(args)
     cfg = _apply_cadence(cfg, args)
@@ -147,7 +175,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           auto_register=args.auto_register,
                           auto_release_after=args.auto_release_after,
                           micro_chunk=args.micro_chunk,
-                          chunk_stagger=args.chunk_stagger)
+                          chunk_stagger=args.chunk_stagger,
+                          chaos=chaos,
+                          degradation=degradation,
+                          quarantine_restore_after=args.quarantine_restore_after,
+                          alert_flush_every=args.alert_flush_every)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -167,7 +199,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # ingest health belongs in the service artifact: a zero-missed-deadline
     # line is only evidence if data was flowing and parsing cleanly
     for attr in ("records_parsed", "parse_errors", "unknown_ids",
-                 "native_active", "poll_failures"):
+                 "native_active", "poll_failures", "polls_short_circuited"):
         v = getattr(source, attr, None)
         if v is not None:
             stats[attr] = v
@@ -422,6 +454,38 @@ def main(argv: list[str] | None = None) -> int:
                         "Pick N well above ordinary outages: NaN semantics "
                         "keep scoring through gaps, release discards the "
                         "learned context. 0 = never (default)")
+    p.add_argument("--chaos-spec", default=None,
+                   help="JSON fault-injection schedule (rtap_tpu.resilience."
+                        "chaos: {'seed': S, 'faults': [...]} or {'seed': S, "
+                        "'generate': {'n_ticks': T, 'n_groups': G, 'rate': "
+                        "R}}): scripted source timeouts, dispatch "
+                        "exceptions, alert-sink OSErrors, checkpoint write "
+                        "failures etc. injected at exactly the scheduled "
+                        "ticks — deterministic per seed (docs/RESILIENCE.md)")
+    p.add_argument("--degrade", action="store_true",
+                   help="shed load under sustained deadline misses, down "
+                        "the declared ladder: learn_thin -> score_only -> "
+                        "tick_widen, with hysteresis; emits degraded/"
+                        "recovered events and the rtap_obs_degradation_"
+                        "level gauge (docs/RESILIENCE.md)")
+    p.add_argument("--degrade-after", type=int, default=3,
+                   help="misses within the 10-tick window that escalate "
+                        "the ladder one level (with --degrade)")
+    p.add_argument("--degrade-recover-after", type=int, default=15,
+                   help="consecutive clean ticks that de-escalate one "
+                        "level (with --degrade)")
+    p.add_argument("--quarantine-restore-after", type=int, default=0,
+                   help="re-load a quarantined group from its last "
+                        "checkpoint after this many ticks of cooldown "
+                        "(needs --checkpoint-dir; 0 = quarantine is "
+                        "permanent for the run). The group loses the ticks "
+                        "since its last save; every other group's cadence "
+                        "is untouched either way")
+    p.add_argument("--alert-flush-every", type=int, default=1,
+                   help="flush the alert JSONL sink once per N batches "
+                        "instead of per batch (1 = per batch, the crash-"
+                        "safe default; higher trades at most N batches of "
+                        "alert loss on a crash for less write overhead)")
     p.add_argument("--obs-port", type=int, default=None,
                    help="serve the telemetry registry over localhost HTTP "
                         "(GET /metrics = Prometheus v0 text, GET /snapshot "

@@ -64,6 +64,17 @@ class TickWatchdog:
             for kind in ("missed_tick", "source_starved", "checkpoint_stall")
         }
 
+    def set_cadence(self, cadence_s: float) -> None:
+        """Adopt a new cadence mid-run (the degradation controller's
+        tick_widen step changes the real-time contract, and misses must
+        be judged against the contract actually in force). The stall
+        budget follows proportionally when it was tracking the cadence;
+        an explicit checkpoint_stall_s stays put."""
+        tracking = self.checkpoint_stall_s == self.cadence_s
+        self.cadence_s = float(cadence_s)
+        if tracking:
+            self.checkpoint_stall_s = self.cadence_s
+
     def _emit(self, kind: str, tick: int, **fields) -> None:
         self._events[kind].inc()
         if self._sink is not None:

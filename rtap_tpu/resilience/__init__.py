@@ -1,0 +1,37 @@
+"""rtap_tpu.resilience — fault policies, graceful degradation, chaos.
+
+The service layer's answer to the watchdog's observations (rtap_tpu.obs
+detects; this package reacts): :class:`Retry` and :class:`CircuitBreaker`
+wrap the IO edges (HTTP polls, JSONL producers, the alert sink,
+checkpoint saves — policies.py), a :class:`DegradationController` sheds
+load down a declared ladder under sustained deadline misses (degrade.py),
+and a deterministic seedable :class:`ChaosEngine` injects scripted faults
+at the loop's seams so every recovery path is exercised in tier-1 rather
+than trusted (chaos.py; ``scripts/chaos_soak.py``, ``serve
+--chaos-spec``). Group quarantine itself lives in service/loop.py — it is
+loop scheduling — but emits the resilience event vocabulary documented in
+docs/RESILIENCE.md.
+"""
+
+from rtap_tpu.resilience.chaos import (
+    FAULT_KINDS,
+    ChaosEngine,
+    ChaosError,
+    ChaosSpec,
+    Fault,
+)
+from rtap_tpu.resilience.degrade import LADDER, DegradationController
+from rtap_tpu.resilience.policies import CircuitBreaker, CircuitOpenError, Retry
+
+__all__ = [
+    "FAULT_KINDS",
+    "LADDER",
+    "ChaosEngine",
+    "ChaosError",
+    "ChaosSpec",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "DegradationController",
+    "Fault",
+    "Retry",
+]

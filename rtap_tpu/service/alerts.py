@@ -23,12 +23,39 @@ class AlertWriter:
     """JSONL alert sink. One line per (stream, tick) whose score crosses the
     threshold; `None` path writes nowhere but still counts. Structured
     watchdog events (`emit_event`) share the stream, discriminated by their
-    "event" key — one file tells the whole incident story in order."""
+    "event" key — one file tells the whole incident story in order.
 
-    def __init__(self, path: str | None = None):
+    The sink is NON-FATAL: a full disk must never kill scoring. Every
+    write goes through retry-then-quarantine — one immediate retry on
+    ``OSError``, then a circuit breaker (`breaker`; 3 consecutive failed
+    batches open it) quarantines the sink: lines are counted and DROPPED
+    (``dropped``, ``rtap_obs_alert_lines_dropped_total``) with zero write
+    attempts until the cooldown admits a probe batch. A probe that lands
+    re-closes the breaker and the stream resumes — with a gap, which the
+    drop counters size. ``count`` tracks threshold crossings regardless
+    of sink health (it feeds the loop stats, not the file).
+
+    `flush_every=N` flushes once per N batches instead of per batch —
+    the fsync-adjacent cost dominated emit at high alert rates. The
+    default 1 keeps flush-per-batch crash-safety: a killed serve loses at
+    most the current batch. Events always flush (rare, load-bearing).
+    """
+
+    def __init__(self, path: str | None = None, flush_every: int = 1,
+                 breaker=None):
+        from rtap_tpu.resilience.policies import CircuitBreaker
+
+        if flush_every < 1:
+            raise ValueError(f"flush_every must be >= 1; got {flush_every}")
         self.path = path
         self._fh: IO[str] | None = open(path, "a") if path else None
         self.count = 0
+        self.dropped = 0
+        self.sink_quarantines = 0  # times the breaker opened on the sink
+        self.flush_every = int(flush_every)
+        self._batches_since_flush = 0
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            fail_threshold=3, cooldown_s=5.0, name="alert_sink")
         obs = get_registry()
         self._obs_alerts = obs.counter(
             "rtap_obs_alerts_total", "alert lines emitted (threshold "
@@ -39,6 +66,75 @@ class AlertWriter:
         self._obs_emit = obs.histogram(
             "rtap_obs_alert_emit_seconds",
             "wall seconds per emit_batch call (JSONL format + write + flush)")
+        self._obs_sink_errors = obs.counter(
+            "rtap_obs_alert_sink_errors_total",
+            "OSError write/flush failures against the alert sink (each "
+            "failed batch counts once, after its immediate retry)")
+        self._obs_dropped = obs.counter(
+            "rtap_obs_alert_lines_dropped_total",
+            "alert/event lines dropped while the sink was failing or "
+            "quarantined (full disk etc. — scoring continued)")
+        self._obs_quarantined = {
+            kind: obs.counter(
+                "rtap_obs_resilience_events_total",
+                "structured resilience events by kind", event=kind)
+            for kind in ("alert_sink_quarantined", "alert_sink_restored")
+        }
+
+    def wrap_sink(self, wrap) -> None:
+        """Wrap the underlying file object (the chaos engine's injection
+        seam: faults land UNDER the retry/quarantine path, proving it)."""
+        if self._fh is not None:
+            self._fh = wrap(self._fh)
+
+    def _safe_write(self, lines: list[str], force_flush: bool = False) -> None:
+        """Write + maybe flush, retry once, quarantine via the breaker.
+        Never raises; failed/skipped lines are counted in ``dropped``."""
+        if self._fh is None or not lines:
+            return
+        if not self._breaker.allow():
+            self.dropped += len(lines)
+            self._obs_dropped.inc(len(lines))
+            return
+        was_closed = self._breaker.state == self._breaker.CLOSED
+        wrote = False  # a flush-only failure must not re-write the lines
+        # on retry (duplicated alert lines would corrupt bit-exactness
+        # consumers of the stream)
+        for attempt in (1, 2):  # retry once, immediately: transient EINTR/
+            # EAGAIN-class blips recover; a full disk fails twice and
+            # feeds the breaker
+            try:
+                if not wrote:
+                    self._fh.writelines(lines)
+                    wrote = True
+                    self._batches_since_flush += 1
+                if force_flush or self._batches_since_flush >= self.flush_every:
+                    self._fh.flush()
+                    self._batches_since_flush = 0
+                self._breaker.record_success()
+                if not was_closed:
+                    # the probe landed: the sink is back. Say so ON the
+                    # now-working stream, with the gap size.
+                    self._obs_quarantined["alert_sink_restored"].inc()
+                    self.emit_event({"event": "alert_sink_restored",
+                                     "lines_dropped": self.dropped})
+                return
+            except OSError:
+                if attempt == 2:
+                    self._obs_sink_errors.inc()
+                    if not wrote:
+                        # flush-only failures leave the lines in the
+                        # stdio buffer — they land on a later successful
+                        # flush, so counting them dropped would overstate
+                        # the gap the restored event reports
+                        self.dropped += len(lines)
+                        self._obs_dropped.inc(len(lines))
+                    self._breaker.record_failure()
+                    if self._breaker.state == self._breaker.OPEN:
+                        # quarantined: counted, not written (the sink is
+                        # the thing that just died)
+                        self.sink_quarantines += 1
+                        self._obs_quarantined["alert_sink_quarantined"].inc()
 
     def emit_batch(
         self,
@@ -57,41 +153,52 @@ class AlertWriter:
             self._obs_alerts.inc(int(idx.size))
         if self._fh is not None and idx.size:
             ts = np.broadcast_to(np.asarray(ts), alerts.shape)
-            for g in idx:
-                self._fh.write(
-                    json.dumps(
-                        {
-                            "stream": stream_ids[g],
-                            "ts": int(ts[g]),
-                            "value": float(np.asarray(values)[g]) if np.ndim(values) == 1 else [float(x) for x in np.asarray(values)[g]],
-                            "raw_score": float(raw[g]),
-                            "log_likelihood": float(log_likelihood[g]),
-                        }
-                    )
-                    + "\n"
+            values = np.asarray(values)
+            # one writelines per batch, not one write per line: the
+            # serialization stays per-line (each line is one JSON object)
+            # but the file sees a single buffered call
+            lines = [
+                json.dumps(
+                    {
+                        "stream": stream_ids[g],
+                        "ts": int(ts[g]),
+                        "value": float(values[g]) if values.ndim == 1
+                        else [float(x) for x in values[g]],
+                        "raw_score": float(raw[g]),
+                        "log_likelihood": float(log_likelihood[g]),
+                    }
                 )
-            self._fh.flush()
+                + "\n"
+                for g in idx
+            ]
+            self._safe_write(lines)
         self._obs_emit.observe(time.perf_counter() - t0)
         return int(idx.size)
 
     def emit_event(self, event: dict) -> None:
         """Write one structured event line (watchdog missed_tick /
-        source_starved / checkpoint_stall, membership changes, ...). Events
-        must carry an "event" key so downstream consumers can split them
-        from alert records on the shared stream. Serialization hoists that
-        key first regardless of the caller's dict order: line consumers
-        (live_soak's counter, the bitexactness tests' filter) split on the
-        literal prefix '{"event"' without parsing every line."""
+        source_starved / checkpoint_stall, quarantine/degradation events,
+        membership changes, ...). Events must carry an "event" key so
+        downstream consumers can split them from alert records on the
+        shared stream. Serialization hoists that key first regardless of
+        the caller's dict order: line consumers (live_soak's counter, the
+        bitexactness tests' filter) split on the literal prefix
+        '{"event"' without parsing every line. Events flush immediately —
+        they are rare and tell the incident story."""
         if "event" not in event:
             raise ValueError(f"structured events need an 'event' key: {event}")
         self._obs_events.inc()
-        if self._fh is not None:
-            self._fh.write(json.dumps({"event": event["event"], **event}) + "\n")
-            self._fh.flush()
+        self._safe_write(
+            [json.dumps({"event": event["event"], **event}) + "\n"],
+            force_flush=True)
 
     def close(self) -> None:
         if self._fh is not None:
-            self._fh.close()
+            try:
+                self._fh.flush()
+                self._fh.close()
+            except OSError:
+                pass  # the quarantine counters already told the story
             self._fh = None
 
 

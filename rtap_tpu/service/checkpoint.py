@@ -94,6 +94,17 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
         else:
             tmp.rename(path)
             swapped = True
+    except BaseException:
+        # a failed save must leave the previous checkpoint intact (the
+        # whole write happened in the temp sibling; the finally below
+        # sweeps it) AND be visible: live_loop turns this into a
+        # checkpoint_save_failed event and its breaker decides whether to
+        # keep trying — a full disk must never kill scoring
+        obs.counter(
+            "rtap_obs_checkpoint_save_failures_total",
+            "group checkpoint saves that raised before landing (previous "
+            "checkpoint left intact)").inc()
+        raise
     finally:
         if not swapped:
             shutil.rmtree(tmp, ignore_errors=True)

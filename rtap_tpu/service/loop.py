@@ -250,6 +250,10 @@ def live_loop(
     auto_release_after: int = 0,
     micro_chunk: int = 1,
     chunk_stagger: bool = False,
+    chaos=None,
+    degradation=None,
+    quarantine_restore_after: int = 0,
+    alert_flush_every: int = 1,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -340,6 +344,36 @@ def live_loop(
     production serve path. `source` values align with the registry's
     stream registration order (contiguous per-group slices).
 
+    Fault containment (docs/RESILIENCE.md): a dispatch or collect
+    exception QUARANTINES that group — it stops being scored, a
+    structured ``group_quarantined`` event lands on the alert stream, and
+    every other group keeps its cadence (groups are independent; one
+    group's wedged device program must not take down the fleet). With
+    `checkpoint_dir` and `quarantine_restore_after=N`, a quarantined
+    group is re-loaded from its last checkpoint N ticks later
+    (``group_restored``); a failed restore gives up loudly
+    (``group_restore_failed``) and the group stays quarantined. A source
+    that RAISES (vs. returning NaN) is caught: the tick scores a
+    whole-vector missing sample and counts ``rtap_obs_source_errors_total``;
+    timestamps going backwards are clamped monotonic and counted.
+    Checkpoint save failures are per-group events (the atomic save left
+    the previous checkpoint intact); 3 consecutive failed rounds open a
+    breaker that quarantines checkpointing until its cooldown. The alert
+    sink is non-fatal end to end (AlertWriter retry-then-quarantine).
+
+    `degradation` (a resilience.DegradationController) sheds load under
+    sustained deadline misses down the declared ladder: learn_thin →
+    score_only → tick_widen, with hysteresis, ``degraded``/``recovered``
+    events and the ``rtap_obs_degradation_level`` gauge. The controller
+    only ever REMOVES learning or widens the effective cadence — scores
+    and alerts keep flowing at every level.
+
+    `chaos` (a resilience.ChaosEngine) injects scripted faults at the
+    loop's seams — source, per-group dispatch/collect, alert sink file,
+    checkpoint saves — for deterministic recovery-path testing
+    (scripts/chaos_soak.py, serve --chaos-spec). None = no injection and
+    zero hot-path cost.
+
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
     state is saved atomically every k ticks (the in-flight pipeline is
@@ -361,6 +395,14 @@ def live_loop(
         raise ValueError("chunk_stagger needs micro_chunk >= 2")
     if dispatch_threads < 1:
         raise ValueError(f"dispatch_threads must be >= 1; got {dispatch_threads}")
+    if quarantine_restore_after < 0:
+        raise ValueError(
+            f"quarantine_restore_after must be >= 0; got "
+            f"{quarantine_restore_after}")
+    if quarantine_restore_after and checkpoint_dir is None:
+        raise ValueError(
+            "quarantine_restore_after needs --checkpoint-dir: restore means "
+            "re-loading the group's last checkpoint")
     if isinstance(group, StreamGroupRegistry):
         # _pending empty is NOT finalized: a stream count that is an exact
         # multiple of group_size seals its last group with nothing pending,
@@ -515,8 +557,107 @@ def live_loop(
             f"auto_release_after must be >= 0; got {auto_release_after}")
     if auto_release_after and reg is None:
         raise ValueError("auto_release_after needs a StreamGroupRegistry")
-    writer = AlertWriter(alert_path)
+    writer = AlertWriter(alert_path, flush_every=alert_flush_every)
     counter = ThroughputCounter()
+    # ---- resilience wiring (rtap_tpu.resilience, docs/RESILIENCE.md) ----
+    if chaos is not None:
+        # injection OUTSIDE the loop's own code: the wrapped source and
+        # alert file exercise the real recovery paths from below
+        source = chaos.wrap_source(source)
+        chaos.wrap_alert_writer(writer)
+
+    def _sync_chaos_routing():
+        """Tell the engine which source-vector slice each group reads, so
+        group-targeted source faults hit exactly that group's streams.
+        Re-synced after every routing rebuild."""
+        if chaos is not None:
+            chaos.set_group_streams({
+                gi: tuple(range(off, off + len(slots)))
+                for gi, (slots, _ids, off) in enumerate(routing)})
+
+    _sync_chaos_routing()
+    if degradation is not None and degradation.sink is None:
+        degradation.sink = writer.emit_event
+    eff_cadence = cadence_s  # widened by the degradation ladder's level 3
+    quarantined: dict[int, dict] = {}  # gi -> {tick, phase, error, restore_at}
+    quarantine_log: list[dict] = []  # full quarantine/restore history, in
+    # stats: the chaos soak's verification oracle must not depend on the
+    # alert stream (whose sink may itself be the faulted component)
+    group_scored = [0] * len(groups)  # per-group scored samples (the chaos
+    # soak's silent-gap check: a group's count must match its unquarantined
+    # tick intervals exactly)
+    _res_counters: dict = {}
+
+    def _res_event(kind: str, tick: int, **fields) -> None:
+        """Structured resilience event: one registry counter bump per kind
+        + one JSONL line on the alert stream (same contract as watchdog
+        events; docs/RESILIENCE.md catalogs the vocabulary)."""
+        c = _res_counters.get(kind)
+        if c is None:
+            c = _res_counters[kind] = obs.counter(
+                "rtap_obs_resilience_events_total",
+                "structured resilience events by kind", event=kind)
+        c.inc()
+        writer.emit_event({"event": kind, "tick": int(tick), **fields})
+
+    obs_groups_quarantined = obs.gauge(
+        "rtap_obs_groups_quarantined",
+        "stream groups currently quarantined (dispatch/collect fault "
+        "isolation)")
+    obs_groups_quarantined.set(0)
+    obs_source_errors = obs.counter(
+        "rtap_obs_source_errors_total",
+        "source callables that RAISED (vs. returning NaN); the tick "
+        "scored a whole-vector missing sample instead of dying")
+    obs_ts_regressions = obs.counter(
+        "rtap_obs_source_time_regressions_total",
+        "ticks whose source timestamp went backwards (clamped monotonic)")
+
+    def _quarantine_group(gi: int, tick: int, phase: str, exc: Exception):
+        """Isolate a faulted group: it stops being dispatched/collected/
+        emitted (and checkpointed — its state may be mid-chunk) while
+        every other group keeps its cadence. In-flight handles for the
+        group are left uncollected by the quarantine check in
+        _collect_tick — after a failed dispatch/collect its seq chain is
+        broken anyway."""
+        if gi in quarantined:
+            return
+        info = {"tick": int(tick), "phase": phase,
+                "error": f"{type(exc).__name__}: {exc}"}
+        if quarantine_restore_after and checkpoint_dir is not None:
+            info["restore_at"] = int(tick) + int(quarantine_restore_after)
+        quarantined[gi] = info
+        quarantine_log.append({"event": "group_quarantined", "group": gi,
+                               "tick": int(tick), "phase": phase})
+        obs_groups_quarantined.set(len(quarantined))
+        _res_event("group_quarantined", tick, group=gi, phase=phase,
+                   error=info["error"],
+                   streams=int(groups[gi].n_live))
+
+    source_error_run = 0  # consecutive source raises (event on the first)
+    last_ts_seen = None  # monotonic clamp floor for source timestamps
+    ts_regress_run = 0  # consecutive clamped ticks (event on the first)
+    fallback_trailing: tuple = ()  # trailing value dims (multi-field
+    # sources) for the NaN substitute when the source raises before ever
+    # returning a vector
+    ck_breaker = None
+    ck_quarantine_announced = False
+    checkpoint_save_failures = 0
+    if checkpoint_dir is not None:
+        from rtap_tpu.resilience.policies import CircuitBreaker
+
+        # 3 consecutive failed save ROUNDS quarantine checkpointing (the
+        # disk is full — stop paying the drain+fetch+fail cost every
+        # cadence); the cooldown admits a probe round later
+        ck_breaker = CircuitBreaker(
+            fail_threshold=3, cooldown_s=max(30.0, 10 * cadence_s),
+            name="checkpoint")
+
+    def _on_save_failure(gi: int, tick: int, exc: Exception) -> None:
+        nonlocal checkpoint_save_failures
+        checkpoint_save_failures += 1
+        _res_event("checkpoint_save_failed", tick, group=gi,
+                   error=f"{type(exc).__name__}: {exc}")
     # deadline/starvation/stall events -> registry counters + structured
     # JSONL lines on the alert stream (obs/watchdog.py)
     watchdog = TickWatchdog(cadence_s, registry=obs,
@@ -543,23 +684,50 @@ def live_loop(
         eff_threads = min(dispatch_threads, len(groups))
         pool = ThreadPoolExecutor(max_workers=eff_threads)
 
+    cur_tick = 0  # the loop's tick clock, read by the fault-capture paths
+
+    def _try_collect(item):
+        """Collect one group's chunk, capturing the fault instead of
+        letting it escape a pool thread: (gi, result-or-None, exc-or-None).
+        Quarantine itself happens after the join, in the loop thread —
+        AlertWriter emission is single-threaded by contract."""
+        gi, grp, h = item
+        try:
+            if chaos is not None:
+                chaos.on_collect(gi, cur_tick)
+            return gi, grp.collect_chunk(h), None
+        except Exception as e:  # noqa: BLE001 — any fault isolates the group
+            return gi, None, e
+
     def _collect_tick(ts_rows, value_rows, handles, rmaps, idx=None):
         # collects in parallel (each blocks on its group's device fetch —
         # the per-group RPC on a remote link), emission strictly serial in
         # group order so the alert stream is schedule-independent. `idx`
         # restricts to a subset of groups (chunk_stagger phase classes).
+        # Quarantined groups (and handles their failed dispatch left None)
+        # are skipped; a collect fault quarantines its group here and the
+        # rest of the tick proceeds untouched.
         sel = range(len(groups)) if idx is None else idx
         t0 = time.perf_counter()
-        pairs = [(groups[i], h) for i, h in zip(sel, handles)]
+        pairs = [(gi, groups[gi], h) for gi, h in zip(sel, handles)
+                 if gi not in quarantined and h is not None]
         if pool is None:
-            results = [grp.collect_chunk(h) for grp, h in pairs]
+            outs = [_try_collect(p) for p in pairs]
         else:
-            results = list(pool.map(
-                lambda gh: gh[0].collect_chunk(gh[1]), pairs))
+            outs = list(pool.map(_try_collect, pairs))
         t1 = time.perf_counter()
         phase_s["collect"] += t1 - t0
+        results: dict = {}
+        for gi, res, exc in outs:
+            if exc is not None:
+                _quarantine_group(gi, cur_tick, "collect", exc)
+            else:
+                results[gi] = res
         scored = 0
-        for gi, (raw, loglik, alerts) in zip(sel, results):
+        for gi, _grp, _h in pairs:  # pairs preserve group order (emission
+            if gi not in results:  # stays schedule-independent)
+                continue
+            raw, loglik, alerts = results[gi]
             slots, ids, off = rmaps[gi]
             n = len(slots)
             for i, (ts, values) in enumerate(zip(ts_rows, value_rows)):
@@ -568,29 +736,53 @@ def live_loop(
                                   alerts[i, slots])
                 counter.add(n)
                 scored += n
+            group_scored[gi] += len(ts_rows) * n
         obs_scored.inc(scored)
         phase_s["emit"] += time.perf_counter() - t1
 
-    warmed: set = set()  # (chunk length m, group config) programs already
-    # dispatched once: the first dispatch of each PROGRAM runs serially —
-    # concurrent cold misses on step.py's compiled-fn lru_cache are not
-    # single-flight, so N pool threads would each trace+compile the same
-    # program (up to Nx the dominant startup cost over the tunnel).
-    # Programs are cached per ModelConfig, and stagger_learn gives groups
-    # DISTINCT learn_phase configs — keying by m alone (the pre-r5-ADVICE
-    # heuristic) let a later phase class's first flush at an already-seen m
-    # cold-compile concurrently in every pool thread. chunk_stagger's
-    # ramp-in dispatches m=1..M chunks, each a distinct program, so warm-up
-    # is per (m, config), never once.
+    warmed: set = set()  # (chunk length m, group config, learn flag)
+    # programs already dispatched once: the first dispatch of each PROGRAM
+    # runs serially — concurrent cold misses on step.py's compiled-fn
+    # lru_cache are not single-flight, so N pool threads would each
+    # trace+compile the same program (up to Nx the dominant startup cost
+    # over the tunnel). Programs are cached per ModelConfig, and
+    # stagger_learn gives groups DISTINCT learn_phase configs — keying by
+    # m alone (the pre-r5-ADVICE heuristic) let a later phase class's
+    # first flush at an already-seen m cold-compile concurrently in every
+    # pool thread. The learn flag is part of the key too: learn=True and
+    # learn=False trace distinct programs, and the degradation ladder's
+    # score_only step flips it mid-run. chunk_stagger's ramp-in dispatches
+    # m=1..M chunks, each a distinct program, so warm-up is per
+    # (m, config, learn), never once.
     seen_m: set = set()  # what the old m-only heuristic would have warmed:
     # a cold program at an already-seen m is exactly a duplicate compile
     # the old keying would NOT have serialized — counted as avoided
 
-    def _dispatch_all(value_rows, ts_rows, rmaps, idx=None):
-        sel = range(len(groups)) if idx is None else idx
+    def _try_dispatch(gi, grp, v, t, learn_flag):
+        """Dispatch one group's chunk, capturing the fault: a raising
+        dispatch (device error, wedged RPC surfacing, injected chaos)
+        must isolate THAT group, not unwind the tick."""
+        try:
+            if chaos is not None:
+                chaos.on_dispatch(gi, cur_tick)
+            return grp.dispatch_chunk(v, t, learn=learn_flag), None
+        except Exception as e:  # noqa: BLE001 — any fault isolates the group
+            return None, e
+
+    def _dispatch_all(value_rows, ts_rows, rmaps, idx=None, learn_flag=None):
+        """Dispatch every non-quarantined group in `idx`; returns handles
+        ALIGNED WITH `idx` (None for quarantined/faulted groups, which
+        _collect_tick skips). A dispatch fault quarantines its group after
+        the pool joins (loop-thread-only emission)."""
+        if learn_flag is None:
+            learn_flag = learn
+        sel = list(range(len(groups))) if idx is None else list(idx)
         m = len(value_rows)
-        staged = []
-        for gi in sel:
+        handles: list = [None] * len(sel)
+        staged = []  # (handle slot j, gi, grp, v, t)
+        for j, gi in enumerate(sel):
+            if gi in quarantined:
+                continue
             grp = groups[gi]
             slots, _ids, off = rmaps[gi]
             # trailing field axis preserved: values may be [G] or [G, n_fields]
@@ -600,38 +792,48 @@ def live_loop(
                 v[i, slots] = row[off:off + len(slots)]
             t = np.repeat(np.asarray(ts_rows, np.int64)[:, None], grp.G,
                           axis=1)
-            staged.append((grp, v, t))
+            staged.append((j, gi, grp, v, t))
+        faults: list = []
         if pool is None:
-            for grp, _v, _t in staged:
-                if (m, grp.cfg) not in warmed:
-                    warmed.add((m, grp.cfg))
+            for j, gi, grp, v, t in staged:
+                key = (m, grp.cfg, learn_flag)
+                if key not in warmed:
+                    warmed.add(key)
                     obs_warm_compiles.inc()
+                handles[j], exc = _try_dispatch(gi, grp, v, t, learn_flag)
+                if exc is not None:
+                    faults.append((gi, exc))
             seen_m.add(m)
-            return [grp.dispatch_chunk(v, t, learn=learn)
-                    for grp, v, t in staged]
-        # pooled path: dispatch each COLD (m, config) program serially once
-        # (the dispatch call blocks through trace+compile, so the cache is
-        # warm before any thread can race it); same-program and warm groups
-        # overlap in the pool as before
-        handles: list = [None] * len(staged)
-        pooled: list[int] = []
-        for j, (grp, v, t) in enumerate(staged):
-            key = (m, grp.cfg)
-            if key not in warmed:
-                warmed.add(key)
-                obs_warm_compiles.inc()
-                if m in seen_m:
-                    obs_dup_avoided.inc()
-                handles[j] = grp.dispatch_chunk(v, t, learn=learn)
-            else:
-                pooled.append(j)
-        seen_m.add(m)
-        if pooled:
-            for j, h in zip(pooled, pool.map(
-                    lambda j: staged[j][0].dispatch_chunk(
-                        staged[j][1], staged[j][2], learn=learn),
-                    pooled)):
-                handles[j] = h
+        else:
+            # pooled path: dispatch each COLD (m, config, learn) program
+            # serially once (the dispatch call blocks through
+            # trace+compile, so the cache is warm before any thread can
+            # race it); same-program and warm groups overlap in the pool
+            pooled: list = []
+            for j, gi, grp, v, t in staged:
+                key = (m, grp.cfg, learn_flag)
+                if key not in warmed:
+                    warmed.add(key)
+                    obs_warm_compiles.inc()
+                    if m in seen_m:
+                        obs_dup_avoided.inc()
+                    handles[j], exc = _try_dispatch(gi, grp, v, t, learn_flag)
+                    if exc is not None:
+                        faults.append((gi, exc))
+                else:
+                    pooled.append((j, gi, grp, v, t))
+            seen_m.add(m)
+            if pooled:
+                outs = list(pool.map(
+                    lambda it: _try_dispatch(it[1], it[2], it[3], it[4],
+                                             learn_flag),
+                    pooled))
+                for (j, gi, _grp, _v, _t), (h, exc) in zip(pooled, outs):
+                    handles[j] = h
+                    if exc is not None:
+                        faults.append((gi, exc))
+        for gi, exc in faults:
+            _quarantine_group(gi, cur_tick, "dispatch", exc)
         return handles
 
     # Cross-tick pipeline (pipeline_depth=2): collect tick k-1 AFTER
@@ -695,8 +897,13 @@ def live_loop(
         first_flush_done[c] = True
         if not class_idx[c]:
             return  # more classes than groups: nothing to dispatch
+        # the degradation ladder removes learning per-chunk at dispatch
+        # time (level 1 thins, level >= 2 freezes); it never adds it
+        lrn = learn and (degradation is None
+                         or degradation.learn_allowed(cur_tick))
         now = time.perf_counter()
-        handles = _dispatch_all(vrows, tsrows, routing, class_idx[c])
+        handles = _dispatch_all(vrows, tsrows, routing, class_idx[c],
+                                learn_flag=lrn)
         phase_s["dispatch"] += time.perf_counter() - now
         in_flights[c].append((tsrows, vrows, handles, routing, class_idx[c]))
         while len(in_flights[c]) >= pipeline_depth:
@@ -708,6 +915,9 @@ def live_loop(
             # an evicted service must not lose since-last-checkpoint learning
             if stop_event is not None and stop_event.is_set():
                 break
+            cur_tick = k
+            if chaos is not None:
+                chaos.set_tick(k)
             t_start = time.perf_counter()
             t_phase = t_start
             phase_tick0 = dict(phase_s)  # per-tick deltas feed the per-
@@ -716,9 +926,81 @@ def live_loop(
             # membership booking excludes collect/emit/dispatch seconds
             # its drains and forced flushes accrue (those book into their
             # own phases; double-counting would mis-name the binding
-            # phase — the instrumentation's job)
+            # phase — the instrumentation's job). Captured BEFORE the
+            # restore block below: a restore's boundary-align drain books
+            # into dispatch/collect, not membership.
             ce_tick0 = (phase_s["collect"] + phase_s["emit"]
                         + phase_s["dispatch"])
+            # quarantine auto-restore (docs/RESILIENCE.md): a group whose
+            # cooldown elapsed re-loads from its last checkpoint — losing
+            # the ticks since that save, keeping every other group's
+            # cadence. Books into the membership phase (it IS a membership
+            # change: the group's model state is replaced wholesale).
+            if quarantined and quarantine_restore_after:
+                due = sorted(
+                    gi for gi, info in quarantined.items()
+                    if info.get("restore_at") is not None
+                    and k >= info["restore_at"])
+                if due:
+                    import os
+
+                    from rtap_tpu.service.checkpoint import (
+                        load_group,
+                        validate_resume,
+                    )
+
+                    _align_boundaries()
+                    restored_any = False
+                    for gi in due:
+                        ck_path = os.path.join(checkpoint_dir,
+                                               f"group{gi:04d}")
+                        old = groups[gi]
+                        try:
+                            if not os.path.isdir(ck_path):
+                                raise FileNotFoundError(
+                                    f"no checkpoint at {ck_path} (the group "
+                                    "was never saved before its fault)")
+                            restored = load_group(ck_path, mesh=old.mesh)
+                            validate_resume(
+                                restored, ck_path, old,
+                                allow_claimed_extras=auto_register
+                                or not learn)
+                        except Exception as e:  # noqa: BLE001
+                            # give up LOUDLY and stop retrying: restore is
+                            # best-effort, quarantine is the safe state
+                            quarantined[gi]["restore_at"] = None
+                            quarantine_log.append(
+                                {"event": "group_restore_failed",
+                                 "group": gi, "tick": int(k)})
+                            _res_event("group_restore_failed", k, group=gi,
+                                       error=f"{type(e).__name__}: {e}")
+                            continue
+                        groups[gi] = restored
+                        if reg is not None:
+                            for slot in reg._slots.values():
+                                if slot.group is old:
+                                    slot.group = restored
+                        del quarantined[gi]
+                        restored_any = True
+                        quarantine_log.append(
+                            {"event": "group_restored", "group": gi,
+                             "tick": int(k),
+                             "resumed_from_tick": int(restored.ticks)})
+                        obs_groups_quarantined.set(len(quarantined))
+                        _res_event("group_restored", k, group=gi,
+                                   resumed_from_tick=int(restored.ticks))
+                    if restored_any:
+                        # the restored instances replace groups[gi]: the
+                        # routing maps hold per-group slot/id snapshots
+                        # and must observe the new objects' membership
+                        routing, n_expected = _build_routing()
+                        routing_version = reg.version if reg is not None \
+                            else 0
+                        _sync_chaos_routing()
+                        obs_rebuilds.inc()
+                        obs_streams.set(n_expected)
+                        if reg is not None and hasattr(source, "set_ids"):
+                            source.set_ids(reg.dispatch_ids())
             # lazy model creation (serve --auto-register, SURVEY.md C19):
             # unknown ids the TCP listener saw claim free pad slots. The
             # pipeline drains first — membership may only change with
@@ -792,13 +1074,36 @@ def live_loop(
                 _align_boundaries()
                 routing, n_expected = _build_routing()
                 routing_version = reg.version
+                _sync_chaos_routing()
                 obs_rebuilds.inc()
                 obs_streams.set(n_expected)
             now = time.perf_counter()
             phase_s["membership"] += (now - t_phase) - (
                 phase_s["collect"] + phase_s["emit"] + phase_s["dispatch"]
                 - ce_tick0)
-            values, ts = source(k)
+            try:
+                values, ts = source(k)
+            except Exception as e:  # noqa: BLE001
+                # a RAISING source (connection drop, garbage payload the
+                # adapter didn't absorb) must not kill scoring: the tick
+                # becomes a whole-vector missing sample — the NaN path the
+                # encoder already handles — counted, and evented on the
+                # first raise of a consecutive run (the counter keeps
+                # counting; the starvation watchdog narrates a long outage)
+                obs_source_errors.inc()
+                source_error_run += 1
+                if source_error_run == 1:
+                    _res_event("source_error", k,
+                               error=f"{type(e).__name__}: {e}")
+                values = np.full((n_expected,) + fallback_trailing, np.nan,
+                                 np.float32)
+                # stay on the SOURCE's timeline, not the host's: a wall
+                # clock ahead of the feed's timestamps would pin the
+                # monotonic clamp below and freeze ts for the whole run
+                ts = last_ts_seen if last_ts_seen is not None \
+                    else int(time.time())
+            else:
+                source_error_run = 0
             phase_s["source"] += time.perf_counter() - now
             values = np.asarray(values, np.float32)
             watchdog.observe_source(k, values)
@@ -807,6 +1112,21 @@ def live_loop(
                     f"source returned {len(values)} values for {n_expected} "
                     "live streams (alignment with registration order is load-"
                     "bearing — a silent mismatch would misroute streams)")
+            fallback_trailing = values.shape[1:]
+            # timestamps must not run backwards into the models' date
+            # encodings (a misbehaving exporter clock): clamp monotonic
+            # non-decreasing, count, and event the first regression of a run
+            ts = int(ts)
+            if last_ts_seen is not None and ts < last_ts_seen:
+                obs_ts_regressions.inc()
+                if ts_regress_run == 0:
+                    _res_event("source_time_regression", k, ts=ts,
+                               clamped_to=last_ts_seen)
+                ts_regress_run += 1
+                ts = last_ts_seen
+            else:
+                ts_regress_run = 0
+                last_ts_seen = ts
             if auto_release_after:
                 # consecutive-silence accounting over THIS tick's values;
                 # releases defer to the next tick's membership block (this
@@ -846,28 +1166,71 @@ def live_loop(
                 # micro_chunk > 1 boundaries land only at multiples of M,
                 # and `ticks_run % checkpoint_every == 0` would silently
                 # degrade the cadence to lcm(M, checkpoint_every)
-                now = time.perf_counter()
-                ce0 = (phase_s["collect"] + phase_s["emit"]
-                       + phase_s["dispatch"])
-                ck0 = phase_s["checkpoint"]
-                _align_boundaries()
-                _save_all(groups, checkpoint_dir)
-                phase_s["checkpoint"] += (time.perf_counter() - now) - (
-                    phase_s["collect"] + phase_s["emit"]
-                    + phase_s["dispatch"] - ce0)
-                watchdog.observe_checkpoint(k, phase_s["checkpoint"] - ck0)
-                checkpoints_saved += 1
-                last_saved = ticks_run
+                if ck_breaker.allow():
+                    ck_quarantine_announced = False
+                    now = time.perf_counter()
+                    ce0 = (phase_s["collect"] + phase_s["emit"]
+                           + phase_s["dispatch"])
+                    ck0 = phase_s["checkpoint"]
+                    _align_boundaries()
+                    _saved, failed = _save_all(
+                        groups, checkpoint_dir, skip=quarantined,
+                        chaos=chaos, tick=k,
+                        on_failure=lambda gi, e: _on_save_failure(gi, k, e))
+                    phase_s["checkpoint"] += (time.perf_counter() - now) - (
+                        phase_s["collect"] + phase_s["emit"]
+                        + phase_s["dispatch"] - ce0)
+                    watchdog.observe_checkpoint(
+                        k, phase_s["checkpoint"] - ck0)
+                    if failed:
+                        # per-group events already emitted; the breaker
+                        # decides when a failing disk stops being worth
+                        # the drain+fetch cost every round. last_saved is
+                        # NOT advanced: the round remains due (retried
+                        # next tick until the breaker opens), and the
+                        # end-of-run best-effort save must still fire —
+                        # advancing it would silently mark failed progress
+                        # as saved and suppress both.
+                        ck_breaker.record_failure()
+                    else:
+                        ck_breaker.record_success()
+                        checkpoints_saved += 1
+                        last_saved = ticks_run
+                else:
+                    # checkpointing quarantined: saves are skipped (and
+                    # said so, once per episode) until the breaker's
+                    # cooldown admits a probe round. Scoring never
+                    # pauses; the round stays due so the probe fires at
+                    # the first allowed tick.
+                    if not ck_quarantine_announced:
+                        ck_quarantine_announced = True
+                        _res_event(
+                            "checkpoint_quarantined", k,
+                            consecutive_failures=
+                            ck_breaker.consecutive_failures,
+                            cooldown_s=ck_breaker.cooldown_s)
             elapsed = time.perf_counter() - t_start
             latencies[k] = elapsed
             obs_ticks.inc()
             obs_tick_seconds.observe(elapsed)
             for p in _PHASES:
                 obs_phase[p].observe(phase_s[p] - phase_tick0[p])
-            budget = cadence_s - elapsed
-            if watchdog.observe_tick(k, elapsed):
+            missed_this = watchdog.observe_tick(k, elapsed)
+            if missed_this:
                 missed += 1
-            elif k + 1 < n_ticks:
+            if degradation is not None:
+                # the controller reacts to the deadline verdicts the
+                # watchdog just judged; its tick_widen step changes the
+                # effective cadence BOTH sides measure against from here on
+                degradation.observe(k, missed_this)
+                new_cadence = cadence_s * degradation.cadence_scale
+                if new_cadence != eff_cadence:
+                    eff_cadence = new_cadence
+                    watchdog.set_cadence(eff_cadence)
+            # a recovery transition can shrink eff_cadence below this
+            # tick's elapsed — clamp, don't feed time.sleep a negative
+            budget = max(0.0, eff_cadence - elapsed)
+            if not missed_this and k + 1 < n_ticks:
                 if stop_event is not None:
                     stop_event.wait(budget)  # a shutdown signal ends the sleep
                 else:
@@ -887,9 +1250,16 @@ def live_loop(
         # Frozen serving (learn=False) never writes: --checkpoint-dir is
         # read-only there (resume the trained model, mutate nothing) — a
         # frozen replica must not clobber the golden checkpoint with
-        # advanced tick counters, and two frozen replicas may share a dir
-        _save_all(groups, checkpoint_dir)
-        checkpoints_saved += 1
+        # advanced tick counters, and two frozen replicas may share a dir.
+        # Bypasses the checkpoint breaker (one last best-effort save);
+        # failures are evented and counted, never raised over a finished
+        # run — each group's previous checkpoint is intact by atomicity.
+        _saved, failed = _save_all(
+            groups, checkpoint_dir, skip=quarantined, chaos=chaos,
+            tick=ticks_run,
+            on_failure=lambda gi, e: _on_save_failure(gi, ticks_run, e))
+        if not failed:
+            checkpoints_saved += 1
     writer.close()
     lat = {}
     if ticks_run > 0:
@@ -911,6 +1281,23 @@ def live_loop(
     if ticks_run > 0:
         extra["phase_ms_per_tick"] = {
             k: round(v / ticks_run * 1e3, 2) for k, v in phase_s.items()}
+    # resilience accounting (docs/RESILIENCE.md): per-group scored counts
+    # are the chaos soak's silent-gap oracle — a group's count must equal
+    # its unquarantined tick span exactly, or streams silently stopped
+    extra["scored_by_group"] = [int(x) for x in group_scored]
+    if quarantined:
+        extra["quarantined"] = {
+            f"group{gi}": {kk: vv for kk, vv in info.items()
+                           if kk != "restore_at"}
+            for gi, info in sorted(quarantined.items())}
+    if quarantine_log:
+        extra["quarantine_log"] = quarantine_log
+    if degradation is not None:
+        extra["degradation"] = degradation.stats()
+    if checkpoint_save_failures:
+        extra["checkpoint_save_failures"] = checkpoint_save_failures
+    if chaos is not None:
+        extra["chaos_injected"] = len(chaos.injected)
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
             "pipeline_depth": pipeline_depth, "micro_chunk": micro_chunk,
@@ -925,14 +1312,35 @@ def live_loop(
             **extra, **lat, **_occupancy()}
 
 
-def _save_all(groups, checkpoint_dir: str) -> None:
-    """One atomic per-group save per group dir (group{i:04d})."""
+def _save_all(groups, checkpoint_dir: str, skip=(), chaos=None, tick: int = 0,
+              on_failure=None) -> tuple[int, int]:
+    """One atomic per-group save per group dir (group{i:04d}).
+
+    Quarantined groups (`skip`) are NOT saved: their state may be
+    mid-chunk and their last good checkpoint is the restore source.
+    Failures are contained per group — reported through `on_failure`,
+    never raised — because a full disk must not kill scoring, and
+    save_group's temp-sibling atomicity guarantees the previous
+    checkpoint is still intact after any failure. Returns
+    (saved, failed) counts."""
     import os
 
     from rtap_tpu.service.checkpoint import save_group
 
+    saved = failed = 0
     for gi, grp in enumerate(groups):
-        save_group(grp, os.path.join(checkpoint_dir, f"group{gi:04d}"))
+        if gi in skip:
+            continue
+        try:
+            if chaos is not None:
+                chaos.on_checkpoint_save(gi, tick)
+            save_group(grp, os.path.join(checkpoint_dir, f"group{gi:04d}"))
+            saved += 1
+        except Exception as e:  # noqa: BLE001 — contained per group
+            failed += 1
+            if on_failure is not None:
+                on_failure(gi, e)
+    return saved, failed
 
 
 def _overflow_total(groups) -> int | None:

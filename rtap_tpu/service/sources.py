@@ -40,6 +40,16 @@ class HttpPollSource:
     times out, not stall the whole group (the reference's collector has the
     same per-poll timeout shape).
 
+    Failed polls get bounded in-tick retry (`retry`, transport errors
+    only) and a per-endpoint circuit breaker (`breaker`): after
+    `fail_threshold` consecutive failed polls the endpoint is skipped
+    outright — NaN tick, zero network wait — until the cooldown passes,
+    then one half-open probe decides. Without the breaker a dead
+    exporter's connect timeout would eat a fixed slice of EVERY tick's
+    cadence budget for the whole outage. Short-circuited polls count in
+    `polls_short_circuited` (and the breaker's own registry metrics), not
+    in `poll_failures` — no network attempt was made.
+
     `track_unknown=True` (serve --auto-register over HTTP): metric KEYS in
     the poll payload that are not registered stream ids are remembered as
     discovery candidates — the reference's collector discovers a node's
@@ -52,12 +62,23 @@ class HttpPollSource:
     MAX_UNKNOWN_TRACKED = 4096
 
     def __init__(self, url: str, stream_ids: list[str], timeout_s: float = 0.5,
-                 track_unknown: bool = False):
+                 track_unknown: bool = False, retry=None, breaker=None):
+        from rtap_tpu.resilience.policies import CircuitBreaker, Retry
+
         self.url = url
         self.stream_ids = list(stream_ids)
         self._known = set(self.stream_ids)
         self.timeout_s = timeout_s
         self.poll_failures = 0
+        self.polls_short_circuited = 0
+        # retry covers transient transport blips inside one tick; delays
+        # stay well under the 1 s cadence budget (2 tries, <= ~0.06 s of
+        # backoff). Parse errors are NOT retried — a malformed payload is
+        # the exporter's steady state, not a blip.
+        self._retry = retry if retry is not None else Retry(
+            attempts=2, base_delay_s=0.05, max_delay_s=0.25, op="http_poll")
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            fail_threshold=5, cooldown_s=30.0, name="http_poll")
         self._track_unknown = bool(track_unknown)
         self._unknown_seen: set[str] = set()
         self._obs_poll_failures = get_registry().counter(
@@ -65,12 +86,20 @@ class HttpPollSource:
             "HTTP metric polls that failed or timed out (whole-vector NaN "
             "ticks)")
 
+    def _fetch(self) -> dict:
+        with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode())
+
     def __call__(self, tick: int) -> tuple[np.ndarray, int]:
         values = np.full(len(self.stream_ids), np.nan, np.float32)
         ts = int(time.time())
+        if not self._breaker.allow():
+            # open breaker: the endpoint is known-dead; report missing
+            # samples immediately instead of paying the connect timeout
+            self.polls_short_circuited += 1
+            return values, ts
         try:
-            with urllib.request.urlopen(self.url, timeout=self.timeout_s) as r:
-                payload = json.loads(r.read().decode())
+            payload = self._retry.call(self._fetch, retry_on=(OSError,))
             metrics = payload.get("metrics", {})
             ts = int(payload.get("ts", ts))
             for i, sid in enumerate(self.stream_ids):
@@ -98,9 +127,11 @@ class HttpPollSource:
                         continue
                     if len(self._unknown_seen) < self.MAX_UNKNOWN_TRACKED:
                         self._unknown_seen.add(key)
+            self._breaker.record_success()
         except Exception:
             self.poll_failures += 1
             self._obs_poll_failures.inc()
+            self._breaker.record_failure()
         return values, ts
 
     # ---- dynamic membership (serve --auto-register) ----
@@ -342,9 +373,51 @@ class TcpJsonlSource:
         return values, ts
 
 
-def send_jsonl(address: tuple[str, int], records: list[dict]) -> None:
-    """Producer-side helper (used by tests and demos): push records to a
-    :class:`TcpJsonlSource` listener."""
-    with socket.create_connection(address, timeout=2.0) as s:
-        payload = "".join(json.dumps(r) + "\n" for r in records)
-        s.sendall(payload.encode())
+#: records per sendall — bounds what one mid-stream connection drop can
+#: leave in doubt (the failing batch is retried; earlier batches are known
+#: delivered)
+_SEND_BATCH = 512
+
+
+def send_jsonl(address: tuple[str, int], records: list[dict],
+               retry=None) -> int:
+    """Producer-side helper (tests, demos, soak feeders): push records to
+    a :class:`TcpJsonlSource` listener. Returns the count actually handed
+    to the kernel.
+
+    A listener restart mid-soak used to surface here as a raised
+    ``ConnectionRefusedError`` that killed the producer; now the
+    connection is retried with bounded exponential backoff (`retry`;
+    default 4 attempts, <= ~1 s of total backoff) and the return value
+    says how many records were delivered — the caller decides whether a
+    shortfall is fatal. Delivery is at-least-once across retries: the
+    batch in flight when a connection dropped is resent whole, which is
+    harmless against TcpJsonlSource's latest-value-per-stream semantics.
+    """
+    from rtap_tpu.resilience.policies import Retry
+
+    if retry is None:
+        retry = Retry(attempts=4, base_delay_s=0.05, max_delay_s=0.5,
+                      op="send_jsonl")
+    payloads = [
+        "".join(json.dumps(r) + "\n"
+                for r in records[i:i + _SEND_BATCH]).encode()
+        for i in range(0, len(records), _SEND_BATCH)
+    ]
+    sizes = [min(_SEND_BATCH, len(records) - i)
+             for i in range(0, len(records), _SEND_BATCH)]
+    delivered = 0
+    next_batch = 0
+    for attempt in range(1, retry.attempts + 1):
+        try:
+            with socket.create_connection(address, timeout=2.0) as s:
+                while next_batch < len(payloads):
+                    s.sendall(payloads[next_batch])
+                    delivered += sizes[next_batch]
+                    next_batch += 1
+            return delivered
+        except OSError:
+            if attempt == retry.attempts:
+                return delivered
+            retry.backoff(attempt)
+    return delivered

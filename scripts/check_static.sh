@@ -4,10 +4,12 @@
 #
 #  1. compileall — every rtap_tpu module must at least parse/compile; an
 #     import-time SyntaxError must fail CI even if no test imports the file.
-#  2. print-gate — no bare print( in rtap_tpu/service/: telemetry and
-#     diagnostics go through rtap_tpu.obs (registry instruments, watchdog
-#     events, snapshots) or logging, never ad-hoc stdout lines the harness
-#     would have to scrape back out of logs.
+#  2. print-gate — no bare print( in rtap_tpu/service/, rtap_tpu/obs/, or
+#     rtap_tpu/resilience/: telemetry and diagnostics go through
+#     rtap_tpu.obs (registry instruments, watchdog events, snapshots) or
+#     logging, never ad-hoc stdout lines the harness would have to scrape
+#     back out of logs. The resilience layer doubly so — its whole point
+#     is structured events a machine can act on.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -15,9 +17,10 @@ python -m compileall -q rtap_tpu
 
 # match real calls (start-of-line or non-identifier char before "print("),
 # not occurrences inside words/strings like "fingerprint(" or docs
-if grep -rnE '(^|[^A-Za-z0-9_."'"'"'])print\(' rtap_tpu/service --include='*.py'; then
-  echo "check_static: bare print( in rtap_tpu/service/ — emit through" \
-       "rtap_tpu.obs (or logging) instead" >&2
+if grep -rnE '(^|[^A-Za-z0-9_."'"'"'])print\(' \
+     rtap_tpu/service rtap_tpu/obs rtap_tpu/resilience --include='*.py'; then
+  echo "check_static: bare print( in rtap_tpu/{service,obs,resilience}/ —" \
+       "emit through rtap_tpu.obs (or logging) instead" >&2
   exit 1
 fi
 

@@ -13,6 +13,10 @@ the virtual device count can be set here.
 """
 
 import os
+import threading
+import time
+
+import pytest
 
 _xla = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _xla:
@@ -21,3 +25,30 @@ if "xla_force_host_platform_device_count" not in _xla:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_nondaemon_threads():
+    """Every test must join its non-daemon threads (ISSUE 2 CI satellite).
+
+    Hung-thread regressions are exactly what chaos/serve runs produce —
+    a dispatch pool whose shutdown path was skipped on a fault, a wedged
+    producer — and a leaked non-daemon thread hangs the whole pytest
+    process at exit, which CI reports as a timeout instead of the guilty
+    test. A short grace period lets orderly shutdowns (pool.shutdown,
+    server close) finish; daemon threads (listeners, watchers) are
+    exempt by construction."""
+    before = set(threading.enumerate())
+    yield
+    deadline = time.time() + 2.0
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon]
+        if not leaked:
+            return
+        if time.time() > deadline:
+            raise AssertionError(
+                f"test leaked non-daemon thread(s): "
+                f"{[t.name for t in leaked]} — these hang pytest at exit "
+                "(join them or mark them daemon)")
+        time.sleep(0.05)

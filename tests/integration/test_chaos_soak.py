@@ -1,0 +1,38 @@
+"""scripts/chaos_soak.py through the real CLI: seeded schedule, live run,
+machine-checked silent-gap verdict, reproducible digest (ISSUE 2
+acceptance: `--seed N` is a full reproducer; a silently-unscored stream
+exits non-zero)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENV = {**os.environ, "RTAP_FORCE_CPU": "1"}
+
+
+def test_chaos_soak_runs_verified_and_digest_is_seed_stable(tmp_path):
+    out = tmp_path / "report.json"
+    p = subprocess.run(
+        [sys.executable, "scripts/chaos_soak.py", "--seed", "3",
+         "--streams", "6", "--group-size", "2", "--ticks", "40",
+         "--cadence", "0.02", "--rate", "0.12", "--backend", "cpu",
+         "--workdir", str(tmp_path / "wd"), "--out", str(out)],
+        cwd=REPO, env=ENV, capture_output=True, text=True, timeout=420,
+    )
+    assert p.returncode == 0, p.stderr[-3000:]
+    report = json.loads(out.read_text())
+    assert report["verified"] and report["failures"] == []
+    assert report["stats"]["ticks"] == 40
+    # the digest is a pure function of the seed + shape: recompute it
+    # here and pin the cross-process stability --seed promises
+    from rtap_tpu.resilience import ChaosSpec
+
+    expect = ChaosSpec.generate(seed=3, n_ticks=40, n_groups=3,
+                                rate=0.12).digest()
+    assert report["schedule_digest"] == expect
+    # every scheduled fault that fired is logged with its tick
+    for inj in report["faults_injected"]:
+        assert 0 <= inj["tick"] < 40 and "kind" in inj

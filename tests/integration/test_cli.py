@@ -125,6 +125,54 @@ def test_serve_tcp_scores_pushed_records(tmp_path):
     assert "latency_p50_ms" in stats
 
 
+def test_serve_rejects_bad_chaos_spec(tmp_path):
+    """A malformed --chaos-spec is a usage error caught BEFORE any
+    listener or registry exists — no half-started serve to clean up."""
+    bad = tmp_path / "chaos.json"
+    bad.write_text('{"faults": [{"kind": "meteor_strike", "tick": 0}]}')
+    p = run_cli("serve", "--streams", "a", "--backend", "cpu",
+                "--chaos-spec", str(bad))
+    assert p.returncode == 2
+    assert "bad --chaos-spec" in p.stderr
+
+
+def test_serve_rejects_bad_degrade_params():
+    """Invalid --degrade knobs are a usage error (exit 2 + message), not
+    a traceback — same contract as every other serve flag."""
+    p = run_cli("serve", "--streams", "a", "--backend", "cpu",
+                "--degrade", "--degrade-after", "11")
+    assert p.returncode == 2
+    assert "bad --degrade parameters" in p.stderr
+
+
+def test_serve_chaos_spec_quarantines_and_survives(tmp_path):
+    """serve --chaos-spec end to end: a scripted dispatch exception
+    quarantines its group mid-serve; the process exits 0 with the
+    quarantine in its stats line and the event on the alert stream."""
+    spec = tmp_path / "chaos.json"
+    spec.write_text(json.dumps({"seed": 7, "faults": [
+        {"kind": "dispatch_exception", "tick": 2, "group": 1},
+        {"kind": "source_timeout", "tick": 1},
+    ]}))
+    alerts = tmp_path / "alerts.jsonl"
+    # two single-stream groups; no feeder (the TCP source yields NaN
+    # ticks, the documented missing-sample path)
+    p = run_cli("serve", "--streams", "a,b", "--group-size", "1",
+                "--ticks", "5", "--cadence", "0.05", "--backend", "cpu",
+                "--alerts", str(alerts), "--chaos-spec", str(spec))
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "chaos spec loaded (2 faults" in p.stderr
+    stats = json.loads(p.stdout.strip().splitlines()[-1])
+    assert stats["ticks"] == 5
+    # group 1 scored ticks 0-1 then quarantined; group 0 never skipped one
+    assert stats["scored_by_group"] == [5, 2]
+    assert stats["quarantine_log"][0]["group"] == 1
+    assert stats["chaos_injected"] == 2
+    events = [json.loads(line) for line in alerts.read_text().splitlines()
+              if line.startswith('{"event"')]
+    assert "group_quarantined" in {e["event"] for e in events}
+
+
 def test_nab_command_end_to_end(tmp_path):
     """`python -m rtap_tpu nab` — the SURVEY §6 drop-in drill: run the
     committed NAB-layout stand-in corpus (truncated + width-scaled for CPU

@@ -1,0 +1,175 @@
+"""Checkpoint FAILURE paths (ISSUE 2 satellite): crash-mid-save residue
+recovery/cleanup, corrupt/truncated meta.json, config-mismatch resume —
+each must fail loudly (or recover explicitly) without ever touching the
+good checkpoint. The happy path lives in tests/unit/test_checkpoint.py."""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import cluster_preset, scaled_cluster_preset
+from rtap_tpu.obs import get_registry
+from rtap_tpu.service.checkpoint import (
+    _recover_residue,
+    load_group,
+    save_group,
+    validate_resume,
+)
+from rtap_tpu.service.registry import StreamGroup
+
+
+def _group(ticks=3, cfg=None):
+    grp = StreamGroup(cfg or cluster_preset(), ["a", "b"], backend="cpu")
+    for i in range(ticks):
+        grp.tick(np.array([1.0 + i, 2.0 + i], np.float32),
+                 1_700_000_000 + i)
+    return grp
+
+
+def _dir_signature(path):
+    """Every file under `path` with its mtime — 'untouched' means equal."""
+    return sorted((str(p.relative_to(path)), p.stat().st_mtime_ns)
+                  for p in path.rglob("*"))
+
+
+# ---- crash-mid-save residue ----------------------------------------
+
+
+def test_recover_residue_renames_complete_old_sibling(tmp_path):
+    """Crash window: old checkpoint renamed aside, tmp not yet renamed in
+    (or lost). load_group must recover the complete .old-* sibling."""
+    grp = _group(ticks=4)
+    save_group(grp, tmp_path / "g")
+    # simulate the crash: the swap moved the good dir aside and died
+    (tmp_path / "g").rename(tmp_path / ".g.old-deadbeef")
+    back = load_group(tmp_path / "g")
+    assert back.ticks == 4
+    assert (tmp_path / "g" / "meta.json").exists()
+    assert not (tmp_path / ".g.old-deadbeef").exists()
+
+
+def test_recover_residue_prefers_newest_and_ignores_incomplete(tmp_path):
+    grp = _group(ticks=2)
+    save_group(grp, tmp_path / "g")
+    grp.tick(np.array([9.0, 9.0], np.float32), 1_700_000_099)
+    save_group(grp, tmp_path / "g2")
+    # two residue candidates: an INCOMPLETE tmp (no meta.json — the
+    # completeness marker) and a complete old; only the complete one counts
+    (tmp_path / ".g.tmp-junk").mkdir()
+    (tmp_path / "g2").rename(tmp_path / ".g.old-newer")
+    shutil.rmtree(tmp_path / "g")
+    got = _recover_residue(tmp_path / "g")
+    assert got == tmp_path / "g"
+    assert load_group(tmp_path / "g").ticks == 3  # the newer candidate
+    assert (tmp_path / ".g.tmp-junk").exists()  # incomplete: not touched
+
+
+def test_recover_residue_noop_when_checkpoint_intact(tmp_path):
+    grp = _group()
+    save_group(grp, tmp_path / "g")
+    (tmp_path / ".g.old-stale").mkdir()  # stale residue, no meta.json
+    sig = _dir_signature(tmp_path / "g")
+    assert _recover_residue(tmp_path / "g") == tmp_path / "g"
+    assert _dir_signature(tmp_path / "g") == sig  # untouched
+
+
+def test_next_save_sweeps_prior_residue_only_after_landing(tmp_path):
+    grp = _group()
+    save_group(grp, tmp_path / "g")
+    (tmp_path / ".g.tmp-crashed").mkdir()
+    (tmp_path / ".g.old-crashed").mkdir()
+    grp.tick(np.array([3.0, 4.0], np.float32), 1_700_000_050)
+    save_group(grp, tmp_path / "g")  # lands, then sweeps
+    residue = [p.name for p in tmp_path.iterdir() if p.name != "g"]
+    assert residue == [], residue
+    assert load_group(tmp_path / "g").ticks == 4
+
+
+# ---- corrupt / truncated meta.json ---------------------------------
+
+
+def test_corrupt_meta_fails_loudly(tmp_path):
+    grp = _group()
+    save_group(grp, tmp_path / "g")
+    (tmp_path / "g" / "meta.json").write_text("not json {{{")
+    with pytest.raises(json.JSONDecodeError):
+        load_group(tmp_path / "g")
+
+
+def test_truncated_meta_fails_loudly_and_good_sibling_untouched(tmp_path):
+    grp = _group(ticks=5)
+    save_group(grp, tmp_path / "good")
+    save_group(grp, tmp_path / "bad")
+    meta = (tmp_path / "bad" / "meta.json").read_text()
+    (tmp_path / "bad" / "meta.json").write_text(meta[: len(meta) // 2])
+    sig = _dir_signature(tmp_path / "good")
+    with pytest.raises(json.JSONDecodeError):
+        load_group(tmp_path / "bad")
+    # the failure touched nothing else: the good checkpoint still loads
+    assert _dir_signature(tmp_path / "good") == sig
+    assert load_group(tmp_path / "good").ticks == 5
+
+
+def test_missing_meta_without_residue_fails_loudly(tmp_path):
+    grp = _group()
+    save_group(grp, tmp_path / "g")
+    (tmp_path / "g" / "meta.json").unlink()
+    with pytest.raises(FileNotFoundError):
+        load_group(tmp_path / "g")
+
+
+# ---- resume config mismatch ----------------------------------------
+
+
+def test_resume_config_mismatch_fails_without_touching_checkpoint(tmp_path):
+    grp = _group(ticks=4)
+    save_group(grp, tmp_path / "g")
+    sig = _dir_signature(tmp_path / "g")
+    resumed = load_group(tmp_path / "g")
+    other = StreamGroup(scaled_cluster_preset(32), ["a", "b"],
+                        backend="cpu")
+    with pytest.raises(ValueError, match="disagrees"):
+        validate_resume(resumed, tmp_path / "g", other)
+    # threshold mismatch is the same class of error
+    other2 = StreamGroup(cluster_preset(), ["a", "b"], backend="cpu",
+                         threshold=0.9)
+    with pytest.raises(ValueError, match="threshold"):
+        validate_resume(resumed, tmp_path / "g", other2)
+    # stream-id mismatch too
+    other3 = StreamGroup(cluster_preset(), ["a", "c"], backend="cpu")
+    with pytest.raises(ValueError, match="refusing to resume"):
+        validate_resume(resumed, tmp_path / "g", other3)
+    assert _dir_signature(tmp_path / "g") == sig
+    assert load_group(tmp_path / "g").ticks == 4
+
+
+# ---- failed save leaves the previous checkpoint intact -------------
+
+
+def test_failed_save_leaves_previous_checkpoint_intact(tmp_path,
+                                                       monkeypatch):
+    import orbax.checkpoint as ocp
+
+    grp = _group(ticks=3)
+    save_group(grp, tmp_path / "g")
+    sig = _dir_signature(tmp_path / "g")
+    failures = get_registry().counter(
+        "rtap_obs_checkpoint_save_failures_total")
+    before = failures.value
+    grp.tick(np.array([7.0, 8.0], np.float32), 1_700_000_060)
+
+    def boom(self, *a, **kw):
+        raise OSError(28, "no space left on device")
+
+    monkeypatch.setattr(ocp.PyTreeCheckpointer, "save", boom)
+    with pytest.raises(OSError):
+        save_group(grp, tmp_path / "g")
+    monkeypatch.undo()
+    # the failure was counted, the good checkpoint is bit-untouched, and
+    # no temp residue remains to confuse a later recovery scan
+    assert failures.value - before == 1
+    assert _dir_signature(tmp_path / "g") == sig
+    assert [p.name for p in tmp_path.iterdir()] == ["g"]
+    assert load_group(tmp_path / "g").ticks == 3  # pre-failure state

@@ -1,0 +1,400 @@
+"""rtap_tpu.resilience unit surface: retry/backoff determinism, breaker
+state machine, degradation ladder hysteresis, chaos-spec determinism, and
+the non-fatal IO edges (send_jsonl, AlertWriter) — no serve loop here
+(tests/integration/test_chaos_serve.py drives the loop end to end)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from rtap_tpu.resilience import (
+    ChaosEngine,
+    ChaosSpec,
+    CircuitBreaker,
+    CircuitOpenError,
+    DegradationController,
+    Fault,
+    Retry,
+)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ---- Retry ----------------------------------------------------------
+
+
+def test_retry_is_deterministic_per_seed():
+    a = Retry(attempts=5, base_delay_s=0.1, jitter=0.5, seed=7,
+              sleep=lambda s: None)
+    b = Retry(attempts=5, base_delay_s=0.1, jitter=0.5, seed=7,
+              sleep=lambda s: None)
+    assert [a.delay_for(i) for i in range(1, 5)] == \
+        [b.delay_for(i) for i in range(1, 5)]
+    # and the backoff actually grows exponentially under the cap
+    c = Retry(attempts=5, base_delay_s=0.1, max_delay_s=10.0, jitter=0.0)
+    assert [c.delay_for(i) for i in (1, 2, 3)] == [0.1, 0.2, 0.4]
+
+
+def test_retry_call_retries_then_raises():
+    slept = []
+    r = Retry(attempts=3, base_delay_s=0.01, jitter=0.0, sleep=slept.append)
+    calls = []
+
+    def fail():
+        calls.append(1)
+        raise OSError("nope")
+
+    with pytest.raises(OSError):
+        r.call(fail)
+    assert len(calls) == 3 and len(slept) == 2  # no sleep after the last
+
+
+def test_retry_succeeds_midway_and_filters_exceptions():
+    r = Retry(attempts=3, base_delay_s=0.0, sleep=lambda s: None)
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert r.call(flaky) == "ok"
+    # non-retry_on exceptions propagate immediately (one call, no retry)
+    state["n"] = 0
+
+    def bug():
+        state["n"] += 1
+        raise ValueError("programming error")
+
+    with pytest.raises(ValueError):
+        r.call(bug)
+    assert state["n"] == 1
+
+
+# ---- CircuitBreaker -------------------------------------------------
+
+
+def test_breaker_opens_after_threshold_and_half_open_probes():
+    clk = _Clock()
+    br = CircuitBreaker(fail_threshold=3, cooldown_s=10.0, clock=clk,
+                        name="t1")
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == br.CLOSED and br.allow()
+    br.record_failure()  # third consecutive: open
+    assert br.state == br.OPEN
+    assert not br.allow()  # short-circuited inside the cooldown
+    clk.t = 11.0
+    assert br.allow()  # half-open: one probe admitted
+    assert br.state == br.HALF_OPEN
+    assert not br.allow()  # no second probe before the verdict
+    br.record_failure()  # probe failed: re-open, cooldown restarts
+    assert br.state == br.OPEN and not br.allow()
+    clk.t = 22.0
+    assert br.allow()
+    br.record_success()  # probe landed: closed, counters reset
+    assert br.state == br.CLOSED and br.consecutive_failures == 0
+
+
+def test_breaker_call_raises_circuit_open():
+    clk = _Clock()
+    br = CircuitBreaker(fail_threshold=1, cooldown_s=5.0, clock=clk,
+                        name="t2")
+    with pytest.raises(OSError):
+        br.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    assert br.state == br.OPEN
+    with pytest.raises(CircuitOpenError):
+        br.call(lambda: "never runs")
+    clk.t = 6.0
+    assert br.call(lambda: "ok") == "ok"
+    assert br.state == br.CLOSED
+
+
+# ---- DegradationController -----------------------------------------
+
+
+def test_degradation_ladder_escalates_and_recovers_with_hysteresis():
+    events = []
+    ctl = DegradationController(window=5, degrade_after=2, recover_after=3,
+                                thin_factor=4, widen_factor=2.0,
+                                event_sink=events.append)
+    assert ctl.level == 0 and ctl.learn_allowed(1) and ctl.cadence_scale == 1
+    ctl.observe(0, True)
+    assert ctl.level == 0  # one miss is not a trend
+    ctl.observe(1, True)
+    assert ctl.level == 1  # learn_thin
+    assert ctl.learn_allowed(4) and not ctl.learn_allowed(5)
+    # the escalation cleared the window: the NEXT level needs fresh misses
+    ctl.observe(2, True)
+    assert ctl.level == 1
+    ctl.observe(3, True)
+    assert ctl.level == 2  # score_only
+    assert not ctl.learn_allowed(4)
+    ctl.observe(4, True)
+    ctl.observe(5, True)
+    assert ctl.level == 3 and ctl.cadence_scale == 2.0  # tick_widen
+    # recovery: one level per recover_after consecutive clean ticks
+    for t in range(6, 9):
+        ctl.observe(t, False)
+    assert ctl.level == 2
+    ctl.observe(9, True)  # a miss resets the clean run
+    for t in range(10, 13):
+        ctl.observe(t, False)
+    assert ctl.level == 1
+    kinds = [e["event"] for e in events]
+    assert kinds == ["degraded", "degraded", "degraded", "recovered",
+                     "recovered"]
+    assert events[2] == {"event": "degraded", "tick": 5, "level": 3,
+                         "step": "tick_widen"}
+    assert ctl.stats()["max_level"] == 3
+
+
+def test_degradation_never_escalates_past_the_ladder():
+    ctl = DegradationController(window=3, degrade_after=1, recover_after=99)
+    for t in range(10):
+        ctl.observe(t, True)
+    assert ctl.level == 3
+
+
+# ---- ChaosSpec / ChaosEngine ---------------------------------------
+
+
+def test_chaos_spec_generate_is_seed_deterministic():
+    a = ChaosSpec.generate(seed=42, n_ticks=200, n_groups=4, rate=0.1)
+    b = ChaosSpec.generate(seed=42, n_ticks=200, n_groups=4, rate=0.1)
+    c = ChaosSpec.generate(seed=43, n_ticks=200, n_groups=4, rate=0.1)
+    assert a.to_dict() == b.to_dict() and a.digest() == b.digest()
+    assert a.digest() != c.digest()
+    assert a.faults  # rate 0.1 over 200 ticks: statistically certain
+    # round-trips through the --chaos-spec JSON shape
+    back = ChaosSpec.from_dict(json.loads(json.dumps(a.to_dict())))
+    assert back.digest() == a.digest()
+
+
+def test_chaos_engine_injects_at_scheduled_ticks_only():
+    spec = ChaosSpec(faults=[
+        Fault(kind="dispatch_exception", tick=3, group=1),
+        Fault(kind="source_timeout", tick=2, duration=2, streams=(0,)),
+        Fault(kind="checkpoint_oserror", tick=5),
+    ])
+    eng = ChaosEngine(spec)
+    eng.on_dispatch(0, 3)  # wrong group: no fault
+    eng.on_dispatch(1, 2)  # wrong tick: no fault
+    with pytest.raises(RuntimeError, match="chaos"):
+        eng.on_dispatch(1, 3)
+    with pytest.raises(OSError):
+        eng.on_checkpoint_save(0, 5)  # group None = every group
+
+    def src(tick):
+        return np.array([1.0, 2.0], np.float32), 100 + tick
+
+    wrapped = eng.wrap_source(src)
+    v, _ = wrapped(1)
+    assert not np.isnan(v).any()
+    v, _ = wrapped(2)
+    assert np.isnan(v[0]) and not np.isnan(v[1])  # targeted stream only
+    v, _ = wrapped(3)  # duration 2: still active
+    assert np.isnan(v[0])
+    v, _ = wrapped(4)
+    assert not np.isnan(v).any()
+    assert [e["kind"] for e in eng.injected] == [
+        "dispatch_exception", "checkpoint_oserror", "source_timeout",
+        "source_timeout"]
+
+
+def test_chaos_engine_group_targeted_source_timeout_uses_routing():
+    """A generated source_timeout carries a GROUP, not stream indices;
+    the engine must resolve it through the loop-provided routing so only
+    that group's slice goes NaN (serve --chaos-spec with a generate
+    spec — healthy groups keep bit-identical inputs)."""
+    eng = ChaosEngine(ChaosSpec(faults=[
+        Fault(kind="source_timeout", tick=0, group=1)]))
+    eng.set_group_streams({0: (0, 1), 1: (2, 3)})
+    wrapped = eng.wrap_source(lambda t: (np.ones(4, np.float32), 5))
+    v, _ = wrapped(0)
+    assert np.isnan(v[[2, 3]]).all()
+    assert not np.isnan(v[[0, 1]]).any()
+    # without a mapping (bare StreamGroup callers), whole-vector NaN is
+    # the declared fallback
+    eng2 = ChaosEngine(ChaosSpec(faults=[
+        Fault(kind="source_timeout", tick=0, group=1)]))
+    v2, _ = eng2.wrap_source(lambda t: (np.ones(4, np.float32), 5))(0)
+    assert np.isnan(v2).all()
+
+
+def test_chaos_spec_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        Fault(kind="meteor_strike", tick=0)
+    with pytest.raises(ValueError, match="'faults' OR 'generate'"):
+        ChaosSpec.from_dict({"faults": [], "generate": {"n_ticks": 1}})
+
+
+# ---- send_jsonl bounded retry --------------------------------------
+
+
+def test_send_jsonl_returns_zero_on_dead_listener_without_raising():
+    from rtap_tpu.service.sources import send_jsonl
+
+    fast = Retry(attempts=2, base_delay_s=0.01, jitter=0.0,
+                 op="send_jsonl_test")
+    # port 9 (discard) refuses on loopback in this environment; a raise
+    # here was exactly the mid-soak producer death ISSUE 2 names
+    delivered = send_jsonl(("127.0.0.1", 9),
+                           [{"id": "a", "value": 1.0}], retry=fast)
+    assert delivered == 0
+
+
+def test_send_jsonl_delivers_and_counts():
+    from rtap_tpu.service.sources import TcpJsonlSource, send_jsonl
+
+    ids = ["a", "b"]
+    with TcpJsonlSource(ids) as src:
+        n = send_jsonl(src.address, [
+            {"id": "a", "value": 1.0, "ts": 10},
+            {"id": "b", "value": 2.0, "ts": 11},
+        ])
+        assert n == 2
+        import time
+
+        deadline = time.time() + 2.0
+        got = np.full(2, np.nan, np.float32)
+        while time.time() < deadline and np.isnan(got).any():
+            v, _ = src(0)
+            got = np.where(np.isnan(got), v, got)
+            time.sleep(0.02)
+        np.testing.assert_allclose(got, [1.0, 2.0])
+
+
+# ---- AlertWriter non-fatal sink ------------------------------------
+
+
+class _FlakyFile:
+    """In-memory file that raises OSError while `broken` is True."""
+
+    def __init__(self):
+        self.lines: list[str] = []
+        self.flushes = 0
+        self.broken = False
+
+    def _check(self):
+        if self.broken:
+            raise OSError(28, "no space left on device")
+
+    def write(self, s):
+        self._check()
+        self.lines.append(s)
+
+    def writelines(self, lines):
+        self._check()
+        self.lines.extend(lines)
+
+    def flush(self):
+        self._check()
+        self.flushes += 1
+
+    def close(self):
+        pass
+
+
+def _writer_with(fh, flush_every=1, breaker=None, tmp_path=None):
+    from rtap_tpu.service.alerts import AlertWriter
+
+    w = AlertWriter(str(tmp_path / "a.jsonl"), flush_every=flush_every,
+                    breaker=breaker)
+    w._fh.close()
+    w._fh = fh
+    return w
+
+
+def _emit_one(w, alert=True):
+    return w.emit_batch(["s0"], np.array([100]), np.array([1.0]),
+                        np.array([0.5]), np.array([9.9]),
+                        np.array([alert]))
+
+
+def test_alert_writer_batches_writes_and_honors_flush_cadence(tmp_path):
+    fh = _FlakyFile()
+    w = _writer_with(fh, flush_every=3, tmp_path=tmp_path)
+    for _ in range(6):
+        _emit_one(w)
+    assert len(fh.lines) == 6
+    assert fh.flushes == 2  # once per 3 batches, not per batch
+    # events always flush (rare, load-bearing)
+    w.emit_event({"event": "x"})
+    assert fh.flushes == 3
+
+
+def test_alert_writer_survives_full_disk_and_recovers(tmp_path):
+    clk = _Clock()
+    br = CircuitBreaker(fail_threshold=2, cooldown_s=5.0, clock=clk,
+                        name="alert_sink_test")
+    fh = _FlakyFile()
+    w = _writer_with(fh, breaker=br, tmp_path=tmp_path)
+    _emit_one(w)
+    assert len(fh.lines) == 1 and w.dropped == 0
+    fh.broken = True  # the disk fills
+    _emit_one(w)  # failure 1 (after its immediate retry)
+    _emit_one(w)  # failure 2: breaker opens -> sink quarantined
+    assert w.dropped == 2 and w.sink_quarantines == 1
+    assert br.state == br.OPEN
+    _emit_one(w)  # quarantined: dropped with zero write attempts
+    assert w.dropped == 3
+    # alert COUNTING is sink-independent: scoring never noticed
+    assert w.count == 4
+    fh.broken = False  # space freed
+    clk.t = 6.0  # cooldown passed: next batch is the half-open probe
+    _emit_one(w)
+    assert br.state == br.CLOSED
+    # the probe line landed, plus the restored event announcing the gap
+    assert any('"event": "alert_sink_restored"' in ln for ln in fh.lines)
+    assert sum('"stream"' in ln for ln in fh.lines) == 2
+    w.close()
+
+
+def test_alert_writer_none_path_still_counts(tmp_path):
+    from rtap_tpu.service.alerts import AlertWriter
+
+    w = AlertWriter(None)
+    assert _emit_one(w) == 1
+    assert w.count == 1 and w.dropped == 0
+    w.close()
+
+
+def test_alert_writer_rejects_bad_flush_every():
+    from rtap_tpu.service.alerts import AlertWriter
+
+    with pytest.raises(ValueError, match="flush_every"):
+        AlertWriter(None, flush_every=0)
+
+
+# ---- HttpPollSource breaker ----------------------------------------
+
+
+def test_http_poll_breaker_short_circuits_dead_endpoint():
+    from rtap_tpu.service.sources import HttpPollSource
+
+    clk = _Clock()
+    br = CircuitBreaker(fail_threshold=2, cooldown_s=30.0, clock=clk,
+                        name="http_poll_test")
+    fast = Retry(attempts=1, base_delay_s=0.0, op="http_poll_test")
+    src = HttpPollSource("http://127.0.0.1:9/nothing", ["a"], timeout_s=0.2,
+                         retry=fast, breaker=br)
+    src(0)
+    src(1)  # second consecutive failure: breaker opens
+    assert src.poll_failures == 2 and br.state == br.OPEN
+    import time
+
+    t0 = time.perf_counter()
+    v, ts = src(2)  # short-circuited: NaN immediately, no connect wait
+    assert time.perf_counter() - t0 < 0.05
+    assert np.isnan(v).all() and ts > 0
+    assert src.polls_short_circuited == 1
+    assert src.poll_failures == 2  # no attempt, no new failure
