@@ -99,6 +99,11 @@ ATTEMPTS: list[tuple[int, int, dict]] = [
     # (the r4 compact/forward candidate rungs were retired after the
     # 2026-08-01 window measured them -58%/-89% — hw_results/bench.log +
     # the profile postmortems are the committed evidence)
+    # r6 candidate: the Pallas TM-learning megakernel (ops/pallas_tm.py,
+    # parity-pinned). A Mosaic compile failure or VMEM overrun costs only
+    # this attempt's subprocess budget — exactly the isolation the ladder
+    # exists for; it cannot become a default without winning here.
+    (256, 64, {"RTAP_TM_SCATTER": "pallas"}),
     (256, 256, {}),
     (512, 128, {}),
     (2048, 64, {}),
@@ -205,6 +210,68 @@ CACHED_EXIT = 4  # emitted-but-cached: distinct rc so exit-code-only consumers
 # can tell a dead-tunnel LKG fallback from a fresh measurement (the JSON line
 # also carries "cached": true; ADVICE.md round 3)
 
+# Full-rate trend series (ISSUE 3 satellite): every fresh bench appends
+# {round, full_rate, headline} here so a flat-since-r04 full-rate line is
+# visible IN-REPO, not only in the verdict. Shares the artifact with
+# scripts/trend_rung.py (which owns the like-for-like protocol study);
+# this series lives under its "rounds" key.
+TREND_PATH = os.environ.get("BENCH_TREND_PATH") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "reports", "trend_rung.json")
+
+
+def _infer_round() -> str | None:
+    """Round label for the trend entry: $BENCH_ROUND when the harness sets
+    it, else one past the newest committed BENCH_rNN.json artifact (the
+    driver's own numbering) — so unattended hw_session runs still label
+    their entries instead of appending null-keyed rows."""
+    env = os.environ.get("BENCH_ROUND")
+    if env:
+        return env
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = [int(m.group(1)) for f in os.listdir(here)
+              if (m := re.fullmatch(r"BENCH_r(\d+)\.json", f))]
+    return f"r{max(rounds) + 1:02d}" if rounds else None
+
+
+def _append_trend(best: dict) -> None:
+    """Append this run's {round, full_rate, headline} to the trend artifact
+    (fresh results only — _finish gates on that; best-effort, a corrupt
+    artifact or read-only FS must not kill the bench emission)."""
+    if os.environ.get("BENCH_ALLOW_CPU") == "1" \
+            and not os.environ.get("BENCH_TREND_PATH"):
+        return  # CPU test drives must never pollute the committed series
+    try:
+        data = {}
+        if os.path.exists(TREND_PATH):
+            with open(TREND_PATH) as f:
+                data = json.load(f)
+        if not isinstance(data, dict):
+            # a mangled artifact must not stop the series (or the bench):
+            # start a fresh object; the old content is in git history
+            data = {}
+        data.setdefault("rounds", []).append({
+            "round": _infer_round(),
+            "headline": round(best["value"], 1),
+            "headline_modes": best.get("modes"),
+            "full_rate": (round(_BEST_FULL["value"], 1)
+                          if _BEST_FULL is not None else None),
+            # a None full_rate means every default-config rung failed this
+            # run — the trend must show the hole, not silently skip it
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        })
+        tmp = TREND_PATH + ".tmp"
+        os.makedirs(os.path.dirname(TREND_PATH), exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2)
+        os.replace(tmp, TREND_PATH)
+    except (OSError, ValueError) as e:
+        # ValueError covers a corrupt JSON artifact: the trend is
+        # best-effort bookkeeping and must never block the emission path
+        # (this runs inside _finish, including the signal handler)
+        log(f"bench: could not append trend entry: {e}")
+
 
 def emit(best: dict | None) -> int | None:
     """Print the single result line; returns the process exit code (0 fresh,
@@ -305,6 +372,7 @@ def _finish(best: dict | None, tunnel_down: bool = False) -> None:
     carries "cached": true either way."""
     if best is not None:
         _store_lkg(best)
+        _append_trend(best)
     code = emit(best)
     if tunnel_down and best is None:
         # regardless of whether an LKG line could be emitted (code is

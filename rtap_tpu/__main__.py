@@ -16,7 +16,8 @@ over the library:
 scripts/) since they are driver/measurement surfaces, not operator ones.
 
 Every command honors ``RTAP_FORCE_CPU=1`` (tunnel-independent runs) and the
-kernel strategy env knobs (RTAP_TM_SCATTER / RTAP_TM_LAYOUT / RTAP_TM_PALLAS).
+kernel strategy env knobs (RTAP_TM_SCATTER / RTAP_TM_LAYOUT / RTAP_TM_SWEEP
+/ RTAP_TM_DENDRITE — docs/KERNELS.md catalogs them).
 """
 
 from __future__ import annotations
@@ -179,7 +180,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                           chaos=chaos,
                           degradation=degradation,
                           quarantine_restore_after=args.quarantine_restore_after,
-                          alert_flush_every=args.alert_flush_every)
+                          alert_flush_every=args.alert_flush_every,
+                          aot_warmup=args.aot_warmup)
     finally:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
@@ -481,6 +483,13 @@ def main(argv: list[str] | None = None) -> int:
                         "permanent for the run). The group loses the ticks "
                         "since its last save; every other group's cadence "
                         "is untouched either way")
+    p.add_argument("--aot-warmup", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="compile every knowable (chunk length, config, "
+                        "learn-phase) program before tick 0 (service/aot.py) "
+                        "so no XLA compile lands inside a scored tick — the "
+                        "1h 100k soak's 9 missed deadlines were all warm-up "
+                        "compiles; --no-aot-warmup restores lazy compilation")
     p.add_argument("--alert-flush-every", type=int, default=1,
                    help="flush the alert JSONL sink once per N batches "
                         "instead of per batch (1 = per batch, the crash-"

@@ -1,169 +1,368 @@
-"""Pallas TPU kernel: fused TM dendrite-activity pass.
+"""Pallas TPU megakernel: the WHOLE TM learning pass fused in VMEM.
 
-The dendrite pass — "for every synapse, is its presynaptic cell active, and
-is it connected?" followed by per-segment counts — runs EVERY tick on the
-full [C, K, S, M] pools (inference and learning alike; SURVEY.md §3.2 TM
-hot loop). The XLA formulation in tm_tpu.py materializes several
-pool-shaped intermediates ([..., Ac] compare, bit probe, two boolean
-masks) between HBM round-trips; this kernel fuses the whole pass in VMEM:
+Round-4 measured the dendrite-only Pallas kernel LOSING to XLA (-13%,
+SCALING.md silicon A/B): hand-scheduling ONE already-cheap pass just added
+dispatch edges around it. The round-6 profile (reports/profile_r06.json,
+scripts/profile_step.py --report) places ~99% of a learn tick inside the TM
+learning pass while the chip is ~90% idle (roofline latency_bound_factor
+10.0) — the cost is op-dispatch/serialization BETWEEN the pass's XLA
+regions, not arithmetic. This kernel therefore fuses the granularity the
+round-4 attempt got wrong: dendrite activity + workspace movement +
+reinforce/grow — the entire per-tick pool traversal — as ONE kernel whose
+intermediates never leave VMEM:
 
-    synapse activity:  msk = Σ_i where(presyn//K == col_ids[i], col_masks[i])
-                       act = presyn >= 0  &  (msk >> (presyn % K)) & 1
-    segment counts:    pot  = Σ_M act            (0/1 f32 matmul on the MXU
-                       conn = Σ_M act & (perm >= thr)   with a block-diagonal
-                                                        reduction matrix)
+    alloc     clear the burst-new segment's synapse slots
+    reinforce +inc toward prev-active presynaptic cells, -dec elsewhere,
+              on the learning segments
+    grow      add winner-cell synapses ascending, evicting the weakest
+              occupied slots when free slots run short
+    punish    -pdec on matching segments in non-active columns
+    death     presyn := -1 at permanence <= 0; per-segment synapse counts
+    dendrite  packed-column activity + connected/potential counts for t+1
 
-Layout: the pools flatten to [C, K*S*M] (rows = columns, lanes = synapses),
-which keeps the VPU lanes dense for any preset; the Σ_M reduction is a
-[C, K*S*M] x [K*S*M, K*S] matmul whose operand is a static 0/1
-block-diagonal matrix — exact integer counts in f32 (counts <= M < 2^24).
+Key design choice vs the XLA formulation (ops/tm_tpu.py): NO column-compact
+workspace. The gather→learn→scatter movement exists to avoid full-pool HBM
+round trips; with the pool VMEM-resident the dense traversal is free of
+exactly that cost, so the kernel runs per-segment lanes [n_seg, M] directly
+and the learning decisions arrive as a per-segment metadata array. All
+DECISION logic (column categorization, allocation targets, capacity
+truncation) stays in XLA on the [C, K, S]-scale tensors — it is 32 KB-scale
+work; the kernel owns the MB-scale pool traversal.
 
-Semantics are bit-identical to `tm_tpu._presyn_active_packed` + the count
-reductions (asserted by tests/parity/test_pallas_tm.py, which runs the
-kernel in interpreter mode on CPU). OFF by default: enable with
-RTAP_TM_PALLAS=1 (or set USE_PALLAS) once profiled on silicon — shipping an
-unmeasured kernel as the default would repeat the round-1 mistake of
-hand-scheduling what XLA already does well.
+Semantics are bit-identical to the default XLA path (RTAP_TM_SCATTER=matmul
+with dense sweeps): the workspace truncation (first col_cap active columns,
+first learn_cap learning segments in ascending (c, k, s) order) is
+reproduced exactly by `tm_learn_pallas`'s mask prep, and every arithmetic
+expression mirrors tm_tpu.py's f32 forms (integer-valued in quantized
+domains, exact below 2^24). Asserted by tests/parity/test_pallas_tm.py via
+interpreter mode on CPU, across the perm domains and under vmap.
 
-Interpreter-mode caveat: off-TPU the kernel runs through the Pallas
-interpreter, which is orders of magnitude slower to compile/run than the
-XLA formulation — fine for the small parity tests, pathological for large
-CPU replays (a G=256 x T=64 chunk fails to even compile within minutes).
-Only enable the flag on real TPU hardware or in small tests.
+Strategy wiring: RTAP_TM_SCATTER=pallas (ops/tm_tpu.py mode table). OFF by
+default — shipping an unmeasured kernel as the default would repeat the
+round-1 mistake; scripts/hw_session.py carries the silicon A/B steps
+(profile_mega*) and the measured winner becomes the default, same protocol
+as the r4 flat/matmul flip. Incompatible with RTAP_TM_DENDRITE=forward
+(the kernel computes dendrite counts itself) and RTAP_TM_SWEEP=compact
+(it fuses the DENSE punish/death semantics); tm_step rejects both combos
+loudly. Inference ticks (learn=False) keep the XLA dendrite path — the
+learning pass is ~99% of the tick, the dendrite pass is already cheap.
+
+Known v1 caveats for the silicon A/B (documented, not guessed around):
+the [n_seg, M] layout leaves M (<= 32) lanes per row, which the TPU tiler
+pads to 128 — VMEM cost ~128/M x the dense bytes (~43 MB-equivalent at the
+cluster preset's M=12: still inside the guard only for sub-preset shapes;
+measured viability on silicon decides whether v2 re-blocks lanes to
+[C, K*S*M]). The winner-loop unrolls W = col_cap * cells_per_column times —
+fine at the cluster preset (80), guarded off at NAB scale (1280).
+
+Interpreter-mode caveat (same as the retired dendrite kernel): off-TPU the
+kernel runs the Pallas interpreter, orders of magnitude slower than XLA —
+fine for small parity tests, pathological beyond them; the guards refuse
+large shapes instead of hanging.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# None = read RTAP_TM_PALLAS env (default off); tests set True/False directly.
-USE_PALLAS: bool | None = None
-
-# The whole per-stream pool must fit VMEM (no grid/blocking in this v1
-# kernel): presyn i32 + perm f32 + reduce matrix + outputs, ~16 MiB budget.
+# The whole per-stream pool plus temporaries must fit VMEM (no grid/blocking
+# in this v1 kernel), with lane padding to 128 accounted: ~12 MiB budget.
 _VMEM_BUDGET_BYTES = 12 * 1024 * 1024
 # Interpreter mode (off-TPU) is for parity tests only; refuse big shapes
 # instead of silently hanging for minutes.
 _INTERPRET_MAX_SYNAPSES = 1 << 18
+# The grow pass unrolls over the winner list twice; beyond this the trace
+# (and the Mosaic schedule) blows up — the NAB preset (W=1280) is refused.
+_MAX_WINNER_UNROLL = 512
+
+_META_COLS = 5  # learn, alloc, grow, punish, n_grow
 
 
-def use_pallas() -> bool:
-    """Whether tm_step routes the dendrite pass through the Pallas kernel.
+def _mega_kernel(K, M, N, W, Ac, consts,
+                 presyn_ref, perm_ref, meta_ref,
+                 pids_ref, pmasks_ref, wids_ref, aids_ref, amasks_ref,
+                 presyn_out, perm_out, nsyn_out, conn_out, pot_out):
+    """One stream's full TM learning pass on [n_seg, M] pools (see module
+    docstring for the stage list). `consts` are the permanence-domain
+    constants (trace-time floats); `pdec` None skips the punish stage."""
+    p_inc, p_dec, p_init, p_one, p_zero, p_thr, pdec = consts
+    presyn = presyn_ref[:]  # [n_seg, M] i32
+    perm = perm_ref[:]  # [n_seg, M] f32 (domain values)
+    meta = meta_ref[:]  # [n_seg, 5] i32
+    learn = meta[:, 0:1] > 0
+    alloc = meta[:, 1:2] > 0
+    grow = meta[:, 2:3] > 0
+    punish = meta[:, 3:4] > 0
+    n_grow = meta[:, 4:5]
 
-    NOTE: consulted at TRACE time — a compiled tm_step/group_step keeps
-    whichever path it was traced with. Toggle via :func:`set_use_pallas`
-    (which drops jit caches) rather than mutating the env mid-process.
-    """
-    if USE_PALLAS is not None:
-        return USE_PALLAS
-    return os.environ.get("RTAP_TM_PALLAS", "0") not in ("", "0")
+    # --- burst-new allocation: clear the allocated segment's slots ---
+    presyn = jnp.where(alloc, -1, presyn)
+    perm = jnp.where(alloc, 0.0, perm)
+
+    def packed_act(pres, ids_ref, masks_ref):
+        # packed-column membership (tm_tpu._presyn_active_packed, unrolled
+        # over the tiny Ac like the r4 dendrite kernel)
+        c_pre = pres // K  # -1 -> -1 (floor): never equals a valid col id
+        k_pre = pres % K  # -1 -> K-1, masked by pres >= 0 below
+        msk = jnp.zeros_like(pres)
+        for i in range(Ac):
+            msk = msk + jnp.where(c_pre == ids_ref[0, i], masks_ref[0, i], 0)
+        return (pres >= 0) & (((msk >> k_pre) & 1) > 0)
+
+    # --- reinforce learning segments toward prev-active cells ---
+    act = packed_act(presyn, pids_ref, pmasks_ref)
+    exists = presyn >= 0
+    perm = jnp.where(
+        learn,
+        jnp.clip(perm + p_inc * act - p_dec * (exists & ~act), 0.0, p_one),
+        perm,
+    )
+
+    # --- grow pass 1: eligible-winner count per segment (eligibility reads
+    # the PRE-eviction pool, exactly like _grow_compact's membership) ---
+    presyn_pre = presyn
+    n_seg = presyn.shape[0]
+    n_elig = jnp.zeros((n_seg, 1), jnp.int32)
+    for w in range(W):
+        wid = wids_ref[0, w]
+        already = jnp.sum(
+            (presyn_pre == wid).astype(jnp.int32), axis=1, keepdims=True) > 0
+        n_elig = n_elig + ((wid < N) & ~already).astype(jnp.int32)
+    n_new = jnp.minimum(n_elig, jnp.maximum(n_grow, 0))
+    n_new = jnp.where(grow, n_new, 0)  # non-growing segments add nothing
+
+    # --- evict weakest occupied synapses when free slots run short:
+    # stable ascending rank by (permanence, slot), compare-count form ---
+    occupied = presyn >= 0
+    n_free = M - jnp.sum(occupied.astype(jnp.int32), axis=1, keepdims=True)
+    short = n_new - n_free
+    key = jnp.where(occupied, perm, jnp.float32(jnp.inf))
+    slot = jax.lax.broadcasted_iota(jnp.int32, (1, M), 1)
+    ranks = jnp.zeros((n_seg, M), jnp.int32)
+    for mp in range(M):
+        kmp = key[:, mp:mp + 1]
+        ranks = ranks + ((kmp < key) | ((kmp == key) & (mp < slot))).astype(jnp.int32)
+    evict = occupied & (ranks < short)
+    presyn = jnp.where(evict, -1, presyn)
+    perm = jnp.where(evict, 0.0, perm)
+
+    # --- fill free slots ascending with chosen winners ascending ---
+    free = presyn < 0
+    frank_cols = []  # 0-based rank of each slot among free slots
+    accf = jnp.zeros((n_seg, 1), jnp.int32)
+    for m in range(M):
+        frank_cols.append(accf)
+        accf = accf + free[:, m:m + 1].astype(jnp.int32)
+    frank = jnp.concatenate(frank_cols, axis=1)
+    fill = jnp.zeros((n_seg, M), jnp.int32)
+    accr = jnp.zeros((n_seg, 1), jnp.int32)
+    for w in range(W):
+        wid = wids_ref[0, w]
+        already = jnp.sum(
+            (presyn_pre == wid).astype(jnp.int32), axis=1, keepdims=True) > 0
+        elig = (wid < N) & ~already
+        rank_w = accr + elig.astype(jnp.int32)  # 1-based among eligible
+        accr = rank_w
+        chosen = elig & (rank_w <= n_grow)
+        fill = jnp.where(chosen & (frank == rank_w - 1), wid, fill)
+    assign = free & (frank < n_new) & grow
+    presyn = jnp.where(assign, fill, presyn)
+    perm = jnp.where(assign, p_init, perm)
+
+    # --- punish matching segments in non-active columns (dense sweep
+    # semantics; punished columns are disjoint from learning columns, so
+    # the pre-grow membership `act` is still exact there) ---
+    if pdec is not None:
+        perm = jnp.where(punish & act, jnp.maximum(perm - pdec, p_zero), perm)
+
+    # --- synapse death at permanence <= 0, per-segment occupancy ---
+    dead = (presyn >= 0) & (perm <= p_zero)
+    presyn = jnp.where(dead, -1, presyn)
+    nsyn = jnp.sum((presyn >= 0).astype(jnp.int32), axis=1, keepdims=True)
+
+    # --- dendrite activity for t+1 on the updated pools ---
+    dact = packed_act(presyn, aids_ref, amasks_ref)
+    pot = jnp.sum(dact.astype(jnp.int32), axis=1, keepdims=True)
+    conn = jnp.sum((dact & (perm >= p_thr)).astype(jnp.int32),
+                   axis=1, keepdims=True)
+
+    presyn_out[:] = presyn
+    perm_out[:] = perm
+    nsyn_out[:] = nsyn
+    conn_out[:] = conn
+    pot_out[:] = pot
 
 
-def set_use_pallas(on: bool | None) -> None:
-    """Set the kernel flag AND clear jit caches so already-traced step
-    functions re-trace with the new path (the flag is a trace-time constant,
-    not a jit cache key)."""
-    global USE_PALLAS
-    USE_PALLAS = on
-    jax.clear_caches()
-
-
-@functools.lru_cache(maxsize=None)
-def _reduce_matrix(ks: int, m: int) -> np.ndarray:
-    """Block-diagonal 0/1 [ks*m, ks] f32: column s sums synapse lanes
-    [s*m, (s+1)*m) — the Σ_M reduction as one MXU matmul."""
-    r = np.zeros((ks * m, ks), np.float32)
-    for s in range(ks):
-        r[s * m : (s + 1) * m, s] = 1.0
-    return r
-
-
-def _kernel(K: int, thr: float, Ac: int,
-            presyn_ref, perm_ref, ids_ref, masks_ref, red_ref,
-            conn_ref, pot_ref):
-    presyn = presyn_ref[:]  # [C, K*S*M] i32
-    c_pre = presyn // K  # -1 -> -1 (floor): never equals a valid col id
-    k_pre = presyn % K  # -1 -> K-1, masked by presyn >= 0 below
-    msk = jnp.zeros_like(presyn)
-    for i in range(Ac):  # static unroll: Ac = col_cap is tiny (10-40)
-        msk = msk + jnp.where(c_pre == ids_ref[0, i], masks_ref[0, i], 0)
-    syn_act = (presyn >= 0) & (((msk >> k_pre) & 1) > 0)
-    pot_f = syn_act.astype(jnp.float32)
-    conn_f = jnp.where(perm_ref[:] >= thr, pot_f, 0.0)
-    red = red_ref[:]
-    conn_ref[:] = jnp.round(
-        jnp.dot(conn_f, red, preferred_element_type=jnp.float32)
-    ).astype(jnp.int32)
-    pot_ref[:] = jnp.round(
-        jnp.dot(pot_f, red, preferred_element_type=jnp.float32)
-    ).astype(jnp.int32)
-
-
-def dendrite_activity_pallas(
-    presyn: jnp.ndarray,  # [C, K, S, M] int (any width; -1 = empty)
-    syn_perm: jnp.ndarray,  # [C, K, S, M] storage domain
-    col_ids: jnp.ndarray,  # [Ac] i32 active column ids (C fills)
-    col_masks: jnp.ndarray,  # [Ac] i32 packed K-bit cell masks
-    connected_thr,  # python scalar in the storage domain
-    interpret: bool | None = None,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """-> (conn_count [C, K, S] i32, pot_count [C, K, S] i32).
-
-    `interpret` defaults to True off-TPU (CPU tests run the interpreter);
-    pass False only on real TPU.
-    """
-    C, K, S, M = presyn.shape
-    Ac = col_ids.shape[0]
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+def _guard_shapes(C, K, S, M, W, interpret):
     n_syn = C * K * S * M
     if interpret and n_syn > _INTERPRET_MAX_SYNAPSES:
         raise ValueError(
-            f"Pallas dendrite kernel in INTERPRETER mode with {n_syn} synapses "
-            f"(> {_INTERPRET_MAX_SYNAPSES}): this path exists for small parity "
-            "tests; on CPU leave RTAP_TM_PALLAS off (the XLA formulation is "
-            "the fast path there)"
+            f"Pallas TM megakernel in INTERPRETER mode with {n_syn} synapses "
+            f"(> {_INTERPRET_MAX_SYNAPSES}): this path exists for small "
+            "parity tests; on CPU leave RTAP_TM_SCATTER at the default "
+            "(the XLA formulation is the fast path there)"
         )
-    # v1 kernel has no grid/blocking: the whole per-stream pool must fit VMEM
-    block_bytes = n_syn * (4 + 4) + (K * S * M) * (K * S) * 4 + C * K * S * 2 * 4
+    if W > _MAX_WINNER_UNROLL:
+        raise ValueError(
+            f"Pallas TM megakernel with winner-list length {W} (> "
+            f"{_MAX_WINNER_UNROLL}): the grow pass unrolls over it twice — "
+            "this preset (col_cap * cells_per_column too large, e.g. the NAB "
+            "preset) needs the XLA path"
+        )
+    # v1 has no grid/blocking: pools + temporaries must fit VMEM, with the
+    # [n_seg, M] rows lane-padded to 128 on real hardware
+    lanes = M if interpret else max(M, 128)
+    block_bytes = C * K * S * (lanes * 4 * 6 + _META_COLS * 4 + 3 * 4)
     if block_bytes > _VMEM_BUDGET_BYTES:
         raise ValueError(
-            f"Pallas dendrite kernel needs ~{block_bytes >> 20} MiB VMEM for "
-            f"[C={C}, K={K}, S={S}, M={M}] (budget ~{_VMEM_BUDGET_BYTES >> 20} "
-            "MiB): this preset is too large for the unblocked v1 kernel — "
-            "leave RTAP_TM_PALLAS off for it"
+            f"Pallas TM megakernel needs ~{block_bytes >> 20} MiB VMEM for "
+            f"[C={C}, K={K}, S={S}, M={M}] incl. lane padding (budget "
+            f"~{_VMEM_BUDGET_BYTES >> 20} MiB): this preset is too large for "
+            "the unblocked v1 kernel — keep RTAP_TM_SCATTER=matmul for it"
         )
-    kernel = functools.partial(_kernel, K, float(connected_thr), Ac)
-    conn, pot = pl.pallas_call(
+
+
+def tm_learn_pallas(
+    cfg,
+    dom,
+    presyn: jnp.ndarray,  # kernel-layout pool (any int dtype; -1 = empty)
+    syn_perm: jnp.ndarray,  # kernel-layout pool (storage domain)
+    seg_last: jnp.ndarray,  # kernel-layout [C, K*S] or [C, K, S] i32
+    seg_pot4: jnp.ndarray,  # i32 [C, K, S] (prev step)
+    matching_seg4: jnp.ndarray,  # bool [C, K, S] (prev step)
+    learn_mask: jnp.ndarray,  # bool [C, K, S] (predicted + burst-match)
+    alloc,  # (alloc_col [C], bn_k [C], bn_s [C]) from _segment_learning_mask
+    active_cols: jnp.ndarray,  # bool [C]
+    have_winners: jnp.ndarray,  # bool scalar
+    it: jnp.ndarray,  # i32 scalar (this step's iteration stamp)
+    pcol_ids: jnp.ndarray,  # [Ac] packed prev-active columns
+    pcol_masks: jnp.ndarray,
+    p_cols: jnp.ndarray,  # i32 scalar: TOTAL prev-active columns (overflow)
+    winner_ids: jnp.ndarray,  # [Ac*K] prev winner cell ids (fills = N)
+    acol_ids: jnp.ndarray,  # [Ac] packed CURRENT active cells (dendrite)
+    acol_masks: jnp.ndarray,
+    interpret: bool | None = None,
+):
+    """XLA-side harness for the megakernel: reproduce the workspace
+    truncation as dense masks, call the kernel, apply the [C, K, S]-scale
+    epilogue (seg_last stamping/death). Returns
+    (presyn' i32 [n_seg, M], perm' f32 [n_seg, M], seg_last' i32 [n_seg],
+    conn [n_seg], pot [n_seg], overflow bool scalar) — caller casts/reshapes
+    back to the pool layout/domain.
+    """
+    C = active_cols.shape[0]
+    K = cfg.cells_per_column
+    S = cfg.max_segments_per_cell
+    M = cfg.max_synapses_per_segment
+    n_seg = C * K * S
+    N = C * K
+    L, Ac = cfg.learn_cap, cfg.col_cap
+    W = winner_ids.shape[0]
+    G = cfg.new_synapse_count
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    _guard_shapes(C, K, S, M, W, interpret)
+
+    # --- the workspace truncation, as dense masks: the XLA path captures
+    # the first Ac active columns ascending, then the first L learning
+    # segments in ascending (c, k, s) order — identical selection here ---
+    alloc_col, bn_k, bn_s = alloc
+    burst_new = alloc_col < C
+    captured = active_cols & (jnp.cumsum(active_cols.astype(jnp.int32)) <= Ac)
+    kk = jnp.arange(K, dtype=jnp.int32)
+    ss = jnp.arange(S, dtype=jnp.int32)
+    alloc_seg = (
+        (burst_new & captured)[:, None, None]
+        & (kk[None, :, None] == bn_k[:, None, None])
+        & (ss[None, None, :] == bn_s[:, None, None])
+    )  # [C, K, S]
+    ws_learn = ((learn_mask & captured[:, None, None]) | alloc_seg).reshape(-1)
+    learn_trunc = ws_learn & (jnp.cumsum(ws_learn.astype(jnp.int32)) <= L)
+    grow_seg = learn_trunc & have_winners
+    n_grow = (G - jnp.where(alloc_seg, 0, seg_pot4).reshape(-1)).astype(jnp.int32)
+
+    pdec = None
+    if cfg.predicted_segment_decrement > 0.0:
+        pdec = float(dom.rate(cfg.predicted_segment_decrement))
+        punish_seg = (matching_seg4 & ~active_cols[:, None, None]).reshape(-1)
+    else:
+        punish_seg = jnp.zeros(n_seg, bool)
+
+    meta = jnp.stack(
+        [
+            learn_trunc.astype(jnp.int32),
+            alloc_seg.reshape(-1).astype(jnp.int32),
+            grow_seg.astype(jnp.int32),
+            punish_seg.astype(jnp.int32),
+            n_grow,
+        ],
+        axis=1,
+    )  # [n_seg, _META_COLS]
+
+    consts = (
+        float(dom.rate(cfg.permanence_increment)),
+        float(dom.rate(cfg.permanence_decrement)),
+        float(dom.rate(cfg.initial_permanence)),
+        float(dom.one),
+        float(dom.zero),
+        float(dom.threshold(cfg.connected_permanence)),
+        pdec,
+    )
+    kernel = functools.partial(_mega_kernel, K, M, N, W, Ac, consts)
+    i32, f32 = jnp.int32, jnp.float32
+    presyn_n, perm_n, nsyn, conn, pot = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((C, K * S), jnp.int32),
-            jax.ShapeDtypeStruct((C, K * S), jnp.int32),
+            jax.ShapeDtypeStruct((n_seg, M), i32),
+            jax.ShapeDtypeStruct((n_seg, M), f32),
+            jax.ShapeDtypeStruct((n_seg, 1), i32),
+            jax.ShapeDtypeStruct((n_seg, 1), i32),
+            jax.ShapeDtypeStruct((n_seg, 1), i32),
         ),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
     )(
-        presyn.reshape(C, K * S * M).astype(jnp.int32),
-        syn_perm.reshape(C, K * S * M).astype(jnp.float32),
-        col_ids.reshape(1, Ac).astype(jnp.int32),
-        col_masks.reshape(1, Ac).astype(jnp.int32),
-        jnp.asarray(_reduce_matrix(K * S, M)),
+        presyn.reshape(n_seg, M).astype(i32),
+        syn_perm.reshape(n_seg, M).astype(f32),
+        meta,
+        pcol_ids.reshape(1, Ac).astype(i32),
+        pcol_masks.reshape(1, Ac).astype(i32),
+        winner_ids.reshape(1, W).astype(i32),
+        acol_ids.reshape(1, Ac).astype(i32),
+        acol_masks.reshape(1, Ac).astype(i32),
     )
-    return conn.reshape(C, K, S), pot.reshape(C, K, S)
+    nsyn = nsyn.reshape(-1)
+
+    # --- [C, K, S]-scale epilogue (identical to the XLA tail): stamp
+    # alloc + learned segments, then empty-segment death post-sweep ---
+    sl = seg_last.reshape(-1)
+    sl = jnp.where(alloc_seg.reshape(-1) | learn_trunc, it, sl)
+    sl = jnp.where((sl >= 0) & (nsyn == 0), -1, sl)
+
+    # same capacity-overflow accounting as the workspace path: truncated
+    # active set, truncated prev-active packing, or > learn_cap learners
+    overflow = (
+        (active_cols.sum() > Ac) | (p_cols > Ac) | (ws_learn.sum() > L)
+    )
+    return presyn_n, perm_n, sl, conn.reshape(-1), pot.reshape(-1), overflow

@@ -34,21 +34,24 @@ from rtap_tpu.ops.sp_tpu import sp_step
 from rtap_tpu.ops.tm_tpu import tm_step
 
 
-def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
+def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
+              inv: dict | None = None):
     """One fused record step -> (new_state, out). Pure/traceable.
 
     `values` is [n_fields] f32 (NaN = missing sample), `ts_unix` scalar i32.
     `out` is the raw anomaly score (f32 scalar), or the tuple
     (raw, predicted_value, prediction_prob) when the SDR classifier is
     enabled (cfg.classifier.enabled — a static property, so call sites can
-    unpack unconditionally for a given config).
+    unpack unconditionally for a given config). `inv` carries tm_step's
+    tick-invariant operands (ops/tm_tpu.tm_invariants) when the caller
+    hoists them out of a scan; None rebuilds them in-trace.
     """
     enc_offset, enc_bound = bind_offsets(values, state["enc_offset"], state["enc_bound"])
     state = {**state, "enc_offset": enc_offset, "enc_bound": enc_bound}
     sdr = encode_device(cfg, values, ts_unix, enc_offset, state["enc_resolution"])
     pattern_prev = state["prev_active"]  # TM active cells at t-1
     state, active = sp_step(state, sdr, cfg.sp, learn)
-    state, raw = tm_step(state, active, cfg.tm, learn)
+    state, raw = tm_step(state, active, cfg.tm, learn, inv=inv)
     if cfg.classifier.enabled:
         from rtap_tpu.ops.classifier_tpu import classifier_step
 
@@ -68,7 +71,8 @@ def fused_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     return from_kernel_layout(state, cfg.tm), out
 
 
-def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
+def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
+          inv: dict | None = None):
     """One group tick on KERNEL-layout state, honoring cfg.learn_every.
 
     With a learning cadence (cfg.learn_every > 1 and learn=True) the
@@ -78,11 +82,14 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
     predicate would lower to select and execute BOTH branches, paying the
     learning pass it exists to skip. Groups tick in lockstep (registry
     invariant), so one flag serves all G streams.
+
+    `inv` (tm_invariants) is closed over, NOT vmapped: one shared
+    HBM-resident copy serves all G streams.
     """
 
     def step_all(lrn):
         return lambda ss: jax.vmap(
-            lambda s1, vv, tt: step_impl(s1, vv, tt, cfg, lrn)
+            lambda s1, vv, tt: step_impl(s1, vv, tt, cfg, lrn, inv)
         )(ss, values, ts_unix)
 
     if not (learn and cfg.cadence_active):
@@ -113,12 +120,18 @@ def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mod
     The kernel-layout adapters sit OUTSIDE the scan: under RTAP_TM_LAYOUT=
     flat the carry holds flat pools for all T ticks and the public [C,K,S,M]
     layout is restored once per chunk (shape-only reshapes — checkpoints,
-    oracle parity, and the service API never see kernel layout)."""
-    from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
+    oracle parity, and the service API never see kernel layout). Likewise
+    the tick-invariant kernel operands (the flat layout's per-segment
+    reduction matrix) are built ONCE here and closed over by the body, so
+    they are hoisted out of the scan by construction and stay HBM-resident
+    across the whole T-tick chunk."""
+    from rtap_tpu.ops.tm_tpu import from_kernel_layout, tm_invariants, to_kernel_layout
+
+    inv = tm_invariants(cfg.tm)
 
     def body(s, inp):
         v, t = inp
-        return _tick(s, v, t, cfg, learn)
+        return _tick(s, v, t, cfg, learn, inv)
 
     state, out = jax.lax.scan(body, to_kernel_layout(state), (values, ts_unix))
     return from_kernel_layout(state, cfg.tm), out

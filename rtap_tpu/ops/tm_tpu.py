@@ -32,6 +32,19 @@ either as dense full-pool sweeps or as the round-4 compact touched-rows pass
 forward synapse index (RTAP_TM_DENDRITE; ops/fwd_index.py) — see the switch
 table below; every combination is parity-pinned.
 
+Round 6 (docs/KERNELS.md): the roofline pinned the step latency-bound
+(10x over the HBM floor, MXU < 0.1%) — the binding cost is the number of
+scheduled regions per scan iteration, not arithmetic. The workspace path
+is therefore region-consolidated: presyn + perm (+ seg_pot / the forward
+diff base) ride ONE one-hot MXU pass per gather/scatter stage instead of
+one pass per tensor (bitwise identical per block — each output element
+touches only its own operand columns), the dendrite conn/pot counts share
+one block-diagonal reduction, and tick-invariant operands (the flat
+layout's reduction matrix) hoist out of the chunk scan via
+:func:`tm_invariants`. The escalation beyond what XLA will fuse is the
+RTAP_TM_SCATTER=pallas megakernel (ops/pallas_tm.py): the whole learning
+pass VMEM-resident with no workspace movement at all.
+
 Capacity bounds (col_cap active columns, learn_cap learning segments per
 step) are static-shape requirements of XLA; overflow beyond the bounds is
 counted in state["tm_overflow"] so tests can assert it never fires at the
@@ -40,7 +53,7 @@ configured sizes.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -73,9 +86,14 @@ def _tpu_paths() -> bool:
 # All alternatives are bit-identical (tests/parity/); scripts/hw_session.py
 # races them on silicon and the measured winners become defaults.
 #
-#   RTAP_TM_SCATTER  matmul|indexed   workspace row movement: one-hot MXU
+#   RTAP_TM_SCATTER  matmul|indexed|pallas
+#                                     workspace row movement: one-hot MXU
 #                                     matmuls (full-pool f32 round trips) vs
 #                                     jnp.take/.at[].set of touched rows only
+#                                     vs the Pallas TM-learning megakernel
+#                                     (ops/pallas_tm.py: the whole learning
+#                                     pass fused in VMEM, dense sweeps, no
+#                                     workspace movement at all)
 #   RTAP_TM_LAYOUT   aos|flat         pools [C,K,S,M] (TPU tiling pads the
 #                                     tiny trailing dims up to ~20x) vs
 #                                     [C, K*S*M] with block-diagonal-matmul
@@ -95,7 +113,7 @@ def _tpu_paths() -> bool:
 import os as _os
 
 _MODE_CHOICES = {
-    "scatter": ("matmul", "indexed"),
+    "scatter": ("matmul", "indexed", "pallas"),
     "layout": ("aos", "flat"),
     "sweep": ("dense", "compact"),
     "dendrite": ("scan", "forward"),
@@ -227,6 +245,33 @@ def from_kernel_layout(state: dict, cfg: TMConfig) -> dict:
         x = out[k]
         out[k] = x.reshape(*x.shape[:-1], *tails[nd])
     return out
+
+
+@lru_cache(maxsize=None)
+def _reduce_matrix(ks: int, m: int):
+    """Block-diagonal 0/1 [ks*m, ks] f32: column s sums synapse lanes
+    [s*m, (s+1)*m) — the per-segment Σ_M reduction as one MXU matmul.
+    (Moved here from the retired dendrite-only Pallas kernel: it is the
+    flat layout's seg_sum operand, load-bearing independent of Pallas.)"""
+    import numpy as np
+
+    r = np.zeros((ks * m, ks), np.float32)
+    for s in range(ks):
+        r[s * m : (s + 1) * m, s] = 1.0
+    return r
+
+
+def tm_invariants(cfg: TMConfig) -> dict | None:
+    """Tick-invariant device operands of :func:`tm_step`, built ONCE so a
+    caller scanning over ticks (ops/step.py:_scan_chunk) can hoist them
+    out of the scan body explicitly — they stay HBM-resident across the
+    whole T-tick chunk instead of rematerializing as per-iteration
+    constants. None when the current layout needs none (aos reduces on the
+    trailing dim directly)."""
+    if layout_mode() != "flat":
+        return None
+    K, S, M = cfg.cells_per_column, cfg.max_segments_per_cell, cfg.max_synapses_per_segment
+    return {"red": jnp.asarray(_reduce_matrix(K * S, M))}
 
 
 def _compact_ids(mask: jnp.ndarray, size: int) -> jnp.ndarray:
@@ -433,11 +478,15 @@ def _gather_rows_i32(x: jnp.ndarray, oh_b: jnp.ndarray) -> jnp.ndarray:
 
 
 @partial(jax.jit, static_argnames=("cfg", "learn"))
-def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = True):
+def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = True,
+            inv: dict | None = None):
     """One TM step -> (new_state, raw anomaly score f32). Pure.
 
     `state` uses the models/state.py TM layout plus "tm_overflow" (i32
-    overflow counter, device-only observability).
+    overflow counter, device-only observability). `inv` optionally carries
+    the tick-invariant operands from :func:`tm_invariants` so a scanning
+    caller hoists them out of its loop body; None rebuilds them as
+    in-trace constants (single-dispatch callers).
     """
     flat = layout_mode() == "flat"
     if flat:
@@ -459,18 +508,35 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     pool_shape = (C, K * S * M) if flat else (C, K, S, M)
     seg_shape = (C, K * S) if flat else (C, K, S)
 
+    def _red():
+        if inv is not None:
+            return inv["red"]
+        return jnp.asarray(_reduce_matrix(K * S, M))
+
     def seg_sum(x):
         """Per-segment count over synapse lanes -> i32 [*seg_shape]. Flat
         layout reduces via the block-diagonal 0/1 MXU matmul (counts <= M <<
         2^24: f32-exact) instead of a minor-dim sum the tiler pads."""
         if not flat:
             return x.sum(-1)
-        from rtap_tpu.ops.pallas_tm import _reduce_matrix
-
-        red = jnp.asarray(_reduce_matrix(K * S, M))
         return jnp.round(
-            jax.lax.dot(x.astype(jnp.float32), red, precision=_HI)
+            jax.lax.dot(x.astype(jnp.float32), _red(), precision=_HI)
         ).astype(jnp.int32)
+
+    def seg_sum2(a, b):
+        """TWO per-segment counts in ONE reduction: the operands stack on
+        the row axis so the flat layout pays a single [2C, K*S*M] MXU pass
+        instead of two (fused-region consolidation; bitwise identical per
+        block — each output element touches only its own operand rows)."""
+        if not flat:
+            return a.sum(-1), b.sum(-1)
+        both = jnp.round(
+            jax.lax.dot(
+                jnp.concatenate([a, b], 0).astype(jnp.float32), _red(),
+                precision=_HI,
+            )
+        ).astype(jnp.int32)
+        return both[:C], both[C:]
 
     def seg_expand(x):
         """Broadcast a per-segment value onto its synapse lanes."""
@@ -555,8 +621,50 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
     fwd_of = state.get("fwd_of")
     n_seg = C * K * S
 
+    pallas_learn = learn and scatter_mode() == "pallas"
+    if scatter_mode() == "pallas":
+        if forward:
+            raise ValueError(
+                "RTAP_TM_SCATTER=pallas is incompatible with "
+                "RTAP_TM_DENDRITE=forward: the megakernel computes dendrite "
+                "counts itself and maintains no forward index"
+            )
+        if sweep_mode() == "compact":
+            raise ValueError(
+                "RTAP_TM_SCATTER=pallas is incompatible with "
+                "RTAP_TM_SWEEP=compact: the megakernel fuses the DENSE "
+                "punish/death sweeps in VMEM"
+            )
+
     overflow_learn = jnp.bool_(False)
-    if learn:
+    conn_count = pot_count = tm_overflow = None
+    if pallas_learn:
+        # --- the whole learning pass as ONE Pallas kernel, VMEM-resident
+        # (ops/pallas_tm.py): decisions stay here on [C, K, S]-scale
+        # tensors; the kernel owns every pool traversal including the
+        # dendrite counts for t+1 ---
+        from rtap_tpu.ops.pallas_tm import tm_learn_pallas
+
+        pcol_ids, pcol_masks, p_cols = _pack_active(state["prev_active"], Ac)
+        winner_ids = _winner_id_list(state["prev_winner"], Ac)  # [Ac*K]
+        acol_ids, acol_masks, a_cols = _pack_active(active_cells, Ac)
+        presyn_n, perm_n, sl, conn_f, pot_f, overflow_learn = tm_learn_pallas(
+            cfg, dom, presyn, syn_perm, seg_last,
+            seg_pot4, matching_seg4, learn_mask, alloc,
+            active_cols, have_winners, it,
+            pcol_ids, pcol_masks, p_cols, winner_ids,
+            acol_ids, acol_masks,
+        )
+        presyn = presyn_n.astype(presyn_dt).reshape(*pool_shape)
+        perm_w = jnp.round(perm_n) if dom.bits else perm_n  # exact already
+        syn_perm = perm_w.astype(p_dt).reshape(*pool_shape)
+        seg_last = sl.reshape(*seg_shape)
+        conn_count = conn_f.reshape(*seg_shape)
+        pot_count = pot_f.reshape(*seg_shape)
+        tm_overflow = state["tm_overflow"] + (
+            overflow_learn | (a_cols > Ac)
+        ).astype(jnp.int32)
+    if learn and not pallas_learn:
         alloc_col, bn_k, bn_s = alloc
         burst_new = alloc_col < C  # [C]
 
@@ -580,14 +688,27 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
                 learn_mask.reshape(C, -1)[idx_c] & (col_ids < C)[:, None]
             ).reshape(Ac, K, S)
         else:
-            ws_presyn = jnp.round(
-                _gather_rows_f32(presyn.reshape(C, -1).astype(jnp.float32), col_oh)
-            ).astype(jnp.int32)  # [Ac, K*S*M]
-            ws_perm = _gather_rows_f32(syn_perm.reshape(C, -1).astype(jnp.float32), col_oh)  # [Ac, K*S*M]
+            # ONE one-hot MXU pass gathers presyn + perm + seg_pot together
+            # (fused-region consolidation: each output element of the
+            # concatenated matmul touches only its own operand block, so
+            # the values are bitwise those of the three separate gathers;
+            # seg_pot <= M << 2^24 and cell ids < 2^24 are f32-exact)
+            KSM = K * S * M
+            cat = jnp.concatenate(
+                [
+                    presyn.reshape(C, -1).astype(jnp.float32),
+                    syn_perm.reshape(C, -1).astype(jnp.float32),
+                    state["seg_pot"].reshape(C, -1).astype(jnp.float32),
+                ],
+                axis=1,
+            )  # [C, 2*KSM + K*S]
+            g = _gather_rows_f32(cat, col_oh)  # [Ac, 2*KSM + K*S]
+            ws_presyn = jnp.round(g[:, :KSM]).astype(jnp.int32)  # [Ac, K*S*M]
+            ws_perm = g[:, KSM:2 * KSM]  # [Ac, K*S*M]
+            ws_pot = jnp.round(g[:, 2 * KSM:]).astype(jnp.int32).reshape(Ac, K, S)
+            # seg_last carries unbounded iteration stamps (> 2^24 possible):
+            # it keeps the exact integer gather
             ws_last = _gather_rows_i32(seg_last.reshape(C, -1), col_oh_b).reshape(Ac, K, S)
-            ws_pot = jnp.round(
-                _gather_rows_f32(state["seg_pot"].reshape(C, -1).astype(jnp.float32), col_oh)
-            ).astype(jnp.int32).reshape(Ac, K, S)  # seg_pot <= M << 2^24: f32-exact
             ws_learn = (
                 (col_oh_b[:, :, None] & learn_mask.reshape(C, -1)[None]).any(1).reshape(Ac, K, S)
             )
@@ -603,12 +724,9 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         sel_k = jnp.arange(K, dtype=jnp.int32)[None, :] == ws_bnk[:, None]  # [Ac, K]
         sel_s = jnp.arange(S, dtype=jnp.int32)[None, :] == ws_bns[:, None]  # [Ac, S]
         ws_alloc = ws_bn[:, None, None] & sel_k[:, :, None] & sel_s[:, None, :]  # [Ac, K, S]
-        ws_presyn = jnp.where(
-            ws_alloc.reshape(Ac, -1, 1).repeat(M, -1).reshape(Ac, -1), -1, ws_presyn
-        )
-        ws_perm = jnp.where(
-            ws_alloc.reshape(Ac, -1, 1).repeat(M, -1).reshape(Ac, -1), 0.0, ws_perm
-        )
+        alloc_lanes = jnp.repeat(ws_alloc.reshape(Ac, K * S), M, axis=-1)  # [Ac, K*S*M]
+        ws_presyn = jnp.where(alloc_lanes, -1, ws_presyn)
+        ws_perm = jnp.where(alloc_lanes, 0.0, ws_perm)
         ws_pot = jnp.where(ws_alloc, 0, ws_pot)
         ws_last = jnp.where(ws_alloc, it, ws_last)
         ws_learn = ws_learn | ws_alloc
@@ -630,15 +748,17 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         else:
             row_oh_b = idx[:, None] == jnp.arange(R2, dtype=jnp.int32)  # [L, R2]
             row_oh = row_oh_b.astype(jnp.float32)
-            presyn_l = jnp.round(
-                _gather_rows_f32(ws_presyn_r.astype(jnp.float32), row_oh)
-            ).astype(jnp.int32)  # [L, M]
-            perm_l = _gather_rows_f32(ws_perm_r, row_oh)  # [L, M]
+            # presyn + perm (+ the forward diff base) compact in ONE
+            # [L, R2] MXU pass — same consolidation as the column gather
+            parts = [ws_presyn_r.astype(jnp.float32), ws_perm_r]
+            if forward:
+                parts.append(ws_presyn0_r.astype(jnp.float32))
+            gl = _gather_rows_f32(jnp.concatenate(parts, axis=1), row_oh)  # [L, 2-3M]
+            presyn_l = jnp.round(gl[:, :M]).astype(jnp.int32)  # [L, M]
+            perm_l = gl[:, M:2 * M]  # [L, M]
             pot_l = jnp.where(row_oh_b, ws_pot.reshape(-1)[None, :], 0).sum(-1)  # [L]
             if forward:
-                presyn_l0 = jnp.round(
-                    _gather_rows_f32(ws_presyn0_r.astype(jnp.float32), row_oh)
-                ).astype(jnp.int32)
+                presyn_l0 = jnp.round(gl[:, 2 * M:]).astype(jnp.int32)
 
         # prev-step active cells, column-compact (shared by reinforce + punish)
         pcol_ids, pcol_masks, p_cols = _pack_active(state["prev_active"], Ac)
@@ -681,10 +801,14 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
             ws_perm_r = ws_perm_r.at[idx].set(perm_l, mode="drop")
         else:
             hit_rows = row_oh_b.any(0)  # [R2]
-            scat_presyn = jnp.round(
-                jax.lax.dot(row_oh.T, presyn_l.astype(jnp.float32), precision=_HI)
-            ).astype(jnp.int32)
-            scat_perm = jax.lax.dot(row_oh.T, perm_l, precision=_HI)
+            # presyn + perm scatter back in ONE transposed one-hot MXU pass
+            scat = jax.lax.dot(
+                row_oh.T,
+                jnp.concatenate([presyn_l.astype(jnp.float32), perm_l], axis=1),
+                precision=_HI,
+            )  # [R2, 2M]
+            scat_presyn = jnp.round(scat[:, :M]).astype(jnp.int32)
+            scat_perm = scat[:, M:]
             ws_presyn_r = jnp.where(hit_rows[:, None], scat_presyn, ws_presyn_r)
             ws_perm_r = jnp.where(hit_rows[:, None], scat_perm, ws_perm_r)
         if indexed:
@@ -724,10 +848,21 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         else:
             hit_pool = hit_cols.reshape(C, *([1] * (len(pool_shape) - 1)))
             hit_seg = hit_cols.reshape(C, *([1] * (len(seg_shape) - 1)))
-            pool_presyn = jnp.round(
-                jax.lax.dot(col_oh.T, ws_presyn_r.reshape(Ac, -1).astype(jnp.float32), precision=_HI)
-            ).astype(presyn_dt).reshape(*pool_shape)
-            pool_perm_f = jax.lax.dot(col_oh.T, ws_perm_r.reshape(Ac, -1), precision=_HI)
+            # presyn + perm pools restored in ONE [C, Ac] x [Ac, 2*KSM] pass
+            KSM = K * S * M
+            pools = jax.lax.dot(
+                col_oh.T,
+                jnp.concatenate(
+                    [
+                        ws_presyn_r.reshape(Ac, -1).astype(jnp.float32),
+                        ws_perm_r.reshape(Ac, -1),
+                    ],
+                    axis=1,
+                ),
+                precision=_HI,
+            )  # [C, 2*KSM]
+            pool_presyn = jnp.round(pools[:, :KSM]).astype(presyn_dt).reshape(*pool_shape)
+            pool_perm_f = pools[:, KSM:]
             if dom.bits:
                 pool_perm_f = jnp.round(pool_perm_f)  # exact already; belt+braces
             pool_perm = pool_perm_f.astype(p_dt).reshape(*pool_shape)
@@ -849,7 +984,9 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
 
     # --- dendrite activity for t+1 over existing segments ---
     exists_seg = seg_last >= 0
-    if forward:
+    if pallas_learn:
+        pass  # the megakernel already produced conn/pot counts + overflow
+    elif forward:
         # forward index: gather only the <= Ac*K active cells' fanout rows
         # (ops/fwd_index.py) instead of sweeping the pools
         from rtap_tpu.ops.fwd_index import dendrite_counts
@@ -871,21 +1008,10 @@ def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = 
         tm_overflow = state["tm_overflow"] + (
             overflow_learn | (a_cols > Ac)
         ).astype(jnp.int32)
-        from rtap_tpu.ops.pallas_tm import dendrite_activity_pallas, use_pallas
-
-        if use_pallas():
-            # fused VMEM kernel, bit-identical semantics (ops/pallas_tm.py);
-            # opt-in until profiled on silicon
-            conn_count, pot_count = dendrite_activity_pallas(
-                presyn.reshape(C, K, S, M), syn_perm.reshape(C, K, S, M),
-                acol_ids, acol_masks, p_connected,
-            )
-            conn_count = conn_count.reshape(*seg_shape)
-            pot_count = pot_count.reshape(*seg_shape)
-        else:
-            syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
-            conn_count = seg_sum(syn_act & (syn_perm >= p_connected))
-            pot_count = seg_sum(syn_act)
+        syn_act = _presyn_active_packed(presyn, acol_ids, acol_masks, K)
+        conn_count, pot_count = seg_sum2(
+            syn_act & (syn_perm >= p_connected), syn_act
+        )
     active_seg = exists_seg & (conn_count >= cfg.activation_threshold)
     matching_seg = exists_seg & (pot_count >= cfg.min_threshold)
     seg_pot = jnp.where(exists_seg, pot_count, 0).astype(jnp.int16)

@@ -254,6 +254,7 @@ def live_loop(
     degradation=None,
     quarantine_restore_after: int = 0,
     alert_flush_every: int = 1,
+    aot_warmup: bool = False,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -637,9 +638,13 @@ def live_loop(
     source_error_run = 0  # consecutive source raises (event on the first)
     last_ts_seen = None  # monotonic clamp floor for source timestamps
     ts_regress_run = 0  # consecutive clamped ticks (event on the first)
-    fallback_trailing: tuple = ()  # trailing value dims (multi-field
-    # sources) for the NaN substitute when the source raises before ever
-    # returning a vector
+    # trailing value dims for the NaN substitute when the source raises.
+    # Seeded from the model config, NOT discovered from the first good
+    # poll: a multivariate source that raises on tick 0 would otherwise
+    # get a [G]-shaped substitute where dispatch expects [G, n_fields],
+    # and the shape error would quarantine EVERY group permanently.
+    _nf = groups[0].cfg.n_fields if groups else 1
+    fallback_trailing: tuple = (_nf,) if _nf > 1 else ()
     ck_breaker = None
     ck_quarantine_announced = False
     checkpoint_save_failures = 0
@@ -740,7 +745,23 @@ def live_loop(
         obs_scored.inc(scored)
         phase_s["emit"] += time.perf_counter() - t1
 
-    warmed: set = set()  # (chunk length m, group config, learn flag)
+    aot_programs = 0
+    if aot_warmup:
+        # compile every knowable (chunk length, config, learn) program —
+        # and the first-claim realignment program — BEFORE tick 0, so no
+        # XLA compile can land inside a scored tick (service/aot.py; the
+        # 1h 100k soak's 9 missed deadlines were all warm-up compiles)
+        from rtap_tpu.service.aot import prewarm
+
+        prewarmed = prewarm(
+            groups, micro_chunk, learn, degradation=degradation,
+            include_claim=auto_register or any(
+                g.free_slot_count() for g in groups))
+        aot_programs = len(prewarmed)
+    else:
+        prewarmed = set()
+
+    warmed: set = set(prewarmed)  # (chunk length m, group config, learn flag)
     # programs already dispatched once: the first dispatch of each PROGRAM
     # runs serially — concurrent cold misses on step.py's compiled-fn
     # lru_cache are not single-flight, so N pool threads would each
@@ -1298,6 +1319,14 @@ def live_loop(
         extra["checkpoint_save_failures"] = checkpoint_save_failures
     if chaos is not None:
         extra["chaos_injected"] = len(chaos.injected)
+    if aot_warmup:
+        extra["aot_programs_compiled"] = aot_programs
+        # cold programs the loop still had to single-flight AFTER the AOT
+        # pass — the integration test pins this at zero; nonzero means the
+        # knowable-program enumeration missed a shape (a bug, surfaced
+        # here instead of as a tail-latency spike)
+        extra["cold_compiles_after_warmup"] = max(
+            0, len(warmed) - len(prewarmed))
     return {**counter.stats(), "alerts": writer.count, "missed_deadlines": missed,
             "ticks": ticks_run, "cadence_s": cadence_s, "n_groups": len(groups),
             "pipeline_depth": pipeline_depth, "micro_chunk": micro_chunk,

@@ -48,8 +48,6 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     ("profile_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
                          "--gs", "1024", "--layout", "aos",
                          "--scatter", "indexed"]),
-    ("profile_pallas", [sys.executable, "scripts/profile_step.py", "--T", "32",
-                        "--gs", "1024", "--layout", "aos", "--pallas"]),
     ("profile_f32_indexed", [sys.executable, "scripts/profile_step.py", "--T", "32",
                              "--gs", "1024", "--layout", "aos",
                              "--perm-bits", "0", "--scatter", "indexed"]),
@@ -296,13 +294,6 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
     # kernel lost at 256-col/aos (24.3k vs 31.9k); arithmetic intensity at
     # 32-col/flat is different. A/B at the exact headline config (k=2) and
     # its full-rate base.
-    ("r5_pallas_32col_k2", [sys.executable, "scripts/profile_step.py",
-                            "--T", "32", "--gs", "1024", "--layout", "flat",
-                            "--columns", "32", "--learn-every", "2",
-                            "--pallas"]),
-    ("r5_pallas_32col", [sys.executable, "scripts/profile_step.py",
-                         "--T", "32", "--gs", "1024", "--layout", "flat",
-                         "--columns", "32", "--pallas"]),
     # The >65k wall is per-program workspace, which scales with G AND the
     # scan chunk T (verdict r4 item 2: "smaller scan T at scale"). If T=8
     # compiles at 98304 where T=32 500s, the wall is the T-scaled feed/
@@ -607,6 +598,30 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                                 "--out",
                                 "reports/live_soak_100k_lifecycle.json"],
      3600.0),
+    # ---------------- round 6 (ISSUE 3: close the latency-bound gap) ----
+    # Most-valuable-first: (1) the silicon profile_r06 — re-measures the
+    # full-rate number UNDER the fused-region consolidation and commits
+    # the per-region HLO extraction the round's analysis cites (this run
+    # OVERWRITES reports/profile_r06.json, replacing the CPU-labeled
+    # stand-in artifact with silicon — exactly the intended upgrade);
+    # (2) the megakernel A/B at the preset width and the headline width
+    # (RTAP_TM_SCATTER=pallas; a Mosaic compile failure or VMEM overrun is
+    # a MEASURED negative result — the step log is the evidence either
+    # way, same protocol as the r4 candidates); (3) a fresh bench, whose
+    # ladder now carries the pallas rung and appends the full-rate trend
+    # entry to reports/trend_rung.json.
+    ("profile_r06", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                     "--gs", "1024", "--layout", "flat",
+                     "--report", "reports/profile_r06.json"], 1500.0),
+    ("profile_mega", [sys.executable, "scripts/profile_step.py", "--T", "32",
+                      "--gs", "1024", "--layout", "flat",
+                      "--scatter", "pallas"], 1500.0),
+    ("profile_mega_32col", [sys.executable, "scripts/profile_step.py",
+                            "--T", "32", "--gs", "1024", "--layout", "flat",
+                            "--columns", "32", "--scatter", "pallas"],
+     1500.0),
+    ("r6_bench", [sys.executable, "bench.py"], 1700.0),
+    ("r6_trend_rung", [sys.executable, "scripts/trend_rung.py"], 1500.0),
 ]
 
 

@@ -1,18 +1,27 @@
 """Profile the fused stream-group step on the real chip.
 
 Breaks the per-tick cost down by (a) group size scaling, (b) component
-ablation (encode / SP / TM, learn on/off), so optimization effort lands on
-the measured bottleneck (VERDICT r1 next-step 1). Run on hardware:
+ablation (encode / SP / TM, learn on/off), and (c) — with --report — a
+programmatic per-region cost extraction of the compiled program (entry-
+computation region counts by opcode, XLA cost/memory analysis), so
+optimization effort lands on the measured bottleneck (VERDICT r1
+next-step 1) and the "where does the 10x latency-bound gap go" question
+(reports/roofline.json) gets a committed, machine-readable answer. Run on
+hardware:
 
-    PYTHONPATH=/root/repo:/root/.axon_site python scripts/profile_step.py [--trace DIR]
+    PYTHONPATH=/root/repo:/root/.axon_site python scripts/profile_step.py \
+        [--trace DIR] [--report reports/profile_r06.json]
 
 Prints a table to stderr; with --trace, wraps one measured chunk in a
-jax.profiler trace for xprof.
+jax.profiler trace for xprof; with --report, writes the full breakdown +
+region analysis as one JSON artifact (platform-labeled — a CPU-drive run
+is marked as such, never passed off as silicon).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -61,6 +70,81 @@ def time_fn(fn, state, iters=3, warmup=1):
     return (time.perf_counter() - t0) / iters
 
 
+def region_analysis(cfg, G: int, T: int) -> dict:
+    """Programmatic per-region cost extraction of the compiled fused step.
+
+    Compiles the REAL chunk_step at (G, T) and reads, from the optimized
+    HLO itself (no trace viewer in the loop): the entry-computation
+    instruction count — each top-level instruction is one scheduled region
+    / kernel launch, the currency the roofline's latency_bound_factor says
+    we overspend — a histogram by opcode, the fusion-region count, and
+    XLA's cost/memory analysis. Platform-dependent by construction: the
+    committed artifact labels the platform, and the silicon number is the
+    one that decides (hw_session step profile_r06)."""
+    import re
+
+    from rtap_tpu.models.state import init_state
+    from rtap_tpu.ops.step import chunk_step
+
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x)[None], (G, *np.shape(x))),
+        init_state(cfg, seed=0))
+    vals = jnp.zeros((T, G, cfg.n_fields), jnp.float32)
+    ts = jnp.zeros((T, G), jnp.int32)
+    fn = jax.jit(lambda s, v, t: chunk_step(s, v, t, cfg, learn=True))
+    compiled = fn.lower(state, vals, ts).compile()
+
+    txt = compiled.as_text()
+
+    def op_histogram(block: str) -> dict[str, int]:
+        # one instruction per line: `%name = <shape> opcode(...)`; the
+        # shape may be a spaced tuple, so the opcode is the FIRST
+        # word-followed-by-( after the `=`
+        ops: dict[str, int] = {}
+        for line in block.splitlines():
+            m = re.search(r"=\s+.*?\s([a-z][a-z0-9_-]*)\(", line)
+            if m:
+                ops[m.group(1)] = ops.get(m.group(1), 0) + 1
+        return ops
+
+    # entry computation: from "ENTRY %name" to its closing brace
+    entry = txt[txt.index("ENTRY "):] if "ENTRY " in txt else txt
+    entry = entry[:entry.index("\n}") + 2] if "\n}" in entry else entry
+    ops = op_histogram(entry)
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    mem = compiled.memory_analysis()
+    out = {
+        "entry_instructions": sum(ops.values()),
+        "fusion_regions": ops.get("fusion", 0),
+        "while_loops": ops.get("while", 0),
+        "opcode_histogram": dict(sorted(ops.items(), key=lambda kv: -kv[1])),
+        "flops_per_chunk": float(ca.get("flops", 0.0)),
+        "bytes_accessed_per_chunk": float(ca.get("bytes accessed", 0.0)),
+    }
+    if mem is not None:
+        for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                  "output_size_in_bytes", "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                out[k] = int(v)
+    # the scan body is where per-tick dispatch gaps live: resolve the
+    # while instruction's body= computation and count ITS regions — each
+    # is a per-tick dispatch boundary, paid T times per chunk
+    wm = re.search(r"\swhile\(.*?body=%?([\w.\-]+)", entry)
+    if wm:
+        bm = re.search(r"\n%" + re.escape(wm.group(1)) + r"\s.*?\n}",
+                       txt, re.S)
+        if bm:
+            bops = op_histogram(bm.group(0))
+            out["scan_body_instructions"] = sum(bops.values())
+            out["scan_body_fusions"] = bops.get("fusion", 0)
+            out["scan_body_opcode_histogram"] = dict(
+                sorted(bops.items(), key=lambda kv: -kv[1]))
+    return out
+
+
 # ---- ablation kernels: scan-over-T, vmap-over-G, one component only ----
 
 def _scan_vmap(body, state, xs):
@@ -106,15 +190,22 @@ def main():
     ap.add_argument("--trace", default=None)
     ap.add_argument("--T", type=int, default=32)
     ap.add_argument("--gs", type=int, nargs="*", default=[512, 2048, 4096, 8192])
-    ap.add_argument("--pallas", action="store_true",
-                    help="route the TM dendrite pass through the Pallas "
-                         "kernel (ops/pallas_tm.py) — compare a run with "
-                         "and without this flag on hardware")
-    ap.add_argument("--scatter", choices=("matmul", "indexed"), default=None,
+    ap.add_argument("--report", default=None,
+                    help="write the full profile (G sweep, ablations, "
+                         "per-region cost extraction of the compiled "
+                         "program) to this JSON path")
+    ap.add_argument("--region-g", type=int, default=1024,
+                    help="group size the --report region extraction "
+                         "compiles at (compile-only — G=1024 is the "
+                         "roofline's reference point and stays cheap even "
+                         "where executing it would not be)")
+    ap.add_argument("--scatter", choices=("matmul", "indexed", "pallas"),
+                    default=None,
                     help="TM workspace-movement strategy (ops/tm_tpu.py "
                          "SCATTER_MODE): 'indexed' moves only touched rows, "
-                         "'matmul' is the one-hot MXU formulation — A/B on "
-                         "hardware")
+                         "'matmul' is the one-hot MXU formulation, 'pallas' "
+                         "is the VMEM TM-learning megakernel "
+                         "(ops/pallas_tm.py) — A/B on hardware")
     ap.add_argument("--layout", choices=("aos", "flat"), default=None,
                     help="TM kernel tensor layout (ops/tm_tpu.py LAYOUT_MODE):"
                          " 'flat' carries [C, K*S*M] pools through the scan "
@@ -159,11 +250,6 @@ def main():
     from rtap_tpu.utils.platform import enable_compile_cache
 
     enable_compile_cache(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    if args.pallas:
-        from rtap_tpu.ops.pallas_tm import set_use_pallas
-
-        set_use_pallas(True)
-        log("Pallas dendrite kernel: ENABLED")
     if args.scatter:
         from rtap_tpu.ops.tm_tpu import set_scatter_mode
 
@@ -215,6 +301,25 @@ def main():
     log(f"platform: {jax.devices()[0].platform} {jax.devices()[0].device_kind} "
         f"(perm_bits={args.perm_bits})")
 
+    report = {
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "T": T,
+        "perm_bits": args.perm_bits,
+        "columns": args.columns,
+        "learn_every": args.learn_every,
+        "modes": None,  # filled below (import deferred until flags applied)
+        "g_sweep": {},
+        "ablations_ms_per_tick": {},
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    from rtap_tpu.ops.tm_tpu import (
+        dendrite_mode, layout_mode, scatter_mode, sweep_mode,
+    )
+
+    report["modes"] = (f"{layout_mode()}/{scatter_mode()}/{sweep_mode()}"
+                       f"/{dendrite_mode()}")
+
     log("\n== G scaling, full step (learn=True) ==")
     results = {}
     for G in args.gs:
@@ -225,8 +330,14 @@ def main():
             per_tick = dt / T
             rate = G * T / dt
             results[G] = rate
+            report["g_sweep"][str(G)] = {
+                "ms_per_tick": round(per_tick * 1e3, 3),
+                "metrics_per_s": round(rate, 1),
+            }
             log(f"G={G:6d}: {per_tick*1e3:8.2f} ms/tick  {rate:10.0f} metrics/s")
         except Exception as e:
+            report["g_sweep"][str(G)] = {
+                "failed": f"{type(e).__name__}: {str(e)[:160]}"}
             log(f"G={G:6d}: FAILED {type(e).__name__}: {str(e)[:120]}")
 
     if not results:
@@ -245,12 +356,17 @@ def main():
     log(f"\n== ablations at G={G}, T={T} ==")
     vals, ts = make_inputs(G, T, cfg.n_fields)
 
+    report["ablation_G"] = G
+
     def ablate(label, fn):
         try:
             st = replicate_state_device(init_state(cfg, 0), G)
             dt = time_fn(fn, st, iters=2)
+            report["ablations_ms_per_tick"][label.strip()] = round(dt / T * 1e3, 3)
             log(f"{label}: {dt/T*1e3:8.2f} ms/tick")
         except Exception as e:
+            report["ablations_ms_per_tick"][label.strip()] = (
+                f"FAILED {type(e).__name__}")
             log(f"{label}: FAILED {type(e).__name__}: {str(e)[:100]}")
 
     ablate("full learn=True ", lambda s: chunk_step(s, vals, ts, cfg, True))
@@ -280,6 +396,30 @@ def main():
             st, raw = chunk_step(st, vals, ts, cfg, True)
             jax.block_until_ready(raw)
         log(f"trace written to {args.trace}")
+        report["trace_dir"] = args.trace
+
+    if args.report:
+        # per-region cost extraction of the program the sweep measured:
+        # region counts name where the latency-bound factor goes (dispatch
+        # edges between regions), cost/memory analysis ties them to the
+        # roofline floors
+        try:
+            log("\n== per-region cost extraction (compiled HLO) ==")
+            ra = region_analysis(cfg, args.region_g, T)
+            ra["G"] = args.region_g
+            report["region_analysis"] = ra
+            log(f"entry instructions: {ra['entry_instructions']} "
+                f"(fusions {ra['fusion_regions']}); scan body: "
+                f"{ra.get('scan_body_instructions', '?')} instructions / "
+                f"{ra.get('scan_body_fusions', '?')} fusions")
+        except Exception as e:  # keep the measured numbers even if HLO
+            # introspection breaks on some backend
+            report["region_analysis"] = {"failed": f"{type(e).__name__}: {e}"}
+            log(f"region analysis FAILED: {type(e).__name__}: {str(e)[:120]}")
+        os.makedirs(os.path.dirname(os.path.abspath(args.report)), exist_ok=True)
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2)
+        log(f"report written to {args.report}")
 
 
 if __name__ == "__main__":

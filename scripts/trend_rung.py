@@ -100,6 +100,16 @@ def main() -> int:
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    # bench.py appends its per-round {round, full_rate, headline} series
+    # under "rounds" in this same artifact — a protocol-study rerun must
+    # carry it forward, not wipe it
+    try:
+        with open(args.out) as f:
+            prev_rounds = json.load(f).get("rounds")
+    except (OSError, ValueError):
+        prev_rounds = None
+    if prev_rounds is not None:
+        out["rounds"] = prev_rounds
     with open(args.out, "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))

@@ -67,6 +67,7 @@ def test_e2e_raw_score_parity(n_fields):
         assert r_cpu.log_likelihood == pytest.approx(r_tpu.log_likelihood, rel=1e-9), f"step {i}"
 
 
+@pytest.mark.quick
 @exact_only
 def test_e2e_state_parity_exact():
     """After N steps, the full device state matches the oracle bit-for-bit."""

@@ -31,6 +31,7 @@ def test_hash_bits_parity():
     np.testing.assert_array_equal(np_b, dev_b)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("n_fields", [1, 3])
 def test_encode_parity(n_fields):
     cfg = ModelConfig(

@@ -80,6 +80,7 @@ def test_e2e_parity_forward_dendrite(forward_dendrite, perm_bits):
         assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
 
 
+@pytest.mark.quick
 @exact_only
 @pytest.mark.parametrize("impl", ["scatter", "matmul"])
 def test_forward_vs_scan_full_state(impl):

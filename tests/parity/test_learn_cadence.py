@@ -58,6 +58,7 @@ def make_vals(n, G, seed=3):
     return v
 
 
+@pytest.mark.quick
 @exact_only
 def test_cadence_device_matches_oracle_with_explicit_flags():
     """group_step under cfg.learn_every == oracle fed the same flag sequence."""

@@ -67,6 +67,7 @@ def test_state_dtypes_and_bytes():
     assert presyn_dtype(nab_preset()) == np.int32
 
 
+@pytest.mark.quick
 @exact_only
 @pytest.mark.parametrize("perm_bits", [16, 8])
 def test_e2e_state_parity_quantized(perm_bits):

@@ -43,6 +43,7 @@ def _run_parity(cfg: ModelConfig, n_steps: int, learn: bool, atol=0.0):
     assert int(host["sp_iter"]) == int(dev["sp_iter"]) == (n_steps if learn else 0)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("learn", [True, False])
 def test_sp_parity_small(learn):
     cfg = ModelConfig(

@@ -74,6 +74,7 @@ def test_e2e_parity_compact_sweep_all_strategies(compact_sweep, scatter):
         tm_tpu.set_layout_mode(None)
 
 
+@pytest.mark.quick
 @exact_only
 def test_compact_vs_dense_full_state():
     """Same inputs through compact-sweep and dense-sweep device models ->

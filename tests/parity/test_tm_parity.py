@@ -71,6 +71,7 @@ def _pattern(rng, C, n_active):
     return rng.choice(C, size=n_active, replace=False)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("learn", [True, False])
 def test_tm_parity_repeating_sequence(learn):
     """A-B-C-D repeated: drives prediction, reinforcement, growth."""
@@ -112,6 +113,7 @@ def test_tm_parity_random_stream_with_eviction():
     _run_parity(C, cfg, seq)
 
 
+@pytest.mark.quick
 @pytest.mark.parametrize("layout", ["aos", "flat"])
 def test_tm_parity_explicit_layouts(layout):
     """Full state parity under BOTH kernel layouts, explicitly pinned.

@@ -70,6 +70,7 @@ def test_e2e_parity_with_flat_layout(flat_layout, perm_bits):
         assert r_cpu.raw_score == pytest.approx(r_tpu.raw_score, abs=0.0), f"step {i}"
 
 
+@pytest.mark.quick
 @exact_only
 @pytest.mark.parametrize("perm_bits", [0, 16])
 def test_e2e_parity_flat_layout_all_tpu_paths(

@@ -13,6 +13,9 @@ def load_bench(tmp_path, monkeypatch, lkg: dict | None):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.LKG_PATH = str(tmp_path / "BENCH_LKG.json")
+    # the trend series is a committed artifact too: every test writes to
+    # its own sandbox (a _finish() with a fresh best appends a round)
+    mod.TREND_PATH = str(tmp_path / "trend_rung.json")
     if lkg is not None:
         (tmp_path / "BENCH_LKG.json").write_text(json.dumps(lkg))
     return mod
@@ -151,3 +154,79 @@ def test_lkg_roundtrips_full_rate_value(tmp_path, monkeypatch, capsys):
     assert b.emit(None) == b.CACHED_EXIT
     out = json.loads(capsys.readouterr().out.strip())
     assert out["value"] == 115429.0 and out["full_rate_value"] == 31905.0
+
+
+def test_append_trend_appends_and_preserves_protocol_study(tmp_path, monkeypatch):
+    """The full-rate trend rides reports/trend_rung.json as a first-class
+    series: every fresh bench appends {round, full_rate, headline} under
+    "rounds" WITHOUT clobbering the protocol-study keys trend_rung.py
+    owns (ISSUE 3 satellite)."""
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    monkeypatch.setenv("BENCH_ROUND", "6")
+    b = load_bench(tmp_path, monkeypatch, None)
+    b.TREND_PATH = str(tmp_path / "trend_rung.json")
+    (tmp_path / "trend_rung.json").write_text(json.dumps(
+        {"novel_feed_metrics_per_s": 32904.0, "config": "x"}))
+    b._BEST_FULL = {"value": 33100.4}
+    b._append_trend({"value": 86000.2, "modes": "flat/matmul/dense/learn_every=4"})
+    data = json.loads((tmp_path / "trend_rung.json").read_text())
+    assert data["novel_feed_metrics_per_s"] == 32904.0  # study keys intact
+    assert len(data["rounds"]) == 1
+    entry = data["rounds"][0]
+    assert entry["round"] == "6"
+    assert entry["headline"] == 86000.2
+    assert entry["full_rate"] == 33100.4
+    # second fresh run appends, never rewrites history
+    b._append_trend({"value": 90000.0, "modes": "m"})
+    data = json.loads((tmp_path / "trend_rung.json").read_text())
+    assert len(data["rounds"]) == 2
+
+
+def test_append_trend_records_full_rate_hole(tmp_path, monkeypatch):
+    """Every default-config rung failing must show as full_rate: null in
+    the series — a hole in the trend, not a silently skipped round."""
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    monkeypatch.delenv("BENCH_ROUND", raising=False)
+    b = load_bench(tmp_path, monkeypatch, None)
+    b.TREND_PATH = str(tmp_path / "trend_rung.json")
+    assert b._BEST_FULL is None
+    b._append_trend({"value": 50.0, "modes": "m"})
+    data = json.loads((tmp_path / "trend_rung.json").read_text())
+    assert data["rounds"][0]["full_rate"] is None
+
+
+def test_append_trend_cpu_drive_guard(tmp_path, monkeypatch):
+    """BENCH_ALLOW_CPU=1 without an explicit BENCH_TREND_PATH must never
+    touch the committed series (same guard family as the LKG store)."""
+    monkeypatch.setenv("BENCH_ALLOW_CPU", "1")
+    monkeypatch.delenv("BENCH_TREND_PATH", raising=False)
+    b = load_bench(tmp_path, monkeypatch, None)
+    b.TREND_PATH = str(tmp_path / "trend_rung.json")
+    b._append_trend({"value": 1.0})
+    assert not (tmp_path / "trend_rung.json").exists()
+
+
+def test_append_trend_survives_corrupt_artifact(tmp_path, monkeypatch):
+    """_append_trend runs inside _finish (including the signal handler):
+    a mangled trend artifact must degrade to a fresh series, and a
+    non-JSON one must not raise through the emission path."""
+    monkeypatch.delenv("BENCH_ALLOW_CPU", raising=False)
+    b = load_bench(tmp_path, monkeypatch, None)
+    b.TREND_PATH = str(tmp_path / "trend_rung.json")
+    (tmp_path / "trend_rung.json").write_text("{not json")
+    b._append_trend({"value": 1.0})  # must not raise
+    (tmp_path / "trend_rung.json").write_text("[1, 2]")  # wrong shape
+    b._append_trend({"value": 2.0})
+    data = json.loads((tmp_path / "trend_rung.json").read_text())
+    assert [e["headline"] for e in data["rounds"]] == [2.0]
+
+
+def test_infer_round_from_committed_artifacts(tmp_path, monkeypatch):
+    """Unattended hw_session bench runs label trend entries one past the
+    newest committed BENCH_rNN.json instead of appending null rounds."""
+    monkeypatch.delenv("BENCH_ROUND", raising=False)
+    b = load_bench(tmp_path, monkeypatch, None)
+    # bench.py sits in the repo root next to BENCH_r01..r05
+    assert b._infer_round() == "r06"
+    monkeypatch.setenv("BENCH_ROUND", "override")
+    assert b._infer_round() == "override"

@@ -398,3 +398,31 @@ def test_http_poll_breaker_short_circuits_dead_endpoint():
     assert np.isnan(v).all() and ts > 0
     assert src.polls_short_circuited == 1
     assert src.poll_failures == 2  # no attempt, no new failure
+
+
+def test_multivariate_source_raise_on_first_tick_does_not_quarantine():
+    """A source that RAISES on tick 0 of a multivariate serve must score a
+    NaN missing-sample tick shaped [G, n_fields] — not a [G] substitute
+    whose dispatch shape error would quarantine every group permanently."""
+    import numpy as np
+
+    from rtap_tpu.config import node_preset
+    from rtap_tpu.service.loop import live_loop
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    cfg = node_preset(n_metrics=3, perm_bits=16)
+    reg = StreamGroupRegistry(cfg, group_size=2, backend="cpu")
+    for i in range(2):
+        reg.add_stream(f"n{i}")
+    reg.finalize()
+
+    def source(k):
+        if k == 0:
+            raise OSError("collector not up yet")
+        rng = np.random.default_rng(k)
+        return (30 + rng.random((2, 3))).astype(np.float32), 1_700_000_000 + k
+
+    stats = live_loop(source, reg, n_ticks=4, cadence_s=0.0)
+    assert stats["ticks"] == 4
+    assert not stats.get("quarantined")
+    assert stats["scored_by_group"] == [8]  # 4 ticks x 2 streams, no gap

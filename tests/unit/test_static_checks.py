@@ -1,6 +1,10 @@
-"""scripts/check_static.sh rides tier-1: compileall over rtap_tpu plus the
-no-bare-print gate for rtap_tpu/service/ (telemetry goes through
-rtap_tpu.obs, never ad-hoc stdout lines the harness would have to scrape)."""
+"""scripts/check_static.sh rides tier-1: compileall over rtap_tpu AND
+scripts/ + bench.py, plus the AST print-gate — NO print() in the serve
+stack (service/obs/resilience: telemetry goes through rtap_tpu.obs, never
+ad-hoc stdout lines the harness would have to scrape), and everywhere else
+in the package/scripts a print() must either target an explicit stream
+(file=) or be the sanctioned one-JSON-line artifact emission
+(json.dumps/.to_json single argument)."""
 
 import glob
 import os
@@ -9,32 +13,55 @@ import subprocess
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def test_check_static_passes():
-    proc = subprocess.run(
+def _run():
+    return subprocess.run(
         ["bash", os.path.join(REPO, "scripts", "check_static.sh")],
         cwd=REPO, capture_output=True, text=True, timeout=300,
     )
+
+
+def _cleanup(victim, subdir):
+    os.remove(victim)
+    # the script's compileall step byte-compiles the canary before the
+    # print gate fails — drop the orphaned pyc too, not just the source
+    base = os.path.splitext(os.path.basename(victim))[0]
+    for pyc in glob.glob(os.path.join(subdir, "__pycache__", base + "*")):
+        os.remove(pyc)
+
+
+def test_check_static_passes():
+    proc = _run()
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "check_static: OK" in proc.stdout
 
 
-def test_print_gate_actually_bites():
-    """The grep gate must fail on a real bare print( — guard the guard
-    (a pattern typo could silently let prints back into the service layer)."""
-    victim = os.path.join(REPO, "rtap_tpu", "service", "_gate_canary.py")
+def test_print_gate_bites_in_serve_stack():
+    """The strict gate must fail on ANY print( in service/ — even one
+    aimed at stderr (guard the guard: a checker regression could silently
+    let prints back into the serve stack)."""
+    subdir = os.path.join(REPO, "rtap_tpu", "service")
+    victim = os.path.join(subdir, "_gate_canary.py")
     with open(victim, "w") as f:
-        f.write('print("scraped-stdout telemetry")\n')
+        f.write('import sys\nprint("scraped", file=sys.stderr)\n')
     try:
-        proc = subprocess.run(
-            ["bash", os.path.join(REPO, "scripts", "check_static.sh")],
-            cwd=REPO, capture_output=True, text=True, timeout=300,
-        )
+        proc = _run()
     finally:
-        os.remove(victim)
-        # the script's compileall step byte-compiles the canary before the
-        # grep gate fails — drop the orphaned pyc too, not just the source
-        for pyc in glob.glob(os.path.join(
-                REPO, "rtap_tpu", "service", "__pycache__", "_gate_canary*")):
-            os.remove(pyc)
+        _cleanup(victim, subdir)
     assert proc.returncode != 0
     assert "_gate_canary" in proc.stdout + proc.stderr
+
+
+def test_print_gate_bites_in_scripts():
+    """The widened gate (ISSUE 3 satellite) must catch a bare print in
+    scripts/ — including the multi-line call form a line-grep cannot see —
+    while leaving file=stderr diagnostics and JSON emission legal."""
+    subdir = os.path.join(REPO, "scripts")
+    victim = os.path.join(subdir, "_gate_canary_s.py")
+    with open(victim, "w") as f:
+        f.write('print(\n    "bare stdout"\n)\n')
+    try:
+        proc = _run()
+    finally:
+        _cleanup(victim, subdir)
+    assert proc.returncode != 0
+    assert "_gate_canary_s" in proc.stdout + proc.stderr
