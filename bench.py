@@ -512,16 +512,20 @@ def run_obs_bench() -> None:
     """`bench.py --obs-bench`: the telemetry-overhead self-benchmark.
 
     The obs subsystem instruments every serve-path tick; its acceptance bar
-    is <= 1% of the tick budget (docs/TELEMETRY.md). Prints one JSON line
+    is <= 1% of the tick budget (docs/TELEMETRY.md) — and so are the span
+    ring + flight recorder (ISSUE 4). Prints one JSON line per surface
     with per-op costs and the projected per-tick fraction at 1 s cadence;
-    exits 1 if the bar is blown (so CI/harness runs fail loudly).
+    exits 1 if either bar is blown (so CI/harness runs fail loudly).
     """
-    from rtap_tpu.obs.selfbench import measure
+    from rtap_tpu.obs.selfbench import measure, measure_trace
 
     res = measure()
     res["pass_1pct_budget"] = res["per_tick_overhead_frac"] <= 0.01
     print(json.dumps({"metric": "obs_overhead", **res}), flush=True)
-    if not res["pass_1pct_budget"]:
+    tres = measure_trace()
+    tres["pass_1pct_budget"] = tres["per_tick_overhead_frac"] <= 0.01
+    print(json.dumps({"metric": "obs_trace_overhead", **tres}), flush=True)
+    if not (res["pass_1pct_budget"] and tres["pass_1pct_budget"]):
         sys.exit(1)
 
 

@@ -138,9 +138,39 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # (--obs-snapshot; $RTAP_OBS_SNAPSHOT is the session runner's default)
     from rtap_tpu.obs import ExpositionServer, default_snapshot_path, write_snapshot
 
+    # per-tick tracing + black-box flight recorder (obs/trace.py,
+    # obs/flight.py, docs/POSTMORTEM.md). The span ring also backs the
+    # obs server's /trace route, so --obs-port alone enables it.
+    trace = None
+    flight = None
+    if args.trace_out or args.postmortem_dir or args.obs_port is not None:
+        from rtap_tpu.obs import TraceRecorder
+
+        trace = TraceRecorder(capacity=args.trace_ring)
+    if args.postmortem_dir:
+        from rtap_tpu.obs import FlightRecorder
+
+        os.makedirs(args.postmortem_dir, exist_ok=True)
+        flight = FlightRecorder(
+            trace=trace, n_ticks=args.flight_ticks,
+            out_dir=args.postmortem_dir,
+            info={"command": "serve", "streams": len(ids),
+                  "group_size": gsize, "cadence_s": args.cadence,
+                  "ticks": args.ticks, "backend": args.backend,
+                  "preset": args.preset, "micro_chunk": args.micro_chunk,
+                  "pipeline_depth": args.pipeline_depth,
+                  "freeze": bool(args.freeze)})
+        print(f"serve: flight recorder armed (last {args.flight_ticks} "
+              f"ticks -> {args.postmortem_dir})", file=sys.stderr)
+    attributor = None
+    if args.alert_attribution:
+        from rtap_tpu.service.attribution import AlertAttributor
+
+        attributor = AlertAttributor(cfg)
     obs_server = None
     if args.obs_port is not None:
-        obs_server = ExpositionServer(port=args.obs_port).start()
+        obs_server = ExpositionServer(port=args.obs_port, trace=trace,
+                                      flight=flight).start()
         ohost, oport = obs_server.address
         print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
               file=sys.stderr)
@@ -164,30 +194,78 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         prev[sig] = signal.signal(sig, _on_signal)
+    jax_tracing = False
+    if args.jax_trace:
+        # device-side XLA trace paired with the host span timeline: the
+        # hw_session device-trace step loads both into Perfetto
+        import jax
+
+        jax.profiler.start_trace(args.jax_trace)
+        jax_tracing = True
+        print(f"serve: jax profiler tracing to {args.jax_trace}",
+              file=sys.stderr)
     try:
-        stats = live_loop(source, grp, n_ticks=args.ticks, cadence_s=args.cadence,
-                          alert_path=args.alerts,
-                          checkpoint_dir=args.checkpoint_dir,
-                          checkpoint_every=args.checkpoint_every,
-                          stop_event=stop,
-                          pipeline_depth=args.pipeline_depth,
-                          dispatch_threads=args.dispatch_threads,
-                          learn=not args.freeze,
-                          auto_register=args.auto_register,
-                          auto_release_after=args.auto_release_after,
-                          micro_chunk=args.micro_chunk,
-                          chunk_stagger=args.chunk_stagger,
-                          chaos=chaos,
-                          degradation=degradation,
-                          quarantine_restore_after=args.quarantine_restore_after,
-                          alert_flush_every=args.alert_flush_every,
-                          aot_warmup=args.aot_warmup)
+        try:
+            stats = live_loop(source, grp, n_ticks=args.ticks, cadence_s=args.cadence,
+                              alert_path=args.alerts,
+                              checkpoint_dir=args.checkpoint_dir,
+                              checkpoint_every=args.checkpoint_every,
+                              stop_event=stop,
+                              pipeline_depth=args.pipeline_depth,
+                              dispatch_threads=args.dispatch_threads,
+                              learn=not args.freeze,
+                              auto_register=args.auto_register,
+                              auto_release_after=args.auto_release_after,
+                              micro_chunk=args.micro_chunk,
+                              chunk_stagger=args.chunk_stagger,
+                              chaos=chaos,
+                              degradation=degradation,
+                              quarantine_restore_after=args.quarantine_restore_after,
+                              alert_flush_every=args.alert_flush_every,
+                              aot_warmup=args.aot_warmup,
+                              trace=trace, flight=flight,
+                              attributor=attributor)
+        except BaseException as e:  # noqa: BLE001 — dump, then re-raise
+            # crash black-box: an exception escaping serve dumps a
+            # postmortem bundle BEFORE the traceback, so a dead soak
+            # leaves its last N ticks of evidence behind. (Worker-thread
+            # faults already surface here: the loop joins its pool and
+            # re-raises captured exceptions in the loop thread.)
+            if flight is not None:
+                flight.record_event({
+                    "event": "unhandled_exception",
+                    "error": f"{type(e).__name__}: {e}"})
+                flight.dump("unhandled_exception")
+            raise
     finally:
+        if jax_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                print(f"serve: jax profiler stop failed: {e}",
+                      file=sys.stderr)
         for sig, handler in prev.items():
             signal.signal(sig, handler)
         close()
         if obs_server is not None:
             obs_server.close()
+        if args.trace_out and trace is not None:
+            # Perfetto-loadable Chrome trace JSON, atomically (tmp +
+            # replace): written even on an error path — the timeline of
+            # a dying serve is exactly what the postmortem needs. Best
+            # effort: must not mask the loop's own exception.
+            try:
+                tmp = args.trace_out + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(trace.chrome_trace(), f)
+                os.replace(tmp, args.trace_out)
+                print(f"serve: host trace written to {args.trace_out} "
+                      f"({trace.total} records, {trace.dropped} dropped)",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"serve: trace write failed: {e}", file=sys.stderr)
         if obs_snapshot:
             # final registry snapshot even on an error path: a soak that
             # died mid-run must still leave its telemetry on disk. Best
@@ -504,6 +582,41 @@ def main(argv: list[str] | None = None) -> int:
                    help="append one JSONL telemetry snapshot line to this "
                         "file on exit (default: $RTAP_OBS_SNAPSHOT if set "
                         "— the no-network hw-session surface)")
+    p.add_argument("--trace-out", default=None,
+                   help="write the per-tick host span timeline as Chrome "
+                        "trace-event JSON to this file on exit (load it in "
+                        "ui.perfetto.dev; docs/POSTMORTEM.md). Tracing is "
+                        "a bounded in-memory ring, near-zero overhead — "
+                        "also served live at GET /trace?last=N with "
+                        "--obs-port")
+    p.add_argument("--trace-ring", type=int, default=65536,
+                   help="span-ring capacity in records PER WRITER THREAD "
+                        "(~33 B each); older records are overwritten and "
+                        "counted in rtap_obs_trace_dropped")
+    p.add_argument("--postmortem-dir", default=None,
+                   help="arm the black-box flight recorder: the last "
+                        "--flight-ticks ticks of spans/events/metric "
+                        "deltas auto-dump here as an atomic postmortem "
+                        "bundle on group quarantine, degradation-level "
+                        "change, missed-tick burst, or a crash "
+                        "(scripts/postmortem.py pretty-prints one; "
+                        "docs/POSTMORTEM.md is the runbook)")
+    p.add_argument("--flight-ticks", type=int, default=240,
+                   help="flight-recorder window: how many recent ticks a "
+                        "postmortem bundle covers (bounded ring; memory "
+                        "is O(flight_ticks * n_groups))")
+    p.add_argument("--alert-attribution", action="store_true",
+                   help="per-alert provenance: alert JSONL lines gain a "
+                        "top_fields block naming the encoder fields whose "
+                        "representation moved most (SDR bucket-overlap "
+                        "decode — service/attribution.py); meaningful for "
+                        "multivariate models, cheap either way")
+    p.add_argument("--jax-trace", default=None,
+                   help="wrap the serve window in jax.profiler.trace "
+                        "writing the XLA device trace to this directory "
+                        "(pairs with --trace-out: host + device timelines "
+                        "of the same ticks — the hw_session device-trace "
+                        "step)")
     p.add_argument("--freeze", action="store_true",
                    help="inference-only serving (NuPIC disableLearning "
                         "parity): SP/TM/classifier state is bit-frozen, raw "

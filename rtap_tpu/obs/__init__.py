@@ -3,9 +3,13 @@
 One process-wide :class:`TelemetryRegistry` of counters, gauges, and
 fixed-bucket latency histograms (obs/metrics.py); Prometheus-v0 text and
 JSONL-snapshot exposition over localhost HTTP or to a file
-(obs/expo.py); and a tick watchdog that turns deadline misses, source
+(obs/expo.py); a tick watchdog that turns deadline misses, source
 starvation, and checkpoint stalls into counters + structured JSONL
-events (obs/watchdog.py). The serve hot paths (service/loop.py,
+events (obs/watchdog.py); a per-tick span recorder exporting
+Perfetto-loadable Chrome trace JSON (obs/trace.py); and a black-box
+flight recorder that auto-dumps atomic postmortem bundles on
+quarantine/degradation/miss-burst/crash (obs/flight.py,
+docs/POSTMORTEM.md). The serve hot paths (service/loop.py,
 service/alerts.py, service/sources.py, service/checkpoint.py) emit
 through this seam; docs/TELEMETRY.md catalogs every metric.
 """
@@ -26,20 +30,25 @@ from rtap_tpu.obs.metrics import (
     get_registry,
     log_buckets,
 )
+from rtap_tpu.obs.flight import FlightRecorder, validate_bundle
+from rtap_tpu.obs.trace import TraceRecorder
 from rtap_tpu.obs.watchdog import TickWatchdog
 
 __all__ = [
     "Counter",
     "ExpositionServer",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "TelemetryRegistry",
     "TickWatchdog",
+    "TraceRecorder",
     "default_snapshot_path",
     "get_registry",
     "log_buckets",
     "read_last_snapshot",
     "render_prometheus",
     "summarize_snapshot",
+    "validate_bundle",
     "write_snapshot",
 ]

@@ -166,12 +166,43 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "rtap-obs/0"
 
     def do_GET(self):  # noqa: N802 — http.server API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path in ("/metrics", "/"):
             body = render_prometheus(self.server.registry).encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/snapshot":
             body = (json.dumps(self.server.registry.snapshot()) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/trace":
+            # the span recorder's timeline as Chrome trace-event JSON
+            # (save the body and open it in ui.perfetto.dev). ?last=N
+            # windows to the last N ticks (default 120).
+            tr = getattr(self.server, "trace", None)
+            if tr is None:
+                self.send_error(404, "tracing not enabled (serve --trace-out"
+                                     " / --postmortem-dir)")
+                return
+            try:
+                from urllib.parse import parse_qs
+
+                last = int(parse_qs(query).get("last", ["120"])[0])
+            except (ValueError, IndexError):
+                self.send_error(400, "bad ?last= value")
+                return
+            body = (json.dumps(tr.chrome_trace(last_ticks=last))
+                    + "\n").encode()
+            ctype = "application/json"
+        elif path == "/postmortem":
+            # on-demand flight-recorder dump; returns the bundle path (or
+            # null when throttled). GET because it is an operator poke on
+            # a localhost-only diagnostic server, not a REST resource.
+            fl = getattr(self.server, "flight", None)
+            if fl is None:
+                self.send_error(404, "flight recorder not enabled "
+                                     "(serve --postmortem-dir)")
+                return
+            body = (json.dumps({"bundle": fl.dump("on_demand")})
+                    + "\n").encode()
             ctype = "application/json"
         else:
             self.send_error(404)
@@ -197,14 +228,21 @@ class ExpositionServer:
     ``port=0`` binds ephemeral (the serve/TCP path's orphan-proof style);
     the bound address is ``.address``. Start/stop via context manager or
     ``start()``/``close()``. Scrape ``/metrics`` for Prometheus text,
-    ``/snapshot`` for the JSON snapshot.
+    ``/snapshot`` for the JSON snapshot; with a ``trace`` recorder
+    attached, ``/trace?last=N`` serves the Perfetto-loadable timeline,
+    and with a ``flight`` recorder, ``/postmortem`` dumps a bundle on
+    demand (rings are written lock-free by the loop, so a concurrent
+    read is point-in-time diagnostic data, not a consistent snapshot).
     """
 
     def __init__(self, registry: TelemetryRegistry | None = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 trace=None, flight=None):
         self.registry = registry or get_registry()
         self._server = _Server((host, port), _Handler)
         self._server.registry = self.registry
+        self._server.trace = trace
+        self._server.flight = flight
         self.address = self._server.server_address  # (host, bound port)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)

@@ -15,12 +15,18 @@ import time
 
 from rtap_tpu.obs.metrics import TelemetryRegistry
 
-__all__ = ["measure", "OPS_PER_TICK"]
+__all__ = ["measure", "measure_trace", "OPS_PER_TICK",
+           "TRACE_SPANS_PER_TICK"]
 
 #: instrument operations a serve tick costs at the production shape (six
 #: phase observes + tick latency observe + ticks/scored/alert counters +
 #: streams gauge + watchdog deadline check), rounded up for headroom
 OPS_PER_TICK = 32
+
+#: span-ring appends a serve tick costs at the production multi-group
+#: shape: the tick span + six phase spans + one dispatch and one collect
+#: child span per group at 16 groups (7 + 2*16 = 39), rounded up
+TRACE_SPANS_PER_TICK = 40
 
 
 def _time_op(fn, n: int) -> float:
@@ -51,6 +57,58 @@ def measure(n: int = 50_000, cadence_s: float = 1.0) -> dict:
         "gauge_ns": round(gauge_s * 1e9, 1),
         "histogram_observe_ns": round(hist_s * 1e9, 1),
         "ops_per_tick": OPS_PER_TICK,
+        "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
+        "per_tick_overhead_frac": per_tick_s / cadence_s,
+        "cadence_s": cadence_s,
+    }
+
+
+def measure_trace(n: int = 50_000, cadence_s: float = 1.0,
+                  n_groups: int = 16) -> dict:
+    """Trace-ring + flight-recorder hot-path cost, same protocol as
+    :func:`measure`: per-op nanoseconds on a private recorder, projected
+    to a tick at the production multi-group shape (ISSUE 4 acceptance:
+    tracing + flight recording together stay <= 1% of the tick budget).
+
+    A tick costs ``TRACE_SPANS_PER_TICK`` span appends plus ONE flight
+    ``record_tick`` (instants ride event paths — rare by construction,
+    measured anyway for the record)."""
+    from rtap_tpu.obs.flight import FlightRecorder
+    from rtap_tpu.obs.metrics import TelemetryRegistry
+    from rtap_tpu.obs.trace import TraceRecorder
+
+    tr = TraceRecorder(capacity=4096)
+    t0 = time.perf_counter()
+    # warm the shard + name intern out of the measurement (first-op cost)
+    tr.add_span("dispatch", 0, t0, 0.001, group=3)
+    tr.add_instant("missed_tick", 0, {"elapsed_s": 1.2})
+    span_s = _time_op(lambda: tr.add_span("dispatch", 1, t0, 0.001, group=3),
+                      n)
+    n_inst = max(1, n // 10)
+    inst_s = _time_op(
+        lambda: tr.add_instant("missed_tick", 1, {"elapsed_s": 1.2}), n_inst)
+
+    fl = FlightRecorder(trace=tr, n_ticks=256,
+                        registry=TelemetryRegistry())
+    phases = {p: 0.001 for p in ("source", "membership", "dispatch",
+                                 "collect", "emit", "checkpoint")}
+    scored = [n_groups] * n_groups
+    tick = [0]
+
+    def _rt():
+        tick[0] += 1
+        fl.record_tick(tick[0], 0.01, phases, scored, False)
+
+    _rt()  # size the rings out of the measurement
+    rt_s = _time_op(_rt, max(1, n // 5))
+
+    per_tick_s = TRACE_SPANS_PER_TICK * span_s + rt_s
+    return {
+        "trace_span_ns": round(span_s * 1e9, 1),
+        "trace_instant_ns": round(inst_s * 1e9, 1),
+        "flight_record_tick_ns": round(rt_s * 1e9, 1),
+        "spans_per_tick": TRACE_SPANS_PER_TICK,
+        "n_groups": n_groups,
         "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
         "per_tick_overhead_frac": per_tick_s / cadence_s,
         "cadence_s": cadence_s,

@@ -44,10 +44,17 @@ class TickWatchdog:
                  registry: TelemetryRegistry | None = None,
                  event_sink: Callable[[dict], None] | None = None,
                  starved_after: int = 3,
-                 checkpoint_stall_s: float | None = None):
+                 checkpoint_stall_s: float | None = None,
+                 trace=None, flight=None):
         if starved_after < 1:
             raise ValueError(f"starved_after must be >= 1; got {starved_after}")
         reg = registry or get_registry()
+        # optional timeline hooks (obs/trace.py, obs/flight.py): every
+        # watchdog event also lands as an instant on the span timeline —
+        # a missed_tick mark sits visually inside the tick that blew the
+        # budget — and in the flight recorder's bounded event ring
+        self._trace = trace
+        self._flight = flight
         self.cadence_s = float(cadence_s)
         self.checkpoint_stall_s = float(
             checkpoint_stall_s if checkpoint_stall_s is not None else cadence_s)
@@ -77,6 +84,11 @@ class TickWatchdog:
 
     def _emit(self, kind: str, tick: int, **fields) -> None:
         self._events[kind].inc()
+        if self._trace is not None:
+            self._trace.add_instant(kind, int(tick), fields)
+        if self._flight is not None:
+            self._flight.record_event({"event": kind, "tick": int(tick),
+                                       **fields})
         if self._sink is not None:
             self._sink({"event": kind, "tick": int(tick), **fields})
 

@@ -42,12 +42,17 @@ class AlertWriter:
     """
 
     def __init__(self, path: str | None = None, flush_every: int = 1,
-                 breaker=None):
+                 breaker=None, attributor=None):
         from rtap_tpu.resilience.policies import CircuitBreaker
 
         if flush_every < 1:
             raise ValueError(f"flush_every must be >= 1; got {flush_every}")
         self.path = path
+        # per-alert provenance (service/attribution.py, serve
+        # --alert-attribution): alert lines gain a top_fields block.
+        # History advances on EVERY batch (attribution compares against
+        # the previous tick), alert or not.
+        self._attributor = attributor
         self._fh: IO[str] | None = open(path, "a") if path else None
         self.count = 0
         self.dropped = 0
@@ -151,6 +156,13 @@ class AlertWriter:
         self.count += idx.size
         if idx.size:
             self._obs_alerts.inc(int(idx.size))
+        attr = None
+        if self._attributor is not None:
+            # history must advance on every batch, not just alerting ones
+            # — but the per-alert decode is only worth computing when a
+            # sink will carry it (path=None serves count-only callers)
+            attr = self._attributor.update_and_attribute(
+                stream_ids, values, idx if self._fh is not None else idx[:0])
         if self._fh is not None and idx.size:
             ts = np.broadcast_to(np.asarray(ts), alerts.shape)
             values = np.asarray(values)
@@ -166,6 +178,8 @@ class AlertWriter:
                         else [float(x) for x in values[g]],
                         "raw_score": float(raw[g]),
                         "log_likelihood": float(log_likelihood[g]),
+                        **({"top_fields": attr.get(int(g), [])}
+                           if attr is not None else {}),
                     }
                 )
                 + "\n"

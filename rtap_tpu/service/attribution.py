@@ -1,0 +1,153 @@
+"""Per-alert provenance: which encoder fields drove an anomaly alert.
+
+The paper's premise is alerts that arrive *before* failure; an operator
+acting on one needs to know WHICH of a node's fused metrics (cpu? mem?
+net?) moved. SDR semantics make that decodable ("Properties of Sparse
+Distributed Representations" / "Encoding Data for HTM Systems",
+PAPERS.md): each field owns a disjoint encoder bit range, the RDSE maps
+value -> bucket ``b`` -> bits ``{hash(b + k) : k < w}``, and buckets
+``b0``, ``b1`` share exactly ``max(0, w - |b1 - b0|)`` hash keys — SDR
+overlap decays linearly with bucket distance, BY CONSTRUCTION. So a
+field whose consecutive-tick encodings stopped overlapping is a field
+whose representation jumped, and the anomalous columns (active but
+unpredicted) inherit that novelty through their field-segment potential
+pools.
+
+:class:`AlertAttributor` decodes in this encoder key-space: per alerting
+stream it compares the current tick's per-field bucket against the
+previous tick's, converts bucket distance to lost-overlap fraction
+``min(1, |Δbucket| / w)``, and reports the top-k fields by normalized
+contribution. The offset term of the bucket map cancels in the
+difference, so no per-stream encoder state needs fetching from the
+device — attribution costs one O(n_fields) numpy pass per ALERTING
+stream plus one per-group history copy per tick, and is exact in
+key-space (the per-tick column masks never reach the host from the
+chunked device scan, so column-level decoding post-hoc is not possible
+without changing the compiled step; the key-space decode is the same
+overlap those columns see).
+
+Enabled by ``serve --alert-attribution``; alert JSONL lines gain
+``"top_fields": [{"field": i, "contribution": c, "bucket_delta": d},
+...]`` (empty list on the first tick a stream is seen, or when nothing
+moved — e.g. a purely temporal/date-driven anomaly).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+from rtap_tpu.models.oracle.encoders import rdse_bucket, scalar_bucket
+
+__all__ = ["AlertAttributor"]
+
+#: LRU bound on tracked routing tuples. Sized an order of magnitude
+#: above any feasible live fleet — the serving shapes top out at ~100
+#: groups (100k streams at G=1024) and the compiler wall caps streams
+#: per chip well before 8192 groups — so in practice only RETIRED
+#: tuples (membership-rebuild churn) are ever evicted; a fleet that
+#: somehow exceeds the cap degrades to empty top_fields and counts it
+#: in ``live_evictions`` instead of hiding it.
+_MAX_TRACKED_ROUTES = 8192
+
+
+class AlertAttributor:
+    """Stateful per-field novelty decoder for alert provenance.
+
+    One instance serves the whole loop: history is keyed by the emission
+    routing's id tuple (one entry per group; rebuilt snapshots age out),
+    and the previous-value row carries the last FINITE value per field —
+    a missing sample must not erase the baseline the next real value is
+    judged against.
+    """
+
+    def __init__(self, cfg: ModelConfig, top_k: int = 3):
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1; got {top_k}")
+        self.cfg = cfg
+        self.top_k = int(top_k)
+        if cfg.scalar is not None:
+            self._w = int(cfg.scalar.width)
+        else:
+            self._w = int(cfg.rdse.active_bits)
+            # same f32 rounding as the encoder's own resolution path
+            self._res = float(np.float32(cfg.rdse.resolution))
+        self._prev: dict[tuple, tuple[np.ndarray, int]] = {}
+        self._calls = 0
+        #: evictions of recently-updated (plausibly live) routes — stays
+        #: 0 unless the fleet exceeds _MAX_TRACKED_ROUTES groups
+        self.live_evictions = 0
+
+    def _bucket_delta(self, cur: np.ndarray, base: np.ndarray) -> np.ndarray:
+        """Per-field bucket distance between two value rows.
+
+        RDSE: computed directly as round((cur - base)/res) — subtracting
+        FIRST is what makes the offset cancel exactly AND keeps f32
+        precision (round(cur/res) - round(base/res) loses small moves on
+        large-magnitude baselines and saturates at the ±2^30 bucket
+        clamp, zeroing the attribution of the very field that spiked).
+        Scalar encoder: bucket difference after the range clip (the
+        clipped domain is small by construction)."""
+        if self.cfg.scalar is not None:
+            return (scalar_bucket(cur, self.cfg.scalar)
+                    - scalar_bucket(base, self.cfg.scalar))
+        return rdse_bucket(cur, base, self._res)
+
+    def update_and_attribute(self, stream_ids: list[str],
+                             values: np.ndarray,
+                             alert_idx: np.ndarray) -> dict[int, list[dict]]:
+        """Advance per-stream history one tick; attribute the alerts.
+
+        `values` is the emission batch's value block ([n] or
+        [n, n_fields], aligned with `stream_ids`); `alert_idx` the
+        indices whose alert fired. Returns {index: top_fields list}.
+        """
+        self._calls += 1
+        vals = np.asarray(values, np.float32)
+        if vals.ndim == 1:
+            vals = vals[:, None]
+        key = tuple(stream_ids)
+        entry = self._prev.get(key)
+        prev = entry[0] if entry is not None else None
+        if prev is not None and prev.shape != vals.shape:
+            prev = None  # field-shape change: restart history
+        # carry the last finite value forward per field: NaN gaps keep
+        # the pre-gap baseline (the encoder's missing-sample semantics)
+        if prev is None:
+            carried = vals.copy()
+        else:
+            carried = np.where(np.isfinite(vals), vals, prev)
+        self._prev[key] = (carried, self._calls)
+        if len(self._prev) > _MAX_TRACKED_ROUTES:
+            # LRU prune (rare: only route churn beyond the cap reaches
+            # here). An evicted entry updated within the last cap-worth
+            # of calls was plausibly a LIVE group's — count it loudly.
+            items = sorted(self._prev.items(), key=lambda kv: kv[1][1])
+            drop = items[: len(items) - _MAX_TRACKED_ROUTES]
+            floor = self._calls - _MAX_TRACKED_ROUTES
+            self.live_evictions += sum(1 for _, v in drop if v[1] >= floor)
+            self._prev = dict(items[len(drop):])
+        out: dict[int, list[dict]] = {}
+        for g in np.asarray(alert_idx).ravel():
+            g = int(g)
+            if prev is None:
+                out[g] = []
+                continue
+            cur, base = vals[g], prev[g]
+            finite = np.isfinite(cur) & np.isfinite(base)
+            db = np.zeros(cur.shape[0], np.int64)
+            if finite.any():
+                db[finite] = self._bucket_delta(cur[finite], base[finite])
+            novelty = np.minimum(np.abs(db), self._w) / float(self._w)
+            total = float(novelty.sum())
+            if total <= 0.0:
+                out[g] = []
+                continue
+            order = np.argsort(-novelty, kind="stable")[: self.top_k]
+            out[g] = [
+                {"field": int(f),
+                 "contribution": round(float(novelty[f] / total), 4),
+                 "bucket_delta": int(db[f])}
+                for f in order if novelty[f] > 0.0
+            ]
+        return out

@@ -66,6 +66,7 @@ def replay_streams(
     checkpoint_dir: str | None = None,
     checkpoint_every: int = 0,
     debounce: int = 1,
+    trace=None,
 ) -> ReplayResult:
     """Replay equal-length streams through grouped models at full speed.
 
@@ -158,7 +159,13 @@ def replay_streams(
 
         def collect(span, handle):
             t0, t1 = span
+            tc0 = time.perf_counter() if trace is not None else 0.0
             r, ll, al = grp.collect_chunk(handle)
+            if trace is not None:
+                # chunk-granularity spans (replay has no cadence): the
+                # correlation tick is the chunk's first tick
+                trace.add_span("replay_collect", t0, tc0,
+                               time.perf_counter() - tc0, group=gi)
             raw[t0:t1, lo : lo + live] = r[:, :live]
             loglik[t0:t1, lo : lo + live] = ll[:, :live]
             alerts[t0:t1, lo : lo + live] = al[:, :live]
@@ -177,7 +184,12 @@ def replay_streams(
         chunks_done = 0
         for t0 in range(grp.ticks, T, chunk_ticks):
             t1 = min(t0 + chunk_ticks, T)
-            pending.append(((t0, t1), grp.dispatch_chunk(gv[t0:t1], gt[t0:t1], learn=learn)))
+            td0 = time.perf_counter() if trace is not None else 0.0
+            handle = grp.dispatch_chunk(gv[t0:t1], gt[t0:t1], learn=learn)
+            if trace is not None:
+                trace.add_span("replay_dispatch", t0, td0,
+                               time.perf_counter() - td0, group=gi)
+            pending.append(((t0, t1), handle))
             if len(pending) >= 2:
                 collect(*pending.popleft())
                 chunks_done += 1
@@ -255,6 +267,9 @@ def live_loop(
     quarantine_restore_after: int = 0,
     alert_flush_every: int = 1,
     aot_warmup: bool = False,
+    trace=None,
+    flight=None,
+    attributor=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -374,6 +389,32 @@ def live_loop(
     checkpoint saves — for deterministic recovery-path testing
     (scripts/chaos_soak.py, serve --chaos-spec). None = no injection and
     zero hot-path cost.
+
+    `trace` (an obs.TraceRecorder) records the per-tick timeline: every
+    phase interval the loop already clocks becomes a span (plus a
+    whole-tick span and per-group dispatch/collect child spans from
+    inside the fault-capture wrappers), and every watchdog/resilience
+    event becomes an instant at the same tick — exported as
+    Perfetto-loadable Chrome trace JSON (serve --trace-out, GET /trace).
+    The membership and checkpoint spans are positioned at their block
+    start with the BOOKED duration (the same drain-exclusion arithmetic
+    the phase histograms use), so their on-screen width matches the
+    attributed cost, not the raw wall interval. None = zero hot-path
+    cost.
+
+    `flight` (an obs.FlightRecorder) keeps a bounded black-box ring of
+    the last N ticks (latency, per-phase deltas, per-group scored
+    digest, deadline verdicts, recent events) and auto-dumps an atomic
+    postmortem bundle on group quarantine, degradation-level change, or
+    a missed-tick burst (docs/POSTMORTEM.md). Dumps are queued mid-tick
+    and written AFTER the tick's deadline accounting, so the bundle
+    write itself shows up (honestly) in the NEXT tick's budget, never
+    inside a phase span.
+
+    `attributor` (a service.attribution.AlertAttributor) adds per-alert
+    `top_fields` provenance to alert JSONL lines (serve
+    --alert-attribution): the fields whose encoder representation moved
+    most, decoded in RDSE key-space (docs/TELEMETRY.md).
 
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
@@ -547,6 +588,17 @@ def live_loop(
         "rtap_obs_dup_compiles_avoided_total",
         "cold programs the pre-(m, config) warm-up keying would have "
         "compiled concurrently in N pool threads (ADVICE r5)")
+    obs_trace_records = obs_trace_dropped = None
+    if trace is not None:
+        # span-ring health as gauges, set once per tick (the recorder has
+        # no counters of its own — its hot path is a handful of stores)
+        obs_trace_records = obs.gauge(
+            "rtap_obs_trace_records",
+            "span/instant records appended to the trace ring this run")
+        obs_trace_dropped = obs.gauge(
+            "rtap_obs_trace_dropped",
+            "trace records overwritten by ring wrap-around (grow "
+            "--trace-ring if postmortems need deeper history)")
     auto_registered = 0
     auto_rejected_total = 0
     auto_rejected: set = set()  # bounded de-dup memory, not the count
@@ -558,7 +610,8 @@ def live_loop(
             f"auto_release_after must be >= 0; got {auto_release_after}")
     if auto_release_after and reg is None:
         raise ValueError("auto_release_after needs a StreamGroupRegistry")
-    writer = AlertWriter(alert_path, flush_every=alert_flush_every)
+    writer = AlertWriter(alert_path, flush_every=alert_flush_every,
+                         attributor=attributor)
     counter = ThroughputCounter()
     # ---- resilience wiring (rtap_tpu.resilience, docs/RESILIENCE.md) ----
     if chaos is not None:
@@ -599,6 +652,13 @@ def live_loop(
                 "rtap_obs_resilience_events_total",
                 "structured resilience events by kind", event=kind)
         c.inc()
+        if trace is not None:
+            # same timeline as the phase spans: the quarantine/degrade
+            # mark lands visually inside the span that raised it
+            trace.add_instant(kind, int(tick), fields,
+                              group=int(fields.get("group", -1)))
+        if flight is not None:
+            flight.record_event({"event": kind, "tick": int(tick), **fields})
         writer.emit_event({"event": kind, "tick": int(tick), **fields})
 
     obs_groups_quarantined = obs.gauge(
@@ -634,6 +694,10 @@ def live_loop(
         _res_event("group_quarantined", tick, group=gi, phase=phase,
                    error=info["error"],
                    streams=int(groups[gi].n_live))
+        if flight is not None:
+            # the black-box moment: dump a postmortem bundle for this
+            # isolation (queued; written after the tick's accounting)
+            flight.request_dump("group_quarantined", tick)
 
     source_error_run = 0  # consecutive source raises (event on the first)
     last_ts_seen = None  # monotonic clamp floor for source timestamps
@@ -666,7 +730,8 @@ def live_loop(
     # deadline/starvation/stall events -> registry counters + structured
     # JSONL lines on the alert stream (obs/watchdog.py)
     watchdog = TickWatchdog(cadence_s, registry=obs,
-                            event_sink=writer.emit_event)
+                            event_sink=writer.emit_event,
+                            trace=trace, flight=flight)
     missed = 0
     checkpoints_saved = 0
     ticks_run = 0
@@ -697,12 +762,19 @@ def live_loop(
         Quarantine itself happens after the join, in the loop thread —
         AlertWriter emission is single-threaded by contract."""
         gi, grp, h = item
+        tg0 = time.perf_counter() if trace is not None else 0.0
         try:
             if chaos is not None:
                 chaos.on_collect(gi, cur_tick)
             return gi, grp.collect_chunk(h), None
         except Exception as e:  # noqa: BLE001 — any fault isolates the group
             return gi, None, e
+        finally:
+            if trace is not None:
+                # per-group child span on the group's own track — runs in
+                # a pool thread; the recorder's shards are per-thread
+                trace.add_span("collect", cur_tick, tg0,
+                               time.perf_counter() - tg0, group=gi)
 
     def _collect_tick(ts_rows, value_rows, handles, rmaps, idx=None):
         # collects in parallel (each blocks on its group's device fetch —
@@ -722,6 +794,8 @@ def live_loop(
             outs = list(pool.map(_try_collect, pairs))
         t1 = time.perf_counter()
         phase_s["collect"] += t1 - t0
+        if trace is not None:
+            trace.add_span("collect", cur_tick, t0, t1 - t0)
         results: dict = {}
         for gi, res, exc in outs:
             if exc is not None:
@@ -743,7 +817,10 @@ def live_loop(
                 scored += n
             group_scored[gi] += len(ts_rows) * n
         obs_scored.inc(scored)
-        phase_s["emit"] += time.perf_counter() - t1
+        t2 = time.perf_counter()
+        phase_s["emit"] += t2 - t1
+        if trace is not None:
+            trace.add_span("emit", cur_tick, t1, t2 - t1)
 
     aot_programs = 0
     if aot_warmup:
@@ -783,12 +860,17 @@ def live_loop(
         """Dispatch one group's chunk, capturing the fault: a raising
         dispatch (device error, wedged RPC surfacing, injected chaos)
         must isolate THAT group, not unwind the tick."""
+        tg0 = time.perf_counter() if trace is not None else 0.0
         try:
             if chaos is not None:
                 chaos.on_dispatch(gi, cur_tick)
             return grp.dispatch_chunk(v, t, learn=learn_flag), None
         except Exception as e:  # noqa: BLE001 — any fault isolates the group
             return None, e
+        finally:
+            if trace is not None:
+                trace.add_span("dispatch", cur_tick, tg0,
+                               time.perf_counter() - tg0, group=gi)
 
     def _dispatch_all(value_rows, ts_rows, rmaps, idx=None, learn_flag=None):
         """Dispatch every non-quarantined group in `idx`; returns handles
@@ -925,7 +1007,10 @@ def live_loop(
         now = time.perf_counter()
         handles = _dispatch_all(vrows, tsrows, routing, class_idx[c],
                                 learn_flag=lrn)
-        phase_s["dispatch"] += time.perf_counter() - now
+        t1 = time.perf_counter()
+        phase_s["dispatch"] += t1 - now
+        if trace is not None:
+            trace.add_span("dispatch", cur_tick, now, t1 - now)
         in_flights[c].append((tsrows, vrows, handles, routing, class_idx[c]))
         while len(in_flights[c]) >= pipeline_depth:
             _collect_tick(*in_flights[c].popleft())
@@ -941,6 +1026,7 @@ def live_loop(
                 chaos.set_tick(k)
             t_start = time.perf_counter()
             t_phase = t_start
+            scored_tick0 = list(group_scored) if flight is not None else None
             phase_tick0 = dict(phase_s)  # per-tick deltas feed the per-
             # phase histograms at tick end (cumulative sums stay the
             # source of truth for the membership-exclusion arithmetic)
@@ -1099,9 +1185,15 @@ def live_loop(
                 obs_rebuilds.inc()
                 obs_streams.set(n_expected)
             now = time.perf_counter()
-            phase_s["membership"] += (now - t_phase) - (
+            _mem_booked = (now - t_phase) - (
                 phase_s["collect"] + phase_s["emit"] + phase_s["dispatch"]
                 - ce_tick0)
+            phase_s["membership"] += _mem_booked
+            if trace is not None and _mem_booked > 1e-6:
+                # positioned at the block start with the BOOKED duration
+                # (drains inside the block already own their own spans)
+                trace.add_span("membership", k, t_phase,
+                               max(0.0, _mem_booked))
             try:
                 values, ts = source(k)
             except Exception as e:  # noqa: BLE001
@@ -1125,7 +1217,10 @@ def live_loop(
                     else int(time.time())
             else:
                 source_error_run = 0
-            phase_s["source"] += time.perf_counter() - now
+            _src_t1 = time.perf_counter()
+            phase_s["source"] += _src_t1 - now
+            if trace is not None:
+                trace.add_span("source", k, now, _src_t1 - now)
             values = np.asarray(values, np.float32)
             watchdog.observe_source(k, values)
             if len(values) != n_expected:
@@ -1201,6 +1296,9 @@ def live_loop(
                     phase_s["checkpoint"] += (time.perf_counter() - now) - (
                         phase_s["collect"] + phase_s["emit"]
                         + phase_s["dispatch"] - ce0)
+                    if trace is not None:
+                        trace.add_span("checkpoint", k, now,
+                                       max(0.0, phase_s["checkpoint"] - ck0))
                     watchdog.observe_checkpoint(
                         k, phase_s["checkpoint"] - ck0)
                     if failed:
@@ -1236,6 +1334,10 @@ def live_loop(
             obs_tick_seconds.observe(elapsed)
             for p in _PHASES:
                 obs_phase[p].observe(phase_s[p] - phase_tick0[p])
+            if trace is not None:
+                trace.add_span("tick", k, t_start, elapsed)
+                obs_trace_records.set(trace.total)
+                obs_trace_dropped.set(trace.dropped)
             missed_this = watchdog.observe_tick(k, elapsed)
             if missed_this:
                 missed += 1
@@ -1243,14 +1345,36 @@ def live_loop(
                 # the controller reacts to the deadline verdicts the
                 # watchdog just judged; its tick_widen step changes the
                 # effective cadence BOTH sides measure against from here on
+                _deg_level0 = degradation.level
                 degradation.observe(k, missed_this)
+                if flight is not None and degradation.level != _deg_level0:
+                    # every ladder move (either direction) is a black-box
+                    # moment: capture the window that caused it
+                    flight.request_dump("degradation_level_change", k)
                 new_cadence = cadence_s * degradation.cadence_scale
                 if new_cadence != eff_cadence:
                     eff_cadence = new_cadence
                     watchdog.set_cadence(eff_cadence)
+            if flight is not None:
+                flight.record_tick(
+                    k, elapsed,
+                    {p: phase_s[p] - phase_tick0[p] for p in _PHASES},
+                    [a - b for a, b in zip(group_scored, scored_tick0)],
+                    missed_this)
+                # queued dumps (quarantine/degradation/miss burst) write
+                # HERE — after deadline accounting, before the sleep, so
+                # the cost never lands inside a phase span; the budget
+                # below is recomputed from the wall clock, so a dump
+                # consumes this tick's remaining SLEEP, not the cadence
+                # (pacing stays honest — the next tick starts on time or
+                # immediately, never late-but-unreported)
+                flight.flush_pending()
             # a recovery transition can shrink eff_cadence below this
-            # tick's elapsed — clamp, don't feed time.sleep a negative
-            budget = max(0.0, eff_cadence - elapsed)
+            # tick's elapsed — clamp, don't feed time.sleep a negative.
+            # Wall-clock based (not `elapsed`): post-accounting work
+            # (bundle dumps above) must shorten the sleep, not stretch
+            # the tick period silently past the cadence.
+            budget = max(0.0, eff_cadence - (time.perf_counter() - t_start))
             if not missed_this and k + 1 < n_ticks:
                 if stop_event is not None:
                     stop_event.wait(budget)  # a shutdown signal ends the sleep
@@ -1264,6 +1388,10 @@ def live_loop(
     finally:
         if pool is not None:
             pool.shutdown(wait=True)
+        if flight is not None:
+            # a quarantine raised by the final drain (or an early stop)
+            # queued its dump after the last in-loop flush — write it
+            flight.flush_pending()
     if learn and checkpoint_dir and ticks_run > last_saved:
         # final state on exit (clean or stopped), like replay_streams — a
         # resume must not lose already-learned ticks. Gated on the dir
@@ -1319,6 +1447,8 @@ def live_loop(
         extra["checkpoint_save_failures"] = checkpoint_save_failures
     if chaos is not None:
         extra["chaos_injected"] = len(chaos.injected)
+    if flight is not None:
+        extra["postmortem"] = flight.stats()
     if aot_warmup:
         extra["aot_programs_compiled"] = aot_programs
         # cold programs the loop still had to single-flight AFTER the AOT

@@ -126,13 +126,25 @@ def main() -> int:
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_soak_")
     os.makedirs(workdir, exist_ok=True)
     alerts_path = os.path.join(workdir, "alerts.jsonl")
+    # black-box coverage (ISSUE 4): every chaos run flies with the span
+    # recorder + flight recorder armed, and the verdict below asserts a
+    # chaos-induced quarantine left a VALID postmortem bundle behind
+    from rtap_tpu.obs import FlightRecorder, TraceRecorder, validate_bundle
+
+    trace = TraceRecorder(capacity=32768)
+    pm_dir = os.path.join(workdir, "postmortems")
+    flight = FlightRecorder(
+        trace=trace, n_ticks=min(args.ticks, 240), out_dir=pm_dir,
+        info={"command": "chaos_soak", "seed": args.seed,
+              "schedule_digest": digest, "streams": args.streams,
+              "group_size": args.group_size})
     stats = live_loop(
         source, reg, n_ticks=args.ticks, cadence_s=args.cadence,
         alert_path=alerts_path,
         checkpoint_dir=os.path.join(workdir, "ck"),
         checkpoint_every=args.checkpoint_every,
         quarantine_restore_after=args.restore_after,
-        chaos=engine)
+        chaos=engine, trace=trace, flight=flight)
 
     with open(alerts_path) as f:
         events = [json.loads(line) for line in f
@@ -160,6 +172,35 @@ def main() -> int:
             f"per-group counts sum to {sum(got)} != scored "
             f"{stats['scored']}")
 
+    # ---- postmortem-bundle verdict: a chaos-injected quarantine must
+    # leave a loadable black box behind (trace spans + event lines > 0)
+    quarantines = [e for e in stats.get("quarantine_log", [])
+                   if e["event"] == "group_quarantined"]
+    bundle_dirs = sorted(
+        os.path.join(pm_dir, d) for d in os.listdir(pm_dir)
+        if not d.startswith(".tmp")) if os.path.isdir(pm_dir) else []
+    verdicts = [validate_bundle(b) for b in bundle_dirs]
+    if quarantines and not bundle_dirs:
+        failures.append(
+            f"{len(quarantines)} quarantine(s) occurred but no postmortem "
+            "bundle was dumped")
+    for b, v in zip(bundle_dirs, verdicts):
+        if not v["ok"]:
+            failures.append(f"invalid postmortem bundle {b}: {v['problems']}")
+        elif v["events"] == 0:
+            failures.append(f"postmortem bundle {b} captured zero events")
+    pm_report = {
+        "dir": pm_dir,
+        "bundles": [os.path.basename(b) for b in bundle_dirs],
+        "valid": sum(1 for v in verdicts if v["ok"]),
+        "spans": sum(v["spans"] for v in verdicts),
+        "instants": sum(v["instants"] for v in verdicts),
+        "events": sum(v["events"] for v in verdicts),
+        "dumps_skipped": stats.get("postmortem", {}).get("dumps_skipped", 0),
+        "trace_records": trace.total,
+        "trace_dropped": trace.dropped,
+    }
+
     report = {
         "seed": args.seed,
         "schedule_digest": digest,
@@ -168,6 +209,7 @@ def main() -> int:
         "events": sorted({e["event"] for e in events}),
         "intervals": {f"group{g}": intervals[g] for g in range(n_groups)},
         "expected_by_group": expected,
+        "postmortem": pm_report,
         "stats": stats,
         "verified": not failures,
         "failures": failures,
@@ -185,8 +227,8 @@ def main() -> int:
         return VERIFY_FAILED_EXIT
     log(f"OK: {stats['scored']} scored, "
         f"{len(engine.injected)} faults injected, "
-        f"{len([e for e in events if e['event'] == 'group_quarantined'])} "
-        "quarantines")
+        f"{len(quarantines)} quarantines, "
+        f"{pm_report['valid']} valid postmortem bundle(s)")
     return 0
 
 

@@ -622,6 +622,28 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
      1500.0),
     ("r6_bench", [sys.executable, "bench.py"], 1700.0),
     ("r6_trend_rung", [sys.executable, "scripts/trend_rung.py"], 1500.0),
+    # ---------------- round 7 (ISSUE 4: tracing + flight recorder) ----
+    # Paired host+device timelines of the SAME 100-tick serve window at
+    # the production multi-group shape: jax.profiler.trace captures the
+    # XLA device trace (TensorBoard/Perfetto-loadable, under
+    # hw_results/device_trace_r07/) while serve's span recorder writes
+    # the host timeline (hw_results/host_trace_r07.json) — the first
+    # artifact that can attribute a missed tick to device compute vs the
+    # dispatch RPC wall vs host phases on silicon. The flight recorder
+    # flies armed so any quarantine/miss-burst during the window leaves
+    # a bundle next to the traces. 100 ticks keeps the device trace file
+    # small enough to commit; budget covers init + warm-up + the window.
+    ("r7_device_trace", [sys.executable, "scripts/live_soak.py",
+                         "--streams", "4096", "--group-size", "1024",
+                         "--columns", "32", "--learn-every", "2",
+                         "--stagger-learn", "--ticks", "100",
+                         "--pipeline-depth", "2", "--dispatch-threads", "4",
+                         "--jax-trace", "hw_results/device_trace_r07",
+                         "--trace-out", "hw_results/host_trace_r07.json",
+                         "--postmortem-dir", "hw_results/postmortems_r07",
+                         "--startup-timeout", "900",
+                         "--out", "reports/live_soak_trace_r07.json"],
+     2400.0),
 ]
 
 

@@ -214,6 +214,18 @@ def main() -> int:
                          "new appears -> auto-registered). Enables serve "
                          "--auto-register and --auto-release-after "
                          "(2x churn interval) automatically")
+    ap.add_argument("--jax-trace", default=None,
+                    help="passed through to serve: wrap the soak window in "
+                         "jax.profiler.trace writing the XLA device trace "
+                         "to this directory (the hw_session device-trace "
+                         "step pairs it with the host span timeline)")
+    ap.add_argument("--trace-out", default=None,
+                    help="passed through to serve: write the host span "
+                         "timeline as Perfetto-loadable Chrome trace JSON")
+    ap.add_argument("--postmortem-dir", default=None,
+                    help="passed through to serve: arm the flight "
+                         "recorder (auto postmortem bundles on "
+                         "quarantine/degradation/miss-burst/crash)")
     ap.add_argument("--startup-timeout", type=float, default=420.0,
                     help="budget for serve's backend init + first compile")
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "live_soak.json"))
@@ -270,6 +282,12 @@ def main() -> int:
         cmd += ["--chunk-stagger"]
     if args.freeze:
         cmd += ["--freeze"]
+    if args.jax_trace:
+        cmd += ["--jax-trace", args.jax_trace]
+    if args.trace_out:
+        cmd += ["--trace-out", args.trace_out]
+    if args.postmortem_dir:
+        cmd += ["--postmortem-dir", args.postmortem_dir]
     if args.churn_every:
         cmd += ["--auto-register",
                 "--auto-release-after", str(2 * args.churn_every)]

@@ -1,0 +1,136 @@
+"""Pretty-print (and machine-check) a flight-recorder postmortem bundle.
+
+A bundle is the atomic directory `serve --postmortem-dir` dumps on group
+quarantine, degradation-level change, missed-tick burst, crash, or on
+demand (rtap_tpu/obs/flight.py; docs/POSTMORTEM.md is the triage
+runbook). This script renders the human view: what triggered the dump,
+the timeline summary (window, per-phase cost, slowest spans), and the
+event ledger in tick order. `--json` emits the machine view instead
+(validate_bundle verdict + summary), and the exit code is the verdict
+(0 valid, 2 invalid) either way, so harnesses can gate on it.
+
+Usage: python scripts/postmortem.py BUNDLE_DIR [--json]
+       [--slowest N] [--events N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+INVALID_EXIT = 2
+
+
+def err(msg: str) -> None:
+    print(f"[postmortem] {msg}", file=sys.stderr, flush=True)
+
+
+def _load_json(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _spans(bundle: str) -> list[dict]:
+    tj = _load_json(os.path.join(bundle, "trace.json")) or {}
+    return [e for e in tj.get("traceEvents", []) if e.get("ph") == "X"]
+
+
+def _events(bundle: str) -> list[dict]:
+    out = []
+    try:
+        with open(os.path.join(bundle, "events.jsonl")) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def render(bundle: str, summary: dict, slowest: int, n_events: int) -> str:
+    lines = []
+    t = summary.get("ticks", {})
+    lines.append(f"postmortem bundle: {os.path.basename(bundle)}")
+    lines.append(f"  reason   : {summary.get('reason')} at tick "
+                 f"{summary.get('tick')}")
+    lines.append(f"  window   : ticks {t.get('first')}..{t.get('last')} "
+                 f"({t.get('count')} recorded, {t.get('missed')} missed "
+                 "deadlines)")
+    tm = summary.get("tick_ms")
+    if tm:
+        lines.append(f"  tick     : mean {tm['mean']} ms, max {tm['max']} ms")
+    pm = summary.get("phase_ms") or {}
+    if pm:
+        lines.append("  phases   : " + ", ".join(
+            f"{p} mean {v['mean']}/max {v['max']} ms"
+            for p, v in sorted(pm.items(), key=lambda kv: -kv[1]["mean"])))
+    tr = summary.get("trace")
+    if tr:
+        lines.append(f"  trace    : {tr['records']} records "
+                     f"({tr['dropped']} dropped) — load trace.json in "
+                     "ui.perfetto.dev")
+    spans = _spans(bundle)
+    if spans:
+        top = sorted(spans, key=lambda e: -e.get("dur", 0))[:slowest]
+        lines.append(f"  slowest {len(top)} spans:")
+        for e in top:
+            a = e.get("args", {})
+            where = f"group{a['group']}" if "group" in a else "loop"
+            lines.append(f"    {e.get('dur', 0) / 1e3:9.2f} ms  "
+                         f"{e.get('name'):<14} tick {a.get('tick')} "
+                         f"({where})")
+    events = _events(bundle)
+    by_kind = summary.get("events", {}).get("by_kind", {})
+    if by_kind:
+        lines.append("  events   : " + ", ".join(
+            f"{k}x{v}" for k, v in by_kind.items()))
+    if events:
+        lines.append(f"  event ledger (last {min(n_events, len(events))}):")
+        for e in events[-n_events:]:
+            rest = {k: v for k, v in e.items() if k not in ("event", "tick")}
+            line = (f"    tick {e.get('tick', '?')!s:>6}  "
+                    f"{e.get('event'):<24}")
+            if rest:
+                line += " " + json.dumps(rest)
+            lines.append(line)
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", help="postmortem bundle directory")
+    ap.add_argument("--json", action="store_true",
+                    help="machine view: validation verdict + summary JSON")
+    ap.add_argument("--slowest", type=int, default=8,
+                    help="how many slowest spans to show")
+    ap.add_argument("--events", type=int, default=20,
+                    help="how many trailing event lines to show")
+    args = ap.parse_args()
+
+    from rtap_tpu.obs import validate_bundle
+
+    verdict = validate_bundle(args.bundle)
+    summary = _load_json(os.path.join(args.bundle, "summary.json")) or {}
+    if args.json:
+        print(json.dumps({"verdict": verdict, "summary": summary}))
+    else:
+        print(render(args.bundle, summary, args.slowest, args.events),
+              file=sys.stdout if verdict["ok"] else sys.stderr)
+        if not verdict["ok"]:
+            err(f"INVALID bundle: {verdict['problems']}")
+    return 0 if verdict["ok"] else INVALID_EXIT
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,3 +36,20 @@ def test_chaos_soak_runs_verified_and_digest_is_seed_stable(tmp_path):
     # every scheduled fault that fired is logged with its tick
     for inj in report["faults_injected"]:
         assert 0 <= inj["tick"] < 40 and "kind" in inj
+    # ---- flight-recorder verdict folded into the digest (ISSUE 4): the
+    # soak flies armed, every dumped bundle validated (the script itself
+    # fails on an invalid one, so verified=True implies valid==bundles),
+    # and a quarantine without a bundle is a failure the script catches
+    pm = report["postmortem"]
+    assert pm["valid"] == len(pm["bundles"])
+    assert pm["trace_records"] > 0
+    quarantined = any(e["event"] == "group_quarantined"
+                      for e in report["stats"].get("quarantine_log", []))
+    if quarantined:
+        assert pm["bundles"] and pm["spans"] > 0 and pm["events"] > 0
+    if pm["bundles"]:
+        # the bundles are real directories in the workdir, loadable
+        from rtap_tpu.obs import validate_bundle
+
+        for b in pm["bundles"]:
+            assert validate_bundle(os.path.join(pm["dir"], b))["ok"]

@@ -173,6 +173,50 @@ def test_serve_chaos_spec_quarantines_and_survives(tmp_path):
     assert "group_quarantined" in {e["event"] for e in events}
 
 
+def test_serve_trace_out_and_postmortem_dir_end_to_end(tmp_path):
+    """ISSUE 4 CLI surface: serve --trace-out writes Perfetto-loadable
+    Chrome trace JSON on exit, and --postmortem-dir auto-dumps a valid
+    bundle when a scripted fault quarantines a group — all through the
+    real operator command."""
+    spec = tmp_path / "chaos.json"
+    spec.write_text(json.dumps({"seed": 7, "faults": [
+        {"kind": "dispatch_exception", "tick": 2, "group": 1}]}))
+    trace_out = tmp_path / "trace.json"
+    pm_dir = tmp_path / "pm"
+    p = run_cli("serve", "--streams", "a,b", "--group-size", "1",
+                "--ticks", "5", "--cadence", "0.05", "--backend", "cpu",
+                "--alerts", str(tmp_path / "alerts.jsonl"),
+                "--chaos-spec", str(spec),
+                "--trace-out", str(trace_out),
+                "--postmortem-dir", str(pm_dir),
+                "--alert-attribution")
+    assert p.returncode == 0, p.stderr[-2000:]
+    stats = json.loads(p.stdout.strip().splitlines()[-1])
+    assert stats["postmortem"]["bundles"] >= 1
+    # the host timeline landed, schema-valid
+    tj = json.loads(trace_out.read_text())
+    spans = [e for e in tj["traceEvents"] if e.get("ph") == "X"]
+    assert {"tick", "source", "dispatch"} <= {e["name"] for e in spans}
+    assert any(e.get("ph") == "i" and e["name"] == "group_quarantined"
+               and e["args"]["tick"] == 2 for e in tj["traceEvents"])
+    # the bundle validates and names the quarantine
+    from rtap_tpu.obs import validate_bundle
+
+    bundles = [d for d in pm_dir.iterdir() if not d.name.startswith(".tmp")]
+    assert len(bundles) == stats["postmortem"]["bundles"]
+    verdicts = {v["reason"]: v for v in map(validate_bundle, map(str, bundles))}
+    assert all(v["ok"] for v in verdicts.values()), verdicts
+    q = verdicts["group_quarantined"]  # a miss-burst bundle may ride along
+    assert q["tick"] == 2
+    q_dir = next(d for d in bundles if "group_quarantined" in d.name)
+    # and scripts/postmortem.py renders it with exit 0
+    pp = subprocess.run(
+        [sys.executable, "scripts/postmortem.py", str(q_dir)],
+        cwd=REPO, env=ENV, capture_output=True, text=True, timeout=120)
+    assert pp.returncode == 0, pp.stderr[-2000:]
+    assert "group_quarantined" in pp.stdout
+
+
 def test_nab_command_end_to_end(tmp_path):
     """`python -m rtap_tpu nab` — the SURVEY §6 drop-in drill: run the
     committed NAB-layout stand-in corpus (truncated + width-scaled for CPU

@@ -1,0 +1,171 @@
+"""Per-alert provenance (ISSUE 4 satellite): the encoder key-space
+decode in service/attribution.py must name the field that actually
+spiked on a known multivariate fault, ride alert JSONL lines through
+AlertWriter, and survive NaN gaps / routing changes without growing
+state."""
+
+import json
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import cluster_preset, node_preset
+from rtap_tpu.service.alerts import AlertWriter
+from rtap_tpu.service.attribution import AlertAttributor
+
+NO_ALERTS = np.array([], np.int64)
+
+
+@pytest.mark.quick
+def test_known_multivariate_spike_attributes_to_the_spiked_field():
+    cfg = node_preset(3)  # cpu/mem/net fused into one SDR
+    at = AlertAttributor(cfg, top_k=3)
+    ids = ["node0", "node1"]
+    base = np.array([[30.0, 50.0, 10.0], [1.0, 2.0, 3.0]], np.float32)
+    at.update_and_attribute(ids, base, NO_ALERTS)
+    spike = base.copy()
+    spike[0, 1] += 500.0  # mem on node0 jumps; cpu/net unchanged
+    out = at.update_and_attribute(ids, spike, np.array([0]))
+    top = out[0]
+    assert top and top[0]["field"] == 1
+    # the other fields didn't move a bucket: the spiked field owns the
+    # whole contribution mass
+    assert top[0]["contribution"] == pytest.approx(1.0)
+    assert abs(top[0]["bucket_delta"]) >= cfg.rdse.active_bits
+    assert [f["field"] for f in top] == [1]
+
+
+@pytest.mark.quick
+def test_partial_moves_rank_fields_by_bucket_distance():
+    cfg = node_preset(3)
+    at = AlertAttributor(cfg, top_k=2)
+    ids = ["n0"]
+    res = float(np.float32(cfg.rdse.resolution))
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 10.0]], np.float32),
+                            NO_ALERTS)
+    # field 2 moves 4 buckets, field 0 moves 2, field 1 holds still
+    nxt = np.array([[10.0 + 2 * res, 10.0, 10.0 + 4 * res]], np.float32)
+    out = at.update_and_attribute(ids, nxt, np.array([0]))
+    fields = [f["field"] for f in out[0]]
+    assert fields == [2, 0]  # top_k=2, ranked by lost overlap
+    assert out[0][0]["contribution"] > out[0][1]["contribution"]
+
+
+@pytest.mark.quick
+def test_large_magnitude_baseline_keeps_precision():
+    """Review fix: the bucket delta must be round((cur-base)/res), not
+    round(cur/res) - round(base/res) — on a large-magnitude baseline
+    (cumulative counters ~1e10) the separate roundings saturate the
+    ±2^30 bucket clamp / lose the move to f32 mantissa, zeroing the
+    attribution of the very field that spiked."""
+    cfg = node_preset(3)
+    at = AlertAttributor(cfg)
+    ids = ["n0"]
+    base = np.array([[1.0e10, 2.0e10, 3.0e10]], np.float32)
+    at.update_and_attribute(ids, base, NO_ALERTS)
+    spike = base.copy()
+    spike[0, 1] += 1.0e9  # a real move, tiny relative to the baseline
+    out = at.update_and_attribute(ids, spike, np.array([0]))
+    assert out[0] and out[0][0]["field"] == 1
+    assert out[0][0]["contribution"] == pytest.approx(1.0)
+
+
+@pytest.mark.quick
+def test_nan_gap_keeps_the_pre_gap_baseline():
+    cfg = node_preset(2)
+    at = AlertAttributor(cfg)
+    ids = ["n0"]
+    at.update_and_attribute(ids, np.array([[5.0, 5.0]], np.float32),
+                            NO_ALERTS)
+    # a missing sample (both fields NaN) must not become the baseline
+    at.update_and_attribute(
+        ids, np.array([[np.nan, np.nan]], np.float32), NO_ALERTS)
+    out = at.update_and_attribute(
+        ids, np.array([[5.0, 500.0]], np.float32), np.array([0]))
+    assert out[0] and out[0][0]["field"] == 1
+
+
+@pytest.mark.quick
+def test_first_tick_and_no_movement_yield_empty_attribution():
+    cfg = node_preset(2)
+    at = AlertAttributor(cfg)
+    ids = ["n0"]
+    v = np.array([[1.0, 2.0]], np.float32)
+    assert at.update_and_attribute(ids, v, np.array([0]))[0] == []
+    # unchanged values: nothing to attribute (temporal/date-driven alert)
+    assert at.update_and_attribute(ids, v, np.array([0]))[0] == []
+
+
+@pytest.mark.quick
+def test_univariate_streams_attribute_to_field_zero():
+    cfg = cluster_preset()
+    at = AlertAttributor(cfg)
+    ids = ["s0", "s1"]
+    at.update_and_attribute(ids, np.array([10.0, 10.0], np.float32),
+                            NO_ALERTS)
+    out = at.update_and_attribute(
+        ids, np.array([10.0, 900.0], np.float32), np.array([1]))
+    assert out[1][0]["field"] == 0
+    assert out[1][0]["contribution"] == pytest.approx(1.0)
+
+
+@pytest.mark.quick
+def test_alert_writer_rides_top_fields_onto_alert_lines(tmp_path):
+    cfg = node_preset(3)
+    path = tmp_path / "alerts.jsonl"
+    w = AlertWriter(str(path), attributor=AlertAttributor(cfg))
+    ids = ["n0", "n1"]
+    base = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], np.float32)
+    raw = np.zeros(2, np.float32)
+    ll = np.zeros(2)
+    # tick 0: history primes, no alert
+    w.emit_batch(ids, np.array([100, 100]), base, raw, ll,
+                 np.zeros(2, bool))
+    spike = base.copy()
+    spike[1, 0] += 400.0
+    w.emit_batch(ids, np.array([101, 101]), spike, raw, ll,
+                 np.array([False, True]))
+    w.close()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["stream"] == "n1"
+    assert lines[0]["top_fields"][0]["field"] == 0
+    # without an attributor the schema is unchanged
+    w2 = AlertWriter(str(tmp_path / "plain.jsonl"))
+    w2.emit_batch(ids, np.array([1, 1]), base, raw, ll,
+                  np.array([True, False]))
+    w2.close()
+    line = json.loads((tmp_path / "plain.jsonl").read_text())
+    assert "top_fields" not in line
+
+
+@pytest.mark.quick
+def test_routing_history_keeps_many_live_groups_and_bounds_churn(
+        monkeypatch):
+    """Review fix: a fleet with hundreds of groups (100k streams at
+    G=256 is ~390 routing tuples) must keep EVERY live group's history —
+    the cap only retires churned-away tuples, and an eviction of a
+    recently-updated route is counted, never silent."""
+    import rtap_tpu.service.attribution as mod
+
+    cfg = cluster_preset()
+    at = AlertAttributor(cfg)
+    # 390 live "groups", touched every round: far below the cap, so no
+    # eviction ever — attribution still works after several rounds
+    live = [[f"g{i}"] for i in range(390)]
+    for _round in range(3):
+        for ids in live:
+            at.update_and_attribute(ids, np.array([10.0], np.float32),
+                                    NO_ALERTS)
+    assert len(at._prev) == 390 and at.live_evictions == 0
+    out = at.update_and_attribute(live[0], np.array([900.0], np.float32),
+                                  np.array([0]))
+    assert out[0] and out[0][0]["field"] == 0  # history intact -> attributed
+    # unbounded churn of single-use routes stays bounded at the cap (LRU
+    # drops the oldest), and the cap-overflow accounting fires
+    monkeypatch.setattr(mod, "_MAX_TRACKED_ROUTES", 64)
+    for i in range(200):
+        at.update_and_attribute([f"churn{i}"], np.array([1.0], np.float32),
+                                NO_ALERTS)
+    assert len(at._prev) <= 64
+    assert at.live_evictions > 0  # fresh evictions are visible, not silent

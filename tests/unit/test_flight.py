@@ -1,0 +1,176 @@
+"""obs/flight.py: the black-box flight recorder (ISSUE 4 tentpole).
+Pins strictly bounded ring memory (ring size x record size — the ISSUE's
+acceptance bullet), the atomic bundle layout + validate_bundle verdicts,
+dump throttling (per-reason gap + per-run cap), and the miss-burst
+trigger."""
+
+import json
+import os
+import time
+
+import pytest
+
+from rtap_tpu.obs.flight import FlightRecorder, validate_bundle
+from rtap_tpu.obs.metrics import TelemetryRegistry
+from rtap_tpu.obs.trace import TraceRecorder
+
+PHASES = ("source", "membership", "dispatch", "collect", "emit",
+          "checkpoint")
+
+
+def _phases(v=0.001):
+    return {p: v for p in PHASES}
+
+
+def _fill(fl, n, n_groups=3, missed=False, start=0):
+    for k in range(start, start + n):
+        fl.record_tick(k, 0.01, _phases(), [2] * n_groups, missed)
+
+
+@pytest.mark.quick
+def test_tick_ring_memory_is_strictly_bounded():
+    fl = FlightRecorder(n_ticks=16, registry=TelemetryRegistry())
+    _fill(fl, 100, n_groups=3)
+    # ring size x record size, exactly: tick i64 + elapsed f64 + missed
+    # bool + 6 phase f64 + 3 scored i64 per slot, REGARDLESS of how many
+    # ticks were recorded (the black box can fly forever)
+    per_record = 8 + 8 + 1 + len(PHASES) * 8 + 3 * 8
+    assert fl.nbytes() == 16 * per_record
+    s = fl.summary()
+    assert s["ticks"]["count"] == 16
+    assert s["ticks"]["first"] == 84 and s["ticks"]["last"] == 99
+    assert s["scored_by_group_window"] == [32, 32, 32]
+
+
+@pytest.mark.quick
+def test_event_ring_is_bounded_and_truncated():
+    fl = FlightRecorder(n_ticks=8, n_events=5, max_event_bytes=64,
+                        registry=TelemetryRegistry())
+    for i in range(20):
+        fl.record_event({"event": "missed_tick", "tick": i,
+                         "blob": "x" * 1000})
+    assert len(fl._events) == 5
+    assert all(len(line) <= 64 for line in fl._events)
+    s = fl.summary()
+    assert s["events"]["total_seen"] == 20
+    assert s["events"]["by_kind"] == {"missed_tick": 20}
+
+
+@pytest.mark.quick
+def test_dump_writes_atomic_valid_bundle(tmp_path):
+    tr = TraceRecorder(capacity=256)
+    t0 = time.perf_counter()
+    reg = TelemetryRegistry()
+    fl = FlightRecorder(trace=tr, n_ticks=8, out_dir=str(tmp_path),
+                        registry=reg, info={"command": "test"})
+    for k in range(6):
+        tr.add_span("tick", k, t0 + k * 0.01, 0.009)
+        fl.record_tick(k, 0.009, _phases(), [4, 4], k == 5)
+    tr.add_instant("group_quarantined", 5, {"group": 1})
+    fl.record_event({"event": "group_quarantined", "tick": 5, "group": 1})
+    path = fl.dump("group_quarantined", 5)
+    assert path is not None and os.path.isdir(path)
+    # atomic: no torn temp dirs left behind
+    assert not [d for d in os.listdir(tmp_path) if d.startswith(".tmp")]
+    v = validate_bundle(path)
+    assert v["ok"], v
+    assert v["spans"] == 6 and v["instants"] == 1 and v["events"] == 1
+    assert v["reason"] == "group_quarantined" and v["tick"] == 5
+    summary = json.load(open(os.path.join(path, "summary.json")))
+    assert summary["info"]["command"] == "test"
+    assert summary["ticks"]["missed"] == 1
+    assert summary["phase_ms"]["dispatch"]["mean"] == pytest.approx(1.0)
+    # the registry counters moved
+    assert reg.counter("rtap_obs_postmortem_bundles_total",
+                       reason="group_quarantined").value == 1
+
+
+@pytest.mark.quick
+def test_dump_throttling_per_reason_gap_and_run_cap(tmp_path):
+    fl = FlightRecorder(trace=TraceRecorder(capacity=32), n_ticks=8,
+                        out_dir=str(tmp_path), registry=TelemetryRegistry(),
+                        min_dump_gap_ticks=10, max_bundles=2)
+    _fill(fl, 3)
+    assert fl.dump("group_quarantined", 2) is not None
+    # same reason within the gap: suppressed
+    assert fl.dump("group_quarantined", 5) is None
+    # different reason: its own gap clock
+    assert fl.dump("missed_tick_burst", 5) is not None
+    # run cap reached: everything suppressed from here
+    assert fl.dump("group_quarantined", 50) is None
+    assert fl.dumps_skipped == 2
+    assert len(fl.bundles) == 2
+
+
+@pytest.mark.quick
+def test_miss_burst_queues_one_dump_per_episode(tmp_path):
+    tr = TraceRecorder(capacity=32)
+    fl = FlightRecorder(trace=tr, n_ticks=32,
+                        out_dir=str(tmp_path), registry=TelemetryRegistry(),
+                        miss_burst=3)
+    tr.add_span("tick", 0, time.perf_counter(), 0.01)
+    _fill(fl, 2, missed=False)
+    _fill(fl, 5, missed=True, start=2)  # one burst, however long
+    assert [r for r, _ in fl._pending] == ["missed_tick_burst"]
+    paths = fl.flush_pending()
+    assert len(paths) == 1 and fl._pending == []
+    v = validate_bundle(paths[0])
+    assert v["ok"] and v["reason"] == "missed_tick_burst"
+
+
+@pytest.mark.quick
+def test_crash_dump_is_exempt_from_cap_and_gap(tmp_path):
+    """Review fix: a soak that spent its bundle budget on quarantine
+    churn must STILL leave its crash black box — unhandled_exception
+    bypasses both the per-run cap and the per-reason gap."""
+    tr = TraceRecorder(capacity=32)
+    tr.add_span("tick", 0, time.perf_counter(), 0.01)
+    fl = FlightRecorder(trace=tr, n_ticks=8, out_dir=str(tmp_path),
+                        registry=TelemetryRegistry(), max_bundles=1,
+                        min_dump_gap_ticks=100)
+    _fill(fl, 3)
+    assert fl.dump("group_quarantined", 1) is not None  # cap reached
+    assert fl.dump("group_quarantined", 2) is None
+    p = fl.dump("unhandled_exception", 2)
+    assert p is not None and validate_bundle(p)["ok"]
+
+
+@pytest.mark.quick
+def test_rerun_into_same_dir_never_collides(tmp_path):
+    """Review fix: bundle names carry a per-run tag — a re-run pointed
+    at the same --postmortem-dir (hw_session hardcodes its dir) must
+    dump its own bundle even at the same deterministic tick/reason,
+    never os.rename onto the prior run's directory."""
+    for pass_n in (1, 2):
+        tr = TraceRecorder(capacity=32)
+        tr.add_span("tick", 0, time.perf_counter(), 0.01)
+        fl = FlightRecorder(trace=tr, n_ticks=8, out_dir=str(tmp_path),
+                            registry=TelemetryRegistry())
+        fl._run_tag = f"run{pass_n}"  # distinct runs (time+pid in prod)
+        _fill(fl, 3)
+        assert fl.dump("missed_tick_burst", 2) is not None
+    bundles = [d for d in os.listdir(tmp_path) if not d.startswith(".tmp")]
+    assert len(bundles) == 2  # both runs' evidence retained
+
+
+@pytest.mark.quick
+def test_dump_without_out_dir_is_a_counted_noop():
+    fl = FlightRecorder(n_ticks=4, registry=TelemetryRegistry())
+    _fill(fl, 2)
+    assert fl.dump("on_demand") is None
+    assert fl.dumps_skipped == 1
+
+
+@pytest.mark.quick
+def test_validate_bundle_rejects_garbage(tmp_path):
+    v = validate_bundle(str(tmp_path / "missing"))
+    assert not v["ok"]
+    bad = tmp_path / "bundle"
+    bad.mkdir()
+    (bad / "summary.json").write_text("{not json")
+    (bad / "events.jsonl").write_text('{"event": "x"}\n')
+    (bad / "trace.json").write_text('{"traceEvents": []}')
+    v = validate_bundle(str(bad))
+    assert not v["ok"]
+    assert any("summary.json" in p for p in v["problems"])
+    assert any("no spans" in p for p in v["problems"])

@@ -51,6 +51,22 @@ def test_print_gate_bites_in_serve_stack():
     assert "_gate_canary" in proc.stdout + proc.stderr
 
 
+def test_print_gate_bites_in_obs():
+    """The strict gate covers rtap_tpu/obs/ too — the tracing/flight
+    modules (ISSUE 4) live there, and a postmortem path that printed to
+    stdout would corrupt the one-JSON-line serve artifact contract."""
+    subdir = os.path.join(REPO, "rtap_tpu", "obs")
+    victim = os.path.join(subdir, "_gate_canary_o.py")
+    with open(victim, "w") as f:
+        f.write('import sys\nprint("trace", file=sys.stderr)\n')
+    try:
+        proc = _run()
+    finally:
+        _cleanup(victim, subdir)
+    assert proc.returncode != 0
+    assert "_gate_canary_o" in proc.stdout + proc.stderr
+
+
 def test_print_gate_bites_in_scripts():
     """The widened gate (ISSUE 3 satellite) must catch a bare print in
     scripts/ — including the multi-line call form a line-grep cannot see —
