@@ -105,6 +105,56 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         except ValueError as e:
             print(f"serve: bad --degrade parameters: {e}", file=sys.stderr)
             return 2
+    # durability (docs/RESILIENCE.md, ISSUE 5): the per-tick write-ahead
+    # journal. Constructing it performs recovery (torn tails truncated,
+    # rows loaded for replay); with a journal, --ticks is the run's TOTAL
+    # tick budget across restarts — a resumed serve catches up through
+    # the journal and then runs only the remainder.
+    journal = None
+    n_ticks_eff = args.ticks
+    if args.journal_dir:
+        from rtap_tpu.resilience.journal import TickJournal, parse_fsync
+
+        try:
+            fsync_policy, fsync_every = parse_fsync(args.journal_fsync)
+            journal = TickJournal(
+                args.journal_dir,
+                segment_bytes=args.journal_segment_bytes,
+                max_segments=args.journal_max_segments,
+                fsync=fsync_policy, fsync_every=fsync_every)
+        except (OSError, ValueError) as e:
+            print(f"serve: bad --journal-dir/--journal-fsync: {e}",
+                  file=sys.stderr)
+            return 2
+        base = journal.next_tick
+        if args.checkpoint_dir:
+            from rtap_tpu.service.checkpoint import peek_resume_ticks
+
+            base = max(base, peek_resume_ticks(args.checkpoint_dir))
+        n_ticks_eff = max(0, args.ticks - base)
+        if base:
+            print(f"serve: resuming at tick {base} "
+                  f"({len(journal.recovered_ticks)} journaled rows "
+                  f"recovered; --ticks {args.ticks} is the total budget "
+                  f"-> {n_ticks_eff} new ticks)", file=sys.stderr)
+            if chaos is not None:
+                # under a journal the chaos schedule is GLOBAL-tick
+                # -indexed: a restarted serve shifts it onto its local
+                # clock and fired faults (in particular the proc_exit
+                # that killed the previous incarnation) drop out instead
+                # of re-firing every restart
+                from rtap_tpu.resilience import ChaosEngine as _CE
+
+                chaos = _CE(chaos.spec.shifted(base))
+                print(f"serve: chaos schedule shifted to resume base "
+                      f"{base} ({len(chaos.spec.faults)} faults remain)",
+                      file=sys.stderr)
+        if journal.truncations or journal.dropped_segments:
+            print(f"serve: journal tail truncated on recovery "
+                  f"({journal.truncations} truncation(s), "
+                  f"{journal.truncated_bytes} bytes, "
+                  f"{journal.dropped_segments} dropped segment(s)) — "
+                  "continuing from the last valid record", file=sys.stderr)
     # (--columns + --preset nab rejected in main() before backend init)
     cfg = nab_preset() if args.preset == "nab" else _sized_cluster(args)
     cfg = _apply_cadence(cfg, args)
@@ -206,7 +256,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               file=sys.stderr)
     try:
         try:
-            stats = live_loop(source, grp, n_ticks=args.ticks, cadence_s=args.cadence,
+            stats = live_loop(source, grp, n_ticks=n_ticks_eff, cadence_s=args.cadence,
                               alert_path=args.alerts,
                               checkpoint_dir=args.checkpoint_dir,
                               checkpoint_every=args.checkpoint_every,
@@ -224,7 +274,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                               alert_flush_every=args.alert_flush_every,
                               aot_warmup=args.aot_warmup,
                               trace=trace, flight=flight,
-                              attributor=attributor)
+                              attributor=attributor,
+                              journal=journal)
         except BaseException as e:  # noqa: BLE001 — dump, then re-raise
             # crash black-box: an exception escaping serve dumps a
             # postmortem bundle BEFORE the traceback, so a dead soak
@@ -249,6 +300,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
         close()
+        if journal is not None:
+            journal.close()
         if obs_server is not None:
             obs_server.close()
         if args.trace_out and trace is not None:
@@ -452,6 +505,41 @@ def main(argv: list[str] | None = None) -> int:
                    help="checkpoint cadence in ticks (0 = save only on "
                         "exit/shutdown; with --checkpoint-dir, resume-on-"
                         "start always applies)")
+    p.add_argument("--journal-dir", default=None,
+                   help="per-tick write-ahead journal: every ingested tick "
+                        "row is appended (CRC-framed, segment-rotated) "
+                        "before scoring, and a restarted serve replays the "
+                        "journaled ticks past its checkpoint through the "
+                        "normal scoring path — bit-identical catch-up with "
+                        "exactly-once alerts across a crash. With a "
+                        "journal, --ticks is the run's TOTAL tick budget "
+                        "across restarts (docs/RESILIENCE.md durability)")
+    p.add_argument("--journal-fsync", default="os",
+                   help="journal durability policy: 'os' (page cache; "
+                        "survives kill -9, not power loss — default), "
+                        "'every-tick' (fsync per tick), or 'every-N' "
+                        "(fsync once per N ticks, e.g. every-64)")
+    p.add_argument("--journal-segment-bytes", type=int, default=4 << 20,
+                   help="journal segment rotation size (bytes)")
+    p.add_argument("--journal-max-segments", type=int, default=256,
+                   help="hard bound on journal segments on disk (oldest "
+                        "evicted + counted; checkpoint compaction normally "
+                        "keeps the journal far below this)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run serve as a supervised child process: abnormal "
+                        "deaths (crash, OOM kill, kill -9) restart it with "
+                        "exponential backoff under a restart budget, and "
+                        "each death lands on the incident stream (needs "
+                        "--checkpoint-dir; pair with --journal-dir for "
+                        "tick-exact catch-up — scripts/crash_soak.py is "
+                        "the acceptance soak)")
+    p.add_argument("--supervise-restarts", type=int, default=10,
+                   help="supervisor restart budget: abnormal deaths beyond "
+                        "this exit 3 instead of restarting")
+    p.add_argument("--supervise-backoff", type=float, default=0.5,
+                   help="supervisor restart backoff base seconds (doubles "
+                        "per consecutive fast death, capped at 30 s; a "
+                        "child that stayed up >= 60 s resets the exponent)")
     p.add_argument("--learn-every", type=int, default=1,
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "
@@ -740,6 +828,37 @@ def main(argv: list[str] | None = None) -> int:
               "learning serve, then freeze; or serve frozen with a fixed "
               "fleet", file=sys.stderr)
         return 2
+    if getattr(args, "supervise", False):
+        # supervision wraps the WHOLE child serve (including backend
+        # init): handle it before this process touches the backend — the
+        # parent must never hold the chip its child needs
+        if not args.checkpoint_dir:
+            print("serve: --supervise needs --checkpoint-dir (a restarted "
+                  "child must resume its fleet, not rescore from scratch); "
+                  "add --journal-dir for tick-exact catch-up",
+                  file=sys.stderr)
+            return 2
+        if not args.journal_dir:
+            print("serve: --supervise without --journal-dir will lose the "
+                  "ticks since the last checkpoint on every restart "
+                  "(continuity yes, bit-exact catch-up no)", file=sys.stderr)
+        from rtap_tpu.resilience.supervisor import (
+            Supervisor,
+            strip_supervise_flags,
+        )
+
+        raw = list(argv) if argv is not None else sys.argv[1:]
+        child_cmd = [sys.executable, "-m", "rtap_tpu",
+                     *strip_supervise_flags(raw)]
+        sup = Supervisor(
+            child_cmd, restart_budget=args.supervise_restarts,
+            backoff_base_s=args.supervise_backoff,
+            backoff_max_s=max(30.0, args.supervise_backoff),
+            event_path=args.alerts, postmortem_dir=args.postmortem_dir,
+            log=lambda m: print(m, file=sys.stderr))
+        print(f"serve: supervising {' '.join(child_cmd[3:])} "
+              f"(restart budget {args.supervise_restarts})", file=sys.stderr)
+        return sup.run()
     if getattr(args, "backend", None) == "tpu":
         # fail in 120s on a wedged tunnel instead of hanging the operator's
         # terminal, and reuse compiled programs across service restarts

@@ -15,7 +15,7 @@ import time
 
 from rtap_tpu.obs.metrics import TelemetryRegistry
 
-__all__ = ["measure", "measure_trace", "OPS_PER_TICK",
+__all__ = ["measure", "measure_trace", "measure_journal", "OPS_PER_TICK",
            "TRACE_SPANS_PER_TICK"]
 
 #: instrument operations a serve tick costs at the production shape (six
@@ -109,6 +109,56 @@ def measure_trace(n: int = 50_000, cadence_s: float = 1.0,
         "flight_record_tick_ns": round(rt_s * 1e9, 1),
         "spans_per_tick": TRACE_SPANS_PER_TICK,
         "n_groups": n_groups,
+        "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
+        "per_tick_overhead_frac": per_tick_s / cadence_s,
+        "cadence_s": cadence_s,
+    }
+
+
+def measure_journal(n: int = 2000, cadence_s: float = 1.0,
+                    n_streams: int = 1024) -> dict:
+    """Write-ahead-journal hot-path cost, same protocol as
+    :func:`measure`: a serve tick pays ONE tick-row append (format +
+    write + flush-to-kernel, fsync policy ``os`` — the default) plus one
+    alert-cursor append per emitted chunk, measured on a private journal
+    in a temp dir at the production per-chip row width. ISSUE 5
+    acceptance: journaling stays <= 1% of the tick budget
+    (``bench.py --obs-bench`` gates it alongside the trace/flight bars).
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from rtap_tpu.resilience.journal import TickJournal
+
+    d = tempfile.mkdtemp(prefix="rtap_selfbench_journal_")
+    try:
+        j = TickJournal(d, fsync="os")
+        row = np.full(n_streams, 31.5, np.float32)
+        # warm the segment handle + first-write path out of the timing
+        j.append_tick(0, 1_700_000_000, row)
+        j.append_cursor(0, 0)
+        i = [0]
+
+        def _tick():
+            i[0] += 1
+            j.append_tick(i[0], 1_700_000_000 + i[0], row)
+
+        tick_s = _time_op(_tick, n)
+        cursor_s = _time_op(lambda: j.append_cursor(i[0], 123456), n)
+        rotations = j.rotations
+        j.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    per_tick_s = tick_s + cursor_s
+    return {
+        "journal_tick_append_us": round(tick_s * 1e6, 2),
+        "journal_cursor_append_us": round(cursor_s * 1e6, 2),
+        "n_streams": n_streams,
+        "row_bytes": int(row.nbytes),
+        "segment_rotations": rotations,
+        "fsync": "os",
         "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
         "per_tick_overhead_frac": per_tick_s / cadence_s,
         "cadence_s": cadence_s,

@@ -8,24 +8,41 @@ load down a declared ladder under sustained deadline misses (degrade.py),
 and a deterministic seedable :class:`ChaosEngine` injects scripted faults
 at the loop's seams so every recovery path is exercised in tier-1 rather
 than trusted (chaos.py; ``scripts/chaos_soak.py``, ``serve
---chaos-spec``). Group quarantine itself lives in service/loop.py — it is
+--chaos-spec``). The durability layer (ISSUE 5) lives here too: a
+:class:`TickJournal` write-ahead log of ingested tick rows with
+torn-write-tolerant recovery (journal.py, ``serve --journal-dir``) and a
+:class:`Supervisor` that restarts a dead serve child with backoff and a
+budget (supervisor.py, ``serve --supervise``;
+``scripts/crash_soak.py`` is the kill-9 acceptance soak). Group
+quarantine itself lives in service/loop.py — it is
 loop scheduling — but emits the resilience event vocabulary documented in
 docs/RESILIENCE.md.
 """
 
 from rtap_tpu.resilience.chaos import (
     FAULT_KINDS,
+    GENERATED_KINDS,
+    PROC_EXIT_CODE,
     ChaosEngine,
     ChaosError,
     ChaosSpec,
     Fault,
 )
 from rtap_tpu.resilience.degrade import LADDER, DegradationController
+from rtap_tpu.resilience.journal import (
+    TickJournal,
+    count_journal_ticks,
+    last_journal_tick,
+    parse_fsync,
+)
 from rtap_tpu.resilience.policies import CircuitBreaker, CircuitOpenError, Retry
+from rtap_tpu.resilience.supervisor import Supervisor, strip_supervise_flags
 
 __all__ = [
     "FAULT_KINDS",
+    "GENERATED_KINDS",
     "LADDER",
+    "PROC_EXIT_CODE",
     "ChaosEngine",
     "ChaosError",
     "ChaosSpec",
@@ -34,4 +51,10 @@ __all__ = [
     "DegradationController",
     "Fault",
     "Retry",
+    "Supervisor",
+    "TickJournal",
+    "count_journal_ticks",
+    "last_journal_tick",
+    "parse_fsync",
+    "strip_supervise_flags",
 ]

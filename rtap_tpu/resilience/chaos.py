@@ -26,6 +26,12 @@ Fault vocabulary (``Fault.kind``):
   (full disk) for the fault window
 - ``checkpoint_oserror``  — the per-group checkpoint save raises
   ``OSError`` for the fault window
+- ``proc_exit``           — the PROCESS dies abruptly (``os._exit``, no
+  cleanup, no flush) at the tick boundary right after the tick's row is
+  ingested/journaled — the durability layer's honest crash (ISSUE 5;
+  ``scripts/chaos_soak.py --supervise`` runs this under the supervisor
+  + journal recovery path). Excluded from seed-GENERATED schedules
+  (it would kill the generating test run); schedule it explicitly.
 
 A fault is active for ticks ``[tick, tick + duration)``. Group-targeted
 kinds apply to every group when ``group`` is None. The engine logs every
@@ -46,7 +52,8 @@ import numpy as np
 
 from rtap_tpu.obs import get_registry
 
-__all__ = ["ChaosEngine", "ChaosError", "ChaosSpec", "FAULT_KINDS", "Fault"]
+__all__ = ["ChaosEngine", "ChaosError", "ChaosSpec", "FAULT_KINDS",
+           "Fault", "GENERATED_KINDS", "PROC_EXIT_CODE"]
 
 FAULT_KINDS = (
     "source_timeout",
@@ -58,7 +65,18 @@ FAULT_KINDS = (
     "dispatch_hang",
     "alert_sink_oserror",
     "checkpoint_oserror",
+    "proc_exit",
 )
+
+#: kinds the seed-generator may draw (proc_exit kills the process — it
+#: must be scheduled explicitly, never rolled into an in-process soak);
+#: keeping generated schedules proc_exit-free also keeps every pre-ISSUE-5
+#: seed's schedule byte-identical (digest-stable)
+GENERATED_KINDS = tuple(k for k in FAULT_KINDS if k != "proc_exit")
+
+#: exit code of an injected proc_exit death (distinguishable from real
+#: crashes and from SIGKILL in supervisor logs)
+PROC_EXIT_CODE = 86
 
 #: kinds that target one StreamGroup (``group`` field; None = all groups)
 GROUP_KINDS = ("dispatch_exception", "collect_exception", "dispatch_hang",
@@ -137,7 +155,7 @@ class ChaosSpec:
         reproducer of the injected fault sequence."""
         if not 0 <= rate <= 1:
             raise ValueError(f"rate must be in [0, 1]; got {rate}")
-        kinds = tuple(kinds or FAULT_KINDS)
+        kinds = tuple(kinds or GENERATED_KINDS)
         for k in kinds:
             if k not in FAULT_KINDS:
                 raise ValueError(f"unknown fault kind {k!r}")
@@ -164,6 +182,28 @@ class ChaosSpec:
     def to_dict(self) -> dict:
         return {"seed": self.seed,
                 "faults": [asdict(f) for f in self.faults]}
+
+    def shifted(self, base: int) -> "ChaosSpec":
+        """The schedule as seen by a RESTARTED process that resumes at
+        global tick `base`: faults before the resume point are dropped
+        (they already fired — in particular a proc_exit that fired must
+        not re-kill every restart), the rest shift to the restart's
+        local tick clock. proc_exit fires AFTER its tick is journaled,
+        so a restart's base is always past the killing fault's tick and
+        the drop is unambiguous."""
+        if base <= 0:
+            return self
+        out = []
+        for f in self.faults:
+            if f.tick + f.duration <= base:
+                continue
+            start = max(f.tick, base)
+            out.append(Fault(
+                kind=f.kind, tick=start - base,
+                duration=f.tick + f.duration - start, group=f.group,
+                streams=f.streams, seconds=f.seconds,
+                ts_skew_s=f.ts_skew_s))
+        return ChaosSpec(faults=out, seed=self.seed)
 
     def digest(self) -> str:
         """Stable content hash of the schedule — two runs with the same
@@ -253,6 +293,19 @@ class ChaosEngine:
         if self._find("checkpoint_oserror", tick, group) is not None:
             self._record("checkpoint_oserror", tick, group)
             raise OSError(28, "chaos: no space left on device")
+
+    def on_tick_ingested(self, tick: int) -> None:
+        """Called right after the tick's row was ingested (and journaled,
+        when a journal is armed); a scheduled proc_exit dies HERE —
+        abruptly, no cleanup, no flush (os._exit). Firing after the
+        journal append makes the restart semantics unambiguous: the
+        killing tick is on disk, the resumed process replays it, and
+        ChaosSpec.shifted(base) drops the fault for good."""
+        if self._find("proc_exit", tick) is not None:
+            self._record("proc_exit", tick)
+            import os
+
+            os._exit(PROC_EXIT_CODE)
 
     # ---- object wrappers --------------------------------------------
     def wrap_source(self, source):

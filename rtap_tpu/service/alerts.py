@@ -19,6 +19,28 @@ import numpy as np
 from rtap_tpu.obs import get_registry
 
 
+def heal_torn_tail(path: str) -> int:
+    """Append a newline if `path` ends mid-line (a writer killed
+    mid-``write``): the fragment becomes its own unparseable — and
+    therefore skipped — line instead of merging with the next append
+    and corrupting BOTH records. Shared by the alert sink on reopen and
+    the supervisor's incident-stream appends. Returns bytes added
+    (0 or 1); a missing/empty/unwritable path heals nothing."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(-1, 2)
+            if f.read(1) == b"\n":
+                return 0
+    except (OSError, ValueError):
+        return 0
+    try:
+        with open(path, "a") as f:
+            f.write("\n")
+    except OSError:
+        return 0
+    return 1
+
+
 class AlertWriter:
     """JSONL alert sink. One line per (stream, tick) whose score crosses the
     threshold; `None` path writes nowhere but still counts. Structured
@@ -39,10 +61,25 @@ class AlertWriter:
     the fsync-adjacent cost dominated emit at high alert rates. The
     default 1 keeps flush-per-batch crash-safety: a killed serve loses at
     most the current batch. Events always flush (rare, load-bearing).
+
+    Durability (ISSUE 5, docs/RESILIENCE.md): every alert line carries a
+    stable ``alert_id`` (``group:stream:tick`` — the group index, the
+    stream id, and the GROUP's own tick counter, identical across
+    restarts) whenever the caller supplies ``group``/``tick``. The
+    writer tracks its byte offset into the sink (``sink_offset``; the
+    checkpoint meta records it at drained save instants as the alert
+    cursor) and can be armed with a resume suppression set
+    (``arm_suppression``): alert ids already on disk from a crashed
+    run's post-checkpoint window are counted and NOT re-written during
+    journal replay — exactly-once across the crash. Opening an existing
+    sink whose last line was torn mid-write (killed mid-``writelines``)
+    first heals it with a newline so subsequent lines stay parseable.
     """
 
     def __init__(self, path: str | None = None, flush_every: int = 1,
                  breaker=None, attributor=None):
+        import os
+
         from rtap_tpu.resilience.policies import CircuitBreaker
 
         if flush_every < 1:
@@ -53,8 +90,22 @@ class AlertWriter:
         # History advances on EVERY batch (attribution compares against
         # the previous tick), alert or not.
         self._attributor = attributor
+        self._offset = 0  # bytes handed to the sink (the alert cursor)
+        self.torn_heals = 0
+        if path:
+            try:
+                self._offset = os.path.getsize(path)
+            except OSError:
+                self._offset = 0
+            # heal a torn tail from a killed writer: without the newline
+            # the next append would merge into the partial line and
+            # corrupt BOTH records for line consumers
+            self.torn_heals = heal_torn_tail(path)
+            self._offset += self.torn_heals
         self._fh: IO[str] | None = open(path, "a") if path else None
         self.count = 0
+        self.suppressed = 0  # resume-suppressed (already-delivered) lines
+        self._suppress: set[str] = set()
         self.dropped = 0
         self.sink_quarantines = 0  # times the breaker opened on the sink
         self.flush_every = int(flush_every)
@@ -79,6 +130,10 @@ class AlertWriter:
             "rtap_obs_alert_lines_dropped_total",
             "alert/event lines dropped while the sink was failing or "
             "quarantined (full disk etc. — scoring continued)")
+        self._obs_suppressed = obs.counter(
+            "rtap_obs_alerts_suppressed_total",
+            "already-delivered alert ids suppressed during journal/"
+            "checkpoint resume (exactly-once across a crash)")
         self._obs_quarantined = {
             kind: obs.counter(
                 "rtap_obs_resilience_events_total",
@@ -112,6 +167,11 @@ class AlertWriter:
                 if not wrote:
                     self._fh.writelines(lines)
                     wrote = True
+                    # the alert cursor: bytes handed to the sink (exact
+                    # disk offset whenever the buffer is flushed — the
+                    # checkpoint path flushes before reading it)
+                    self._offset += sum(len(ln.encode("utf-8", "replace"))
+                                        for ln in lines)
                     self._batches_since_flush += 1
                 if force_flush or self._batches_since_flush >= self.flush_every:
                     self._fh.flush()
@@ -141,6 +201,31 @@ class AlertWriter:
                         self.sink_quarantines += 1
                         self._obs_quarantined["alert_sink_quarantined"].inc()
 
+    def arm_suppression(self, alert_ids: set[str]) -> None:
+        """Arm the resume suppression set: lines whose ``alert_id`` is in
+        the set are counted as already delivered and NOT re-written (the
+        set shrinks as ids match, so steady-state cost is an empty-set
+        check). service/loop.py fills it by scanning the alert sink past
+        the checkpoint's alert cursor before a journal replay."""
+        self._suppress |= set(alert_ids)
+
+    def sink_offset(self) -> int:
+        """Bytes handed to the sink so far — the alert-delivery cursor
+        recorded in checkpoint meta (flush first via :meth:`flush_sink`
+        so the cursor equals the on-disk size at a drained instant)."""
+        return self._offset
+
+    def flush_sink(self) -> None:
+        """Force the sink's stdio buffer to the kernel (best effort —
+        failures feed the breaker on the next write, never raise)."""
+        if self._fh is None:
+            return
+        try:
+            self._fh.flush()
+            self._batches_since_flush = 0
+        except OSError:
+            pass
+
     def emit_batch(
         self,
         stream_ids: list[str],
@@ -149,13 +234,21 @@ class AlertWriter:
         raw: np.ndarray,
         log_likelihood: np.ndarray,
         alerts: np.ndarray,
+        group: int | str | None = None,
+        tick: int | None = None,
     ) -> int:
-        """Write one JSONL line per alerting stream; returns alert count."""
+        """Write one JSONL line per alerting stream; returns alert count.
+
+        ``group`` + ``tick`` (the group index — possibly epoch-suffixed
+        after a quarantine restore, see loop._alert_gid — and the
+        group's own tick counter for this row) give every line its
+        stable ``alert_id`` (``group:stream:tick``) — the dedupe/replay
+        key downstream consumers and crash-resume suppression rely
+        on."""
         t0 = time.perf_counter()
         idx = np.nonzero(alerts)[0]
-        self.count += idx.size
-        if idx.size:
-            self._obs_alerts.inc(int(idx.size))
+        self.count += idx.size  # crossings scored, sink/suppression aside
+        suppressed_this = 0
         attr = None
         if self._attributor is not None:
             # history must advance on every batch, not just alerting ones
@@ -166,12 +259,26 @@ class AlertWriter:
         if self._fh is not None and idx.size:
             ts = np.broadcast_to(np.asarray(ts), alerts.shape)
             values = np.asarray(values)
+            with_id = group is not None and tick is not None
             # one writelines per batch, not one write per line: the
             # serialization stays per-line (each line is one JSON object)
             # but the file sees a single buffered call
-            lines = [
-                json.dumps(
+            lines = []
+            for g in idx:
+                aid = f"{group}:{stream_ids[g]}:{int(tick)}" \
+                    if with_id else None
+                if aid is not None and self._suppress and \
+                        aid in self._suppress:
+                    # already delivered by the run that crashed: counted,
+                    # never duplicated (exactly-once across the crash)
+                    self._suppress.discard(aid)
+                    self.suppressed += 1
+                    suppressed_this += 1
+                    self._obs_suppressed.inc()
+                    continue
+                lines.append(json.dumps(
                     {
+                        **({"alert_id": aid} if aid is not None else {}),
                         "stream": stream_ids[g],
                         "ts": int(ts[g]),
                         "value": float(values[g]) if values.ndim == 1
@@ -181,11 +288,13 @@ class AlertWriter:
                         **({"top_fields": attr.get(int(g), [])}
                            if attr is not None else {}),
                     }
-                )
-                + "\n"
-                for g in idx
-            ]
+                ) + "\n")
             self._safe_write(lines)
+        emitted = int(idx.size) - suppressed_this
+        if emitted:
+            # lines handed toward the sink this call: suppressed ids ride
+            # rtap_obs_alerts_suppressed_total instead, never both
+            self._obs_alerts.inc(emitted)
         self._obs_emit.observe(time.perf_counter() - t0)
         return int(idx.size)
 
@@ -214,6 +323,32 @@ class AlertWriter:
             except OSError:
                 pass  # the quarantine counters already told the story
             self._fh = None
+
+
+def scan_alert_ids(path: str, offset: int = 0) -> set[str]:
+    """Alert ids already on disk at/after byte `offset` — the resume
+    suppression set. The checkpoint meta's alert cursor (recorded at a
+    fully-drained save instant) bounds the scan to the post-checkpoint
+    window, so resume cost is O(ticks since the last save), not O(file).
+    Event lines and torn/unparseable fragments are skipped (a torn line
+    never fully delivered its alert — replay re-emits it properly)."""
+    ids: set[str] = set()
+    try:
+        with open(path) as f:
+            f.seek(max(0, int(offset)))
+            for line in f:
+                if line.startswith('{"event"'):
+                    continue
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                aid = d.get("alert_id") if isinstance(d, dict) else None
+                if aid:
+                    ids.add(aid)
+    except OSError:
+        return ids
+    return ids
 
 
 @dataclass

@@ -24,13 +24,30 @@ from rtap_tpu.obs import get_registry
 from rtap_tpu.service.registry import StreamGroup
 
 
-def save_group(grp: StreamGroup, path: str | Path) -> None:
+def save_group(grp: StreamGroup, path: str | Path,
+               alerts_offset: int | None = None,
+               journal_tick: int | None = None) -> None:
     """Write one group's resume state to `path` (a directory, per group).
 
     Atomic on overwrite: the tree + meta are written to a fresh temp sibling
     directory and swapped in with renames, so a crash mid-save can never leave
     a directory that has meta.json (the completeness marker) but a partially
     rewritten state tree.
+
+    `alerts_offset` is the alert-delivery cursor (ISSUE 5): the alert
+    sink's byte size at this save instant. Saves happen with the
+    pipeline fully drained and the sink flushed, so every alert for
+    ticks <= this checkpoint's `ticks` sits BEFORE the cursor and every
+    byte past it belongs to post-checkpoint ticks — on resume, the
+    journal replay scans the sink from the cursor and suppresses exactly
+    the already-delivered alert ids (exactly-once across a crash;
+    docs/RESILIENCE.md durability section).
+
+    `journal_tick` is the GLOBAL journal tick cursor at this save
+    instant. It equals `ticks` on a group's original timeline, but a
+    mid-run quarantine restore REWINDS the group counter while the
+    global clock keeps running — the journal replay must match rows by
+    this global cursor, never by the rewindable per-group one.
     """
     import jax
     import orbax.checkpoint as ocp
@@ -72,7 +89,12 @@ def save_group(grp: StreamGroup, path: str | Path) -> None:
         "n_live": getattr(grp, "n_live", grp.G),
         "sharded": grp.mesh is not None,
         "config": grp.cfg.to_dict(),
+        "alert_epoch": int(getattr(grp, "alert_epoch", 0)),
     }
+    if alerts_offset is not None:
+        meta["alerts_offset"] = int(alerts_offset)
+    if journal_tick is not None:
+        meta["journal_tick"] = int(journal_tick)
     tmp = path.parent / f".{path.name}.tmp-{uuid.uuid4().hex[:8]}"
     swapped = False
     try:
@@ -214,12 +236,39 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
     if "alert_run" in tree:  # pre-debounce checkpoints lack it (zeros then)
         grp._alert_run = np.asarray(tree["alert_run"]).astype(np.int64)
     grp.ticks = int(meta["ticks"])
+    # the alert-delivery cursor rides along for resume-time suppression
+    # (None for pre-durability checkpoints: the scan falls back to 0)
+    grp.resume_alerts_offset = (
+        int(meta["alerts_offset"]) if "alerts_offset" in meta else None)
+    grp.resume_journal_tick = (
+        int(meta["journal_tick"]) if "journal_tick" in meta else None)
+    grp.alert_epoch = int(meta.get("alert_epoch", 0))
     # n_live is now derived from stream_ids (pad-prefix count) — the meta
     # field stays written for inspection/back-compat but is not load-bearing
     get_registry().counter(
         "rtap_obs_checkpoint_loads_total",
         "group checkpoints restored (service/replay resume)").inc()
     return grp
+
+
+def peek_resume_ticks(checkpoint_dir: str | Path) -> int:
+    """Max recorded tick cursor across a dir's group checkpoints, read
+    from meta.json alone (no state load) — the serve CLI's resume-base
+    probe when ``--journal-dir`` treats ``--ticks`` as a total budget
+    across restarts. 0 for a missing/empty/unreadable dir."""
+    best = 0
+    root = Path(checkpoint_dir)
+    if not root.is_dir():
+        return 0
+    for d in root.iterdir():
+        if not d.name.startswith("group") or not d.is_dir():
+            continue
+        try:
+            best = max(best,
+                       int(json.loads((d / "meta.json").read_text())["ticks"]))
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+    return best
 
 
 def validate_resume(resumed: StreamGroup, ck_path, grp: StreamGroup,

@@ -36,6 +36,14 @@ _MAX_REJECTED_TRACKED = 4096
 _PHASES = ("source", "membership", "dispatch", "collect", "emit", "checkpoint")
 
 
+def _alert_gid(gi: int, grp):
+    """The alert_id group field: the bare group index on a group's
+    original timeline, `<gi>.e<epoch>` after a quarantine restore has
+    rewound its tick counter (docs/TELEMETRY.md alert schema)."""
+    epoch = getattr(grp, "alert_epoch", 0)
+    return gi if not epoch else f"{gi}.e{epoch}"
+
+
 def _scored_counter():
     return get_registry().counter(
         "rtap_obs_scored_total",
@@ -117,6 +125,8 @@ def replay_streams(
         "rtap_obs_replay_group_ticks_total",
         "group-ticks collected by replay_streams (sums over groups)")
     resumed_from: dict[str, int] = {}
+    suppression_scanned_from: int | None = None  # lowest alert-cursor
+    # offset whose tail has been scanned into the suppression set
 
     # streams were added in order, so group i owns the contiguous slice
     # ids[i*group_size : i*group_size + n_live], at slots 0..n_live-1
@@ -142,6 +152,21 @@ def replay_streams(
                     )
                 grp = reg.groups[gi] = resumed
                 resumed_from[f"group{gi}"] = grp.ticks
+                ck_off = getattr(grp, "resume_alerts_offset", None)
+                if alert_path is not None and ck_off is not None and (
+                        suppression_scanned_from is None
+                        or ck_off < suppression_scanned_from):
+                    # exactly-once across the crash: alert ids the dead
+                    # run already delivered past the checkpoints' alert
+                    # cursors are suppressed, not duplicated, when the
+                    # tail is re-scored. ONE tail scan covers every
+                    # group (ids are globally unique); only a torn save
+                    # set revealing an even older cursor rescans.
+                    from rtap_tpu.service.alerts import scan_alert_ids
+
+                    writer.arm_suppression(
+                        scan_alert_ids(alert_path, ck_off))
+                    suppression_scanned_from = ck_off
         if grp.ticks < T:
             groups_with_work += 1
         # a group resumed AT the end replays zero ticks (all-NaN rows) by
@@ -175,8 +200,14 @@ def replay_streams(
             obs_scored.inc((t1 - t0) * live)
             obs_replay_ticks.inc(t1 - t0)
             for i in range(t0, t1):
+                # alert_id group:stream:tick — the replay tick IS the
+                # group's tick counter (both started at 0 together);
+                # epoch-suffixed if the resumed checkpoint carries a
+                # rewound-timeline epoch from a live quarantine restore
                 writer.emit_batch(sids, gt[i, :live], gv[i, :live],
-                                  r[i - t0, :live], ll[i - t0, :live], al[i - t0, :live])
+                                  r[i - t0, :live], ll[i - t0, :live],
+                                  al[i - t0, :live],
+                                  group=_alert_gid(gi, grp), tick=i)
 
         # depth-2 pipeline: the device computes chunk t+1 while the host
         # post-processes chunk t (SURVEY.md §7 hard part 3 — overlapped feed)
@@ -203,14 +234,19 @@ def replay_streams(
                     chunks_done += 1
                 from rtap_tpu.service.checkpoint import save_group
 
-                save_group(grp, ck_path)
+                # drained instant: flush the sink so the alert cursor in
+                # meta equals the on-disk size (exactly-once resume)
+                writer.flush_sink()
+                save_group(grp, ck_path, alerts_offset=writer.sink_offset())
         while pending:
             collect(*pending.popleft())
             chunks_done += 1
         if learn and ck_path is not None and checkpoint_every and grp.ticks >= T:
             from rtap_tpu.service.checkpoint import save_group
 
-            save_group(grp, ck_path)  # final state, resumable past the end
+            writer.flush_sink()
+            # final state, resumable past the end
+            save_group(grp, ck_path, alerts_offset=writer.sink_offset())
             # (frozen replay never writes — read-only like serve --freeze)
     writer.close()
     if resumed_from and not groups_with_work:
@@ -270,6 +306,7 @@ def live_loop(
     trace=None,
     flight=None,
     attributor=None,
+    journal=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -415,6 +452,21 @@ def live_loop(
     `top_fields` provenance to alert JSONL lines (serve
     --alert-attribution): the fields whose encoder representation moved
     most, decoded in RDSE key-space (docs/TELEMETRY.md).
+
+    `journal` (a resilience.TickJournal, serve --journal-dir; ISSUE 5
+    durability): every ingested tick row is appended to the write-ahead
+    journal BEFORE scoring, and on entry any recovered rows past each
+    group's checkpoint tick are REPLAYED through the normal scoring
+    path — the resumed fleet reaches the crash point bit-identically to
+    an uninterrupted run, with already-delivered alert ids suppressed
+    via the checkpoint's alert cursor (exactly-once across the crash).
+    After each emitted chunk the journal records an alert-delivery
+    cursor; after each successful checkpoint round it is compacted to
+    the ticks the checkpoints no longer cover. A torn/corrupt journal
+    tail was already truncated (counted) when the caller constructed
+    the TickJournal — recovery never refuses to start
+    (docs/RESILIENCE.md durability section; scripts/crash_soak.py is
+    the kill-9 acceptance soak).
 
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
@@ -809,14 +861,30 @@ def live_loop(
             raw, loglik, alerts = results[gi]
             slots, ids, off = rmaps[gi]
             n = len(slots)
+            # the group's own tick counter names the rows just collected
+            # (collect_chunk already advanced it by the chunk length):
+            # alert_id = group:stream:group-tick is stable across restarts
+            # and identical to an uninterrupted run's. A mid-run
+            # quarantine restore rewinds the counter — its epoch suffix
+            # keeps the rewound timeline's ids collision-free.
+            grp_tick0 = groups[gi].ticks - len(ts_rows)
+            gid = _alert_gid(gi, groups[gi])
             for i, (ts, values) in enumerate(zip(ts_rows, value_rows)):
                 writer.emit_batch(ids, np.full(n, ts), values[off:off + n],
                                   raw[i, slots], loglik[i, slots],
-                                  alerts[i, slots])
+                                  alerts[i, slots], group=gid,
+                                  tick=grp_tick0 + i)
                 counter.add(n)
                 scored += n
             group_scored[gi] += len(ts_rows) * n
         obs_scored.inc(scored)
+        if journal is not None and pairs:
+            # alert-delivery cursor: alerts through this tick have been
+            # handed to the sink at this byte offset (diagnostic trail —
+            # the load-bearing cursor is the checkpoint meta's, taken at
+            # drained instants)
+            journal.append_cursor(journal_base + cur_tick,
+                                  writer.sink_offset())
         t2 = time.perf_counter()
         phase_s["emit"] += t2 - t1
         if trace is not None:
@@ -855,6 +923,147 @@ def live_loop(
     seen_m: set = set()  # what the old m-only heuristic would have warmed:
     # a cold program at an already-seen m is exactly a duplicate compile
     # the old keying would NOT have serialized — counted as avoided
+
+    # ---- journal recovery + replay (resilience/journal.py, ISSUE 5) ----
+    # The write-ahead journal holds every tick row ingested since the
+    # oldest live checkpoint. Replay each recovered row past a group's
+    # checkpoint tick through the normal per-group dispatch/collect path
+    # (m=1 chunks — the same programs, bit-identical results), emitting
+    # alerts under the resume suppression set so already-delivered ids
+    # are never duplicated and never lost. No cadence: catch-up runs as
+    # fast as the chip allows, and its wall cost is reported.
+    journal_replay = {"replayed_ticks": 0, "replay_seconds": 0.0,
+                      "skipped_rows": 0}
+    gpos: list = []
+    if journal is not None:
+        t_jr0 = time.perf_counter()
+        if chaos is not None:
+            # replay is RECOVERY, not live serving: no fault window may
+            # apply to it (a shifted sink fault at local tick 0 would
+            # otherwise drop replayed alerts — permanently, breaking
+            # exactly-once). No Fault window can cover tick -1.
+            chaos.set_tick(-1)
+        # per-group GLOBAL journal cursor: where in the global tick
+        # stream each group's checkpoint stopped. Equals the group's own
+        # counter on its original timeline, but a mid-run quarantine
+        # restore REWINDS the counter while the global clock keeps
+        # running — matching rows by grp.ticks would then feed a
+        # restored group the wrong rows (or falsely gap-quarantine it),
+        # so the save path records the global cursor in meta.
+        gpos = [
+            grp.resume_journal_tick
+            if getattr(grp, "resume_journal_tick", None) is not None
+            else grp.ticks
+            for grp in groups
+        ]
+        jrows = [r for r in journal.recovered_ticks
+                 if r[0] >= min(gpos, default=0)]
+        if journal.truncations or journal.dropped_segments:
+            # the torn tail was truncated at construction — say so on
+            # the incident stream (counted, never a refusal to start)
+            _res_event("journal_tail_truncated", 0,
+                       truncations=int(journal.truncations),
+                       bytes=int(journal.truncated_bytes),
+                       dropped_segments=int(journal.dropped_segments))
+        if jrows:
+            if alert_path is not None:
+                # exactly-once: every alert byte past the checkpoints'
+                # alert cursors belongs to the ticks about to be
+                # replayed — suppress exactly those ids
+                from rtap_tpu.service.alerts import scan_alert_ids
+
+                known_offs = [
+                    off for off in (
+                        getattr(g, "resume_alerts_offset", None)
+                        for g in groups)
+                    if off is not None]
+                writer.arm_suppression(scan_alert_ids(
+                    alert_path, min(known_offs) if known_offs else 0))
+            obs_jr = obs.counter(
+                "rtap_obs_journal_replayed_ticks_total",
+                "journaled ticks replayed through the scoring path on "
+                "resume (crash catch-up)")
+            gap_groups: set = set()  # groups whose replay window has a
+            # hole (compacted/evicted rows): healing is impossible, and
+            # scoring row jt as some earlier tick would SILENTLY corrupt
+            # state and alert ids — skip the group loudly instead
+            for jt, jts, jvals in jrows:
+                jvals = np.asarray(jvals, np.float32)
+                if len(jvals) != n_expected:
+                    # membership changed between record and resume —
+                    # normally impossible: every membership change
+                    # checkpoints + compacts at its drained boundary
+                    # (the routing-rebuild block below), so a surviving
+                    # mismatch means the change ran without a
+                    # --checkpoint-dir; skip the row (counted)
+                    journal_replay["skipped_rows"] += 1
+                    continue
+                for gi, grp in enumerate(groups):
+                    if gi in quarantined or gi in gap_groups \
+                            or gpos[gi] > jt:
+                        continue  # this group's checkpoint is already past
+                    if jt > gpos[gi]:
+                        # QUARANTINE, not just an event: a gap group
+                        # resuming live at its stale counter would score
+                        # fresh rows as the wrong ticks and reuse
+                        # already-delivered alert ids — the exact
+                        # corruption the journal exists to prevent
+                        gap_groups.add(gi)
+                        _quarantine_group(gi, 0, "journal_replay_gap",
+                                          RuntimeError(
+                                              f"journal gap: group "
+                                              f"resumes at global tick "
+                                              f"{gpos[gi]} but the "
+                                              f"first surviving row is "
+                                              f"tick {jt} (compacted/"
+                                              "evicted)"))
+                        continue
+                    slots, g_ids, off = routing[gi]
+                    v = np.full((1, grp.G) + jvals.shape[1:], np.nan,
+                                np.float32)
+                    v[0, slots] = jvals[off:off + len(slots)]
+                    t = np.full((1, grp.G), int(jts), np.int64)
+                    key = (1, grp.cfg, learn)
+                    if key not in warmed:
+                        warmed.add(key)
+                        obs_warm_compiles.inc()
+                    try:
+                        r_raw, r_ll, r_al = grp.collect_chunk(
+                            grp.dispatch_chunk(v, t, learn=learn))
+                    except Exception as e:  # noqa: BLE001 — isolate group
+                        _quarantine_group(gi, jt, "journal_replay", e)
+                        continue
+                    gpos[gi] += 1
+                    n = len(slots)
+                    writer.emit_batch(
+                        g_ids, np.full(n, int(jts)), jvals[off:off + n],
+                        r_raw[0, slots], r_ll[0, slots], r_al[0, slots],
+                        group=_alert_gid(gi, grp), tick=grp.ticks - 1)
+                    counter.add(n)
+                    obs_scored.inc(n)
+                obs_jr.inc()
+                last_ts_seen = int(jts) if last_ts_seen is None \
+                    else max(last_ts_seen, int(jts))
+            journal_replay["replayed_ticks"] = \
+                len(jrows) - journal_replay["skipped_rows"]
+            if gap_groups:
+                journal_replay["gap_groups"] = sorted(gap_groups)
+            journal_replay["replay_seconds"] = round(
+                time.perf_counter() - t_jr0, 4)
+            _res_event("journal_replayed", 0,
+                       ticks=journal_replay["replayed_ticks"],
+                       from_tick=int(jrows[0][0]), to_tick=int(jrows[-1][0]),
+                       seconds=journal_replay["replay_seconds"])
+        del jrows
+        journal.release_recovered()  # a large replay window must not
+        # stay resident for the rest of the run (counts live in stats)
+    # the run's global tick base: journal records and cursors are indexed
+    # past every global position already reached AND every index already
+    # on disk (0 on a fresh start). The next_tick floor matters when
+    # every group gap-quarantined: appends must never reuse an existing
+    # index, so recovery's keep-first-copy dedup stays unambiguous.
+    journal_base = max(gpos + [journal.next_tick]) \
+        if journal is not None else 0
 
     def _try_dispatch(gi, grp, v, t, learn_flag):
         """Dispatch one group's chunk, capturing the fault: a raising
@@ -1082,6 +1291,13 @@ def live_loop(
                             _res_event("group_restore_failed", k, group=gi,
                                        error=f"{type(e).__name__}: {e}")
                             continue
+                        # the restore REWINDS the group's tick counter:
+                        # bump its alert-id epoch so re-used tick
+                        # indices never collide with already-delivered
+                        # ids on the stream (downstream dedupe contract)
+                        restored.alert_epoch = max(
+                            restored.alert_epoch,
+                            getattr(old, "alert_epoch", 0)) + 1
                         groups[gi] = restored
                         if reg is not None:
                             for slot in reg._slots.values():
@@ -1184,6 +1400,27 @@ def live_loop(
                 _sync_chaos_routing()
                 obs_rebuilds.inc()
                 obs_streams.set(n_expected)
+                if journal is not None and checkpoint_dir and learn:
+                    # a membership change resizes the journal's row
+                    # width: checkpoint NOW (the pipeline is drained)
+                    # so the replay window never spans two widths —
+                    # otherwise a crash after a claim would skip the
+                    # post-claim rows as width-mismatched and gap-
+                    # quarantine the fleet on restart
+                    writer.flush_sink()
+                    _saved_m, failed_m = _save_all(
+                        groups, checkpoint_dir, skip=quarantined,
+                        chaos=chaos, tick=k,
+                        on_failure=lambda gi, e: _on_save_failure(
+                            gi, k, e),
+                        alerts_offset=writer.sink_offset(),
+                        journal_tick=journal_base + ticks_run)
+                    if not failed_m:
+                        checkpoints_saved += 1
+                        last_saved = ticks_run
+                        if not quarantined:
+                            journal.compact(min(
+                                (g.ticks for g in groups), default=0))
             now = time.perf_counter()
             _mem_booked = (now - t_phase) - (
                 phase_s["collect"] + phase_s["emit"] + phase_s["dispatch"]
@@ -1243,6 +1480,15 @@ def live_loop(
             else:
                 ts_regress_run = 0
                 last_ts_seen = ts
+            if journal is not None:
+                # the write-ahead moment: the row is durable (flushed to
+                # the kernel; fsync per policy) BEFORE any scoring — a
+                # death past this point replays this tick on restart
+                journal.append_tick(journal_base + k, ts, values)
+            if chaos is not None:
+                # proc_exit fires here — after the row is journaled, so
+                # a restart's resume base is unambiguously past it
+                chaos.on_tick_ingested(k)
             if auto_release_after:
                 # consecutive-silence accounting over THIS tick's values;
                 # releases defer to the next tick's membership block (this
@@ -1289,10 +1535,17 @@ def live_loop(
                            + phase_s["dispatch"])
                     ck0 = phase_s["checkpoint"]
                     _align_boundaries()
+                    # drained instant: flush the sink so each meta's
+                    # alert cursor equals the on-disk size (exactly-once
+                    # resume suppression reads from it)
+                    writer.flush_sink()
                     _saved, failed = _save_all(
                         groups, checkpoint_dir, skip=quarantined,
                         chaos=chaos, tick=k,
-                        on_failure=lambda gi, e: _on_save_failure(gi, k, e))
+                        on_failure=lambda gi, e: _on_save_failure(gi, k, e),
+                        alerts_offset=writer.sink_offset(),
+                        journal_tick=journal_base + ticks_run
+                        if journal is not None else None)
                     phase_s["checkpoint"] += (time.perf_counter() - now) - (
                         phase_s["collect"] + phase_s["emit"]
                         + phase_s["dispatch"] - ce0)
@@ -1315,6 +1568,16 @@ def live_loop(
                         ck_breaker.record_success()
                         checkpoints_saved += 1
                         last_saved = ticks_run
+                        if journal is not None and not quarantined:
+                            # ticks below every live checkpoint can never
+                            # be replayed again — keep the journal
+                            # O(checkpoint_every) ticks on disk. With a
+                            # group QUARANTINED, compaction pauses: its
+                            # restore source is an older checkpoint whose
+                            # replay window must stay on disk (a crash-
+                            # restart replays it back to health)
+                            journal.compact(min(
+                                (g.ticks for g in groups), default=0))
                 else:
                     # checkpointing quarantined: saves are skipped (and
                     # said so, once per episode) until the breaker's
@@ -1392,7 +1655,9 @@ def live_loop(
             # a quarantine raised by the final drain (or an early stop)
             # queued its dump after the last in-loop flush — write it
             flight.flush_pending()
-    if learn and checkpoint_dir and ticks_run > last_saved:
+    if learn and checkpoint_dir \
+            and (ticks_run > last_saved
+                 or journal_replay["replayed_ticks"] > 0):
         # final state on exit (clean or stopped), like replay_streams — a
         # resume must not lose already-learned ticks. Gated on the dir
         # alone: checkpoint_every=0 with a dir means "save only on exit".
@@ -1403,12 +1668,19 @@ def live_loop(
         # Bypasses the checkpoint breaker (one last best-effort save);
         # failures are evented and counted, never raised over a finished
         # run — each group's previous checkpoint is intact by atomicity.
+        writer.flush_sink()
         _saved, failed = _save_all(
             groups, checkpoint_dir, skip=quarantined, chaos=chaos,
             tick=ticks_run,
-            on_failure=lambda gi, e: _on_save_failure(gi, ticks_run, e))
+            on_failure=lambda gi, e: _on_save_failure(gi, ticks_run, e),
+            alerts_offset=writer.sink_offset(),
+            journal_tick=journal_base + ticks_run
+            if journal is not None else None)
         if not failed:
             checkpoints_saved += 1
+            if journal is not None and not quarantined:
+                # same pause-while-quarantined rule as the in-loop site
+                journal.compact(min((g.ticks for g in groups), default=0))
     writer.close()
     lat = {}
     if ticks_run > 0:
@@ -1447,6 +1719,11 @@ def live_loop(
         extra["checkpoint_save_failures"] = checkpoint_save_failures
     if chaos is not None:
         extra["chaos_injected"] = len(chaos.injected)
+    if journal is not None:
+        # the durability artifact: what was recovered/replayed, what the
+        # torn-tail truncation cost, what exactly-once suppressed
+        extra["journal"] = {**journal.stats(), **journal_replay,
+                            "suppressed_alerts": writer.suppressed}
     if flight is not None:
         extra["postmortem"] = flight.stats()
     if aot_warmup:
@@ -1472,7 +1749,8 @@ def live_loop(
 
 
 def _save_all(groups, checkpoint_dir: str, skip=(), chaos=None, tick: int = 0,
-              on_failure=None) -> tuple[int, int]:
+              on_failure=None, alerts_offset: int | None = None,
+              journal_tick: int | None = None) -> tuple[int, int]:
     """One atomic per-group save per group dir (group{i:04d}).
 
     Quarantined groups (`skip`) are NOT saved: their state may be
@@ -1493,7 +1771,9 @@ def _save_all(groups, checkpoint_dir: str, skip=(), chaos=None, tick: int = 0,
         try:
             if chaos is not None:
                 chaos.on_checkpoint_save(gi, tick)
-            save_group(grp, os.path.join(checkpoint_dir, f"group{gi:04d}"))
+            save_group(grp, os.path.join(checkpoint_dir, f"group{gi:04d}"),
+                       alerts_offset=alerts_offset,
+                       journal_tick=journal_tick)
             saved += 1
         except Exception as e:  # noqa: BLE001 — contained per group
             failed += 1

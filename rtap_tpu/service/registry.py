@@ -77,6 +77,12 @@ class StreamGroup:
         self.mesh = mesh
         self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
         self.ticks = 0
+        # alert-id timeline epoch: 0 for a group's original timeline;
+        # bumped when a quarantine restore REWINDS self.ticks mid-run so
+        # re-used tick indices never collide with already-delivered
+        # alert_ids (docs/TELEMETRY.md alert schema; persisted in
+        # checkpoint meta)
+        self.alert_epoch = 0
         self._seq = 0  # dispatch sequence number (pipelined replay ordering)
         self._collected = 0
         # latest predicted values [T, G] (classifier only); kept in sync by

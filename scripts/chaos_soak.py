@@ -15,9 +15,20 @@ machine-checked verdict:
   ``group_restored`` events on the alert stream. Quarantine is allowed
   (that is the mechanism working); silence is not.
 
+``--supervise`` (ISSUE 5) runs the soak OUT of process instead: the
+seeded schedule gains ``proc_exit`` faults (abrupt ``os._exit`` at tick
+boundaries) and the child — ``scripts/crash_soak.py --child``, the
+journaled + checkpointed serve runner — flies under the real
+:class:`rtap_tpu.resilience.Supervisor`. The verdict checks the
+supervisor restarted the child once per scheduled kill, the run still
+completed its total tick budget, journal recovery actually ran
+(``journal_replayed`` events on the incident stream), and the alert
+stream carries zero duplicated ``alert_id``s.
+
 Usage: python scripts/chaos_soak.py --seed 1 [--streams 12]
        [--group-size 4] [--ticks 120] [--cadence 0.05] [--rate 0.08]
        [--backend tpu] [--out reports/chaos_soak.json]
+       [--supervise --kills 2]
 """
 
 from __future__ import annotations
@@ -63,6 +74,125 @@ def _unquarantined_intervals(events: list[dict], n_groups: int,
     return intervals
 
 
+def run_supervised(args) -> int:
+    """`--supervise`: seeded proc_exit kills + source/sink faults against
+    the journaled serve child under the real Supervisor."""
+    import random
+
+    from rtap_tpu.resilience import ChaosSpec, Fault, Supervisor
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_supervise_")
+    os.makedirs(workdir, exist_ok=True)
+    n_groups = -(-args.streams // args.group_size)
+    # in-process-safe kinds ride along at the normal rate; group-killing
+    # kinds stay out (a quarantined group across a restart boundary is a
+    # different study — the journal replays it back to health anyway)
+    base = ChaosSpec.generate(
+        seed=args.seed, n_ticks=args.ticks, n_groups=n_groups,
+        rate=args.rate,
+        kinds=("source_timeout", "source_malformed", "alert_sink_oserror"))
+    rng = random.Random(args.seed ^ 0x5EED)
+    lo, hi = max(1, args.ticks // 5), max(2, args.ticks * 4 // 5)
+    if not 1 <= args.kills <= hi - lo:
+        log(f"--kills {args.kills} does not fit the schedulable window "
+            f"[{lo}, {hi}) of a {args.ticks}-tick run (1..{hi - lo})")
+        return 2
+    kill_ticks = sorted(rng.sample(range(lo, hi), args.kills))
+    faults = sorted(
+        base.faults + [Fault(kind="proc_exit", tick=t) for t in kill_ticks],
+        key=lambda f: f.tick)
+    spec = ChaosSpec(faults=faults, seed=args.seed)
+    spec_path = os.path.join(workdir, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec.to_dict(), f)
+    log(f"supervised schedule: {len(base.faults)} in-process faults + "
+        f"proc_exit at ticks {kill_ticks}, digest {spec.digest()}")
+
+    alerts_path = os.path.join(workdir, "alerts.jsonl")
+    child = [sys.executable, os.path.join(REPO, "scripts", "crash_soak.py"),
+             "--child", "--workdir", workdir, "--seed", str(args.seed),
+             "--ticks", str(args.ticks), "--streams", str(args.streams),
+             "--group-size", str(args.group_size),
+             "--cadence", str(args.cadence),
+             "--checkpoint-every", str(args.checkpoint_every),
+             "--backend", args.backend, "--threshold", str(-1e9),
+             "--journal-fsync", "os", "--spec", spec_path,
+             "--stats-out", os.path.join(workdir, "stats.jsonl")]
+    sup = Supervisor(child, restart_budget=args.kills + 2,
+                     backoff_base_s=0.05, backoff_max_s=1.0,
+                     event_path=alerts_path, log=log)
+    rc = sup.run(install_signals=False)
+
+    failures: list[str] = []
+    if rc != 0:
+        failures.append(f"supervised run ended rc={rc} "
+                        f"(deaths={sup.deaths})")
+    from rtap_tpu.resilience.chaos import PROC_EXIT_CODE
+
+    if sup.deaths != args.kills:
+        failures.append(
+            f"{sup.deaths} death(s) for {args.kills} scheduled proc_exit "
+            "faults — each must fire exactly once across restarts")
+    bad_rc = [r for r in sup.death_rcs if r != PROC_EXIT_CODE]
+    if bad_rc:
+        failures.append(
+            f"death rc(s) {bad_rc} are not the injected proc_exit "
+            f"(rc {PROC_EXIT_CODE}) — a real crash rode the schedule")
+    total = 0
+    stats_path = os.path.join(workdir, "stats.jsonl")
+    if os.path.isfile(stats_path):
+        with open(stats_path) as f:
+            for line in f:
+                s = json.loads(line)
+                total = max(total, s["base"] + s["ran"])
+    if total != args.ticks:
+        failures.append(f"run completed {total} of {args.ticks} total "
+                        "ticks across restarts")
+    # one scanner for both soaks: crash_soak's parse_alert_stream owns
+    # the event-vs-alert split and torn-fragment tolerance
+    from scripts.crash_soak import parse_alert_stream
+
+    parsed = parse_alert_stream(alerts_path)
+    seen_ids = set(parsed["alerts"])
+    dup = parsed["dup"]
+    replay_events = sum(1 for e in parsed["events"]
+                        if e.get("event") == "journal_replayed")
+    if dup:
+        failures.append(f"{len(dup)} duplicated alert_id(s) across "
+                        f"restarts: {dup[:5]}")
+    if args.kills and not replay_events:
+        failures.append("no journal_replayed event despite kills — "
+                        "recovery never ran")
+    report = {
+        "mode": "supervise",
+        "seed": args.seed,
+        "schedule_digest": spec.digest(),
+        "proc_exit_ticks": kill_ticks,
+        "deaths": sup.deaths,
+        "ticks_completed": total,
+        "alert_ids": len(seen_ids),
+        "duplicated": len(dup),
+        "journal_replay_events": replay_events,
+        "verified": not failures,
+        "failures": failures,
+        "workdir": workdir,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    if failures:
+        for msg in failures:
+            log(f"FAIL: {msg}")
+        return VERIFY_FAILED_EXIT
+    log(f"OK: {sup.deaths} proc_exit death(s), {total} ticks completed, "
+        f"{len(seen_ids)} alert ids unique, {replay_events} journal "
+        "replays")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0,
@@ -82,8 +212,17 @@ def main() -> int:
                     help="alerts + checkpoints land here (default: a "
                          "fresh temp dir)")
     ap.add_argument("--out", default=None, help="report JSON path")
+    ap.add_argument("--supervise", action="store_true",
+                    help="out-of-process mode (ISSUE 5): add seeded "
+                         "proc_exit kills and run the journaled serve "
+                         "child under the Supervisor; verify restarts, "
+                         "journal recovery, and zero duplicated alert ids")
+    ap.add_argument("--kills", type=int, default=2,
+                    help="proc_exit faults scheduled with --supervise")
     args = ap.parse_args()
     maybe_force_cpu()
+    if args.supervise:
+        return run_supervised(args)
 
     import numpy as np
 
