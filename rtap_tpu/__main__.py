@@ -169,7 +169,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     grp = StreamGroupRegistry(cfg, group_size=gsize,
                               backend=args.backend, threshold=args.threshold,
                               debounce=args.debounce,
-                              stagger_learn=args.stagger_learn)
+                              stagger_learn=args.stagger_learn,
+                              health=args.health)
     for sid in ids:
         grp.add_stream(sid)
     grp.finalize(reserve=reserve)
@@ -217,10 +218,39 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         from rtap_tpu.service.attribution import AlertAttributor
 
         attributor = AlertAttributor(cfg)
+    # model-health observability (obs/health.py, ISSUE 6): the groups
+    # above were built with health=args.health, so every chunk already
+    # carries the fused on-device aggregates; the tracker folds them
+    # into scorecards (GET /health), detects score drift, and raises
+    # health incidents onto the alert stream + flight recorder
+    health = None
+    if args.health:
+        from rtap_tpu.obs import HealthTracker
+
+        try:
+            health = HealthTracker(
+                cfg,
+                occupancy_threshold=args.health_occupancy_threshold,
+                sparsity_min_frac=args.health_sparsity_min_frac,
+                drift_threshold=args.health_drift_threshold,
+                drift_min_ticks=args.health_drift_min_ticks)
+        except ValueError as e:
+            print(f"serve: bad --health parameters: {e}", file=sys.stderr)
+            return 2
+        print("serve: model-health reducers armed "
+              f"(drift tvd>={args.health_drift_threshold} after "
+              f"{args.health_drift_min_ticks} ticks, pool occupancy>="
+              f"{args.health_occupancy_threshold})", file=sys.stderr)
+    # restart continuity (ISSUE 6 satellite): the run epoch persists
+    # beside the incident stream and the gauge survives into every
+    # snapshot, so a supervised child's counter resets are attributable
+    from rtap_tpu.obs import bump_run_epoch
+
+    bump_run_epoch(args.alerts)
     obs_server = None
     if args.obs_port is not None:
         obs_server = ExpositionServer(port=args.obs_port, trace=trace,
-                                      flight=flight).start()
+                                      flight=flight, health=health).start()
         ohost, oport = obs_server.address
         print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
               file=sys.stderr)
@@ -275,7 +305,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                               aot_warmup=args.aot_warmup,
                               trace=trace, flight=flight,
                               attributor=attributor,
-                              journal=journal)
+                              journal=journal,
+                              health=health)
         except BaseException as e:  # noqa: BLE001 — dump, then re-raise
             # crash black-box: an exception escaping serve dumps a
             # postmortem bundle BEFORE the traceback, so a dead soak
@@ -693,6 +724,34 @@ def main(argv: list[str] | None = None) -> int:
                    help="flight-recorder window: how many recent ticks a "
                         "postmortem bundle covers (bounded ring; memory "
                         "is O(flight_ticks * n_groups))")
+    p.add_argument("--health", action="store_true",
+                   help="model-health observability (docs/TELEMETRY.md "
+                        "health section): fused on-device reducers add "
+                        "segment-pool occupancy, permanence sketch, SDR "
+                        "sparsity, hit rate and score histograms (~200 B/"
+                        "group/tick, pure reads — scores and state are "
+                        "bit-identical) to every chunk; a HealthTracker "
+                        "folds them into per-group scorecards served at "
+                        "GET /health, detects score drift by EWMA, and "
+                        "raises pool_saturated / sparsity_collapsed / "
+                        "score_drift incidents that auto-dump postmortem "
+                        "bundles like a quarantine does")
+    p.add_argument("--health-occupancy-threshold", type=float, default=0.9,
+                   help="segment-pool mean occupancy fraction at/above "
+                        "which a group raises pool_saturated (with "
+                        "--health; ROADMAP-3 right-sizing signal)")
+    p.add_argument("--health-sparsity-min-frac", type=float, default=0.5,
+                   help="fraction of the expected active-column density "
+                        "(k/C) below which a live group raises "
+                        "sparsity_collapsed (with --health)")
+    p.add_argument("--health-drift-threshold", type=float, default=0.25,
+                   help="total-variation distance between the fast and "
+                        "slow EWMA score distributions at/above which a "
+                        "group raises score_drift (with --health)")
+    p.add_argument("--health-drift-min-ticks", type=int, default=120,
+                   help="scored ticks a group must fold before the drift "
+                        "detector may fire (the slow EWMA baseline needs "
+                        "weight before a distance to it means anything)")
     p.add_argument("--alert-attribution", action="store_true",
                    help="per-alert provenance: alert JSONL lines gain a "
                         "top_fields block naming the encoder fields whose "

@@ -31,6 +31,7 @@ from rtap_tpu.obs.metrics import (
     log_buckets,
 )
 from rtap_tpu.obs.flight import FlightRecorder, validate_bundle
+from rtap_tpu.obs.health import HealthTracker, bump_run_epoch
 from rtap_tpu.obs.trace import TraceRecorder
 from rtap_tpu.obs.watchdog import TickWatchdog
 
@@ -39,10 +40,12 @@ __all__ = [
     "ExpositionServer",
     "FlightRecorder",
     "Gauge",
+    "HealthTracker",
     "Histogram",
     "TelemetryRegistry",
     "TickWatchdog",
     "TraceRecorder",
+    "bump_run_epoch",
     "default_snapshot_path",
     "get_registry",
     "log_buckets",

@@ -192,6 +192,19 @@ class _Handler(BaseHTTPRequestHandler):
             body = (json.dumps(tr.chrome_trace(last_ticks=last))
                     + "\n").encode()
             ctype = "application/json"
+        elif path == "/health":
+            # fleet rollup + per-group model-health scorecards (ISSUE 6):
+            # occupancy, sparsity, hit rate, score quantiles, drift
+            # verdict — the HealthTracker's point-in-time snapshot (the
+            # loop thread folds concurrently; diagnostic read, not a
+            # consistent cut — same contract as /trace)
+            ht = getattr(self.server, "health", None)
+            if ht is None:
+                self.send_error(404, "health reducers not enabled "
+                                     "(serve --health)")
+                return
+            body = (json.dumps(ht.snapshot()) + "\n").encode()
+            ctype = "application/json"
         elif path == "/postmortem":
             # on-demand flight-recorder dump; returns the bundle path (or
             # null when throttled). GET because it is an operator poke on
@@ -230,19 +243,23 @@ class ExpositionServer:
     ``start()``/``close()``. Scrape ``/metrics`` for Prometheus text,
     ``/snapshot`` for the JSON snapshot; with a ``trace`` recorder
     attached, ``/trace?last=N`` serves the Perfetto-loadable timeline,
-    and with a ``flight`` recorder, ``/postmortem`` dumps a bundle on
-    demand (rings are written lock-free by the loop, so a concurrent
-    read is point-in-time diagnostic data, not a consistent snapshot).
+    with a ``flight`` recorder, ``/postmortem`` dumps a bundle on
+    demand, and with a ``health`` tracker (obs/health.py),
+    ``/health`` serves the fleet rollup + per-group model scorecards
+    (rings/scorecards are written lock-free by the loop, so a
+    concurrent read is point-in-time diagnostic data, not a consistent
+    snapshot).
     """
 
     def __init__(self, registry: TelemetryRegistry | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 trace=None, flight=None):
+                 trace=None, flight=None, health=None):
         self.registry = registry or get_registry()
         self._server = _Server((host, port), _Handler)
         self._server.registry = self.registry
         self._server.trace = trace
         self._server.flight = flight
+        self._server.health = health
         self.address = self._server.server_address  # (host, bound port)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)

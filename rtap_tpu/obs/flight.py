@@ -65,7 +65,8 @@ class FlightRecorder:
                  registry: TelemetryRegistry | None = None,
                  n_events: int = 512, max_event_bytes: int = 1024,
                  miss_burst: int = 5, min_dump_gap_ticks: int = 120,
-                 max_bundles: int = 16, info: dict | None = None):
+                 max_bundles: int = 16, info: dict | None = None,
+                 health_provider=None):
         if n_ticks < 1:
             raise ValueError(f"n_ticks must be >= 1; got {n_ticks}")
         if miss_burst < 1:
@@ -79,6 +80,11 @@ class FlightRecorder:
         self.max_bundles = int(max_bundles)
         self.max_event_bytes = int(max_event_bytes)
         self.info = dict(info or {})
+        # optional model-health snapshot source (obs/health.py ISSUE 6):
+        # a callable returning a JSON-able dict, embedded in every
+        # bundle's summary.json so triage gets model state, not just
+        # timing. live_loop wires the HealthTracker's snapshot in.
+        self.health_provider = health_provider
         # tick rings (preallocated; the scored ring is sized on first use
         # because the group count is the loop's to know)
         self._tick = np.full(self.n_ticks, -1, np.int64)
@@ -259,6 +265,11 @@ class FlightRecorder:
             out["registry"] = summarize_snapshot(self.registry.snapshot())
         except Exception:  # noqa: BLE001 — a summary must not kill a dump
             out["registry"] = None
+        if self.health_provider is not None:
+            try:
+                out["health"] = self.health_provider()
+            except Exception:  # noqa: BLE001 — must not kill a dump
+                out["health"] = None
         return out
 
     def dump(self, reason: str, tick: int | None = None) -> str | None:

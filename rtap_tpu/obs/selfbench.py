@@ -15,8 +15,8 @@ import time
 
 from rtap_tpu.obs.metrics import TelemetryRegistry
 
-__all__ = ["measure", "measure_trace", "measure_journal", "OPS_PER_TICK",
-           "TRACE_SPANS_PER_TICK"]
+__all__ = ["measure", "measure_trace", "measure_journal", "measure_health",
+           "OPS_PER_TICK", "TRACE_SPANS_PER_TICK", "HEALTH_FOLDS_PER_TICK"]
 
 #: instrument operations a serve tick costs at the production shape (six
 #: phase observes + tick latency observe + ticks/scored/alert counters +
@@ -27,6 +27,10 @@ OPS_PER_TICK = 32
 #: shape: the tick span + six phase spans + one dispatch and one collect
 #: child span per group at 16 groups (7 + 2*16 = 39), rounded up
 TRACE_SPANS_PER_TICK = 40
+
+#: HealthTracker.fold calls a serve tick costs at the production
+#: multi-group shape: one per collected chunk per group, 16 groups
+HEALTH_FOLDS_PER_TICK = 16
 
 
 def _time_op(fn, n: int) -> float:
@@ -109,6 +113,65 @@ def measure_trace(n: int = 50_000, cadence_s: float = 1.0,
         "flight_record_tick_ns": round(rt_s * 1e9, 1),
         "spans_per_tick": TRACE_SPANS_PER_TICK,
         "n_groups": n_groups,
+        "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
+        "per_tick_overhead_frac": per_tick_s / cadence_s,
+        "cadence_s": cadence_s,
+    }
+
+
+def measure_health(n: int = 2000, cadence_s: float = 1.0,
+                   n_groups: int = HEALTH_FOLDS_PER_TICK) -> dict:
+    """Model-health host-path cost, same protocol as :func:`measure`:
+    per-fold nanoseconds of ``HealthTracker.fold`` on a private tracker
+    fed realistic per-tick leaves, projected to a tick at the
+    production multi-group shape (one fold per group per tick at 16
+    groups). The DEVICE-side reducer cost is a property of the compiled
+    step and is measured on silicon by the ``r9_health`` hw-session
+    step; the host fold is what the loop thread pays every tick, and
+    ISSUE 6 gates it <= 1% of the tick budget alongside the metric/
+    trace/journal bars (``bench.py --obs-bench``)."""
+    import numpy as np
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.obs.health import HealthTracker
+    from rtap_tpu.obs.metrics import TelemetryRegistry
+    from rtap_tpu.ops.health_tpu import (
+        OCC_BINS, PERM_BINS, SCORE_BINS, health_nbytes,
+    )
+
+    ht = HealthTracker(cluster_preset(), registry=TelemetryRegistry())
+    rng = np.random.default_rng(0)
+    leaves = {
+        "occ_hist": rng.integers(0, 64, (1, OCC_BINS), dtype=np.int32),
+        "seg_occ_frac": np.float32([0.4]),
+        "syn_frac": np.float32([0.3]),
+        "perm_hist": rng.random((1, PERM_BINS), np.float32),
+        "perm_conn_frac": np.float32([0.5]),
+        "act_col_frac": np.float32([0.02]),
+        "pred_cell_frac": np.float32([0.01]),
+        "hit_num": np.float32([900.0]),
+        "hit_den": np.float32([1024.0]),
+        "score_hist": rng.integers(0, 64, (1, SCORE_BINS), dtype=np.int32),
+        "scored": np.int32([1024]),
+    }
+    gi = [0]
+
+    def _fold():
+        gi[0] = (gi[0] + 1) % n_groups
+        ht.fold(gi[0], leaves, tick=gi[0])
+
+    _fold()  # warm the group slot + instrument shards out of the timing
+    fold_s = _time_op(_fold, n)
+    snap_s = _time_op(ht.snapshot, max(1, n // 20))
+    # one fold per group per tick: the projection must follow the shape
+    # actually measured, not the 16-group default
+    per_tick_s = n_groups * fold_s
+    return {
+        "health_fold_us": round(fold_s * 1e6, 2),
+        "health_snapshot_us": round(snap_s * 1e6, 2),
+        "folds_per_tick": n_groups,
+        "n_groups": n_groups,
+        "leaf_bytes_per_group_tick": health_nbytes(),
         "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
         "per_tick_overhead_frac": per_tick_s / cadence_s,
         "cadence_s": cadence_s,

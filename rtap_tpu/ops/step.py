@@ -72,7 +72,7 @@ def fused_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
 
 
 def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
-          inv: dict | None = None):
+          inv: dict | None = None, health: bool = False):
     """One group tick on KERNEL-layout state, honoring cfg.learn_every.
 
     With a learning cadence (cfg.learn_every > 1 and learn=True) the
@@ -85,6 +85,13 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
 
     `inv` (tm_invariants) is closed over, NOT vmapped: one shared
     HBM-resident copy serves all G streams.
+
+    `health=True` (static) additionally reduces the POST-STEP state to
+    one small per-group health leaf (ops/health_tpu.py) and returns
+    (state, (out, health_leaf)). Pure reads on the tensors the step just
+    produced — the model state and scores are bit-identical either way
+    (tests/integration/test_health_serve.py pins it), and the leaf adds
+    ~200 bytes to the chunk output instead of a device->host state fetch.
     """
 
     def step_all(lrn):
@@ -93,26 +100,39 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
         )(ss, values, ts_unix)
 
     if not (learn and cfg.cadence_active):
-        return step_all(learn)(s)
-    tick = s["tm_iter"].reshape(-1)[0]  # completed steps so far (lockstep)
-    return jax.lax.cond(cfg.learns_on(tick), step_all(True), step_all(False), s)
+        s, out = step_all(learn)(s)
+    else:
+        tick = s["tm_iter"].reshape(-1)[0]  # completed steps so far (lockstep)
+        s, out = jax.lax.cond(
+            cfg.learns_on(tick), step_all(True), step_all(False), s)
+    if not health:
+        return s, out
+    from rtap_tpu.ops.health_tpu import health_reduce
+
+    raw = out[0] if cfg.classifier.enabled else out
+    return s, (out, health_reduce(s, raw, values, cfg))
 
 
-@partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
-def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
+@partial(jax.jit, static_argnames=("cfg", "learn", "health"), donate_argnums=(0,))
+def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True,
+               health: bool = False):
     """Stream-group fused step: every state leaf carries a leading G axis;
     `values` is [G, n_fields] f32, `ts_unix` [G] i32 -> (state, raw [G] f32).
 
     State buffers are donated: at 100k streams the TM pools dominate HBM and
     the update must happen in place (SURVEY.md §7 hard part 4).
+    With `health=True` the out leaf becomes (out, health_leaf) — see
+    :func:`_tick` / ops/health_tpu.py.
     """
     from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
 
-    state, out = _tick(to_kernel_layout(state), values, ts_unix, cfg, learn)
+    state, out = _tick(to_kernel_layout(state), values, ts_unix, cfg, learn,
+                       health=health)
     return from_kernel_layout(state, cfg.tm), out
 
 
-def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool):
+def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
+                health: bool = False):
     """Shared hot-loop body: scan the vmapped fused step over the time axis.
     Used identically by the single-device and shard_map entry points, so the
     two can never diverge semantically.
@@ -131,14 +151,15 @@ def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mod
 
     def body(s, inp):
         v, t = inp
-        return _tick(s, v, t, cfg, learn, inv)
+        return _tick(s, v, t, cfg, learn, inv, health=health)
 
     state, out = jax.lax.scan(body, to_kernel_layout(state), (values, ts_unix))
     return from_kernel_layout(state, cfg.tm), out
 
 
-@partial(jax.jit, static_argnames=("cfg", "learn"), donate_argnums=(0,))
-def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
+@partial(jax.jit, static_argnames=("cfg", "learn", "health"), donate_argnums=(0,))
+def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True,
+               health: bool = False):
     """Multi-tick stream-group step: scan :func:`group_step`'s body over a
     leading time axis so T ticks cost ONE device dispatch.
 
@@ -146,9 +167,12 @@ def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     (state, raw [T, G] f32). This is the replay/bench fast path (SURVEY.md §7
     hard part 3: amortize per-tick dispatch latency by batching ticks when
     replaying faster than real time); the live 1s-cadence service uses
-    :func:`group_step` per tick instead.
+    :func:`group_step` per tick instead. With `health=True` (static) the
+    out leaf becomes (out, health_leaf) and every health-leaf array gains
+    the leading T axis — one ~200 B record per tick, scanned alongside the
+    scores (ops/health_tpu.py).
     """
-    return _scan_chunk(state, values, ts_unix, cfg, learn)
+    return _scan_chunk(state, values, ts_unix, cfg, learn, health=health)
 
 
 @_functools.lru_cache(maxsize=None)

@@ -111,6 +111,16 @@ class Supervisor:
         self._obs_restarts = get_registry().counter(
             "rtap_obs_supervisor_restarts_total",
             "serve child processes restarted after an abnormal death")
+        # ISSUE 6 satellite: the dashboard-facing restart counter. Lives
+        # in the PARENT (which survives every child death), cumulative
+        # over the supervision run — joined with the child-side
+        # rtap_obs_run_epoch gauge it lets dashboards tell a restart's
+        # counter reset from a rollover.
+        self._obs_restarts_cum = get_registry().counter(
+            "rtap_obs_restarts_total",
+            "cumulative serve child restarts over this supervision run "
+            "(parent-process registry; pairs with the child's "
+            "rtap_obs_run_epoch gauge)")
 
     # ---- event plumbing ---------------------------------------------
     def _event(self, event: dict) -> None:
@@ -239,6 +249,7 @@ class Supervisor:
                             self.backoff_base_s
                             * (2 ** (consecutive_fast - 1)))
                 self._obs_restarts.inc()
+                self._obs_restarts_cum.inc()
                 self._event({"event": "serve_child_restarting",
                              "delay_s": round(delay, 3),
                              "restart": self.deaths})

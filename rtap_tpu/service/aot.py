@@ -95,6 +95,15 @@ def prewarm(groups, micro_chunk: int, learn: bool, degradation=None,
     by_cfg: dict = {}
     for m, cfg, lf in programs:
         by_cfg.setdefault(cfg, []).append((m, lf))
+    # health reducers are a static flag of the compiled program (ISSUE 6):
+    # warm the variant the groups will actually dispatch, or the warm-up
+    # compiles a program the loop never uses and pays the real compile
+    # inside a scored tick
+    health_by_cfg = {
+        cfg: any(getattr(g, "health", False)
+                 for g in device_groups if g.cfg == cfg)
+        for cfg in by_cfg
+    }
     for cfg, mls in by_cfg.items():
         G = next(g.G for g in device_groups if g.cfg == cfg)
         # one scratch state per config, threaded through every program
@@ -104,7 +113,8 @@ def prewarm(groups, micro_chunk: int, learn: bool, degradation=None,
         for m, lf in sorted(mls):
             vals = jnp.full((m, G, cfg.n_fields), jnp.nan, jnp.float32)
             ts = jnp.zeros((m, G), jnp.int32)
-            scratch, _ = chunk_step(scratch, vals, ts, cfg, learn=lf)
+            scratch, _ = chunk_step(scratch, vals, ts, cfg, learn=lf,
+                                    health=health_by_cfg[cfg])
             counter.inc()
             warmed.add((m, cfg, lf))
         if include_claim:

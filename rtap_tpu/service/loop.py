@@ -307,6 +307,7 @@ def live_loop(
     flight=None,
     attributor=None,
     journal=None,
+    health=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -468,6 +469,19 @@ def live_loop(
     (docs/RESILIENCE.md durability section; scripts/crash_soak.py is
     the kill-9 acceptance soak).
 
+    `health` (an obs.HealthTracker, serve --health; ISSUE 6): when the
+    groups were built with ``health=True``, every collected chunk
+    carries the fused on-device model-health leaf
+    (ops/health_tpu.py — segment-pool occupancy, permanence sketch,
+    SDR sparsity, predicted->active hit rate, score histogram; pure
+    reads, bit-exact-neutral) and the tracker folds it into per-group
+    scorecards with EWMA score-drift detection. Health incidents
+    (``pool_saturated`` / ``sparsity_collapsed`` / ``score_drift``)
+    ride the alert/incident stream like watchdog events and request a
+    flight-recorder postmortem dump like a quarantine does. The
+    scorecards serve at ``GET /health`` and land in
+    ``stats["health"]``. None = leaves (if any) are simply not folded.
+
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
     state is saved atomically every k ticks (the in-flight pipeline is
@@ -527,6 +541,10 @@ def live_loop(
             if not os.path.isdir(ck_path):
                 continue
             resumed = load_group(ck_path, mesh=grp.mesh)
+            # the health flag is serve-run config, not checkpoint state:
+            # the resumed instance dispatches the same program variant
+            # the built group would have (ISSUE 6)
+            resumed.health = getattr(grp, "health", False)
             # claimed extras resume when this run could have claimed them
             # (auto_register) OR when it serves frozen: an elastically-
             # learned fleet must be servable read-only from its own
@@ -684,6 +702,17 @@ def live_loop(
     _sync_chaos_routing()
     if degradation is not None and degradation.sink is None:
         degradation.sink = writer.emit_event
+    if health is not None:
+        # same wiring contract as the watchdog/degradation: incidents
+        # ride the alert stream, and a health incident is a black-box
+        # moment — the flight recorder dumps a postmortem for it, and
+        # every bundle's summary embeds the latest scorecards
+        if health.sink is None:
+            health.sink = writer.emit_event
+        if health.flight is None:
+            health.flight = flight
+        if flight is not None and flight.health_provider is None:
+            flight.health_provider = health.snapshot
     eff_cadence = cadence_s  # widened by the degradation ladder's level 3
     quarantined: dict[int, dict] = {}  # gi -> {tick, phase, error, restore_at}
     quarantine_log: list[dict] = []  # full quarantine/restore history, in
@@ -877,6 +906,11 @@ def live_loop(
                 counter.add(n)
                 scored += n
             group_scored[gi] += len(ts_rows) * n
+            if health is not None and groups[gi].last_health is not None:
+                # fold the chunk's fused health leaves into the group's
+                # scorecard (one call per collected chunk per group; the
+                # tracker's own cost is gated by bench.py --obs-bench)
+                health.fold(gi, groups[gi].last_health, tick=cur_tick)
         obs_scored.inc(scored)
         if journal is not None and pairs:
             # alert-delivery cursor: alerts through this tick have been
@@ -1034,6 +1068,16 @@ def live_loop(
                         _quarantine_group(gi, jt, "journal_replay", e)
                         continue
                     gpos[gi] += 1
+                    if health is not None and grp.last_health is not None:
+                        # catch-up ticks warm the scorecards/EWMAs too:
+                        # the resumed fleet reaches the live edge with
+                        # its drift baseline intact, not cold. Tick 0,
+                        # like every other replay-time event (_res_event
+                        # journal_replayed): the live loop folds with
+                        # LOCAL ticks, and a global-tick fold here would
+                        # park the flight recorder's per-reason dump
+                        # throttle thousands of ticks in the future
+                        health.fold(gi, grp.last_health, tick=0)
                     n = len(slots)
                     writer.emit_batch(
                         g_ids, np.full(n, int(jts)), jvals[off:off + n],
@@ -1277,6 +1321,7 @@ def live_loop(
                                     f"no checkpoint at {ck_path} (the group "
                                     "was never saved before its fault)")
                             restored = load_group(ck_path, mesh=old.mesh)
+                            restored.health = getattr(old, "health", False)
                             validate_resume(
                                 restored, ck_path, old,
                                 allow_claimed_extras=auto_register
@@ -1726,6 +1771,9 @@ def live_loop(
                             "suppressed_alerts": writer.suppressed}
     if flight is not None:
         extra["postmortem"] = flight.stats()
+    if health is not None:
+        # the model-health artifact: scorecard rollup + incident counts
+        extra["health"] = health.stats()
     if aot_warmup:
         extra["aot_programs_compiled"] = aot_programs
         # cold programs the loop still had to single-flight AFTER the AOT

@@ -47,6 +47,16 @@ class StreamGroup:
     debounce counter, so a claimed slot is indistinguishable from a fresh
     model; the group's compiled program never changes (shapes are static —
     membership is data, not topology).
+
+    ``health=True`` (ISSUE 6) makes every dispatched step additionally
+    return the fused per-group model-health leaf (ops/health_tpu.py:
+    occupancy/permanence/sparsity/hit-rate/score-histogram aggregates,
+    ~200 B/tick); :meth:`collect_chunk` and :meth:`tick` stash it in
+    ``self.last_health`` (numpy tree, leading tick axis) for the host
+    HealthTracker to fold. Scores and model state are bit-identical with
+    health on or off — the leaf is pure reads. Unsupported under a mesh
+    (the aggregate would need a cross-shard collective, and
+    sharded_chunk_step is collective-free by contract).
     """
 
     def __init__(
@@ -58,9 +68,15 @@ class StreamGroup:
         threshold: float = 0.5,
         mesh=None,
         debounce: int = 1,
+        health: bool = False,
     ):
         if debounce < 1:
             raise ValueError(f"debounce must be >= 1, got {debounce}")
+        if health and mesh is not None:
+            raise ValueError(
+                "health reducers are unsupported on meshed groups: the "
+                "per-group aggregate would need a cross-shard collective "
+                "(sharded_chunk_step is collective-free by contract)")
         self.cfg = cfg
         self.stream_ids = list(stream_ids)
         self.G = len(self.stream_ids)
@@ -75,6 +91,10 @@ class StreamGroup:
         self.debounce = int(debounce)
         self._alert_run = np.zeros(self.G, np.int64)  # consecutive hit count
         self.mesh = mesh
+        self.health = bool(health)
+        # latest per-tick health leaves [T, ...] (health=True only);
+        # kept in sync by collect_chunk and tick like last_predictions
+        self.last_health: dict | None = None
         self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
         self.ticks = 0
         # alert-id timeline epoch: 0 for a group's original timeline;
@@ -262,11 +282,22 @@ class StreamGroup:
 
                 self.state, out = group_step(
                     self.state, self._put(values), self._put(ts.astype(np.int32)), self.cfg,
-                    learn=learn,
+                    learn=learn, health=self.health,
                 )
+                if self.health:
+                    out, health = out
+                    self.last_health = {
+                        k: np.asarray(v)[None, ...] for k, v in health.items()}
                 raw, pred = self._unpack_out(out, time_axis=False)
         else:
             raw, pred = self._raw_cpu(values, ts, learn)
+            if self.health:
+                from rtap_tpu.ops.health_tpu import health_from_states
+
+                self.last_health = {
+                    k: np.asarray(v)[None, ...] for k, v in
+                    health_from_states(self._states, raw, values,
+                                       self.cfg).items()}
         self.last_predictions = None if pred is None else pred[None, :]
         self.ticks += 1
         lik, loglik = self.likelihood.update(raw)
@@ -321,17 +352,35 @@ class StreamGroup:
 
                 self.state, out = chunk_step(
                     self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1),
-                    self.cfg, learn=learn,
+                    self.cfg, learn=learn, health=self.health,
                 )
+            health = None
+            if self.health and self.mesh is None:
+                out, health = out
             # seq advances only on successful dispatch: a raise above must
             # leave the pipeline collectable, not permanently desynced
             self._seq += 1
-            return {"out": out, "T": T, "seq": self._seq, "device": True}
-        outs = [self._raw_cpu(values[i], np.asarray(ts[i]), learn) for i in range(T)]
+            return {"out": out, "health": health, "T": T, "seq": self._seq,
+                    "device": True}
+        outs = []
+        hticks = []
+        for i in range(T):
+            o = self._raw_cpu(values[i], np.asarray(ts[i]), learn)
+            outs.append(o)
+            if self.health:
+                # host twin of the fused reducer, on the post-tick oracle
+                # states (same schema as the device leaf, [T, ...] stacked)
+                from rtap_tpu.ops.health_tpu import health_from_states
+
+                hticks.append(health_from_states(
+                    self._states, o[0], values[i], self.cfg))
         raw = np.stack([o[0] for o in outs])
         pred = np.stack([o[1] for o in outs]) if self.cfg.classifier.enabled else None
+        health = {k: np.stack([h[k] for h in hticks]) for k in hticks[0]} \
+            if hticks else None
         self._seq += 1
-        return {"raw": raw, "pred": pred, "T": T, "seq": self._seq, "device": False}
+        return {"raw": raw, "pred": pred, "health": health, "T": T,
+                "seq": self._seq, "device": False}
 
     def collect_chunk(self, handle: dict) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Block on a dispatched chunk -> (raw [T,G], log_likelihood [T,G],
@@ -347,6 +396,11 @@ class StreamGroup:
             raw, pred = self._unpack_out(handle["out"], time_axis=False)
         else:
             raw, pred = handle["raw"], handle["pred"]
+        if handle.get("health") is not None:
+            # fetch rides the same blocking boundary as the scores — no
+            # extra device round trip (the leaf is ~200 B/tick)
+            self.last_health = {
+                k: np.asarray(v) for k, v in handle["health"].items()}
         self._collected = handle["seq"]
         T = handle["T"]
         self.last_predictions = pred
@@ -395,8 +449,10 @@ class StreamGroupRegistry:
         mesh=None,
         debounce: int = 1,
         stagger_learn: bool = False,
+        health: bool = False,
     ):
         self.cfg = cfg
+        self.health = bool(health)
         # Stagger the learning-cadence phase across groups (group i learns
         # on ticks where (it - i % learn_every) % learn_every == 0): with
         # every group at phase 0 the whole fleet learns on the SAME ticks,
@@ -474,7 +530,7 @@ class StreamGroupRegistry:
             self._group_cfg(len(self.groups)), padded,
             seed=self.seed + len(self.groups),
             backend=self.backend, threshold=self.threshold, mesh=self.mesh,
-            debounce=self.debounce,
+            debounce=self.debounce, health=self.health,
         )
         for i, sid in enumerate(ids):
             self._slots[sid] = _Slot(grp, i)
@@ -522,6 +578,7 @@ class StreamGroupRegistry:
             [f"{PAD_PREFIX}{i}" for i in range(self.group_size)],
             seed=self.seed + len(self.groups), backend=self.backend,
             threshold=self.threshold, mesh=self.mesh, debounce=self.debounce,
+            health=self.health,
         )
         self.groups.append(grp)
 

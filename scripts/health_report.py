@@ -1,0 +1,147 @@
+"""Pretty-print a fleet model-health scorecard (ISSUE 6).
+
+Renders the one health schema everywhere it lands:
+
+- live, from a serving process: ``--url http://127.0.0.1:PORT/health``
+  (the obs server route — ``serve --health --obs-port``),
+- from a JSON file holding a /health snapshot (e.g. ``curl`` output or
+  a harness artifact),
+- from a postmortem bundle dir (reads ``summary.json``'s embedded
+  ``health`` block — triage gets model state, not just timing).
+
+``--json`` emits the machine view (the snapshot itself). Exit code: 0
+when a health block was found and rendered, 2 otherwise, so harnesses
+can gate on it.
+
+Usage: python scripts/health_report.py TARGET [--json] [--groups N]
+       python scripts/health_report.py --url http://HOST:PORT/health
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+INVALID_EXIT = 2
+
+#: occupancy-histogram bar glyphs (eighth blocks, ascending)
+_BARS = " ▁▂▃▄▅▆▇█"
+
+
+def err(msg: str) -> None:
+    print(f"[health] {msg}", file=sys.stderr, flush=True)
+
+
+def _sparkline(hist) -> str:
+    hist = [float(x) for x in (hist or [])]
+    top = max(hist) if hist else 0.0
+    if top <= 0:
+        return "·" * len(hist)
+    return "".join(_BARS[min(8, int(round(v / top * 8)))] for v in hist)
+
+
+def load_snapshot(target: str | None, url: str | None) -> dict | None:
+    """Resolve TARGET/--url to a health snapshot dict, or None."""
+    if url:
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.load(r)
+        except Exception as e:  # noqa: BLE001 — CLI surface, say why
+            err(f"GET {url} failed: {e}")
+            return None
+    if target is None:
+        return None
+    path = target
+    if os.path.isdir(path):
+        path = os.path.join(path, "summary.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        err(f"cannot read {path}: {e}")
+        return None
+    if isinstance(doc, dict) and "fleet" in doc and "groups" in doc:
+        return doc  # a /health snapshot
+    if isinstance(doc, dict) and isinstance(doc.get("health"), dict):
+        return doc["health"]  # a postmortem summary.json
+    err(f"{path} holds no health snapshot (need fleet+groups, or a "
+        "postmortem summary.json with a health block — was the serve "
+        "run started with --health?)")
+    return None
+
+
+def render(snap: dict, max_groups: int) -> str:
+    fleet = snap.get("fleet", {})
+    groups = snap.get("groups", [])
+    lines = []
+    lines.append(
+        f"fleet health: {fleet.get('verdict', '?')} "
+        f"({fleet.get('groups', 0)} groups, "
+        f"{fleet.get('ticks_folded', 0)} ticks folded)")
+    hr = fleet.get("hit_rate")
+    lines.append(
+        f"  pool occupancy max : {fleet.get('pool_occupancy_max')}"
+        f"    hit rate : {'n/a' if hr is None else round(hr, 4)}"
+        f"    active-col frac : {fleet.get('active_col_frac_mean')}")
+    lines.append(
+        f"  score drift max    : {fleet.get('score_drift_max')}"
+        f"    incidents : {fleet.get('events_by_kind') or 'none'}")
+    att = fleet.get("groups_attention") or []
+    if att:
+        lines.append(f"  needs attention    : groups {att}")
+    show = groups[:max_groups]
+    for g in show:
+        occ, syn, sp, sc = (g.get("occupancy", {}), g.get("synapses", {}),
+                            g.get("sparsity", {}), g.get("score", {}))
+        q = sc.get("quantiles") or {}
+        lines.append(
+            f"  group {g.get('group'):>3} [{g.get('verdict', '?')}] "
+            f"occ {occ.get('frac')} |{_sparkline(occ.get('hist'))}| "
+            f"conn {syn.get('connected_frac')} "
+            f"act {sp.get('active_col_frac')}"
+            f"/{sp.get('expected_active_frac')} "
+            f"hit {g.get('hit_rate')} "
+            f"p50/p90/p99 {q.get('p50')}/{q.get('p90')}/{q.get('p99')} "
+            f"drift {sc.get('drift_tvd')}"
+            f"{' DRIFTING' if sc.get('drifting') else ''}")
+    if len(groups) > len(show):
+        lines.append(f"  ... {len(groups) - len(show)} more groups "
+                     "(--groups N to widen)")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", nargs="?", default=None,
+                    help="health snapshot JSON file, or a postmortem "
+                         "bundle dir (reads its summary.json)")
+    ap.add_argument("--url", default=None,
+                    help="fetch the snapshot live from a serving "
+                         "process's GET /health route")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine view (the snapshot JSON)")
+    ap.add_argument("--groups", type=int, default=16,
+                    help="per-group rows to render (default 16)")
+    args = ap.parse_args()
+    if (args.target is None) == (args.url is None):
+        err("pass exactly one of TARGET or --url")
+        return INVALID_EXIT
+    snap = load_snapshot(args.target, args.url)
+    if snap is None:
+        return INVALID_EXIT
+    if args.json:
+        print(json.dumps(snap, indent=2))
+    else:
+        print(render(snap, args.groups), file=sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

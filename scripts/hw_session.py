@@ -665,6 +665,30 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                        "--threshold", "0.5", "--journal-fsync", "every-64",
                        "--out", "reports/crash_soak_r08.json"],
      3600.0),
+    # ---------------- round 9 (ISSUE 6: model-health observability) ----
+    # The health-reducer silicon numbers the docs cite: the same
+    # 4096x1024 production soak shape as r7, with the fused on-device
+    # health reducers armed. Evidence harvested from the run's obs
+    # snapshot + stats line: (1) OVERHEAD — tick latency percentiles and
+    # missed-deadline count vs the r7 baseline quantify what the ~200 B/
+    # group/tick reducer pass costs inside the compiled step (the CPU
+    # path is proven bit-exact and <= 1%-host-fold in tier-1; the
+    # device-side region cost only silicon can price); (2) OCCUPANCY —
+    # the fleet's real segment-pool occupancy histogram at steady state,
+    # the first measured input to ROADMAP-3 pool right-sizing. The
+    # flight recorder flies armed so any pool_saturated/score_drift
+    # incident during the window leaves a bundle with the scorecard
+    # embedded.
+    ("r9_health", [sys.executable, "scripts/live_soak.py",
+                   "--streams", "4096", "--group-size", "1024",
+                   "--columns", "32", "--learn-every", "2",
+                   "--stagger-learn", "--ticks", "300",
+                   "--pipeline-depth", "2", "--dispatch-threads", "4",
+                   "--health",
+                   "--postmortem-dir", "hw_results/postmortems_r09",
+                   "--startup-timeout", "900",
+                   "--out", "reports/live_soak_health_r09.json"],
+     2400.0),
 ]
 
 

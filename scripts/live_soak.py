@@ -214,6 +214,12 @@ def main() -> int:
                          "new appears -> auto-registered). Enables serve "
                          "--auto-register and --auto-release-after "
                          "(2x churn interval) automatically")
+    ap.add_argument("--health", action="store_true",
+                    help="arm the serve child's model-health reducers "
+                         "(serve --health): fused on-device occupancy/"
+                         "sparsity/score aggregates + scorecards; the "
+                         "fleet gauges land in the obs snapshot this "
+                         "soak reads back")
     ap.add_argument("--jax-trace", default=None,
                     help="passed through to serve: wrap the soak window in "
                          "jax.profiler.trace writing the XLA device trace "
@@ -282,6 +288,8 @@ def main() -> int:
         cmd += ["--chunk-stagger"]
     if args.freeze:
         cmd += ["--freeze"]
+    if args.health:
+        cmd += ["--health"]
     if args.jax_trace:
         cmd += ["--jax-trace", args.jax_trace]
     if args.trace_out:

@@ -80,6 +80,29 @@ def render(bundle: str, summary: dict, slowest: int, n_events: int) -> str:
         lines.append(f"  trace    : {tr['records']} records "
                      f"({tr['dropped']} dropped) — load trace.json in "
                      "ui.perfetto.dev")
+    health = summary.get("health")
+    if isinstance(health, dict) and health.get("fleet"):
+        # the embedded model-health scorecard (ISSUE 6): triage gets the
+        # model's state at the incident, not just the timing story
+        fl = health["fleet"]
+        lines.append(
+            f"  health   : {fl.get('verdict', '?')} — pool occ max "
+            f"{fl.get('pool_occupancy_max')}, hit rate "
+            f"{fl.get('hit_rate')}, drift max "
+            f"{fl.get('score_drift_max')}"
+            + (f", attention: groups {fl['groups_attention']}"
+               if fl.get("groups_attention") else ""))
+        for g in health.get("groups", []):
+            if g.get("verdict", "ok") == "ok":
+                continue
+            sc = g.get("score", {})
+            lines.append(
+                f"    group {g.get('group')}: {g['verdict']} "
+                f"(occ {g.get('occupancy', {}).get('frac')}, act "
+                f"{g.get('sparsity', {}).get('active_col_frac')}, "
+                f"drift {sc.get('drift_tvd')})")
+        lines.append("    full scorecards: scripts/health_report.py "
+                     f"{os.path.basename(bundle)}")
     spans = _spans(bundle)
     if spans:
         top = sorted(spans, key=lambda e: -e.get("dur", 0))[:slowest]

@@ -1,0 +1,216 @@
+"""Model-health observability through the real serve stack (ISSUE 6).
+
+Acceptance tests: (1) serving with health reducers enabled is BIT-EXACT
+against serving without them — final model state and the alert stream
+are byte-identical (the reducers are pure reads); (2) GET /health on
+the obs server serves the fleet rollup + per-group scorecard schema;
+(3) a seeded drift scenario raises ``score_drift`` onto the incident
+stream and auto-dumps a postmortem bundle whose summary embeds the
+scorecard; (4) the operator CLI surface (`serve --health`) end to end.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import scaled_cluster_preset
+from rtap_tpu.obs import (
+    ExpositionServer,
+    FlightRecorder,
+    HealthTracker,
+    validate_bundle,
+)
+from rtap_tpu.service.loop import live_loop
+from rtap_tpu.service.registry import StreamGroupRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CFG = scaled_cluster_preset(32)
+N_STREAMS = 6
+GROUP_SIZE = 4
+N_TICKS = 8
+
+
+def _registry(health: bool):
+    reg = StreamGroupRegistry(CFG, group_size=GROUP_SIZE, backend="tpu",
+                              threshold=0.0, debounce=1, health=health)
+    for i in range(N_STREAMS):
+        reg.add_stream(f"s{i}")
+    reg.finalize()
+    return reg
+
+
+def _feed(k):
+    rng = np.random.Generator(np.random.Philox(key=(61, k)))
+    return (30 + 5 * rng.random(N_STREAMS)).astype(np.float32), \
+        1_700_000_000 + k
+
+
+def _alert_lines(path):
+    with open(path) as f:
+        return [ln for ln in f.read().splitlines()
+                if ln and not ln.startswith('{"event"')]
+
+
+@pytest.mark.quick
+def test_health_on_vs_off_bit_exact_state_and_alert_stream(tmp_path):
+    """The ISSUE 6 neutrality bar: the reducers are pure reads — model
+    state and the alert stream are provably unchanged with health on."""
+    finals = {}
+    for mode in (False, True):
+        reg = _registry(health=mode)
+        alerts = tmp_path / f"alerts_{mode}.jsonl"
+        ht = HealthTracker(CFG) if mode else None
+        stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.005,
+                          alert_path=str(alerts), micro_chunk=2,
+                          health=ht)
+        assert stats["ticks"] == N_TICKS
+        finals[mode] = [
+            {k: np.asarray(v) for k, v in g.state.items()}
+            for g in reg.groups
+        ]
+        if mode:
+            assert stats["health"]["groups"] == len(reg.groups)
+            assert stats["health"]["ticks_folded"] == \
+                N_TICKS * len(reg.groups)
+    for g_off, g_on in zip(finals[False], finals[True]):
+        assert sorted(g_off) == sorted(g_on)
+        for k in g_off:
+            np.testing.assert_array_equal(g_off[k], g_on[k], err_msg=k)
+    # threshold 0 + debounce 1: every (stream, tick) alerted — the
+    # streams must agree byte for byte (scores AND likelihoods)
+    lines_off = _alert_lines(tmp_path / "alerts_False.jsonl")
+    lines_on = _alert_lines(tmp_path / "alerts_True.jsonl")
+    assert lines_off and lines_off == lines_on
+
+
+@pytest.mark.quick
+def test_health_route_serves_fleet_rollup_and_scorecards():
+    reg = _registry(health=True)
+    ht = HealthTracker(CFG)
+    live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.005, health=ht)
+    with ExpositionServer(health=ht) as srv:
+        host, port = srv.address
+        body = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/health", timeout=10).read())
+        # a tracker-less server must say so, not 500
+        resp = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10)
+        assert resp.status == 200
+    fleet = body["fleet"]
+    assert fleet["groups"] == len(reg.groups)
+    assert fleet["ticks_folded"] == N_TICKS * len(reg.groups)
+    assert fleet["verdict"] in ("ok", "attention")
+    assert 0.0 <= fleet["pool_occupancy_max"] <= 1.0
+    assert fleet["hit_rate"] is None or 0.0 <= fleet["hit_rate"] <= 1.0
+    assert len(body["groups"]) == len(reg.groups)
+    for g in body["groups"]:
+        assert len(g["occupancy"]["hist"]) == g["occupancy"]["bins"]
+        assert sum(g["occupancy"]["hist"]) == GROUP_SIZE if \
+            g["group"] == 0 else True
+        assert len(g["synapses"]["perm_hist"]) == g["synapses"]["bins"]
+        assert 0.0 <= g["sparsity"]["active_col_frac"] <= 1.0
+        assert g["sparsity"]["expected_active_frac"] == pytest.approx(
+            CFG.sp.num_active_columns / CFG.sp.columns)
+        q = g["score"]["quantiles"]
+        assert set(q) == {"p50", "p90", "p99"}
+        assert isinstance(g["score"]["drifting"], bool)
+        assert g["verdict"]
+
+
+@pytest.mark.quick
+def test_health_route_404_without_tracker():
+    with ExpositionServer() as srv:
+        host, port = srv.address
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"http://{host}:{port}/health",
+                                   timeout=10)
+        assert e.value.code == 404
+
+
+@pytest.mark.quick
+def test_seeded_drift_dumps_postmortem_with_scorecard(tmp_path):
+    """The incident path end to end: a mid-run score-distribution shift
+    trips the EWMA detector; the event lands on the incident stream and
+    the flight recorder dumps a valid bundle embedding the scorecard,
+    which both renderers accept."""
+    reg = _registry(health=True)
+    pm = tmp_path / "pm"
+
+    def feed(k):
+        if k < 26:
+            vals = np.full(N_STREAMS, 30.0, np.float32)  # learnable calm
+        else:
+            # violent alternation: raw scores jump to the top bins
+            vals = np.full(N_STREAMS, 10.0 if k % 2 else 90.0, np.float32)
+        return vals, 1_700_000_000 + k
+
+    fl = FlightRecorder(n_ticks=64, out_dir=str(pm))
+    ht = HealthTracker(CFG, drift_min_ticks=8, drift_threshold=0.2,
+                       alpha_fast=0.5, alpha_slow=0.01, warmup_ticks=4)
+    alerts = tmp_path / "alerts.jsonl"
+    stats = live_loop(feed, reg, n_ticks=40, cadence_s=0.002,
+                      alert_path=str(alerts), flight=fl, health=ht)
+    assert stats["health"]["events"].get("score_drift", 0) >= 1
+    events = [json.loads(ln) for ln in alerts.read_text().splitlines()
+              if ln.startswith('{"event"')]
+    drift = [e for e in events if e["event"] == "score_drift"]
+    assert drift and drift[0]["tvd"] >= 0.2
+    assert "quantiles" in drift[0] and "baseline_quantiles" in drift[0]
+    bundles = [d for d in pm.iterdir() if "score_drift" in d.name]
+    assert bundles, list(pm.iterdir())
+    v = validate_bundle(str(bundles[0]))
+    assert v["ok"], v
+    summary = json.loads((bundles[0] / "summary.json").read_text())
+    assert summary["reason"] == "score_drift"
+    health = summary["health"]
+    assert any(g["score"]["drifting"] for g in health["groups"])
+    assert health["fleet"]["verdict"] == "attention"
+    # both operator renderers accept the bundle
+    for script in ("scripts/postmortem.py", "scripts/health_report.py"):
+        p = subprocess.run(
+            [sys.executable, script, str(bundles[0])],
+            cwd=REPO, env={**os.environ, "RTAP_FORCE_CPU": "1"},
+            capture_output=True, text=True, timeout=300)
+        assert p.returncode == 0, (script, p.stderr[-2000:])
+    p = subprocess.run(
+        [sys.executable, "scripts/health_report.py", str(bundles[0])],
+        cwd=REPO, env={**os.environ, "RTAP_FORCE_CPU": "1"},
+        capture_output=True, text=True, timeout=300)
+    assert "DRIFTING" in p.stdout or "attention" in p.stdout
+
+
+@pytest.mark.quick
+def test_serve_cli_health_end_to_end(tmp_path):
+    """`serve --health` through the operator command: stats carry the
+    health block, the snapshot carries the fleet gauges and the run
+    epoch, and the epoch sidecar persists beside the incident stream."""
+    alerts = tmp_path / "alerts.jsonl"
+    snap_path = tmp_path / "obs.jsonl"
+    p = subprocess.run(
+        [sys.executable, "-m", "rtap_tpu", "serve",
+         "--streams", "a,b", "--group-size", "2",
+         "--ticks", "4", "--cadence", "0.05", "--backend", "cpu",
+         "--alerts", str(alerts), "--health",
+         "--obs-snapshot", str(snap_path)],
+        cwd=REPO, env={**os.environ, "RTAP_FORCE_CPU": "1"},
+        capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "model-health reducers armed" in p.stderr
+    stats = json.loads(p.stdout.strip().splitlines()[-1])
+    assert stats["health"]["groups"] == 1
+    assert stats["health"]["ticks_folded"] == 4
+    from rtap_tpu.obs import read_last_snapshot, summarize_snapshot
+
+    s = summarize_snapshot(read_last_snapshot(str(snap_path)))
+    assert s["rtap_obs_run_epoch"] == 1
+    assert "rtap_obs_health_pool_occupancy_max" in s
+    assert s["rtap_obs_health_fold_seconds"]["count"] >= 4
+    assert json.loads(
+        (tmp_path / "alerts.jsonl.epoch").read_text())["epoch"] == 1

@@ -1,0 +1,296 @@
+"""Model-health observability units (ISSUE 6).
+
+Covers the fused on-device reducers (ops/health_tpu.py: device vs host
+twin vs CPU-oracle backend parity, schema + size bound), the
+HealthTracker (drift / saturation / collapse incidents with hysteresis,
+quantiles, flight-dump requests), the run-epoch continuity counter, and
+the <= 1% host-fold overhead gate.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import scaled_cluster_preset
+from rtap_tpu.obs.health import HealthTracker, bump_run_epoch
+from rtap_tpu.obs.metrics import TelemetryRegistry
+from rtap_tpu.ops.health_tpu import (
+    HEALTH_KEYS,
+    OCC_BINS,
+    PERM_BINS,
+    SCORE_BINS,
+    health_nbytes,
+    health_reduce_host,
+)
+from rtap_tpu.service.registry import StreamGroup
+
+CFG = scaled_cluster_preset(32)
+G = 4
+T = 6
+
+
+def _data(seed=0, n=G, t=T):
+    rng = np.random.Generator(np.random.Philox(key=(seed, 7)))
+    vals = (30 + 5 * rng.random((t, n))).astype(np.float32)
+    ts = np.tile(1_700_000_000 + np.arange(t)[:, None], (1, n)).astype(np.int64)
+    return vals, ts
+
+
+def _device_group(**kw):
+    return StreamGroup(CFG, [f"s{i}" for i in range(G)], backend="tpu",
+                       health=True, **kw)
+
+
+# ---------------------------------------------------------- reducers --
+@pytest.mark.quick
+def test_health_leaf_schema_and_size_bound():
+    grp = _device_group()
+    vals, ts = _data()
+    grp.run_chunk(vals, ts)
+    assert sorted(grp.last_health) == sorted(HEALTH_KEYS)
+    per_tick = sum(np.asarray(v[0]).nbytes for v in grp.last_health.values())
+    # "a few hundred bytes per group per tick" is a schema contract, not
+    # an aspiration — and the helper must agree with the real leaf
+    assert per_tick == health_nbytes()
+    assert per_tick < 512
+    for k, v in grp.last_health.items():
+        assert v.shape[0] == T, k
+
+
+@pytest.mark.quick
+def test_health_device_vs_host_twin_parity():
+    grp = _device_group()
+    vals, ts = _data()
+    raw, _ll, _al = grp.run_chunk(vals, ts)
+    host = health_reduce_host(
+        {k: np.asarray(v) for k, v in grp.state.items()},
+        raw[-1], vals[-1][:, None], CFG)
+    for k in HEALTH_KEYS:
+        np.testing.assert_allclose(
+            np.asarray(grp.last_health[k][-1]), np.asarray(host[k]),
+            rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+@pytest.mark.quick
+def test_health_cpu_backend_matches_device():
+    vals, ts = _data(seed=3)
+    gd = _device_group()
+    gc = StreamGroup(CFG, [f"s{i}" for i in range(G)], backend="cpu",
+                     health=True)
+    rd, *_ = gd.run_chunk(vals, ts)
+    rc, *_ = gc.run_chunk(vals, ts)
+    np.testing.assert_array_equal(rd, rc)
+    for k in HEALTH_KEYS:
+        np.testing.assert_allclose(
+            np.asarray(gd.last_health[k]), np.asarray(gc.last_health[k]),
+            rtol=1e-5, atol=1e-6, err_msg=k)
+
+
+@pytest.mark.quick
+def test_health_live_mask_excludes_silent_streams():
+    """All-NaN (pad/silent) streams must not dilute the scorecard: a
+    half-silent group reports the same occupancy/sparsity as a fully
+    live one fed the same data."""
+    vals, ts = _data(seed=5)
+    vals = np.repeat(vals[:, :1], G, axis=1)  # identical data per stream:
+    # the live-masked means must then be invariant to how many streams
+    # are live
+    full = _device_group()
+    full.run_chunk(vals, ts)
+    half = StreamGroup(CFG, [f"s{i}" for i in range(G // 2)]
+                       + [f"__pad{i}" for i in range(G // 2)],
+                       backend="tpu", health=True)
+    hv = vals.copy()
+    hv[:, G // 2:] = np.nan  # pads are fed NaN by the loop's routing
+    half.run_chunk(hv, ts)
+    assert int(half.last_health["scored"][-1]) == G // 2
+    assert int(half.last_health["occ_hist"][-1].sum()) == G // 2
+    # live streams saw identical data -> identical per-stream stats, and
+    # the live-masked means must agree between the two fleets
+    np.testing.assert_allclose(half.last_health["act_col_frac"],
+                               full.last_health["act_col_frac"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(half.last_health["seg_occ_frac"],
+                               full.last_health["seg_occ_frac"],
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.quick
+def test_health_requires_no_mesh():
+    with pytest.raises(ValueError, match="mesh"):
+        StreamGroup(CFG, ["a"], backend="tpu", health=True, mesh=object())
+
+
+# ----------------------------------------------------------- tracker --
+def _leaf(score_bin=0, scored=8, occ=0.2, act=None, t=1):
+    """Synthetic per-tick health leaves ([T, ...])."""
+    act = CFG.sp.num_active_columns / CFG.sp.columns if act is None else act
+    hist = np.zeros((t, SCORE_BINS), np.int32)
+    hist[:, score_bin] = scored
+    return {
+        "occ_hist": np.tile(
+            np.eye(OCC_BINS, dtype=np.int32)[
+                min(OCC_BINS - 1, int(occ * OCC_BINS))], (t, 1)),
+        "seg_occ_frac": np.full(t, occ, np.float32),
+        "syn_frac": np.full(t, 0.1, np.float32),
+        "perm_hist": np.full((t, PERM_BINS), 1.0 / PERM_BINS, np.float32),
+        "perm_conn_frac": np.full(t, 0.5, np.float32),
+        "act_col_frac": np.full(t, act, np.float32),
+        "pred_cell_frac": np.full(t, 0.01, np.float32),
+        "hit_num": np.full(t, 0.75 * scored, np.float32),
+        "hit_den": np.full(t, float(scored), np.float32),
+        "score_hist": hist,
+        "scored": np.full(t, scored, np.int32),
+    }
+
+
+class _FlightStub:
+    def __init__(self):
+        self.events = []
+        self.dumps = []
+        self.health_provider = None
+
+    def record_event(self, ev):
+        self.events.append(ev)
+
+    def request_dump(self, reason, tick):
+        self.dumps.append((reason, tick))
+
+
+@pytest.mark.quick
+def test_tracker_score_drift_fires_once_and_requests_dump():
+    events = []
+    fl = _FlightStub()
+    ht = HealthTracker(CFG, registry=TelemetryRegistry(),
+                       sink=events.append, flight=fl,
+                       drift_min_ticks=4, drift_threshold=0.3,
+                       alpha_fast=0.5, alpha_slow=0.01)
+    for k in range(6):
+        ht.fold(0, _leaf(score_bin=1), tick=k)
+    assert not any(e["event"] == "score_drift" for e in events)
+    # the distribution jumps to the top bin: fast EWMA chases it, the
+    # slow baseline stays put -> tvd crosses the threshold, ONCE
+    for k in range(6, 12):
+        ht.fold(0, _leaf(score_bin=SCORE_BINS - 1), tick=k)
+    drift = [e for e in events if e["event"] == "score_drift"]
+    assert len(drift) == 1 and drift[0]["group"] == 0
+    assert drift[0]["tvd"] >= 0.3
+    assert ("score_drift", drift[0]["tick"]) in fl.dumps
+    assert ht.scorecard(0)["score"]["drifting"]
+    # back to the baseline long enough -> clears, re-arms, fires again
+    for k in range(12, 400):
+        ht.fold(0, _leaf(score_bin=1), tick=k)
+    assert not ht.scorecard(0)["score"]["drifting"]
+    for k in range(400, 410):
+        ht.fold(0, _leaf(score_bin=SCORE_BINS - 1), tick=k)
+    assert sum(1 for e in events if e["event"] == "score_drift") == 2
+
+
+@pytest.mark.quick
+def test_tracker_pool_saturated_hysteresis():
+    events = []
+    ht = HealthTracker(CFG, registry=TelemetryRegistry(),
+                       sink=events.append, occupancy_threshold=0.9)
+    ht.fold(1, _leaf(occ=0.95), tick=0)
+    ht.fold(1, _leaf(occ=0.96), tick=1)  # still saturated: no re-fire
+    sat = [e for e in events if e["event"] == "pool_saturated"]
+    assert len(sat) == 1 and sat[0]["occupancy"] == 0.95
+    ht.fold(1, _leaf(occ=0.85), tick=2)  # above 0.9*thr: stays armed off
+    ht.fold(1, _leaf(occ=0.95), tick=3)  # did not clear below margin
+    assert sum(1 for e in events if e["event"] == "pool_saturated") == 1
+    ht.fold(1, _leaf(occ=0.5), tick=4)  # clears (below 0.81)
+    ht.fold(1, _leaf(occ=0.95), tick=5)
+    assert sum(1 for e in events if e["event"] == "pool_saturated") == 2
+
+
+@pytest.mark.quick
+def test_tracker_outage_ticks_do_not_flap_saturation():
+    """An all-NaN source outage zeroes every live-masked mean; adopting
+    those zeros would clear the saturation edge-trigger and re-fire the
+    incident (plus a postmortem dump) on every source recovery. Outage
+    ticks must leave the scorecard and the condition state alone."""
+    events = []
+    ht = HealthTracker(CFG, registry=TelemetryRegistry(),
+                       sink=events.append, occupancy_threshold=0.9)
+    ht.fold(0, _leaf(occ=0.95), tick=0)
+    ht.fold(0, _leaf(occ=0.0, scored=0), tick=1)  # breaker/NaN outage
+    ht.fold(0, _leaf(occ=0.95), tick=2)  # recovery: no re-fire
+    assert sum(1 for e in events if e["event"] == "pool_saturated") == 1
+    # the scorecard kept the last real observation through the outage
+    assert ht.scorecard(0)["occupancy"]["frac"] == pytest.approx(0.95)
+
+
+@pytest.mark.quick
+def test_tracker_sparsity_collapse_respects_warmup_and_floor():
+    events = []
+    ht = HealthTracker(CFG, registry=TelemetryRegistry(),
+                       sink=events.append, sparsity_min_frac=0.5,
+                       warmup_ticks=3)
+    collapsed = 0.1 * CFG.sp.num_active_columns / CFG.sp.columns
+    ht.fold(0, _leaf(act=collapsed), tick=0)  # warm-up: not judged yet
+    assert not any(e["event"] == "sparsity_collapsed" for e in events)
+    ht.fold(0, _leaf(act=collapsed, t=3), tick=1)
+    assert sum(1 for e in events
+               if e["event"] == "sparsity_collapsed") == 1
+    # healthy sparsity clears the flag; a fresh collapse re-fires
+    ht.fold(0, _leaf(), tick=2)
+    ht.fold(0, _leaf(act=collapsed), tick=3)
+    assert sum(1 for e in events
+               if e["event"] == "sparsity_collapsed") == 2
+
+
+@pytest.mark.quick
+def test_tracker_quantiles_and_snapshot_schema():
+    ht = HealthTracker(CFG, registry=TelemetryRegistry())
+    ht.fold(0, _leaf(score_bin=0, t=4), tick=3)
+    ht.fold(2, _leaf(score_bin=SCORE_BINS - 1, t=4), tick=3)
+    snap = ht.snapshot()
+    assert snap["fleet"]["groups"] == 2
+    assert snap["fleet"]["verdict"] == "ok"
+    assert [g["group"] for g in snap["groups"]] == [0, 2]
+    g0 = snap["groups"][0]
+    for section in ("occupancy", "synapses", "sparsity", "score"):
+        assert section in g0
+    q0 = g0["score"]["quantiles"]
+    q2 = snap["groups"][1]["score"]["quantiles"]
+    # all mass in the bottom vs top bin -> quantiles pinned to the bin
+    assert q0["p99"] <= 1.0 / SCORE_BINS
+    assert q2["p50"] >= 1.0 - 1.0 / SCORE_BINS
+    assert json.dumps(snap)  # JSON-able end to end (the /health body)
+    assert ht.scorecard(0)["hit_rate"] == pytest.approx(0.75)
+
+
+@pytest.mark.quick
+def test_tracker_rejects_bad_params():
+    for kw in ({"occupancy_threshold": 0.0}, {"drift_threshold": 2.0},
+               {"sparsity_min_frac": 1.0}, {"drift_min_ticks": 0},
+               {"alpha_fast": 0.01, "alpha_slow": 0.5}):
+        with pytest.raises(ValueError):
+            HealthTracker(CFG, registry=TelemetryRegistry(), **kw)
+
+
+# -------------------------------------------------- run epoch + bench --
+@pytest.mark.quick
+def test_bump_run_epoch_monotonic_and_corruption_tolerant(tmp_path):
+    reg = TelemetryRegistry()
+    beside = str(tmp_path / "alerts.jsonl")
+    assert bump_run_epoch(beside, registry=reg) == 1
+    assert bump_run_epoch(beside, registry=reg) == 2
+    assert bump_run_epoch(beside, registry=reg) == 3
+    gauges = {(i.name): i.value for i in reg.collect()}
+    assert gauges["rtap_obs_run_epoch"] == 3
+    # corrupt sidecar: restart the count, never raise
+    (tmp_path / "alerts.jsonl.epoch").write_text("not json{")
+    assert bump_run_epoch(beside, registry=reg) == 1
+    # no incident stream -> nothing to be continuous with
+    assert bump_run_epoch(None, registry=reg) == 0
+
+
+@pytest.mark.quick
+def test_health_fold_overhead_within_one_percent_of_tick_budget():
+    from rtap_tpu.obs.selfbench import measure_health
+
+    res = measure_health(n=300)
+    assert res["per_tick_overhead_frac"] <= 0.01, res
+    assert res["leaf_bytes_per_group_tick"] == health_nbytes()
