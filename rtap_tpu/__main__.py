@@ -178,6 +178,29 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         source = HttpPollSource(args.http, ids,
                                 track_unknown=args.auto_register)
         close = lambda: None  # noqa: E731
+    elif args.ingest_port is not None or args.ingest_shm:
+        # wire-speed binary ingest (ISSUE 7, docs/INGEST.md): the RB1
+        # batch protocol over persistent sockets and/or a shared-memory
+        # ring, addressed by the registry's (shard, group, slot) slot
+        # map. Quotas/backfill are admission-control knobs of this path.
+        from rtap_tpu.ingest import BinaryBatchSource
+
+        bsrc = BinaryBatchSource(
+            grp.slot_map(),
+            port=args.ingest_port,
+            shm=args.ingest_shm or None,
+            quota_rows=args.ingest_quota,
+            backfill_horizon=args.ingest_backfill_horizon,
+            track_unknown=args.auto_register).start()
+        if bsrc.address is not None:
+            bhost, bport = bsrc.address
+            print(f"serve: listening for binary batch frames on "
+                  f"{bhost}:{bport}", file=sys.stderr)
+        if bsrc.ring_name is not None:
+            print(f"serve: binary ingest shm ring {bsrc.ring_name!r} "
+                  "created (co-located exporters attach by name)",
+                  file=sys.stderr)
+        source, close = bsrc, bsrc.close
     else:
         tcp = TcpJsonlSource(ids, port=args.port,
                              track_unknown=args.auto_register).start()
@@ -363,7 +386,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # ingest health belongs in the service artifact: a zero-missed-deadline
     # line is only evidence if data was flowing and parsing cleanly
     for attr in ("records_parsed", "parse_errors", "unknown_ids",
-                 "native_active", "poll_failures", "polls_short_circuited"):
+                 "native_active", "poll_failures", "polls_short_circuited",
+                 "frames_applied", "garbage_bytes", "rows_quota_dropped",
+                 "rows_late_dropped", "rows_backfilled",
+                 "rows_backpressure_dropped", "rows_stale_epoch"):
         v = getattr(source, attr, None)
         if v is not None:
             stats[attr] = v
@@ -515,6 +541,34 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--http", default=None,
                    help="poll this metrics endpoint each tick (default: TCP listener)")
     p.add_argument("--port", type=int, default=0, help="TCP listen port (0 = ephemeral)")
+    p.add_argument("--ingest-port", type=int, default=None,
+                   help="listen for the RB1 binary batch protocol on this "
+                        "port (0 = ephemeral) instead of per-record JSONL: "
+                        "length-prefixed CRC-framed frames of packed "
+                        "(slot, value, ts_delta) rows addressed by the "
+                        "registry's (shard, group, slot) slot map, decoded "
+                        "with zero per-record Python — the wire-speed "
+                        "ingest front end (docs/INGEST.md; "
+                        "scripts/ingest_bench.py measures it)")
+    p.add_argument("--ingest-shm", default=None,
+                   help="also create a shared-memory frame ring under this "
+                        "name for co-located exporters (same RB1 frames, "
+                        "no socket; combine with --ingest-port or use "
+                        "alone). The ring is drained once per tick")
+    p.add_argument("--ingest-quota", type=int, default=0,
+                   help="admission control: max binary-ingest rows per "
+                        "tenant per tick (frames carry a tenant header); "
+                        "rows beyond the quota are dropped + counted "
+                        "(rtap_obs_ingest_quota_dropped_total). 0 = off")
+    p.add_argument("--ingest-backfill-horizon", type=int, default=0,
+                   help="binary-ingest timestamp alignment: hold emission "
+                        "this many SECONDS (of row timestamp) behind the "
+                        "newest row seen, so late rows land in the slot "
+                        "their timestamp names instead of overwriting "
+                        "the latest value; older-than-horizon rows drop "
+                        "(counted). At the standard 1 s cadence a second "
+                        "is a tick. 0 = latest-wins (JSONL-equivalent "
+                        "semantics, the default)")
     p.add_argument("--ticks", type=int, default=60)
     p.add_argument("--cadence", type=float, default=1.0)
     p.add_argument("--preset", choices=("cluster", "nab"), default="cluster")
@@ -878,6 +932,28 @@ def main(argv: list[str] | None = None) -> int:
             getattr(args, "columns", None) is not None:
         print("serve: --columns applies to the cluster preset only "
               "(the NAB family scales via scaled_nab_preset)",
+              file=sys.stderr)
+        return 2
+    if getattr(args, "http", None) and (
+            getattr(args, "ingest_port", None) is not None
+            or getattr(args, "ingest_shm", None)):
+        print("serve: --http and --ingest-port/--ingest-shm are exclusive "
+              "(one source feeds the loop)", file=sys.stderr)
+        return 2
+    if getattr(args, "port", 0) and (
+            getattr(args, "ingest_port", None) is not None
+            or getattr(args, "ingest_shm", None)):
+        print("serve: --port (JSONL listener) and --ingest-port/--ingest-shm "
+              "are exclusive — the binary source replaces the JSONL one; "
+              "a JSONL producer pointed at --port would get connection "
+              "refused while serve reports healthy", file=sys.stderr)
+        return 2
+    if (getattr(args, "ingest_quota", 0)
+            or getattr(args, "ingest_backfill_horizon", 0)) \
+            and getattr(args, "ingest_port", None) is None \
+            and not getattr(args, "ingest_shm", None):
+        print("serve: --ingest-quota/--ingest-backfill-horizon are binary-"
+              "ingest admission knobs; add --ingest-port or --ingest-shm",
               file=sys.stderr)
         return 2
     if getattr(args, "freeze", False) and getattr(args, "auto_register", False):

@@ -1,0 +1,60 @@
+"""Wire-speed ingest (ROADMAP item 5, ISSUE 7): the production front end
+that replaces per-record JSON with a zero-copy binary batch protocol.
+
+Layers (each its own module, host-only — no accelerator dependency):
+
+- :mod:`rtap_tpu.ingest.protocol` — the versioned ``RB1`` length-prefixed
+  CRC-framed batch format (packed ``(slot_u32, value_f32, ts_delta_u16)``
+  rows), the (shard, group, slot) slot-code packing, and the frame
+  walker (native C fast path, pure-Python fallback).
+- :mod:`rtap_tpu.ingest.dispatch` — the registry slot map rendered as a
+  vectorized code -> dispatch-position table (``np.frombuffer`` rows
+  scatter straight into per-(group, slot) dispatch buffers with zero
+  per-record Python).
+- :mod:`rtap_tpu.ingest.shm` — the shared-memory frame ring for
+  co-located exporters (same frames, no socket).
+- :mod:`rtap_tpu.ingest.server` — :class:`BinaryBatchSource`, the
+  live_loop source: persistent-socket listener + optional shm drain,
+  ingest-side timestamp alignment/backfill, and admission control
+  (per-tenant quotas, drop-oldest backpressure) wired into
+  ``rtap_obs_ingest_*`` telemetry.
+- :mod:`rtap_tpu.ingest.emit` — producer-side helpers
+  (:func:`send_binary`, :class:`BinaryFeedConnection`), the
+  ``send_jsonl`` twin the soak feeders use.
+
+docs/INGEST.md is the operator runbook (frame layout, endianness,
+versioning rules, backfill semantics, quota/backpressure).
+"""
+
+from rtap_tpu.ingest.dispatch import DispatchTable
+from rtap_tpu.ingest.emit import BinaryFeedConnection, send_binary
+from rtap_tpu.ingest.protocol import (
+    KIND_DATA,
+    KIND_MAP,
+    KIND_NAMES,
+    PROTOCOL_VERSION,
+    FrameWalker,
+    build_frame,
+    decode_slot,
+    encode_slot,
+    pack_rows,
+)
+from rtap_tpu.ingest.server import BinaryBatchSource
+from rtap_tpu.ingest.shm import ShmRing
+
+__all__ = [
+    "BinaryBatchSource",
+    "BinaryFeedConnection",
+    "DispatchTable",
+    "FrameWalker",
+    "KIND_DATA",
+    "KIND_MAP",
+    "KIND_NAMES",
+    "PROTOCOL_VERSION",
+    "ShmRing",
+    "build_frame",
+    "decode_slot",
+    "encode_slot",
+    "pack_rows",
+    "send_binary",
+]

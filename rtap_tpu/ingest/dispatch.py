@@ -1,0 +1,107 @@
+"""Registry slot map -> vectorized wire-code dispatch (ROADMAP-1 shape).
+
+The registry hands sources a SLOT MAP — ``{stream_id: SlotAddress(shard,
+group, slot)}`` (service/registry.py) — instead of a flat id list: the
+addressing every pod-scale design needs (a shard owns groups, a group
+owns slots; a flat id registry cannot express placement). This module
+renders that map as dense numpy lookup tables so a frame's packed rows
+route to their (group, slot) dispatch positions with two fancy-index
+operations and zero per-record Python.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.ingest.protocol import (
+    MAX_GROUPS,
+    MAX_SHARDS,
+    MAX_SLOTS,
+    SLOT_BITS,
+    encode_slot,
+)
+
+
+class DispatchTable:
+    """Bidirectional (shard, group, slot) <-> dispatch-position tables.
+
+    ``ids``/``codes`` follow the registry's dispatch order (the value-
+    vector order live_loop routes by); ``lookup`` maps wire slot codes to
+    dispatch positions (-1 for codes that address no live stream —
+    pads, released slots, or garbage), vectorized over whole frames.
+    """
+
+    def __init__(self, slot_map: dict):
+        # dispatch order = (group, slot) ascending, matching
+        # StreamGroupRegistry.dispatch_ids() (live slots per group in
+        # slot order) — pinned by tests/unit/test_ingest_protocol.py
+        items = sorted(slot_map.items(),
+                       key=lambda kv: (kv[1].group, kv[1].slot))
+        self.ids: list[str] = [sid for sid, _ in items]
+        self.codes = np.array(
+            [encode_slot(a.shard, a.group, a.slot) for _, a in items],
+            np.uint32)
+        self.code_of = {sid: int(c) for sid, c in zip(self.ids, self.codes)}
+        self.n = len(self.ids)
+        # dense [n_groups, max_slot+1] -> dispatch position (or -1):
+        # group/slot extents come from the map, so the table is sized to
+        # the fleet, not to the 14-bit code space
+        n_groups = 1 + max((a.group for _, a in items), default=0)
+        n_slots = 1 + max((a.slot for _, a in items), default=0)
+        self._dense = np.full((n_groups, n_slots), -1, np.int64)
+        for pos, (_sid, a) in enumerate(items):
+            self._dense[a.group, a.slot] = pos
+        self._gmask = np.uint32(MAX_GROUPS - 1)
+        self._smask = np.uint32(MAX_SLOTS - 1)
+
+    def lookup(self, codes: np.ndarray) -> np.ndarray:
+        """Wire codes [N] u32 -> dispatch positions [N] i64 (-1 = no
+        live stream at that address). Shard bits are part of the
+        address: a code whose (group, slot) exists but whose shard
+        disagrees with the map is rejected too."""
+        codes = np.asarray(codes, np.uint32)
+        g = (codes >> np.uint32(SLOT_BITS)) & self._gmask
+        s = codes & self._smask
+        ok = (g < self._dense.shape[0]) & (s < self._dense.shape[1])
+        pos = np.full(codes.shape, -1, np.int64)
+        idx = self._dense[g[ok], s[ok]]
+        # full-code check catches wrong-shard (and any future reserved-
+        # bit) addressing without a separate per-row comparison pass
+        # when everything matches
+        valid = idx >= 0
+        sel = idx[valid]
+        valid[valid] = self.codes[sel] == codes[ok][valid]
+        out = np.full(int(ok.sum()), -1, np.int64)
+        out[valid] = idx[valid]
+        pos[ok] = out
+        return pos
+
+    @classmethod
+    def from_registry(cls, reg) -> "DispatchTable":
+        return cls(reg.slot_map())
+
+
+def decode_frames_to_row(blobs, width: int, table: DispatchTable) -> np.ndarray:
+    """Journal-replay decode: apply raw DATA frame bytes in order onto
+    a NaN row of ``width`` — exactly the ingest-time scatter, re-run,
+    so a journaled binary tick replays bit-identically
+    (resilience/journal.py FRAME records; service/loop.py calls this).
+
+    Raises ValueError on a width mismatch (membership changed without a
+    checkpoint boundary — the caller skips the row, counted)."""
+    from rtap_tpu.ingest.protocol import KIND_DATA, FrameWalker
+
+    if width != table.n:
+        raise ValueError(
+            f"journaled frame width {width} != dispatch width {table.n}")
+    values = np.full(width, np.nan, np.float32)
+    walker = FrameWalker(native=False)  # replay is cold-path
+    for blob in blobs:
+        for fr in walker.feed(blob):
+            if fr.kind != KIND_DATA:
+                continue
+            rows = fr.rows()
+            pos = table.lookup(rows["slot"])
+            valid = pos >= 0
+            values[pos[valid]] = rows["value"][valid]
+    return values

@@ -1,12 +1,13 @@
 """Native host-runtime pieces (C, loaded via ctypes — no pybind11 in this
-environment). Currently: the JSONL metrics-ingest parser (SURVEY.md C18).
+environment). Currently: the JSONL metrics-ingest parser (SURVEY.md C18)
+and the RB1 binary-ingest frame walker (ISSUE 7, rtap_tpu/ingest/).
 
-The shared library is compiled on demand from the adjacent .c source with
-the system compiler into ``_build/`` (atomic rename, so concurrent
-processes can race the build safely) and cached until the source changes.
-Callers must treat ImportError/OSError from :func:`load` as "native path
-unavailable" and fall back to pure Python — the service must run (slower)
-on hosts without a toolchain.
+Each shared library is compiled on demand from its adjacent .c source
+with the system compiler into ``_build/`` (atomic rename, so concurrent
+processes can race the build safely) and cached until the source
+changes. Callers must treat ImportError/OSError from the loaders as
+"native path unavailable" and fall back to pure Python — the service
+must run (slower) on hosts without a toolchain.
 """
 
 from __future__ import annotations
@@ -23,21 +24,24 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "jsonl_parser.c")
 _BUILD_DIR = os.path.join(_DIR, "_build")
 _SO = os.path.join(_BUILD_DIR, "jsonl_parser.so")
+_FW_SRC = os.path.join(_DIR, "frame_walker.c")
+_FW_SO = os.path.join(_BUILD_DIR, "frame_walker.so")
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
+_fw_lib: ctypes.CDLL | None = None
 
 
-def _compile() -> None:
+def _compile(src: str = _SRC, so: str = _SO) -> None:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=_BUILD_DIR)
     os.close(fd)
     try:
         subprocess.run(
-            ["cc", "-O2", "-shared", "-fPIC", "-std=c99", "-o", tmp, _SRC],
+            ["cc", "-O2", "-shared", "-fPIC", "-std=c99", "-o", tmp, src],
             check=True, capture_output=True, text=True,
         )
-        os.replace(tmp, _SO)  # atomic: concurrent builders both win
+        os.replace(tmp, so)  # atomic: concurrent builders both win
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -191,3 +195,72 @@ class ConnParser:
 
     def __del__(self):
         self.close()
+
+
+# ---------------------------------------------------------------------
+# RB1 frame walker (frame_walker.c) — the binary-ingest scan fast path
+# ---------------------------------------------------------------------
+
+#: frames per C scan call; the wrapper loops, so this only bounds the
+#: meta array allocation, not throughput
+_FW_CAP = 4096
+
+
+def load_frame_walker() -> ctypes.CDLL:
+    """The frame-walker library, compiling it first if missing or
+    stale. Raises on any failure — callers fall back to the pure-Python
+    walker (rtap_tpu/ingest/protocol.py)."""
+    global _fw_lib
+    with _lock:
+        if _fw_lib is not None:
+            return _fw_lib
+        if (not os.path.exists(_FW_SO)
+                or os.path.getmtime(_FW_SO) < os.path.getmtime(_FW_SRC)):
+            _compile(_FW_SRC, _FW_SO)
+        lib = ctypes.CDLL(_FW_SO)
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.rtap_fw_scan.restype = ctypes.c_longlong
+        lib.rtap_fw_scan.argtypes = [
+            u8p, ctypes.c_longlong, i64p, ctypes.c_longlong, i64p]
+        _fw_lib = lib
+        return _fw_lib
+
+
+_fw_tls = threading.local()  # reused per-thread scan buffers (the scan
+# runs per recv chunk on the ingest hot path; a fresh 224 KiB meta
+# allocation per chunk was measurable)
+
+
+def frame_walker_scan(buf) -> tuple[list[tuple], int, dict]:
+    """Native twin of protocol.scan_frames_py: scan ``buf`` (bytes-like)
+    for complete RB1 frames -> (metas, consumed, stats), zero-copy over
+    the caller's buffer. Loops the C scanner past its per-call frame
+    cap so semantics match the uncapped Python walker exactly
+    (parity-pinned)."""
+    lib = load_frame_walker()
+    out = getattr(_fw_tls, "out", None)
+    if out is None:
+        out = _fw_tls.out = np.empty(_FW_CAP * 8, np.int64)
+        _fw_tls.stats = np.empty(4, np.int64)
+    raw_stats = _fw_tls.stats
+    data = np.frombuffer(buf, np.uint8)
+    metas: list[tuple] = []
+    stats = {"garbage_bytes": 0, "bad_crc": 0, "version_skew": 0}
+    base = 0
+    while True:
+        raw_stats[:3] = 0
+        n = int(lib.rtap_fw_scan(data[base:], len(data) - base, out,
+                                 _FW_CAP, raw_stats))
+        for i in range(n):
+            kind, ver, epoch, toff, tlen, count, base_ts, poff = \
+                out[i * 8:i * 8 + 8]
+            metas.append((int(kind), int(ver), int(epoch), base + int(toff),
+                          int(tlen), int(count), int(base_ts),
+                          base + int(poff)))
+        stats["garbage_bytes"] += int(raw_stats[0])
+        stats["bad_crc"] += int(raw_stats[1])
+        stats["version_skew"] += int(raw_stats[2])
+        base += int(raw_stats[3])
+        if n < _FW_CAP:
+            return metas, base, stats

@@ -39,6 +39,15 @@ crc32 covers type + payload_len + payload. Record types:
   load-bearing alert cursor for exactly-once resume lives in the
   checkpoint meta, written at a fully-drained instant — see
   service/checkpoint.py and docs/RESILIENCE.md)
+- FRAME  (3): tick i64, ts i64, width i32, raw RB1 ingest frame bytes
+  (ISSUE 7): the binary ingest path journals the tick's wire frames
+  VERBATIM instead of re-encoding the full-width value vector — a
+  100k-stream fleet with 1k rows/tick writes ~10 KB instead of 400 KB.
+  Replay decodes them through the registry's dispatch table
+  (rtap_tpu/ingest/dispatch.decode_frames_to_row), which is
+  valid because membership changes force a checkpoint + compaction
+  boundary, so every frame in the replayable window was ingested under
+  the membership the checkpoints resume.
 
 Segments rotate at ``segment_bytes`` and are bounded by ``max_segments``
 (oldest dropped + counted — sized so it never fires while checkpoints
@@ -59,17 +68,33 @@ import numpy as np
 
 from rtap_tpu.obs import get_registry
 
-__all__ = ["TickJournal", "parse_fsync", "count_journal_ticks",
-           "last_journal_tick", "FSYNC_POLICIES"]
+__all__ = ["TickJournal", "JournaledFrames", "parse_fsync",
+           "count_journal_ticks", "last_journal_tick", "FSYNC_POLICIES"]
+
+
+class JournaledFrames:
+    """A FRAME record's payload: the raw RB1 wire frames of one tick
+    plus the dispatch width they were ingested at. The loop's journal
+    replay materializes the value vector through the binary source's
+    dispatch table (the codes are meaningless without it)."""
+
+    __slots__ = ("width", "blob")
+
+    def __init__(self, width: int, blob: bytes):
+        self.width = int(width)
+        self.blob = blob
 
 _MAGIC = b"RJ"
 _TICK = 1
 _CURSOR = 2
+_FRAME = 3
+_TYPES = (_TICK, _CURSOR, _FRAME)
 _HEADER = struct.Struct("<2sBI")  # magic, type, payload length
 _CRC = struct.Struct("<I")
 _TICK_HEAD = struct.Struct("<qqB")  # tick, ts, ndim
 _DIM = struct.Struct("<i")
 _CURSOR_PAYLOAD = struct.Struct("<qq")  # tick, alert-sink byte offset
+_FRAME_HEAD = struct.Struct("<qqi")  # tick, ts, dispatch width
 #: a payload larger than this is treated as frame corruption, not a
 #: record (a flipped length byte must not make recovery try to allocate
 #: gigabytes): 256 MiB comfortably exceeds any real fleet's tick row
@@ -132,7 +157,7 @@ def _walk_headers(path: Path):
                         break
                     magic, typ, ln = _HEADER.unpack(head)
                     end = off + _HEADER.size + ln + _CRC.size
-                    if magic != _MAGIC or typ not in (_TICK, _CURSOR) \
+                    if magic != _MAGIC or typ not in _TYPES \
                             or ln > _MAX_PAYLOAD or end > size:
                         break
                     yield typ, ln, f
@@ -143,22 +168,23 @@ def _walk_headers(path: Path):
 
 
 def count_journal_ticks(path: str | Path) -> int:
-    """Cheap header-walk count of valid TICK records in a journal dir.
-    NOTE: checkpoint compaction deletes whole segments, so this number
-    can SHRINK across a run — use :func:`last_journal_tick` for
-    monotonic progress probing."""
+    """Cheap header-walk count of valid tick-carrying records (TICK and
+    FRAME) in a journal dir. NOTE: checkpoint compaction deletes whole
+    segments, so this number can SHRINK across a run — use
+    :func:`last_journal_tick` for monotonic progress probing."""
     return sum(1 for typ, _ln, _f in _walk_headers(Path(path))
-               if typ == _TICK)
+               if typ in (_TICK, _FRAME))
 
 
 def last_journal_tick(path: str | Path) -> int:
-    """Highest TICK index visible in a journal dir (header walk, CRCs
-    skipped, torn tail ends the scan) — the crash soak's progress probe.
-    Unlike a record COUNT this is monotonic across segment rotation AND
-    checkpoint compaction; -1 for an empty/missing journal."""
+    """Highest tick index visible in a journal dir (TICK or FRAME
+    records; header walk, CRCs skipped, torn tail ends the scan) — the
+    crash soak's progress probe. Unlike a record COUNT this is
+    monotonic across segment rotation AND checkpoint compaction; -1
+    for an empty/missing journal."""
     last = -1
     for typ, ln, f in _walk_headers(Path(path)):
-        if typ == _TICK and ln >= 8:
+        if typ in (_TICK, _FRAME) and ln >= 8:
             (tick,) = struct.unpack("<q", f.read(8))
             last = max(last, int(tick))
     return last
@@ -287,7 +313,7 @@ class TickJournal:
             while off + _HEADER.size + _CRC.size <= len(data):
                 magic, typ, ln = _HEADER.unpack_from(data, off)
                 end = off + _HEADER.size + ln + _CRC.size
-                if magic != _MAGIC or typ not in (_TICK, _CURSOR) \
+                if magic != _MAGIC or typ not in _TYPES \
                         or ln > _MAX_PAYLOAD or end > len(data):
                     break
                 payload = data[off + _HEADER.size:end - _CRC.size]
@@ -298,7 +324,7 @@ class TickJournal:
                 rec = self._parse(typ, payload)
                 if rec is None:
                     break
-                if typ == _TICK:
+                if typ in (_TICK, _FRAME):
                     if rec[0] <= last_tick:
                         # out-of-order / repeated index: keep the FIRST
                         # copy (appends never reuse an index — the
@@ -337,6 +363,12 @@ class TickJournal:
             if typ == _CURSOR:
                 tick, offset = _CURSOR_PAYLOAD.unpack(payload)
                 return int(tick), int(offset)
+            if typ == _FRAME:
+                tick, ts, width = _FRAME_HEAD.unpack_from(payload, 0)
+                if width < 0:
+                    return None
+                return int(tick), int(ts), JournaledFrames(
+                    int(width), payload[_FRAME_HEAD.size:])
             tick, ts, ndim = _TICK_HEAD.unpack_from(payload, 0)
             off = _TICK_HEAD.size
             shape = []
@@ -410,6 +442,27 @@ class TickJournal:
         self._obs_appends.inc()
         self._obs_bytes.inc(len(rec))
 
+    def _append_tick_record(self, typ: int, tick: int, payload: bytes,
+                            t0: float) -> None:
+        """Shared tail of every tick-carrying append: write, advance
+        the tick cursor, run the fsync policy, observe the cost — TICK
+        and FRAME records must never diverge in durability semantics.
+        ``t0`` is taken BEFORE the caller builds its payload, so the
+        append histogram keeps covering format + write + flush + fsync
+        (the pre-FRAME measurement contract)."""
+        import time as _time
+
+        self._append(typ, payload, int(tick))
+        self.appended_ticks += 1
+        self.next_tick = max(self.next_tick, int(tick) + 1)
+        if self.fsync == "every-tick":
+            self._fsync()
+        elif self.fsync == "every-n":
+            self._ticks_since_fsync += 1
+            if self._ticks_since_fsync >= self.fsync_every:
+                self._fsync()
+        self._obs_append_seconds.observe(_time.perf_counter() - t0)
+
     def append_tick(self, tick: int, ts: int, values: np.ndarray) -> None:
         """Append one ingested tick row (the write-ahead record): global
         tick index, source timestamp, and the raw value vector in
@@ -421,16 +474,20 @@ class TickJournal:
         payload = (_TICK_HEAD.pack(int(tick), int(ts), values.ndim)
                    + b"".join(_DIM.pack(d) for d in values.shape)
                    + values.tobytes())
-        self._append(_TICK, payload, int(tick))
-        self.appended_ticks += 1
-        self.next_tick = max(self.next_tick, int(tick) + 1)
-        if self.fsync == "every-tick":
-            self._fsync()
-        elif self.fsync == "every-n":
-            self._ticks_since_fsync += 1
-            if self._ticks_since_fsync >= self.fsync_every:
-                self._fsync()
-        self._obs_append_seconds.observe(_time.perf_counter() - t0)
+        self._append_tick_record(_TICK, tick, payload, t0)
+
+    def append_tick_frames(self, tick: int, ts: int, width: int,
+                           frames) -> None:
+        """Append one ingested tick as its RAW binary ingest frames
+        (ISSUE 7): the wire bytes land verbatim — no full-width
+        re-encode — plus the dispatch width replay validates against.
+        An empty frame list is a legal all-NaN tick (no data arrived)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        payload = (_FRAME_HEAD.pack(int(tick), int(ts), int(width))
+                   + b"".join(frames))
+        self._append_tick_record(_FRAME, tick, payload, t0)
 
     def append_cursor(self, tick: int, alerts_offset: int) -> None:
         """Append an alert-delivery cursor: alerts through global `tick`

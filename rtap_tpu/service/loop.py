@@ -51,6 +51,21 @@ def _scored_counter():
         "metrics counter (live + replay)")
 
 
+def _sync_source_membership(source, reg) -> None:
+    """Push the registry's membership to the source after any change.
+
+    Slot-map-addressed sources (rtap_tpu.ingest.BinaryBatchSource) get
+    the (shard, group, slot) map — the registry hands out ADDRESSES,
+    not a flat id list (ROADMAP-1); flat-id sources (TcpJsonlSource,
+    HttpPollSource) keep their dispatch-order id list. Sources without
+    either contract re-derive per tick (the length check is the guard).
+    """
+    if hasattr(source, "set_slot_map"):
+        source.set_slot_map(reg.slot_map())
+    elif hasattr(source, "set_ids"):
+        source.set_ids(reg.dispatch_ids())
+
+
 @dataclass
 class ReplayResult:
     stream_ids: list[str]
@@ -587,11 +602,10 @@ def live_loop(
                 f"run's {len(groups)} group(s): the prior run had more "
                 "claimable capacity. Rerun with the same --reserve/"
                 "--group-size so every checkpointed stream resumes")
-        if isinstance(group, StreamGroupRegistry) and resumed_from \
-                and hasattr(source, "set_ids"):
+        if isinstance(group, StreamGroupRegistry) and resumed_from:
             # the source must accept the resumed extras' records and return
-            # values in the (possibly grown) dispatch order
-            source.set_ids(group.dispatch_ids())
+            # values in the (possibly grown) dispatch order / slot map
+            _sync_source_membership(source, group)
         # A crash between per-group saves leaves a torn set (groups at
         # different ticks). Live data is NOT tick-indexed (every group
         # scores whatever arrives now) and groups are fully independent,
@@ -1021,8 +1035,29 @@ def live_loop(
             # hole (compacted/evicted rows): healing is impossible, and
             # scoring row jt as some earlier tick would SILENTLY corrupt
             # state and alert ids — skip the group loudly instead
+            jtable = None  # dispatch table for FRAME records, built once
+            from rtap_tpu.resilience.journal import JournaledFrames
+
             for jt, jts, jvals in jrows:
-                jvals = np.asarray(jvals, np.float32)
+                if isinstance(jvals, JournaledFrames):
+                    # binary-ingest tick: materialize the row by re-
+                    # running the ingest scatter over the raw frames
+                    # (bit-exact; valid because membership changes
+                    # checkpoint + compact at their boundary)
+                    if jvals.width != n_expected or reg is None:
+                        journal_replay["skipped_rows"] += 1
+                        continue
+                    from rtap_tpu.ingest.dispatch import (
+                        DispatchTable,
+                        decode_frames_to_row,
+                    )
+
+                    if jtable is None:
+                        jtable = DispatchTable.from_registry(reg)
+                    jvals = decode_frames_to_row(
+                        [jvals.blob], jvals.width, jtable)
+                else:
+                    jvals = np.asarray(jvals, np.float32)
                 if len(jvals) != n_expected:
                     # membership changed between record and resume —
                     # normally impossible: every membership change
@@ -1367,8 +1402,8 @@ def live_loop(
                         _sync_chaos_routing()
                         obs_rebuilds.inc()
                         obs_streams.set(n_expected)
-                        if reg is not None and hasattr(source, "set_ids"):
-                            source.set_ids(reg.dispatch_ids())
+                        if reg is not None:
+                            _sync_source_membership(source, reg)
             # lazy model creation (serve --auto-register, SURVEY.md C19):
             # unknown ids the TCP listener saw claim free pad slots. The
             # pipeline drains first — membership may only change with
@@ -1407,7 +1442,7 @@ def live_loop(
                         reg.add_stream(sid)
                         auto_registered += 1
                     if claimed:
-                        source.set_ids(reg.dispatch_ids())
+                        _sync_source_membership(source, reg)
             # elastic shrink (serve --auto-release-after): streams silent
             # for N consecutive ticks release their slots back to claimable
             # capacity — a churning monitored cluster (nodes leaving) must
@@ -1427,8 +1462,7 @@ def live_loop(
                 # retry (their records will re-surface as unknown) — a
                 # leave-then-join churn must converge, not blacklist
                 auto_rejected.clear()
-                if hasattr(source, "set_ids"):
-                    source.set_ids(reg.dispatch_ids())
+                _sync_source_membership(source, reg)
             if reg is not None and reg.version != routing_version:
                 # a version bump outside the blocks above (external claim/
                 # release between ticks) still needs the aligned instant:
@@ -1476,6 +1510,7 @@ def live_loop(
                 # (drains inside the block already own their own spans)
                 trace.add_span("membership", k, t_phase,
                                max(0.0, _mem_booked))
+            tick_frames = None  # raw binary ingest frames (journal path)
             try:
                 values, ts = source(k)
             except Exception as e:  # noqa: BLE001
@@ -1499,6 +1534,12 @@ def live_loop(
                     else int(time.time())
             else:
                 source_error_run = 0
+                if journal is not None and hasattr(source,
+                                                   "take_tick_frames"):
+                    # only a SUCCESSFUL poll may journal raw frames —
+                    # the fallback NaN tick below must journal as the
+                    # full-width NaN row it actually scored
+                    tick_frames = source.take_tick_frames()
             _src_t1 = time.perf_counter()
             phase_s["source"] += _src_t1 - now
             if trace is not None:
@@ -1528,8 +1569,15 @@ def live_loop(
             if journal is not None:
                 # the write-ahead moment: the row is durable (flushed to
                 # the kernel; fsync per policy) BEFORE any scoring — a
-                # death past this point replays this tick on restart
-                journal.append_tick(journal_base + k, ts, values)
+                # death past this point replays this tick on restart.
+                # Binary ingest ticks journal their RAW wire frames
+                # (10 B/row that actually arrived) instead of the
+                # re-encoded full-width vector (ISSUE 7)
+                if tick_frames is not None:
+                    journal.append_tick_frames(journal_base + k, ts,
+                                               len(values), tick_frames)
+                else:
+                    journal.append_tick(journal_base + k, ts, values)
             if chaos is not None:
                 # proc_exit fires here — after the row is journaled, so
                 # a restart's resume base is unambiguously past it

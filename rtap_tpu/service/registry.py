@@ -424,6 +424,22 @@ class StreamGroup:
         return self.collect_chunk(self.dispatch_chunk(values, ts, learn))
 
 
+@dataclass(frozen=True)
+class SlotAddress:
+    """A stream's (shard, group, slot) address — the pod-scale
+    addressing the source layer routes by (ROADMAP-1; ISSUE 7).
+
+    ``shard`` is the device-mesh shard that owns the slot's state row
+    (0 everywhere on a single device; under a mesh the stream axis is
+    block-sharded, so shard = slot * n_shards // G). The binary ingest
+    protocol packs this triple into its wire slot code
+    (rtap_tpu/ingest/protocol.encode_slot)."""
+
+    shard: int
+    group: int
+    slot: int
+
+
 @dataclass
 class _Slot:
     group: StreamGroup
@@ -593,6 +609,35 @@ class StreamGroupRegistry:
         """Live stream ids in (group, slot) order — the value-vector order
         live_loop's routing and every source snapshot must follow."""
         return [g.stream_ids[i] for g in self.groups for i in g.live_slots()]
+
+    def slot_map(self) -> dict[str, SlotAddress]:
+        """Live stream id -> (shard, group, slot) address — what the
+        registry hands sources instead of a flat id list (ROADMAP-1).
+
+        Iterating the map in (group, slot) order reproduces
+        :meth:`dispatch_ids` exactly (pinned by
+        tests/unit/test_ingest_protocol.py), so a source that scatters
+        by address and a loop that routes positionally agree by
+        construction. Pads/released slots are absent — a wire record
+        addressed at one is an unknown, not a write."""
+        out: dict[str, SlotAddress] = {}
+        for gi, g in enumerate(self.groups):
+            n_shards = 1
+            if g.mesh is not None:
+                n_shards = int(g.mesh.devices.size)
+                from rtap_tpu.ingest.protocol import MAX_SHARDS
+
+                if n_shards > MAX_SHARDS:
+                    raise ValueError(
+                        f"mesh has {n_shards} devices but the ingest "
+                        f"slot code carries {MAX_SHARDS} shards max "
+                        "(rtap_tpu/ingest/protocol.py SHARD_BITS; a "
+                        "wider mesh needs a protocol magic bump)")
+            for slot in g.live_slots():
+                slot = int(slot)
+                out[g.stream_ids[slot]] = SlotAddress(
+                    shard=slot * n_shards // g.G, group=gi, slot=slot)
+        return out
 
     @property
     def free_slots(self) -> int:

@@ -31,6 +31,21 @@ import numpy as np
 
 from rtap_tpu.obs import get_registry
 
+__all__ = ["HttpPollSource", "TcpJsonlSource", "BinaryBatchSource",
+           "send_jsonl"]
+
+
+def __getattr__(name):
+    # The production wire-speed source lives in rtap_tpu.ingest
+    # (ISSUE 7) but belongs to this module's source family — re-export
+    # lazily so importing the JSONL sources never pays the ingest
+    # package's import.
+    if name == "BinaryBatchSource":
+        from rtap_tpu.ingest.server import BinaryBatchSource
+
+        return BinaryBatchSource
+    raise AttributeError(name)
+
 
 class HttpPollSource:
     """Poll an HTTP metrics endpoint once per tick.
@@ -172,6 +187,11 @@ class TcpJsonlSource:
         self._lock = threading.Lock()
         self._py_parse_errors = 0
         self._py_unknown_ids = 0
+        self._py_records = 0  # successes on the Python fallback path —
+        # counted like the C parser's COUNTER_PARSED so records_parsed
+        # (and rtap_obs_ingest_records_total) agree across parser
+        # backends (ISSUE 7 satellite; pre-fix the Python path returned
+        # None and the counter only moved natively)
         # track_unknown: remember the NAMES of unknown ids so serve
         # --auto-register can lazily create models for them (SURVEY.md
         # C19). Both parse paths capture names: the C parser appends them
@@ -194,7 +214,8 @@ class TcpJsonlSource:
             "--auto-register, otherwise dropped)")
         self._obs_records = obs.counter(
             "rtap_obs_ingest_records_total",
-            "successfully parsed ingest records (native parser only)")
+            "successfully parsed ingest records (JSONL records and "
+            "binary batch rows, both parser backends)")
         # Native C parse path (rtap_tpu/native/jsonl_parser.c): the whole
         # recv-chunk drain in one locked C call instead of per-record
         # json.loads + dict lookup + lock — the host core feeding 100k
@@ -257,6 +278,12 @@ class TcpJsonlSource:
                             outer._latest[i] = np.float32(rec["value"])
                             outer._latest_ts = max(outer._latest_ts,
                                                    int(rec.get("ts", 0)))
+                            # success is counted AFTER the ts conversion:
+                            # a bad ts keeps the value but counts as a
+                            # parse error, not a parsed record — the
+                            # order the C parser implements (pinned by
+                            # the native-parity fuzz)
+                            outer._py_records += 1
                     except Exception:
                         outer._py_parse_errors += 1
 
@@ -293,10 +320,11 @@ class TcpJsonlSource:
         return self._py_unknown_ids + n
 
     @property
-    def records_parsed(self) -> int | None:
-        """Successful-record count (native path only; the Python handler
-        does not count successes)."""
-        return int(self._nstate.counters[0]) if self._nstate is not None else None
+    def records_parsed(self) -> int:
+        """Successful-record count — both parser backends (a record
+        counts once its value AND ts converted, the C parser's rule)."""
+        n = int(self._nstate.counters[0]) if self._nstate is not None else 0
+        return self._py_records + n
 
     @property
     def native_active(self) -> bool:
@@ -366,10 +394,9 @@ class TcpJsonlSource:
         uk = self.unknown_ids
         self._obs_unknown_ids.inc(max(0, uk - self._obs_synced["uk"]))
         self._obs_synced["uk"] = uk
-        if self._nstate is not None:
-            n = self.records_parsed
-            self._obs_records.inc(max(0, n - self._obs_synced["rec"]))
-            self._obs_synced["rec"] = n
+        n = self.records_parsed
+        self._obs_records.inc(max(0, n - self._obs_synced["rec"]))
+        self._obs_synced["rec"] = n
         return values, ts
 
 

@@ -6,7 +6,8 @@
 #     (profiler harness included) must at least parse/compile; an
 #     import-time SyntaxError must fail CI even if no test imports the file.
 #  2. print-gate — AST-based (a line grep cannot see a multi-line call):
-#     - rtap_tpu/service/, rtap_tpu/obs/, rtap_tpu/resilience/: NO print()
+#     - rtap_tpu/service/, rtap_tpu/obs/, rtap_tpu/resilience/,
+#       rtap_tpu/ingest/: NO print()
 #       at all. Telemetry and diagnostics go through rtap_tpu.obs (registry
 #       instruments, watchdog events, snapshots) or logging, never ad-hoc
 #       stdout lines the harness would have to scrape back out of logs.
@@ -29,6 +30,7 @@ STRICT_DIRS = (
     os.path.join("rtap_tpu", "service"),
     os.path.join("rtap_tpu", "obs"),
     os.path.join("rtap_tpu", "resilience"),
+    os.path.join("rtap_tpu", "ingest"),
 )
 
 

@@ -98,7 +98,7 @@ def run_child(args) -> int:
         # the proc_exit that killed the previous incarnation)
         chaos = ChaosEngine(ChaosSpec.from_file(args.spec).shifted(base))
 
-    def source(k: int):
+    def seeded_row(k: int):
         g = base + k  # the feed depends only on the GLOBAL tick
         rng = np.random.Generator(np.random.Philox(key=(args.seed, g)))
         v = (30 + 5 * rng.random(len(ids))).astype(np.float32)
@@ -107,6 +107,28 @@ def run_child(args) -> int:
             # alert traffic too (the floor threshold alerts every tick)
             v[(g // args.spike_every) % len(ids)] += 30.0
         return v, 1_700_000_000 + g
+
+    source = seeded_row
+    if args.binary_ingest:
+        # route the SAME deterministic rows through the binary ingest
+        # path in-process (frames -> walker -> dispatch-table scatter),
+        # so the journal takes the raw-FRAME write-ahead path and every
+        # kill-9 restart replays THROUGH the frame decode — the ISSUE 7
+        # durability soak. Loopback, not a socket: the soak's verdict
+        # is bit-identity, which a paced network feeder cannot promise.
+        from rtap_tpu.ingest import BinaryBatchSource
+        from rtap_tpu.ingest.protocol import data_frame
+
+        bsrc = BinaryBatchSource(reg.slot_map(), port=None)
+        bcodes = bsrc._table.codes
+
+        def source(k: int):
+            v, ts = seeded_row(k)
+            bsrc.feed_frames([data_frame(bcodes, v, ts)])
+            return bsrc(k)
+
+        # live_loop journals raw frames when the source exposes them
+        source.take_tick_frames = bsrc.take_tick_frames
 
     stats = live_loop(
         source, reg, n_ticks=n_eff, cadence_s=args.cadence,
@@ -138,6 +160,8 @@ def child_cmd(args, workdir: str, spec: str | None) -> list[str]:
            "--journal-fsync", args.journal_fsync,
            "--spike-every", str(args.spike_every),
            "--stats-out", os.path.join(workdir, "stats.jsonl")]
+    if args.binary_ingest:
+        cmd.append("--binary-ingest")
     if spec:
         cmd += ["--spec", spec]
     return cmd
@@ -379,6 +403,13 @@ def main() -> int:
                          "exactly-once check. Silicon runs use a real "
                          "threshold + the seeded spikes")
     ap.add_argument("--journal-fsync", default="os")
+    ap.add_argument("--binary-ingest", action="store_true",
+                    help="feed every child through the RB1 binary ingest "
+                         "path (in-process loopback): the journal write-"
+                         "ahead becomes raw FRAME records and each "
+                         "restart's catch-up replays through the frame "
+                         "decode — same bit-identity + exactly-once "
+                         "verdict, over the new path (docs/INGEST.md)")
     ap.add_argument("--spike-every", type=int, default=13)
     ap.add_argument("--restart-backoff", type=float, default=0.05)
     ap.add_argument("--workdir", default=None)

@@ -689,6 +689,28 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                    "--startup-timeout", "900",
                    "--out", "reports/live_soak_health_r09.json"],
      2400.0),
+    # ---------------- round 10 (ISSUE 7: wire-speed binary ingest) -----
+    # Silicon soak at the new ingest ceiling: the same 4096x1024
+    # production shape as r9, fed through serve --ingest-port (RB1
+    # binary batch frames, one vectorized frame per feeder tick — the
+    # host-side ingest edge that bounded the 100k soak at ~102k
+    # metrics/s is off the critical path; reports/ingest_r07.json holds
+    # the host-only microbench: >=5x the JSONL TCP path, multi-M
+    # rows/s). Health + flight armed like r9 so the run doubles as the
+    # regression baseline for both; the artifact's ingest counters
+    # (frames/rows/garbage/backpressure, snapshot rtap_obs_ingest_*)
+    # say data flowed clean at cadence on silicon.
+    ("r10_ingest", [sys.executable, "scripts/live_soak.py",
+                    "--binary-ingest",
+                    "--streams", "4096", "--group-size", "1024",
+                    "--columns", "32", "--learn-every", "2",
+                    "--stagger-learn", "--ticks", "300",
+                    "--pipeline-depth", "2", "--dispatch-threads", "4",
+                    "--health",
+                    "--postmortem-dir", "hw_results/postmortems_r10",
+                    "--startup-timeout", "900",
+                    "--out", "reports/live_soak_ingest_r10.json"],
+     2400.0),
 ]
 
 

@@ -1,13 +1,17 @@
-"""Host ingest-path benchmark: native C JSONL parser vs pure Python.
+"""Host ingest-path benchmark: JSONL (native C / pure Python) vs the RB1
+binary batch protocol (socket and shared-memory ring).
 
-The chip can score ~100k metrics/s (BASELINE.json north star); the host
-core that feeds it must parse at least that many JSONL records/s while
-ALSO driving the device and computing likelihoods. This measures both
-TcpJsonlSource parse paths over a real socket (the production transport,
-including recv/locking) and in-process (parser cost alone), and writes
-reports/ingest_bench.json.
+The chip can score ~245k metrics/s (BENCH_LKG.json headline); the host
+core that feeds it must ingest at least that many records/s while ALSO
+driving the device and computing likelihoods. Per-record JSONL tops out
+near ~100k records/s end-to-end on this class of host — the binding
+edge ROADMAP item 5 names. This measures every transport over the same
+record stream on one host core and writes the comparison artifact
+(reports/ingest_r07.json is the committed ISSUE 7 gate: binary >= 1M
+rows/s parsed on the 1-core tier-1 host AND >= 5x the JSONL TCP path).
 
-    python scripts/ingest_bench.py [--records 300000] [--streams 4096]
+    python scripts/ingest_bench.py [--records 1000000] [--streams 4096]
+        [--frame-rows 4096] [--out reports/ingest_bench.json]
 """
 
 from __future__ import annotations
@@ -36,8 +40,8 @@ def make_payload(n_records: int, ids: list[str]) -> bytes:
     ).encode()
 
 
-SENTINEL = -987654.5  # distinctive final-record value; TCP ordering on the
-# single connection means seeing it implies every earlier record was parsed
+SENTINEL = -987654.5  # distinctive final-record value; in-order delivery
+# (TCP / ring FIFO) means seeing it implies every earlier record was parsed
 
 
 def socket_drive(native: bool, payload: bytes, n_records: int,
@@ -83,38 +87,193 @@ def inproc_drive(payload: bytes, n_records: int, ids: list[str]) -> dict:
     return {"records_per_sec": round(n_records / dt), "wall_s": round(dt, 3)}
 
 
+# ------------------------------------------------------------- binary ----
+
+
+def make_frames(n_records: int, slot_map: dict, ids: list[str],
+                frame_rows: int) -> list[bytes]:
+    """The same record stream as make_payload, as RB1 DATA frames."""
+    from rtap_tpu.ingest.protocol import data_frame, encode_slot
+
+    G = len(ids)
+    code_by_pos = np.array(
+        [encode_slot(a.shard, a.group, a.slot)
+         for a in (slot_map[s] for s in ids)], np.uint32)
+    idx = np.arange(n_records, dtype=np.int64)
+    codes = code_by_pos[idx % G]
+    values = (1.0 + (idx % 1000) * 0.5).astype(np.float32)
+    frames = []
+    for off in range(0, n_records, frame_rows):
+        sl = slice(off, min(off + frame_rows, n_records))
+        frames.append(data_frame(codes[sl], values[sl],
+                                 1_700_000_000 + off,
+                                 deltas=(idx[sl] - off).astype(np.uint16)))
+    return frames
+
+
+def binary_socket_drive(frames: list[bytes], n_records: int,
+                        slot_map: dict, ids: list[str]) -> dict:
+    """Full pipeline over a real socket: frame walk + CRC + decode +
+    scatter, sentinel-terminated like the JSONL drives."""
+    from rtap_tpu.ingest import BinaryBatchSource
+    from rtap_tpu.ingest.protocol import data_frame
+
+    src = BinaryBatchSource(slot_map).start()
+    code0 = src._table.codes[:1]
+    tail = data_frame(code0, np.array([SENTINEL], np.float32), 1_700_000_000)
+    try:
+        t0 = time.perf_counter()
+        with socket.create_connection(src.address, timeout=5.0) as s:
+            s.recv(1 << 20)  # MAP hello
+            for fr in frames:
+                s.sendall(fr)
+            s.sendall(tail)
+            deadline = time.time() + 600
+            done = False
+            while time.time() < deadline:
+                with src._lock:
+                    done = src._latest[0] == np.float32(SENTINEL)
+                if done:
+                    break
+                time.sleep(0.005)
+        dt = time.perf_counter() - t0
+    finally:
+        src.close()
+    if not done:
+        raise SystemExit("ingest bench: binary payload not consumed in budget")
+    return {"records_per_sec": round(n_records / dt), "wall_s": round(dt, 3)}
+
+
+def binary_inproc_drive(frames: list[bytes], n_records: int,
+                        slot_map: dict) -> dict:
+    """Decode + scatter cost alone (no socket): walker feed per frame."""
+    from rtap_tpu.ingest import BinaryBatchSource
+
+    src = BinaryBatchSource(slot_map, port=None)
+    t0 = time.perf_counter()
+    src.feed_frames(frames)
+    dt = time.perf_counter() - t0
+    assert src.records_parsed == n_records, src.records_parsed
+    return {"records_per_sec": round(n_records / dt), "wall_s": round(dt, 3)}
+
+
+def shm_drive(frames: list[bytes], n_records: int, slot_map: dict) -> dict:
+    """Shared-memory ring end-to-end: producer push + per-tick drain."""
+    from rtap_tpu.ingest import BinaryBatchSource, ShmRing
+
+    name = f"rtap_ibench_{os.getpid()}"
+    ring_bytes = 32 << 20
+    if any(len(fr) > ring_bytes for fr in frames):
+        raise SystemExit(
+            "ingest bench: a frame exceeds the shm ring capacity "
+            f"({ring_bytes} B) — lower --frame-rows")
+    src = BinaryBatchSource(slot_map, port=None, shm=name,
+                            shm_bytes=ring_bytes)
+    w = ShmRing.attach(name)
+    tick = 0
+    deadline = time.time() + 600  # same budget discipline as the
+    # socket lanes: a wedged ring must fail, not hang the bench
+    try:
+        t0 = time.perf_counter()
+        for fr in frames:
+            while not w.push(fr):
+                src(tick)  # ring full: consumer drains (backpressure)
+                tick += 1
+                if time.time() > deadline:
+                    raise SystemExit("ingest bench: shm ring wedged")
+        while src.records_parsed < n_records:
+            src(tick)
+            tick += 1
+            if time.time() > deadline:
+                raise SystemExit(
+                    "ingest bench: shm payload not consumed in budget")
+        dt = time.perf_counter() - t0
+    finally:
+        w.close()
+        src.close()
+    return {"records_per_sec": round(n_records / dt), "wall_s": round(dt, 3)}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--records", type=int, default=300_000)
+    ap.add_argument("--records", type=int, default=1_000_000)
+    ap.add_argument("--jsonl-records", type=int, default=None,
+                    help="records for the (slow) JSONL lanes; default: "
+                         "min(records, 300k) — rates are per-second "
+                         "either way")
     ap.add_argument("--streams", type=int, default=4096)
+    ap.add_argument("--frame-rows", type=int, default=8192,
+                    help="rows per RB1 DATA frame (8192 is the measured "
+                         "sweet spot on the 1-core host: fewer Python "
+                         "frame crossings per byte; producers feeding "
+                         "100k streams at 1 s send ~12 such frames/tick)")
+    ap.add_argument("--group-size", type=int, default=1024)
     ap.add_argument("--out", default=os.path.join(REPO, "reports", "ingest_bench.json"))
     args = ap.parse_args()
 
-    ids = [f"node{i // 4:04d}.m{i % 4}" for i in range(args.streams)]
-    payload = make_payload(args.records, ids)
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.service.registry import StreamGroupRegistry
 
-    native_inproc = inproc_drive(payload, args.records, ids)
-    native_sock = socket_drive(True, payload, args.records, ids)
-    python_sock = socket_drive(False, payload, args.records, ids)
+    ids = [f"node{i // 4:04d}.m{i % 4}" for i in range(args.streams)]
+    # the real registry's slot map (cpu backend: no device init; the
+    # bench is host-only by design — ISSUE 7's provable-on-host gate)
+    reg = StreamGroupRegistry(cluster_preset(),
+                              group_size=min(args.group_size, args.streams),
+                              backend="cpu")
+    for sid in ids:
+        reg.add_stream(sid)
+    reg.finalize()
+    slot_map = reg.slot_map()
+
+    n_jsonl = args.jsonl_records or min(args.records, 300_000)
+    payload = make_payload(n_jsonl, ids)
+    frames = make_frames(args.records, slot_map, ids, args.frame_rows)
+
+    native_inproc = inproc_drive(payload, n_jsonl, ids)
+    native_sock = socket_drive(True, payload, n_jsonl, ids)
+    python_sock = socket_drive(False, payload, n_jsonl, ids)
+    bin_inproc = binary_inproc_drive(frames, args.records, slot_map)
+    bin_sock = binary_socket_drive(frames, args.records, slot_map, ids)
+    shm = shm_drive(frames, args.records, slot_map)
+
+    from rtap_tpu.ingest.protocol import FrameWalker
 
     result = {
         "records": args.records,
+        "jsonl_records": n_jsonl,
         "streams": args.streams,
-        "payload_mb": round(len(payload) / 1e6, 1),
+        "frame_rows": args.frame_rows,
+        "payload_mb_jsonl": round(len(payload) / 1e6, 1),
+        "payload_mb_binary": round(sum(len(f) for f in frames) / 1e6, 1),
+        "native_walker": FrameWalker().native_active,
         "native_parser_inproc": native_inproc,
         "native_socket_end_to_end": native_sock,
         "python_socket_end_to_end": python_sock,
-        "speedup_socket": round(native_sock["records_per_sec"]
-                                / python_sock["records_per_sec"], 1),
-        "note": ("records/s through TcpJsonlSource on one host core; the "
-                 "100k-streams/s north star needs >=100k records/s of "
-                 "headroom left over for device driving + likelihood"),
+        "binary_decode_inproc": bin_inproc,
+        "binary_socket_end_to_end": bin_sock,
+        "binary_shm_ring_end_to_end": shm,
+        "speedup_jsonl_native_vs_python": round(
+            native_sock["records_per_sec"]
+            / python_sock["records_per_sec"], 1),
+        "speedup_binary_vs_jsonl_socket": round(
+            bin_sock["records_per_sec"]
+            / native_sock["records_per_sec"], 1),
+        "gate_binary_1m_rows_per_sec":
+            bin_sock["records_per_sec"] >= 1_000_000,
+        "gate_binary_5x_jsonl":
+            bin_sock["records_per_sec"]
+            >= 5 * native_sock["records_per_sec"],
+        "note": ("records/s through the live_loop source transports on one "
+                 "host core; the ISSUE 7 acceptance gate is binary >= 1M "
+                 "rows/s AND >= 5x the (native) JSONL TCP path in the "
+                 "same harness"),
     }
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result))
-    return 0
+    return 0 if (result["gate_binary_1m_rows_per_sec"]
+                 and result["gate_binary_5x_jsonl"]) else 1
 
 
 if __name__ == "__main__":

@@ -56,7 +56,7 @@ class Feeder:
     """
 
     def __init__(self, port: int, ids: list[str], cadence_s: float,
-                 churn_every: int = 0):
+                 churn_every: int = 0, binary: bool = False):
         self.port = port
         self.ids = list(ids)
         self.cadence_s = cadence_s
@@ -69,7 +69,76 @@ class Feeder:
         self.stop = threading.Event()
         self.ticks_pushed = 0
         self.error: str | None = None
-        self.thread = threading.Thread(target=self._run, daemon=True)
+        # binary: push RB1 batch frames over the persistent connection
+        # (serve --ingest-port) instead of JSONL lines — one vectorized
+        # frame per tick, no per-record formatting at all (the JSONL
+        # feeder's ~350 ms/tick json cost at the 100k shape disappears)
+        self.binary = bool(binary)
+        self.thread = threading.Thread(
+            target=self._run_binary if binary else self._run, daemon=True)
+
+    def _run_binary(self) -> None:
+        phase = None
+        try:
+            import numpy as np
+
+            from rtap_tpu.ingest.emit import BinaryFeedConnection
+            from rtap_tpu.ingest.protocol import data_frame
+            from rtap_tpu.utils.measure import make_sine_feed
+
+            conn = BinaryFeedConnection(("127.0.0.1", self.port),
+                                        timeout_s=30.0)
+            codes = None
+            pending_names: set[str] = set()
+            while not self.stop.is_set():
+                t_start = time.perf_counter()
+                ts = int(time.time())
+                chunk, _, phase = make_sine_feed(
+                    len(self.ids), 1, key=(7, 42 + self.ticks_pushed),
+                    t0=self.ticks_pushed, phase=phase,
+                )
+                if conn.poll_map():
+                    # serve pushed a fresh map (ANY membership change —
+                    # e.g. an auto-release — bumps the epoch, and stale-
+                    # epoch frames are refused whole): re-encode
+                    codes = None
+                if codes is None or len(codes) != len(self.ids):
+                    codes = np.array(
+                        [conn.code_of.get(s, -1) for s in self.ids],
+                        np.int64)
+                known = codes >= 0
+                if known.any():
+                    conn.send_frame(data_frame(
+                        codes[known].astype(np.uint32),
+                        chunk[0].astype(np.float32)[known], ts,
+                        epoch=conn.epoch))
+                self.ticks_pushed += 1
+                if self.churn_every and \
+                        self.ticks_pushed % self.churn_every == 0:
+                    ci = self.churned % len(self.ids)
+                    self.ids[ci] = f"churn{self.churned:04d}.m0"
+                    self.churned += 1
+                    pending_names.add(self.ids[ci])
+                    conn.send_names(sorted(pending_names))
+                    codes = None
+                if pending_names:
+                    # serve's membership block claims announced names at
+                    # tick boundaries; refresh the map until they appear
+                    # EVERY tick — each claim also bumps the map epoch,
+                    # and frames stamped with the old epoch are refused
+                    # (stale-code protection), so a lazy refresh here
+                    # would go deaf for real streams too
+                    conn.refresh_map()
+                    pending_names -= set(conn.code_of)
+                    codes = None
+                budget = self.cadence_s - (time.perf_counter() - t_start)
+                if budget > 0:
+                    self.stop.wait(budget)
+            conn.close()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # serve finished its tick budget and closed the listener
+        except Exception as e:  # noqa: BLE001 — recorded, surfaced, fatal
+            self.error = f"{type(e).__name__}: {e}"
 
     def _run(self) -> None:
         phase = None  # first chunk draws it; passed back for continuity
@@ -152,7 +221,8 @@ def wait_for_listener(proc: subprocess.Popen, stderr_lines: list[str],
     line -> bound port. Only THIS child's line is trusted (an orphan from a
     killed earlier attempt can answer a connect-probe; it cannot write to
     this process's pipe)."""
-    pat = re.compile(r"listening for JSONL records on \S+?:(\d+)")
+    pat = re.compile(r"listening for (?:JSONL records|binary batch frames) "
+                     r"on \S+?:(\d+)")
     deadline = time.time() + deadline_s
     while time.time() < deadline:
         for line in stderr_lines:
@@ -208,6 +278,12 @@ def main() -> int:
                          "shape)")
     ap.add_argument("--freeze", action="store_true",
                     help="passed through to serve: inference-only soak")
+    ap.add_argument("--binary-ingest", action="store_true",
+                    help="feed serve through the RB1 binary batch protocol "
+                         "(serve --ingest-port) instead of per-record "
+                         "JSONL: one vectorized frame per tick from the "
+                         "feeder, zero per-record Python on either side "
+                         "(ISSUE 7 wire-speed ingest; docs/INGEST.md)")
     ap.add_argument("--churn-every", type=int, default=0,
                     help="elastic-churn soak: every N feeder ticks, rotate "
                          "one stream id (old goes silent -> auto-released; "
@@ -264,7 +340,8 @@ def main() -> int:
     cmd = [
         sys.executable, "-m", "rtap_tpu", "serve",
         "--streams", "@" + ids_path,
-        "--port", "0",
+        *(["--ingest-port", "0"] if args.binary_ingest
+          else ["--port", "0"]),
         "--ticks", str(args.ticks),
         "--cadence", str(args.cadence),
         "--backend", args.backend,
@@ -313,7 +390,8 @@ def main() -> int:
     try:
         port = wait_for_listener(proc, stderr_lines, args.startup_timeout)
         feeder = Feeder(port, ids, args.cadence,
-                        churn_every=args.churn_every)
+                        churn_every=args.churn_every,
+                        binary=args.binary_ingest)
         feeder.thread.start()
         log(f"feeder attached on port {port}; soaking...")
         out = proc.stdout.read()  # EOF = serve exited; drain thread owns stderr
@@ -371,6 +449,7 @@ def main() -> int:
         "micro_chunk": args.micro_chunk,
         "learn_full_until": args.learn_full_until,
         "chunk_stagger": args.chunk_stagger,
+        "binary_ingest": args.binary_ingest,
         "churn_every": args.churn_every, "ids_churned": feeder.churned,
         "alert_lines": n_alert_lines,
         "event_lines": n_event_lines,

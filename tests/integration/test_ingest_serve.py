@@ -1,0 +1,277 @@
+"""Wire-speed binary ingest end-to-end (ISSUE 7): the live_loop
+equivalence proof (binary path bit-identical to the JSONL path on the
+same row sequence — state AND alert stream), the auto-register NAMES
+protocol, journal FRAME-record crash replay, and serve --ingest-port
+CLI end-to-end. (File named to sort after test_cli.py — the tier-1
+870 s window dies before it, by design; the quick tier runs it.)"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import cluster_preset
+from rtap_tpu.ingest import BinaryBatchSource, send_binary
+from rtap_tpu.ingest.emit import BinaryFeedConnection
+from rtap_tpu.ingest.protocol import data_frame
+from rtap_tpu.service.loop import live_loop
+from rtap_tpu.service.registry import StreamGroupRegistry
+from rtap_tpu.service.sources import TcpJsonlSource, send_jsonl
+
+pytestmark = pytest.mark.quick
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+G = 6
+IDS = [f"n{i // 3}.m{i % 3}" for i in range(G)]
+TICKS = 8
+
+
+def _tiny_cfg():
+    # the durability-soak idiom: the real preset, tiny G, cpu oracle
+    return cluster_preset()
+
+
+def _registry():
+    reg = StreamGroupRegistry(_tiny_cfg(), group_size=3, backend="cpu",
+                              threshold=-1e9)  # floor: densest alert file
+    for sid in IDS:
+        reg.add_stream(sid)
+    reg.finalize()
+    return reg
+
+
+def _records(k: int) -> list[dict]:
+    rng = np.random.Generator(np.random.Philox(key=(41, k)))
+    vals = (30 + 5 * rng.random(G)).astype(np.float32)
+    return [{"id": sid, "value": float(v), "ts": 1_700_000_000 + k}
+            for sid, v in zip(IDS, vals)]
+
+
+def _lockstep(src, send):
+    """Deterministic feed: push tick k's records, wait until the
+    listener applied them, then snapshot — no cadence races, so two
+    transports see byte-identical row sequences."""
+    consumed = [0]
+
+    def source(k: int):
+        recs = _records(k)
+        n = send(src.address, recs)
+        assert n == G
+        consumed[0] += G
+        deadline = time.time() + 20
+        while time.time() < deadline and src.records_parsed < consumed[0]:
+            time.sleep(0.002)
+        assert src.records_parsed == consumed[0]
+        return src(k)
+
+    source.take_tick_frames = getattr(src, "take_tick_frames", None)
+    return source
+
+
+def _run_loop(transport: str, alert_path: str, journal=None,
+              n_ticks: int = TICKS):
+    reg = _registry()
+    if transport == "jsonl":
+        src = TcpJsonlSource(IDS).start()
+        send = send_jsonl
+    else:
+        src = BinaryBatchSource(reg.slot_map()).start()
+        send = send_binary
+    try:
+        wrapper = _lockstep(src, send)
+        if wrapper.take_tick_frames is None:
+            del wrapper.take_tick_frames
+        stats = live_loop(wrapper, reg, n_ticks=n_ticks, cadence_s=0.01,
+                          alert_path=alert_path, journal=journal)
+    finally:
+        src.close()
+    return reg, stats
+
+
+def _alert_lines(path) -> list[bytes]:
+    """The alert stream minus watchdog/resilience EVENT lines: events
+    carry wall-clock payloads (elapsed_s of a missed tick) that cannot
+    be identical across two real-time runs; every scored-alert line
+    must be."""
+    with open(path, "rb") as f:
+        return [ln for ln in f if not ln.startswith(b'{"event"')]
+
+
+def test_binary_live_loop_bit_identical_to_jsonl(tmp_path):
+    """THE acceptance gate: the same row sequence through the binary
+    batch path and the per-record JSONL path yields a byte-identical
+    alert stream and bit-identical model state."""
+    reg_j, stats_j = _run_loop("jsonl", str(tmp_path / "a_jsonl.jsonl"))
+    reg_b, stats_b = _run_loop("binary", str(tmp_path / "a_bin.jsonl"))
+    assert stats_j["scored"] == stats_b["scored"] == G * TICKS
+    aj = _alert_lines(tmp_path / "a_jsonl.jsonl")
+    ab = _alert_lines(tmp_path / "a_bin.jsonl")
+    assert aj == ab and len(aj) == G * TICKS
+    # model state, bit for bit (cpu backend: numpy oracle trees)
+    for gj, gb in zip(reg_j.groups, reg_b.groups):
+        assert gj._states[0].keys() == gb._states[0].keys()
+        for sj, sb in zip(gj._states, gb._states):
+            for key in sj:
+                assert np.array_equal(np.asarray(sj[key]),
+                                      np.asarray(sb[key]),
+                                      equal_nan=True), key
+
+
+def test_journal_frame_replay_matches_uninterrupted(tmp_path):
+    """A binary-ingest serve killed mid-run resumes through the
+    journal's raw-FRAME records bit-identically: alerts exactly-once,
+    final state equal to the uninterrupted run's."""
+    from rtap_tpu.resilience.journal import TickJournal
+
+    # reference: 8 uninterrupted ticks
+    reg_ref, _ = _run_loop("binary", str(tmp_path / "ref.jsonl"))
+    # interrupted: 5 ticks journaled, then a fresh loop over the same
+    # journal replays them and runs the remaining 3 (global feed clock)
+    jdir = tmp_path / "journal"
+    j1 = TickJournal(jdir)
+    _run_loop("binary", str(tmp_path / "crash.jsonl"), journal=j1,
+              n_ticks=5)
+    j1.close()
+    j2 = TickJournal(jdir)
+    assert j2.recovered_count == 5
+    reg2 = _registry()
+    src2 = BinaryBatchSource(reg2.slot_map()).start()
+    try:
+        base = j2.next_tick
+        consumed = [0]
+
+        def source(k: int):
+            recs = _records(base + k)
+            assert send_binary(src2.address, recs) == G
+            consumed[0] += G
+            deadline = time.time() + 20
+            while time.time() < deadline \
+                    and src2.records_parsed < consumed[0]:
+                time.sleep(0.002)
+            return src2(k)
+
+        source.take_tick_frames = src2.take_tick_frames
+        stats = live_loop(source, reg2, n_ticks=TICKS - 5, cadence_s=0.01,
+                          alert_path=str(tmp_path / "crash.jsonl"),
+                          journal=j2)
+    finally:
+        src2.close()
+        j2.close()
+    assert stats["journal"]["replayed_ticks"] == 5
+    assert stats["journal"]["skipped_rows"] == 0
+    ref = _alert_lines(tmp_path / "ref.jsonl")
+    crash = _alert_lines(tmp_path / "crash.jsonl")
+    assert ref == crash  # exactly-once, content-identical
+    for gr, g2 in zip(reg_ref.groups, reg2.groups):
+        for sr, s2 in zip(gr._states, g2._states):
+            for key in sr:
+                assert np.array_equal(np.asarray(sr[key]),
+                                      np.asarray(s2[key]),
+                                      equal_nan=True), key
+
+
+def test_auto_register_via_names_frames(tmp_path):
+    """The shared membership protocol over binary: NAMES frames announce
+    unknown ids, serve-side claims hand back fresh slot codes, and the
+    producer's refreshed MAP routes rows to the claimed model."""
+    reg = StreamGroupRegistry(_tiny_cfg(), group_size=3, backend="cpu",
+                              threshold=-1e9)
+    for sid in IDS:
+        reg.add_stream(sid)
+    reg.finalize(reserve=3)
+    src = BinaryBatchSource(reg.slot_map(), track_unknown=True).start()
+    try:
+        newcomers = ["late.a", "late.b"]
+        ticks = {"k": 0}
+
+        def source(k):
+            if k == 0:
+                with BinaryFeedConnection(src.address) as conn:
+                    assert all(s not in conn.code_of for s in newcomers)
+                    conn.send_names(newcomers)
+                deadline = time.time() + 20
+                while time.time() < deadline and src.frames_applied < 2:
+                    time.sleep(0.002)
+            elif k == 2:
+                # membership changed at tick 1's head; the refreshed
+                # map must now carry the claimed codes
+                recs = [{"id": s, "value": 42.0, "ts": 1_700_000_100}
+                        for s in newcomers]
+                assert send_binary(src.address, recs) == 2
+                deadline = time.time() + 20
+                while time.time() < deadline and src.records_parsed < 2:
+                    time.sleep(0.002)
+            ticks["k"] = k
+            return src(k)
+
+        # the loop talks membership to the SOURCE object's protocol
+        # surface; a wrapper callable must carry it through
+        source.drain_unknown = src.drain_unknown
+        source.set_slot_map = src.set_slot_map
+        stats = live_loop(source, reg, n_ticks=4, cadence_s=0.01,
+                          alert_path=str(tmp_path / "a.jsonl"),
+                          auto_register=True)
+    finally:
+        src.close()
+    assert stats["auto_registered"] == 2
+    assert all(s in reg for s in newcomers)
+    assert src.records_parsed == 2 and src.rows_unknown == 0
+
+
+def test_serve_cli_ingest_port(tmp_path):
+    """serve --ingest-port end-to-end: binary listener line on stderr,
+    send_binary feeds it, the stats line carries the ingest surface."""
+    import re
+    import threading
+
+    ids = ",".join(IDS)
+    env = {**os.environ, "RTAP_FORCE_CPU": "1",
+           "RTAP_OBS_SNAPSHOT": str(tmp_path / "obs.jsonl")}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "rtap_tpu", "serve", "--streams", ids,
+         "--ingest-port", "0", "--ingest-quota", "50",
+         "--backend", "cpu", "--ticks", "4", "--cadence", "0.2",
+         "--group-size", "3", "--threshold", "-1000000000.0",
+         "--debounce", "1",
+         "--alerts", str(tmp_path / "alerts.jsonl")],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env=env)
+    stderr_lines: list[str] = []
+    drain = threading.Thread(
+        target=lambda: stderr_lines.extend(iter(proc.stderr.readline, "")),
+        daemon=True)
+    drain.start()
+    port = None
+    deadline = time.time() + 120
+    pat = re.compile(r"listening for binary batch frames on \S+?:(\d+)")
+    while time.time() < deadline and port is None:
+        for line in stderr_lines:
+            m = pat.search(line)
+            if m:
+                port = int(m.group(1))
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"serve died rc={proc.returncode}: {''.join(stderr_lines)}")
+        time.sleep(0.05)
+    assert port is not None, "".join(stderr_lines)
+    pushed = 0
+    t_end = time.time() + 10
+    while proc.poll() is None and time.time() < t_end:
+        pushed += send_binary(("127.0.0.1", port), _records(pushed))
+        time.sleep(0.1)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == 0, "".join(stderr_lines)
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert stats["ticks"] == 4
+    assert stats["records_parsed"] > 0
+    assert stats["frames_applied"] > 0
+    assert stats["native_active"] in (True, False)
+    assert stats["rows_quota_dropped"] == 0
+    assert stats["alerts"] > 0
+    assert (tmp_path / "alerts.jsonl").exists()

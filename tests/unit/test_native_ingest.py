@@ -162,15 +162,19 @@ def _drive(native: bool) -> tuple[np.ndarray, int, int, int]:
                     break
             time.sleep(0.02)
         values, ts = src(0)
-    return values, ts, src.parse_errors, src.unknown_ids
+    return values, ts, src.parse_errors, src.unknown_ids, src.records_parsed
 
 
 @needs_native
 def test_socket_parity_native_vs_python():
-    v_n, ts_n, pe_n, unk_n = _drive(native=True)
-    v_p, ts_p, pe_p, unk_p = _drive(native=False)
+    v_n, ts_n, pe_n, unk_n, rec_n = _drive(native=True)
+    v_p, ts_p, pe_p, unk_p, rec_p = _drive(native=False)
     assert np.array_equal(v_n, v_p, equal_nan=True)
     assert (ts_n, pe_n, unk_n) == (ts_p, pe_p, unk_p) == (ts_p, 1, 1)
+    # ISSUE 7 satellite: success counting must agree across parser
+    # backends (the Python fallback used to return None and starve
+    # rtap_obs_ingest_records_total)
+    assert rec_n == rec_p == 501
 
 
 @needs_native
@@ -240,11 +244,14 @@ def test_socket_parity_fuzz(seed):
                         break
                 time.sleep(0.01)
             values, ts = src(0)
-        results.append((values, ts, src.parse_errors, src.unknown_ids))
-    (v_n, ts_n, pe_n, unk_n), (v_p, ts_p, pe_p, unk_p) = results
+        results.append((values, ts, src.parse_errors, src.unknown_ids,
+                        src.records_parsed))
+    (v_n, ts_n, pe_n, unk_n, rec_n), (v_p, ts_p, pe_p, unk_p, rec_p) \
+        = results
     assert np.array_equal(v_n, v_p, equal_nan=True)
-    assert (ts_n, pe_n, unk_n) == (ts_p, pe_p, unk_p)
+    assert (ts_n, pe_n, unk_n, rec_n) == (ts_p, pe_p, unk_p, rec_p)
     assert pe_n > 0 and unk_n > 0  # the fuzz actually exercised both paths
+    assert rec_n > 0  # and the success counter, on BOTH backends
 
 
 @needs_native
@@ -308,6 +315,22 @@ def test_python_fallback_forced():
             time.sleep(0.02)
         values, ts = src(0)
     assert values[0] == np.float32(3.5) and ts == 9
+    assert src.records_parsed == 1  # counted on the fallback path too
+
+
+def test_python_fallback_bad_ts_keeps_value_not_counted():
+    """The C parser's ordering rule on the Python path: a bad ts keeps
+    the value (written first) but the record counts as a parse error,
+    never a parsed success — backends must agree on BOTH tallies."""
+    src = TcpJsonlSource(["x"], native=False)
+    with src:
+        send_jsonl(src.address, [{"id": "x", "value": 5, "ts": "xx"}])
+        deadline = time.time() + 5
+        while time.time() < deadline and src.parse_errors < 1:
+            time.sleep(0.02)
+        values, _ = src(0)
+    assert values[0] == np.float32(5.0)
+    assert src.records_parsed == 0 and src.parse_errors == 1
 
 
 @needs_native
