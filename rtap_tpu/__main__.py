@@ -155,6 +155,28 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                   f"{journal.truncated_bytes} bytes, "
                   f"{journal.dropped_segments} dropped segment(s)) — "
                   "continuing from the last valid record", file=sys.stderr)
+    # hot-standby replication + leadership lease (ISSUE 8,
+    # docs/RESILIENCE.md failover runbook). The lease is constructed
+    # here; a LEADER acquires it now (refusing to start split-brained),
+    # a STANDBY only watches it until promotion.
+    lease = None
+    if args.lease_file:
+        from rtap_tpu.resilience.replicate import Lease
+
+        lease = Lease(args.lease_file,
+                      owner=f"{os.uname().nodename}:{os.getpid()}",
+                      timeout_s=args.lease_timeout)
+        if not args.standby:
+            if not lease.try_acquire():
+                print(f"serve: lease {args.lease_file} is held by "
+                      f"{lease.holder()!r} and fresh — refusing to serve "
+                      "split-brained (start this process with --standby, "
+                      "or wait out the lease timeout)", file=sys.stderr)
+                return 2
+            # liveness = process alive, not tick-loop fast: the
+            # heartbeat keeps the lease fresh through multi-second
+            # synchronous work (checkpoint rounds)
+            lease.start_heartbeat()
     # (--columns + --preset nab rejected in main() before backend init)
     cfg = nab_preset() if args.preset == "nab" else _sized_cluster(args)
     cfg = _apply_cadence(cfg, args)
@@ -174,6 +196,77 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     for sid in ids:
         grp.add_stream(sid)
     grp.finalize(reserve=reserve)
+    # orderly shutdown: SIGTERM/SIGINT finish the current tick (or end a
+    # standby's follow loop), save final state, and still print stats —
+    # installed BEFORE the standby block so a follow loop is stoppable
+    import signal
+    import threading
+
+    stop = threading.Event()
+    prev = {}
+
+    def _on_signal(*_):
+        stop.set()
+        # restore the previous handlers so a SECOND signal force-exits —
+        # a tick wedged on the device must not make the process
+        # unkillable except by SIGKILL
+        for s, h in prev.items():
+            signal.signal(s, h)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, _on_signal)
+    resume_sup = None
+    follower = None
+    if args.standby:
+        # hot standby (ISSUE 8): mirror the leader's journal stream,
+        # keep model state warm at the live edge, promote on lease
+        # loss — then fall through into normal (leader) serving below
+        from rtap_tpu.resilience.replicate import StandbyFollower
+
+        follower = StandbyFollower(
+            grp, journal, lease=lease, port=args.replicate_listen,
+            alert_path=args.alerts, checkpoint_dir=args.checkpoint_dir,
+            learn=not args.freeze, cadence_s=args.cadence,
+            stop_event=stop)
+        print(f"serve: standby following on port "
+              f"{args.replicate_listen} (lease {args.lease_file}, "
+              f"timeout {args.lease_timeout}s)", file=sys.stderr)
+        outcome = follower.run()
+        if outcome == "stopped":
+            for sig, handler in prev.items():
+                signal.signal(sig, handler)
+            journal.close()
+            print(json.dumps({"standby": follower.stats(),
+                              "stopped": True}))
+            return 0
+        # promoted: the follower checkpointed the warm fleet and
+        # spliced the alert stream; serve the REMAINING budget as the
+        # leader (the resume machinery below picks it all up)
+        base = max(journal.next_tick, 0)
+        if args.checkpoint_dir:
+            from rtap_tpu.service.checkpoint import peek_resume_ticks
+
+            base = max(base, peek_resume_ticks(args.checkpoint_dir))
+        n_ticks_eff = max(0, args.ticks - base)
+        resume_sup = follower.resume_suppression
+        lease.start_heartbeat()
+        print(f"serve: standby PROMOTED to leader at tick {base} "
+              f"(lease epoch {lease.epoch}, detected in "
+              f"{follower.promote_detect_s:.3f}s; {n_ticks_eff} ticks "
+              "remain)", file=sys.stderr)
+    sender = None
+    if args.replicate_to:
+        from rtap_tpu.resilience.replicate import ReplicationSender
+
+        host, _sep, port_s = args.replicate_to.rpartition(":")
+        sender = ReplicationSender(
+            (host or "127.0.0.1", int(port_s)), journal,
+            checkpoint_dir=args.checkpoint_dir, chaos=chaos).start()
+        journal.tee = sender.tee
+        journal.compact_floor = sender.compact_floor
+        print(f"serve: replicating journal appends to "
+              f"{args.replicate_to} (bounded buffer, drop-oldest)",
+              file=sys.stderr)
     if args.http:
         source = HttpPollSource(args.http, ids,
                                 track_unknown=args.auto_register)
@@ -278,25 +371,13 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
               file=sys.stderr)
     obs_snapshot = args.obs_snapshot or default_snapshot_path()
-    # orderly shutdown: SIGTERM/SIGINT finish the current tick, save a
-    # final checkpoint (with --checkpoint-dir), and still print the stats
-    # line — an evicted service must not lose state or exit silently
-    import signal
-    import threading
-
-    stop = threading.Event()
-    prev = {}
-
-    def _on_signal(*_):
-        stop.set()
-        # restore the previous handlers so a SECOND signal force-exits —
-        # a tick wedged on the device must not make the process
-        # unkillable except by SIGKILL
-        for s, h in prev.items():
-            signal.signal(s, h)
-
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        prev[sig] = signal.signal(sig, _on_signal)
+    if lease is not None and hasattr(source, "announce_leader") \
+            and getattr(source, "address", None) is not None:
+        # the lease advertises this leader's RB1 ingest address so a
+        # fenced predecessor can re-point its producers (the MAP
+        # __leader__ push — docs/INGEST.md)
+        lhost, lport = source.address
+        lease.set_meta(ingest=f"{lhost}:{lport}")
     jax_tracing = False
     if args.jax_trace:
         # device-side XLA trace paired with the host span timeline: the
@@ -329,7 +410,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                               trace=trace, flight=flight,
                               attributor=attributor,
                               journal=journal,
-                              health=health)
+                              health=health,
+                              lease=lease,
+                              resume_suppression=resume_sup)
         except BaseException as e:  # noqa: BLE001 — dump, then re-raise
             # crash black-box: an exception escaping serve dumps a
             # postmortem bundle BEFORE the traceback, so a dead soak
@@ -342,6 +425,14 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                     "error": f"{type(e).__name__}: {e}"})
                 flight.dump("unhandled_exception")
             raise
+        if stats.get("fenced") and lease is not None:
+            # fenced out by a promoted standby: re-point any connected
+            # RB1 producers at the new leader BEFORE the source closes
+            hint = lease.holder_meta().get("ingest")
+            if hint and hasattr(source, "announce_leader"):
+                source.announce_leader(hint)
+                print(f"serve: pushed MAP re-point to new leader {hint}",
+                      file=sys.stderr)
     finally:
         if jax_tracing:
             try:
@@ -354,7 +445,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         for sig, handler in prev.items():
             signal.signal(sig, handler)
         close()
+        if sender is not None:
+            sender.close()
+        if lease is not None:
+            lease.stop_heartbeat()
         if journal is not None:
+            journal.tee = None
             journal.close()
         if obs_server is not None:
             obs_server.close()
@@ -393,6 +489,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         v = getattr(source, attr, None)
         if v is not None:
             stats[attr] = v
+    if sender is not None:
+        stats["replication"] = sender.stats()
+    if args.standby:
+        stats["promoted_from_standby"] = True
+        stats["promote_detect_s"] = round(follower.promote_detect_s, 3)
+        stats["standby"] = follower.stats()
+    if stats.get("fenced"):
+        from rtap_tpu.resilience.replicate import FENCED_RC
+
+        print(f"serve: FENCED by {lease.holder()!r} at epoch "
+              f"{lease.holder_meta().get('epoch')} — exiting rc "
+              f"{FENCED_RC}", file=sys.stderr)
+        print(json.dumps(stats))
+        return FENCED_RC
     print(json.dumps(stats))
     return 0
 
@@ -625,6 +735,44 @@ def main(argv: list[str] | None = None) -> int:
                    help="supervisor restart backoff base seconds (doubles "
                         "per consecutive fast death, capped at 30 s; a "
                         "child that stayed up >= 60 s resets the exponent)")
+    p.add_argument("--replicate-to", default=None, metavar="HOST:PORT",
+                   help="hot-standby replication (docs/RESILIENCE.md "
+                        "failover runbook): tee every journal append — "
+                        "the exact CRC-framed record bytes — to a "
+                        "standby serve listening there, through a "
+                        "bounded drop-oldest buffer (a slow standby "
+                        "never stalls the tick; rtap_obs_repl_* sizes "
+                        "the lag). Needs --journal-dir; journal "
+                        "compaction pauses at the standby's ack while "
+                        "one is connected")
+    p.add_argument("--standby", action="store_true",
+                   help="run as the hot standby: listen for a leader's "
+                        "replication stream (--replicate-listen), apply "
+                        "every shipped tick through the normal scoring "
+                        "path (bit-identical warm state), emit nothing, "
+                        "and PROMOTE to leader when the lease goes "
+                        "stale — splicing the alert stream exactly-once "
+                        "and serving the remaining --ticks budget. "
+                        "Needs --replicate-listen, --journal-dir, "
+                        "--checkpoint-dir, and --lease-file")
+    p.add_argument("--replicate-listen", type=int, default=None,
+                   help="standby replication listen port (0 = ephemeral)")
+    p.add_argument("--lease-file", default=None,
+                   help="leadership lease file (shared storage): the "
+                        "leader's heartbeat thread refreshes it at "
+                        "timeout/3; a standby "
+                        "promotes when it goes stale, bumping the "
+                        "monotonic fencing epoch — a paused old leader "
+                        "that wakes up is fenced out of the alert sink "
+                        "and exits rc 7 (docs/RESILIENCE.md)")
+    p.add_argument("--lease-timeout", type=float, default=5.0,
+                   help="seconds without a lease refresh before a "
+                        "standby declares the leader dead and promotes "
+                        "(staleness must persist an extra timeout/2 — "
+                        "single starved heartbeat reads never false-"
+                        "promote; detection ~= 1.5x timeout, so keep "
+                        "the timeout <= ~5 cadences for a 10-tick "
+                        "takeover budget)")
     p.add_argument("--learn-every", type=int, default=1,
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "
@@ -954,6 +1102,69 @@ def main(argv: list[str] | None = None) -> int:
             and not getattr(args, "ingest_shm", None):
         print("serve: --ingest-quota/--ingest-backfill-horizon are binary-"
               "ingest admission knobs; add --ingest-port or --ingest-shm",
+              file=sys.stderr)
+        return 2
+    if getattr(args, "replicate_to", None) and not getattr(args, "journal_dir", None):
+        print("serve: --replicate-to ships the write-ahead journal — add "
+              "--journal-dir", file=sys.stderr)
+        return 2
+    if getattr(args, "replicate_to", None) and not getattr(args, "lease_file", None) \
+            and not getattr(args, "standby", False):
+        print("serve: --replicate-to needs --lease-file — a leader "
+              "without the lease cannot be fenced, and its standby "
+              "(which requires the lease) would find it absent and "
+              "promote immediately: two live leaders on one alert sink",
+              file=sys.stderr)
+        return 2
+    if getattr(args, "replicate_to", None) \
+            and not getattr(args, "checkpoint_dir", None):
+        print("serve: --replicate-to needs --checkpoint-dir — the "
+              "shared checkpoint dir is the reconnect-after-gap "
+              "fallback (a standby whose position was compacted or "
+              "evicted out of the journal resyncs from it) and the "
+              "promotion target", file=sys.stderr)
+        return 2
+    if getattr(args, "standby", False):
+        missing = [f for f, v in (
+            ("--replicate-listen", args.replicate_listen is not None),
+            ("--journal-dir", bool(args.journal_dir)),
+            ("--checkpoint-dir", bool(args.checkpoint_dir)),
+            ("--lease-file", bool(args.lease_file)),
+        ) if not v]
+        if missing:
+            print(f"serve: --standby needs {', '.join(missing)} (the "
+                  "standby mirrors the journal, promotes from the shared "
+                  "checkpoint dir, and watches the lease)", file=sys.stderr)
+            return 2
+        if args.supervise:
+            print("serve: --standby under --supervise is unsupported — "
+                  "supervise the PAIR from scripts/failover_soak.py "
+                  "instead (roles swap across restarts)", file=sys.stderr)
+            return 2
+    if (getattr(args, "standby", False)
+            or getattr(args, "replicate_to", None)) and (
+            getattr(args, "auto_register", False)
+            or getattr(args, "auto_release_after", 0)):
+        print("serve: replication requires a FIXED fleet — "
+              "--auto-register/--auto-release-after change membership "
+              "mid-stream and the standby's slot addressing would "
+              "diverge (elastic membership under replication is future "
+              "work)", file=sys.stderr)
+        return 2
+    if (getattr(args, "standby", False)
+            or getattr(args, "replicate_to", None)) \
+            and getattr(args, "alert_attribution", False):
+        print("serve: --alert-attribution under replication is "
+              "unsupported — the standby buffers would-be alert lines "
+              "WITHOUT the attributor's routing history, so a "
+              "post-failover splice could not stay byte-identical to "
+              "the leader's stream (attribution under replication is "
+              "future work)", file=sys.stderr)
+        return 2
+    if getattr(args, "replicate_listen", None) is not None \
+            and not getattr(args, "standby", False):
+        print("serve: --replicate-listen is the standby's listen port — "
+              "add --standby (the leader side uses --replicate-to)",
               file=sys.stderr)
         return 2
     if getattr(args, "freeze", False) and getattr(args, "auto_register", False):

@@ -44,7 +44,18 @@ class BinaryFeedConnection:
         self.code_of: dict[str, int] = {}
         self.epoch = 0  # the map's epoch; stamped into every DATA frame
         # so the listener can refuse frames built from a stale map
+        self.leader_hint: str | None = None  # "host:port" of the NEW
+        # leader, when the listener we are talking to lost a failover
+        # (ISSUE 8 __leader__ MAP field); send_binary follows it
         self._read_map()
+
+    def _adopt_map(self, fr) -> None:
+        blob = json.loads(bytes(fr.payload))
+        self.epoch = int(blob.pop("__epoch__", 0))
+        hint = blob.pop("__leader__", None)
+        if hint:
+            self.leader_hint = str(hint)
+        self.code_of = {k: int(v) for k, v in blob.items()}
 
     def _read_map(self) -> None:
         # the constructor's timeout governs every wait on this socket —
@@ -55,9 +66,7 @@ class BinaryFeedConnection:
                 raise ConnectionError("listener closed before MAP frame")
             for fr in self._walker.feed(data):
                 if fr.kind == KIND_MAP and fr.count:
-                    blob = json.loads(bytes(fr.payload))
-                    self.epoch = int(blob.pop("__epoch__", 0))
-                    self.code_of = {k: int(v) for k, v in blob.items()}
+                    self._adopt_map(fr)
                     return
 
     def refresh_map(self) -> None:
@@ -85,10 +94,7 @@ class BinaryFeedConnection:
                     raise ConnectionError("listener closed")
                 for fr in self._walker.feed(data):
                     if fr.kind == KIND_MAP and fr.count:
-                        blob = json.loads(bytes(fr.payload))
-                        self.epoch = int(blob.pop("__epoch__", 0))
-                        self.code_of = {k: int(v)
-                                        for k, v in blob.items()}
+                        self._adopt_map(fr)
                         changed = True
         finally:
             self._sock.settimeout(prev_timeout)
@@ -178,11 +184,25 @@ def send_binary(address, records, retry=None, tenant: str = "") -> int:
     delivered = 0
     sent_names = False
     next_batch = 0
+    redirected = False
     batches = [records[i:i + _SEND_BATCH]
                for i in range(0, len(records), _SEND_BATCH)]
-    for attempt in range(1, retry.attempts + 1):
+    attempt = 0
+    while attempt < retry.attempts:
         try:
             with BinaryFeedConnection(address, tenant=tenant) as conn:
+                if conn.leader_hint and not redirected:
+                    # the listener lost a failover and named its
+                    # successor (ISSUE 8): re-point ONCE — the hinted
+                    # leader's own map is authoritative from here on.
+                    # A successful control exchange, NOT a failure: it
+                    # must not burn a retry attempt (a hint on the last
+                    # attempt still gets its shot at the new leader)
+                    host, _sep, port = conn.leader_hint.rpartition(":")
+                    if host and port.isdigit():
+                        address = (host, int(port))
+                        redirected = True
+                        continue
                 if not sent_names:
                     unknown = sorted({str(r["id"]) for r in records
                                       if r["id"] not in conn.code_of})
@@ -205,7 +225,8 @@ def send_binary(address, records, retry=None, tenant: str = "") -> int:
                     next_batch += 1
             return delivered
         except OSError:
-            if attempt == retry.attempts:
+            attempt += 1
+            if attempt >= retry.attempts:
                 return delivered
             retry.backoff(attempt)
     return delivered

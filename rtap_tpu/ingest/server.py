@@ -44,6 +44,7 @@ vector instead, so journal replay is bit-identical either way.
 from __future__ import annotations
 
 import json
+import socket as socket_module
 import socketserver
 import threading
 import time
@@ -113,6 +114,10 @@ class BinaryBatchSource:
         # still sending with a cached map goes loudly deaf instead of
         # feeding a re-claimed slot's NEW stream (docs/INGEST.md)
         self._map_epoch = 1
+        #: failover redirect (ISSUE 8): when this serve loses leadership
+        #: the MAP gains "__leader__": "host:port" naming who producers
+        #: should reconnect to (announce_leader); None = we are it
+        self._leader_addr: str | None = None
         self._map_blob = self._render_map()
         # accounting (ints, mirrored into the registry instruments below)
         self.rows_applied = 0
@@ -202,23 +207,47 @@ class BinaryBatchSource:
         # replies vs membership pushes share sockets across threads; an
         # interleaved sendall would tear frames on the wire)
         self._send_lock = threading.Lock()
+        #: live handler threads, for the deterministic close() join —
+        #: socketserver's own daemon_threads bookkeeping does not track
+        #: daemon handlers, and a handler blocked in recv() would
+        #: otherwise outlive close() nondeterministically (the conftest
+        #: no-leaked-thread fixture's flake mode under repeated
+        #: open/close in tests)
+        self._handler_threads: set = set()
+        self._closing = False  # close() raises it BEFORE joining: even a
+        # handler that connected in the shutdown race (registered after
+        # the join snapshot, socket never woken) exits within one recv
+        # timeout instead of blocking forever
         if port is not None:
             outer = self
 
             class Handler(socketserver.BaseRequestHandler):
                 def handle(self):
-                    # hello: the current id -> slot-code map, so the
-                    # producer can encode without out-of-band config
-                    try:
-                        outer._send_map(self.request)
-                    except OSError:
-                        return
                     with outer._lock:
-                        outer._conns.add(self.request)
-                    walker = outer._new_walker()
+                        outer._handler_threads.add(threading.current_thread())
+                    walker = None
+                    # ONE finally owns the bookkeeping for every exit
+                    # path, including a hello that fails before the
+                    # loop (a connect-then-die producer must not leak
+                    # its thread entry forever)
                     try:
+                        # hello: the current id -> slot-code map, so the
+                        # producer can encode without out-of-band config
+                        try:
+                            outer._send_map(self.request)
+                        except OSError:
+                            return
+                        with outer._lock:
+                            outer._conns.add(self.request)
+                        walker = outer._new_walker()
+                        self.request.settimeout(0.5)
                         while True:
-                            data = self.request.recv(1 << 20)
+                            try:
+                                data = self.request.recv(1 << 20)
+                            except socket_module.timeout:
+                                if outer._closing:
+                                    break
+                                continue  # idle producer: keep waiting
                             if not data:
                                 break
                             frames = walker.feed(data)
@@ -236,7 +265,10 @@ class BinaryBatchSource:
                     finally:
                         with outer._lock:
                             outer._conns.discard(self.request)
-                        outer._drop_walker(walker)
+                            outer._handler_threads.discard(
+                                threading.current_thread())
+                        if walker is not None:
+                            outer._drop_walker(walker)
 
             class Server(socketserver.ThreadingTCPServer):
                 allow_reuse_address = True
@@ -254,8 +286,29 @@ class BinaryBatchSource:
         return self
 
     def close(self) -> None:
+        """Deterministic shutdown: stop accepting, WAKE every handler
+        (socket shutdown makes its blocking recv return b"" — the
+        wakeup), then join the accept thread and every handler thread
+        with a bounded wait. Repeated open/close in one process (the
+        test suite's pattern) leaves no thread behind to trip the
+        conftest no-leaked-thread fixture; threads stay daemonized so a
+        truly wedged one still cannot hang interpreter exit."""
         if self._server is not None:
-            self._server.shutdown()
+            self._closing = True
+            if self._thread is not None and self._thread.is_alive():
+                self._server.shutdown()  # unblocks serve_forever
+            with self._lock:
+                conns = list(self._conns)
+                handlers = list(self._handler_threads)
+            for sock in conns:
+                try:
+                    sock.shutdown(socket_module.SHUT_RDWR)
+                except OSError:
+                    pass
+            if self._thread is not None and self._thread.is_alive():
+                self._thread.join(timeout=5.0)
+            for t in handlers:
+                t.join(timeout=5.0)
             self._server.server_close()
         if self._ring is not None:
             self._ring.close()
@@ -298,8 +351,32 @@ class BinaryBatchSource:
     # ---- membership (the registry slot-map protocol) -----------------
     def _render_map(self) -> bytes:
         return json.dumps({"__epoch__": self._map_epoch,
+                           **({"__leader__": self._leader_addr}
+                              if self._leader_addr else {}),
                            **self._table.code_of},
                           separators=(",", ":")).encode("utf-8")
+
+    def announce_leader(self, addr: str) -> None:
+        """Failover re-point (ISSUE 8): a FENCED old leader pushes a MAP
+        naming the new leader's ingest address and bumping the epoch, so
+        every connected RB1 producer both goes loudly deaf here (stale
+        epoch) and learns where to reconnect
+        (BinaryFeedConnection.leader_hint; send_binary follows the
+        redirect). Best-effort: producers whose connection already died
+        learn the same thing from their reconnect failing."""
+        with self._lock:
+            self._leader_addr = str(addr)
+            self._map_epoch = self._map_epoch % 0xFFFF + 1
+            self._map_blob = self._render_map()
+            conns = list(self._conns)
+            blob = self._map_blob
+        frame = build_frame(KIND_MAP, blob)
+        with self._send_lock:
+            for sock in conns:
+                try:
+                    sock.sendall(frame)
+                except OSError:
+                    pass
 
     def _send_map(self, sock) -> None:
         with self._lock:

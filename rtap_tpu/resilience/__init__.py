@@ -13,7 +13,12 @@ than trusted (chaos.py; ``scripts/chaos_soak.py``, ``serve
 torn-write-tolerant recovery (journal.py, ``serve --journal-dir``) and a
 :class:`Supervisor` that restarts a dead serve child with backoff and a
 budget (supervisor.py, ``serve --supervise``;
-``scripts/crash_soak.py`` is the kill-9 acceptance soak). Group
+``scripts/crash_soak.py`` is the kill-9 acceptance soak). Availability
+(ISSUE 8) lives in replicate.py: journal shipping to a hot standby,
+a file lease with a monotonic fencing epoch, and promotion with an
+exactly-once alert-stream splice (``serve --replicate-to`` /
+``serve --standby``; ``scripts/failover_soak.py`` is the kill-9
+failover acceptance soak). Group
 quarantine itself lives in service/loop.py — it is
 loop scheduling — but emits the resilience event vocabulary documented in
 docs/RESILIENCE.md.
@@ -36,10 +41,17 @@ from rtap_tpu.resilience.journal import (
     parse_fsync,
 )
 from rtap_tpu.resilience.policies import CircuitBreaker, CircuitOpenError, Retry
+from rtap_tpu.resilience.replicate import (
+    FENCED_RC,
+    Lease,
+    ReplicationSender,
+    StandbyFollower,
+)
 from rtap_tpu.resilience.supervisor import Supervisor, strip_supervise_flags
 
 __all__ = [
     "FAULT_KINDS",
+    "FENCED_RC",
     "GENERATED_KINDS",
     "LADDER",
     "PROC_EXIT_CODE",
@@ -50,7 +62,10 @@ __all__ = [
     "CircuitOpenError",
     "DegradationController",
     "Fault",
+    "Lease",
+    "ReplicationSender",
     "Retry",
+    "StandbyFollower",
     "Supervisor",
     "TickJournal",
     "count_journal_ticks",

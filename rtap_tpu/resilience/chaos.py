@@ -32,6 +32,13 @@ Fault vocabulary (``Fault.kind``):
   ``scripts/chaos_soak.py --supervise`` runs this under the supervisor
   + journal recovery path). Excluded from seed-GENERATED schedules
   (it would kill the generating test run); schedule it explicitly.
+- ``conn_drop`` / ``stall_socket`` / ``corrupt_bytes`` — network faults
+  on the byte-stream edges (ISSUE 8): the replication channel and the
+  binary-ingest wire consult ``ChaosEngine.on_wire`` per shipped
+  record. Excluded from the default generated draw (they fire only
+  where a wire seam exists, and adding them would shift existing
+  seeds' digests); ``scripts/chaos_soak.py --replication`` exercises
+  them against a live leader/standby pair.
 
 A fault is active for ticks ``[tick, tick + duration)``. Group-targeted
 kinds apply to every group when ``group`` is None. The engine logs every
@@ -66,13 +73,26 @@ FAULT_KINDS = (
     "alert_sink_oserror",
     "checkpoint_oserror",
     "proc_exit",
+    # network fault kinds for the byte-stream edges (ISSUE 8): the
+    # replication channel and the binary-ingest wire share one seam —
+    # ChaosEngine.on_wire(tick, data) — so both paths prove the same
+    # recovery vocabulary (CRC skip + resync/backfill, reconnect,
+    # bounded-buffer non-stall)
+    "conn_drop",      # the wire send raises ConnectionResetError
+    "stall_socket",   # the wire send blocks `seconds` (slow peer)
+    "corrupt_bytes",  # bytes flip in flight (CRC must catch, never apply)
 )
 
-#: kinds the seed-generator may draw (proc_exit kills the process — it
-#: must be scheduled explicitly, never rolled into an in-process soak);
-#: keeping generated schedules proc_exit-free also keeps every pre-ISSUE-5
-#: seed's schedule byte-identical (digest-stable)
-GENERATED_KINDS = tuple(k for k in FAULT_KINDS if k != "proc_exit")
+#: kinds NOT in the default generated draw, in addition to keeping every
+#: pre-ISSUE-5 seed's schedule byte-identical (digest-stable):
+#: - proc_exit kills the process (ISSUE 5 — schedule it explicitly);
+#: - the ISSUE 8 wire kinds only fire where a wire seam consults the
+#:   engine (replication sender / binary feeders) — generating them
+#:   into a plain serve schedule would inject nothing, and adding them
+#:   to the draw would shift every existing seed's digest. Pass
+#:   kinds=(..., "corrupt_bytes", ...) to generate() to draw them.
+_UNGENERATED = ("proc_exit", "conn_drop", "stall_socket", "corrupt_bytes")
+GENERATED_KINDS = tuple(k for k in FAULT_KINDS if k not in _UNGENERATED)
 
 #: exit code of an injected proc_exit death (distinguishable from real
 #: crashes and from SIGKILL in supervisor logs)
@@ -242,6 +262,11 @@ class ChaosEngine:
         self._by_kind: dict[str, list[Fault]] = {}
         for f in spec.faults:
             self._by_kind.setdefault(f.kind, []).append(f)
+        #: wire faults fire ONCE per scheduled Fault: the wire RETRIES
+        #: the same record after a fault (reconnect + backfill, resync
+        #: after a CRC skip), so a window that re-fired on the retry
+        #: would be a permanent outage, not an injected fault
+        self._wire_fired: set[int] = set()
 
     def set_tick(self, tick: int) -> None:
         """The loop's current tick — timestamps injections that happen
@@ -293,6 +318,38 @@ class ChaosEngine:
         if self._find("checkpoint_oserror", tick, group) is not None:
             self._record("checkpoint_oserror", tick, group)
             raise OSError(28, "chaos: no space left on device")
+
+    def on_wire(self, tick: int, data: bytes) -> bytes:
+        """The byte-stream wire seam (ISSUE 8): consulted per shipped
+        record by the replication sender (resilience/replicate.py) and
+        by binary-ingest feeders that opt in. Keyed by the RECORD's
+        tick, not the wall clock, so a seeded schedule is an exact
+        reproducer. May block (``stall_socket`` — the leader's tick
+        must not stall, which is the bounded-buffer property this
+        proves), raise (``conn_drop`` — reconnect + journal backfill),
+        or return corrupted bytes (``corrupt_bytes`` — the receiver's
+        CRC walker must skip, never apply, and resync via its gap
+        request)."""
+        f = self._find("stall_socket", tick)
+        if f is not None and id(f) not in self._wire_fired:
+            self._wire_fired.add(id(f))
+            self._record("stall_socket", tick)
+            time.sleep(f.seconds)
+        f = self._find("conn_drop", tick)
+        if f is not None and id(f) not in self._wire_fired:
+            self._wire_fired.add(id(f))
+            self._record("conn_drop", tick)
+            raise ConnectionResetError(
+                f"chaos: wire connection dropped (tick {tick})")
+        f = self._find("corrupt_bytes", tick)
+        if f is not None and id(f) not in self._wire_fired:
+            self._wire_fired.add(id(f))
+            self._record("corrupt_bytes", tick)
+            out = bytearray(data)
+            if out:
+                out[len(out) // 2] ^= 0xFF  # deterministic single flip
+            return bytes(out)
+        return data
 
     def on_tick_ingested(self, tick: int) -> None:
         """Called right after the tick's row was ingested (and journaled,

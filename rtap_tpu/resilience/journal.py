@@ -69,7 +69,8 @@ import numpy as np
 from rtap_tpu.obs import get_registry
 
 __all__ = ["TickJournal", "JournaledFrames", "parse_fsync",
-           "count_journal_ticks", "last_journal_tick", "FSYNC_POLICIES"]
+           "count_journal_ticks", "last_journal_tick", "first_journal_tick",
+           "iter_raw_records", "FSYNC_POLICIES"]
 
 
 class JournaledFrames:
@@ -190,6 +191,53 @@ def last_journal_tick(path: str | Path) -> int:
     return last
 
 
+def first_journal_tick(path: str | Path) -> int:
+    """Lowest tick-carrying record index still on disk (header walk) —
+    the replication sender's backfill probe: a standby asking for ticks
+    below this cannot be served from the journal and falls back to the
+    full-checkpoint fetch (resilience/replicate.py). -1 when empty."""
+    for typ, ln, f in _walk_headers(Path(path)):
+        if typ in (_TICK, _FRAME) and ln >= 8:
+            (tick,) = struct.unpack("<q", f.read(8))
+            return int(tick)
+    return -1
+
+
+def iter_raw_records(path: str | Path, from_tick: int = 0):
+    """Yield ``(typ, tick, record_bytes)`` per CRC-valid record on disk
+    whose tick is >= ``from_tick`` (CURSOR records ride along at their
+    tick), in journal order. This is the replication sender's disk
+    backfill: a reconnecting standby is caught up from the journal
+    itself — the bytes yielded are the exact framed records an online
+    tee would have shipped. A structural/CRC fault (bitrot, a segment
+    unlinked mid-read by compaction, the torn tail) skips the REST of
+    that segment and continues with the next — the receiver sees the
+    missing ticks as a gap, and its no-progress resync escalates to the
+    checkpoint fallback (a mid-journal fault must never turn backfill
+    into a livelock)."""
+    for seg in _list_segments(Path(path)):
+        try:
+            data = seg.read_bytes()
+        except OSError:
+            continue
+        off = 0
+        while off + _HEADER.size + _CRC.size <= len(data):
+            magic, typ, ln = _HEADER.unpack_from(data, off)
+            end = off + _HEADER.size + ln + _CRC.size
+            if magic != _MAGIC or typ not in _TYPES \
+                    or ln > _MAX_PAYLOAD or end > len(data):
+                break
+            payload = data[off + _HEADER.size:end - _CRC.size]
+            (crc,) = _CRC.unpack_from(data, end - _CRC.size)
+            if crc != zlib.crc32(data[off + 2:off + _HEADER.size] + payload):
+                break
+            if len(payload) >= 8:
+                (tick,) = struct.unpack_from("<q", payload, 0)
+                if tick >= from_tick:
+                    yield typ, int(tick), data[off:end]
+            off = end
+
+
 class TickJournal:
     """Append-only per-tick WAL with torn-write-tolerant recovery.
 
@@ -240,6 +288,18 @@ class TickJournal:
         self._fh = None
         self._seg_size = 0
         self._seg_seq = 0
+        #: replication tee (resilience/replicate.py, ISSUE 8): when set,
+        #: called with (typ, tick, record_bytes) AFTER each record is
+        #: flushed to the kernel — the exact framed bytes, so a standby
+        #: applying them rebuilds a byte-identical journal. The tee must
+        #: never block (the sender buffers bounded, drop-oldest).
+        self.tee = None
+        #: replication compaction floor: when set, compact(upto) is
+        #: clamped to min(upto, compact_floor()) so the leader never
+        #: drops records a connected standby has not acked past (the
+        #: PR 5 pause-while-quarantined rule, applied to replication).
+        #: Returning None means no clamp (no standby connected).
+        self.compact_floor = None
         #: per-segment max record tick, for compact() (name -> tick)
         self._seg_max_tick: dict[str, int] = {}
         obs = get_registry()
@@ -435,6 +495,10 @@ class TickJournal:
         # flush to the kernel unconditionally: a SIGKILL after this point
         # loses nothing (fsync below is for power loss, per policy)
         self._fh.flush()
+        if self.tee is not None:
+            # ship AFTER the local flush: the standby can never be ahead
+            # of the leader's own durable log
+            self.tee(typ, int(tick), rec)
         self._seg_size += len(rec)
         self._seg_max_tick[self._seg_name] = max(
             self._seg_max_tick.get(self._seg_name, -1), tick)
@@ -511,7 +575,19 @@ class TickJournal:
     def compact(self, upto_tick: int) -> int:
         """Drop whole segments whose records all predate `upto_tick`
         (the newest checkpoint's tick cursor): those ticks can never be
-        replayed again. Returns segments dropped."""
+        replayed again. Returns segments dropped.
+
+        With a replication ``compact_floor`` armed, the cut is clamped
+        to what the standby has acked: a lagging-but-connected standby
+        PAUSES compaction past its position (mirroring the PR 5
+        quarantine pause) so the records it still needs stay on disk; a
+        DISCONNECTED standby releases the clamp (bounded disk growth),
+        and on reconnect past the gap it takes the full-checkpoint
+        fallback instead (resilience/replicate.py)."""
+        if self.compact_floor is not None:
+            floor = self.compact_floor()
+            if floor is not None:
+                upto_tick = min(int(upto_tick), int(floor))
         dropped = 0
         for seg in _list_segments(self.path):
             if seg.name == getattr(self, "_seg_name", None) \
@@ -529,6 +605,27 @@ class TickJournal:
             self._obs_compacted.inc(dropped)
             self._obs_segments.set(len(_list_segments(self.path)))
         return dropped
+
+    def wipe(self) -> None:
+        """Drop every segment and all recovered state (ISSUE 8): a hot
+        standby adopting the leader's checkpoints discards a local
+        mirror tail that extends past them — after a failover those
+        records belong to the PRE-failover timeline, and the live
+        leader's stream is the only authoritative continuation. The
+        mirror re-syncs from the stream (disk backfill)."""
+        self.close()
+        for seg in _list_segments(self.path):
+            try:
+                seg.unlink()
+            except OSError:
+                pass
+        self.recovered_ticks = []
+        self.cursors = []
+        self.recovered_count = 0
+        self.next_tick = 0
+        self._seg_max_tick.clear()
+        self._seg_size = 0
+        self._obs_segments.set(0)
 
     def close(self) -> None:
         if self._fh is not None:

@@ -19,6 +19,30 @@ import numpy as np
 from rtap_tpu.obs import get_registry
 
 
+def format_alert_line(alert_id, stream: str, ts: int, value,
+                      raw_score: float, log_likelihood: float,
+                      top_fields=None) -> str:
+    """THE alert-line serialization — one function so every producer of
+    alert JSONL bytes (AlertWriter.emit_batch and the hot-standby
+    follower's buffered splice, resilience/replicate.py) emits
+    byte-identical lines for identical inputs: the failover soak's
+    per-id record-equality check depends on it. ``value`` may be a
+    scalar or a 1-D multivariate row."""
+    val = np.asarray(value)
+    return json.dumps(
+        {
+            **({"alert_id": alert_id} if alert_id is not None else {}),
+            "stream": stream,
+            "ts": int(ts),
+            "value": float(val) if val.ndim == 0
+            else [float(x) for x in val],
+            "raw_score": float(raw_score),
+            "log_likelihood": float(log_likelihood),
+            **({"top_fields": top_fields} if top_fields is not None else {}),
+        }
+    ) + "\n"
+
+
 def heal_torn_tail(path: str) -> int:
     """Append a newline if `path` ends mid-line (a writer killed
     mid-``write``): the fragment becomes its own unparseable — and
@@ -77,7 +101,7 @@ class AlertWriter:
     """
 
     def __init__(self, path: str | None = None, flush_every: int = 1,
-                 breaker=None, attributor=None):
+                 breaker=None, attributor=None, fence=None):
         import os
 
         from rtap_tpu.resilience.policies import CircuitBreaker
@@ -85,6 +109,15 @@ class AlertWriter:
         if flush_every < 1:
             raise ValueError(f"flush_every must be >= 1; got {flush_every}")
         self.path = path
+        # leader fencing (ISSUE 8, resilience/replicate.py): a callable
+        # consulted before every sink write; False means this process no
+        # longer holds the leadership lease — a paused old leader that
+        # wakes up after a standby promoted must NOT append to the alert
+        # sink (the new leader owns the stream now). Fenced lines are
+        # dropped + counted, never written; the loop itself also exits
+        # on fence loss, this is the last-line guard under it.
+        self._fence = fence
+        self.fenced_drops = 0
         # per-alert provenance (service/attribution.py, serve
         # --alert-attribution): alert lines gain a top_fields block.
         # History advances on EVERY batch (attribution compares against
@@ -140,6 +173,11 @@ class AlertWriter:
                 "structured resilience events by kind", event=kind)
             for kind in ("alert_sink_quarantined", "alert_sink_restored")
         }
+        self._obs_fenced = obs.counter(
+            "rtap_obs_alert_lines_fenced_total",
+            "alert/event lines refused because this process lost the "
+            "leadership lease (a fenced old leader must never append to "
+            "the sink a promoted standby now owns)")
 
     def wrap_sink(self, wrap) -> None:
         """Wrap the underlying file object (the chaos engine's injection
@@ -151,6 +189,10 @@ class AlertWriter:
         """Write + maybe flush, retry once, quarantine via the breaker.
         Never raises; failed/skipped lines are counted in ``dropped``."""
         if self._fh is None or not lines:
+            return
+        if self._fence is not None and not self._fence():
+            self.fenced_drops += len(lines)
+            self._obs_fenced.inc(len(lines))
             return
         if not self._breaker.allow():
             self.dropped += len(lines)
@@ -276,19 +318,11 @@ class AlertWriter:
                     suppressed_this += 1
                     self._obs_suppressed.inc()
                     continue
-                lines.append(json.dumps(
-                    {
-                        **({"alert_id": aid} if aid is not None else {}),
-                        "stream": stream_ids[g],
-                        "ts": int(ts[g]),
-                        "value": float(values[g]) if values.ndim == 1
-                        else [float(x) for x in values[g]],
-                        "raw_score": float(raw[g]),
-                        "log_likelihood": float(log_likelihood[g]),
-                        **({"top_fields": attr.get(int(g), [])}
-                           if attr is not None else {}),
-                    }
-                ) + "\n")
+                lines.append(format_alert_line(
+                    aid, stream_ids[g], int(ts[g]), values[g],
+                    float(raw[g]), float(log_likelihood[g]),
+                    top_fields=attr.get(int(g), [])
+                    if attr is not None else None))
             self._safe_write(lines)
         emitted = int(idx.size) - suppressed_this
         if emitted:

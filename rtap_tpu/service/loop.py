@@ -323,6 +323,8 @@ def live_loop(
     attributor=None,
     journal=None,
     health=None,
+    lease=None,
+    resume_suppression=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -483,6 +485,19 @@ def live_loop(
     the TickJournal — recovery never refuses to start
     (docs/RESILIENCE.md durability section; scripts/crash_soak.py is
     the kill-9 acceptance soak).
+
+    `lease` (a resilience.replicate.Lease, ISSUE 8 hot-standby
+    failover): the leadership lease this loop serves under. Freshness
+    rides the lease's heartbeat thread (started here if the caller has
+    not already); the loop probes ``still_mine()`` at the top of every
+    tick, and a probe that finds the lease's fencing epoch advanced
+    past ours (a standby promoted while this process was
+    paused/partitioned) FENCES the loop — a ``leader_fenced`` event, an
+    orderly break (``stats["fenced"] = True``; serve exits
+    ``replicate.FENCED_RC``), and the AlertWriter's own fence guard
+    refuses any stragglers, so a zombie old leader can never append to
+    the alert sink the new leader now owns (docs/RESILIENCE.md failover
+    runbook). None = no lease discipline (the single-process default).
 
     `health` (an obs.HealthTracker, serve --health; ISSUE 6): when the
     groups were built with ``health=True``, every collected chunk
@@ -695,7 +710,22 @@ def live_loop(
     if auto_release_after and reg is None:
         raise ValueError("auto_release_after needs a StreamGroupRegistry")
     writer = AlertWriter(alert_path, flush_every=alert_flush_every,
-                         attributor=attributor)
+                         attributor=attributor,
+                         fence=lease.still_mine if lease is not None
+                         else None)
+    if lease is not None:
+        # freshness lives on the heartbeat thread (idempotent when the
+        # caller already started it); the loop itself only DETECTS the
+        # fence via the cached still_mine() probe — a per-tick
+        # read+rewrite of the lease file has no place on the hot path
+        lease.start_heartbeat()
+    if resume_suppression:
+        # a promoted standby hands over the alert ids its dead leader
+        # delivered for ticks the standby never received: this loop will
+        # re-score those ticks live, and the ids must suppress, not
+        # duplicate (resilience/replicate.py StandbyFollower._promote)
+        writer.arm_suppression(set(resume_suppression))
+    fenced = False
     counter = ThroughputCounter()
     # ---- resilience wiring (rtap_tpu.resilience, docs/RESILIENCE.md) ----
     if chaos is not None:
@@ -928,9 +958,14 @@ def live_loop(
         obs_scored.inc(scored)
         if journal is not None and pairs:
             # alert-delivery cursor: alerts through this tick have been
-            # handed to the sink at this byte offset (diagnostic trail —
-            # the load-bearing cursor is the checkpoint meta's, taken at
-            # drained instants)
+            # handed to the sink at this byte offset. A hot standby
+            # PRUNES its buffered alert lines on this record (ISSUE 8),
+            # so the offset must never point past bytes still sitting
+            # in the stdio buffer — flush first (no-op at the
+            # flush-per-batch default; with --alert-flush-every N the
+            # journal pins an every-tick flush, or a kill would lose
+            # alerts the standby already counted as delivered)
+            writer.flush_sink()
             journal.append_cursor(journal_base + cur_tick,
                                   writer.sink_offset())
         t2 = time.perf_counter()
@@ -1309,6 +1344,17 @@ def live_loop(
             # an evicted service must not lose since-last-checkpoint learning
             if stop_event is not None and stop_event.is_set():
                 break
+            if lease is not None and not lease.still_mine():
+                # fenced: a standby promoted past our epoch while this
+                # process was paused/partitioned. Stop scoring AND stop
+                # emitting (the writer's fence already refuses) — the
+                # new leader owns the stream; our unsaved ticks are its
+                # journal's to replay, not ours to double-deliver.
+                fenced = True
+                _res_event("leader_fenced", k,
+                           epoch=int(getattr(lease, "epoch", -1)),
+                           holder=str(lease.holder() or ""))
+                break
             cur_tick = k
             if chaos is not None:
                 chaos.set_tick(k)
@@ -1614,7 +1660,11 @@ def live_loop(
             ticks_run = k + 1
             if learn and checkpoint_every and checkpoint_dir \
                     and (not any(chunk_bufs) or chunk_stagger) \
-                    and ticks_run - last_saved >= checkpoint_every:
+                    and ticks_run - last_saved >= checkpoint_every \
+                    and (lease is None or lease.still_mine()):
+                # (the lease gate keeps a paused old leader that woke
+                # MID-tick from clobbering the promoted standby's
+                # checkpoints before the top-of-tick fence check fires)
                 # nothing may be in flight at save time: drain the pipeline
                 # first (same rule as replay's drain-before-save). The
                 # trigger is due-since-last-save, not a modulus: with
@@ -1748,9 +1798,13 @@ def live_loop(
             # a quarantine raised by the final drain (or an early stop)
             # queued its dump after the last in-loop flush — write it
             flight.flush_pending()
-    if learn and checkpoint_dir \
+    if learn and checkpoint_dir and not fenced \
+            and (lease is None or lease.still_mine()) \
             and (ticks_run > last_saved
                  or journal_replay["replayed_ticks"] > 0):
+        # (a FENCED leader skips the final save too: the shared
+        # checkpoint dir belongs to the promoted standby now, and a
+        # zombie's save would clobber the new timeline's resume state)
         # final state on exit (clean or stopped), like replay_streams — a
         # resume must not lose already-learned ticks. Gated on the dir
         # alone: checkpoint_every=0 with a dir means "save only on exit".
@@ -1792,6 +1846,11 @@ def live_loop(
     if ticks_run < n_ticks:
         extra["stopped_early"] = True
         extra["ticks_requested"] = n_ticks
+    if fenced:
+        # the fence story lives in stats + counters, never on the sink
+        # (the whole point is that a fenced leader appends NOTHING)
+        extra["fenced"] = True
+        extra["fenced_line_drops"] = writer.fenced_drops
     if ticks_run > 0:
         extra["phase_ms_per_tick"] = {
             k: round(v / ticks_run * 1e3, 2) for k, v in phase_s.items()}

@@ -25,10 +25,20 @@ completed its total tick budget, journal recovery actually ran
 (``journal_replayed`` events on the incident stream), and the alert
 stream carries zero duplicated ``alert_id``s.
 
+``--replication`` (ISSUE 8) runs the seeded schedule against a LIVE
+leader/standby pair instead: a journaled leader loop ships every append
+to an in-process :class:`~rtap_tpu.resilience.StandbyFollower` over a
+real socket while the ISSUE 8 network fault kinds — ``conn_drop``,
+``stall_socket``, ``corrupt_bytes`` — fire on the wire at seeded
+record ticks (``ChaosEngine.on_wire``). The verdict: the standby's
+final model state is BIT-IDENTICAL to the leader's (every checkpoint
+leaf) despite the faults, the standby applied every tick, and each
+scheduled wire fault actually injected.
+
 Usage: python scripts/chaos_soak.py --seed 1 [--streams 12]
        [--group-size 4] [--ticks 120] [--cadence 0.05] [--rate 0.08]
        [--backend tpu] [--out reports/chaos_soak.json]
-       [--supervise --kills 2]
+       [--supervise --kills 2] [--replication]
 """
 
 from __future__ import annotations
@@ -193,6 +203,155 @@ def run_supervised(args) -> int:
     return 0
 
 
+def run_replication(args) -> int:
+    """`--replication`: seeded wire faults against a live leader/standby
+    pair; the verdict is standby state bit-identical to the leader's."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.resilience import (
+        ChaosEngine,
+        ChaosSpec,
+        Lease,
+        ReplicationSender,
+        StandbyFollower,
+        TickJournal,
+    )
+    from rtap_tpu.service.loop import _save_all, live_loop
+    from rtap_tpu.service.registry import StreamGroupRegistry
+    from scripts.crash_soak import compare_states
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_repl_")
+    os.makedirs(workdir, exist_ok=True)
+
+    def build_reg():
+        reg = StreamGroupRegistry(cluster_preset(),
+                                  group_size=args.group_size,
+                                  backend=args.backend, threshold=-1e9,
+                                  debounce=1)
+        for i in range(args.streams):
+            reg.add_stream(f"n{i // 3}.m{i % 3}")
+        reg.finalize()
+        return reg
+
+    leader_reg, standby_reg = build_reg(), build_reg()
+    spec = ChaosSpec.generate(
+        seed=args.seed, n_ticks=args.ticks, rate=args.rate,
+        kinds=("conn_drop", "stall_socket", "corrupt_bytes"))
+    engine = ChaosEngine(spec)
+    log(f"replication schedule: {len(spec.faults)} wire faults over "
+        f"{args.ticks} ticks, digest {spec.digest()}")
+
+    lease_path = os.path.join(workdir, "lease")
+    # the pair must STAY a pair for this soak: the standby's lease view
+    # uses an enormous timeout so it never promotes mid-run
+    leader_lease = Lease(lease_path, "leader", timeout_s=30.0)
+    standby_lease = Lease(lease_path, "standby", timeout_s=1e9)
+    assert leader_lease.try_acquire()
+
+    stop = threading.Event()
+    standby_journal = TickJournal(os.path.join(workdir, "standby-journal"))
+    follower = StandbyFollower(
+        standby_reg, standby_journal, lease=standby_lease, port=0,
+        alert_path=None, checkpoint_dir=os.path.join(workdir, "ck"),
+        cadence_s=args.cadence, stop_event=stop)
+    results: dict = {}
+
+    def follow():
+        results["follow"] = follower.run()
+
+    t = threading.Thread(target=follow, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 30.0
+    while follower.address is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    if follower.address is None:
+        log("FATAL: standby listener never came up")
+        return 3
+
+    leader_journal = TickJournal(os.path.join(workdir, "leader-journal"))
+    sender = ReplicationSender(
+        follower.address, leader_journal,
+        checkpoint_dir=os.path.join(workdir, "ck"), chaos=engine).start()
+    leader_journal.tee = sender.tee
+    leader_journal.compact_floor = sender.compact_floor
+
+    def source(k: int):
+        rng = np.random.Generator(np.random.Philox(key=(args.seed, k)))
+        return (30 + 5 * rng.random(
+            len(leader_reg.dispatch_ids()))).astype(np.float32), \
+            1_700_000_000 + k
+
+    stats = live_loop(
+        source, leader_reg, n_ticks=args.ticks, cadence_s=args.cadence,
+        alert_path=os.path.join(workdir, "alerts.jsonl"),
+        checkpoint_dir=os.path.join(workdir, "ck"),
+        checkpoint_every=args.checkpoint_every,
+        journal=leader_journal, lease=leader_lease)
+
+    failures: list[str] = []
+    # let the standby drain the tail (the wire is asynchronous)
+    deadline = time.monotonic() + 60.0
+    while follower.expected < args.ticks and time.monotonic() < deadline:
+        time.sleep(0.02)
+    if follower.expected < args.ticks:
+        failures.append(
+            f"standby applied only {follower.expected} of {args.ticks} "
+            "ticks before the drain deadline")
+    leader_journal.close()
+    sender.close()
+    stop.set()
+    t.join(timeout=30.0)
+    standby_journal.close()
+
+    # the verdict: bit-identical model state, leader vs standby, via the
+    # checkpoint comparison the crash soak already owns
+    lck = os.path.join(workdir, "verify-leader")
+    sck = os.path.join(workdir, "verify-standby")
+    _save_all(leader_reg.groups, lck)
+    _save_all(standby_reg.groups, sck)
+    leaves = compare_states(lck, sck, failures)
+    injected_kinds = {e["kind"] for e in engine.injected}
+    scheduled_kinds = {f.kind for f in spec.faults}
+    missing = sorted(scheduled_kinds - injected_kinds)
+    if missing:
+        failures.append(f"scheduled wire fault kind(s) never injected: "
+                        f"{missing}")
+    if stats["ticks"] != args.ticks:
+        failures.append(f"leader ran {stats['ticks']} of {args.ticks}")
+
+    report = {
+        "mode": "replication",
+        "seed": args.seed,
+        "schedule_digest": spec.digest(),
+        "faults_scheduled": len(spec.faults),
+        "faults_injected": engine.injected,
+        "standby": follower.stats(),
+        "sender": sender.stats(),
+        "state_leaves_compared": leaves,
+        "verified": not failures,
+        "failures": failures,
+        "workdir": workdir,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    if failures:
+        for msg in failures:
+            log(f"FAIL: {msg}")
+        return VERIFY_FAILED_EXIT
+    log(f"OK: {len(engine.injected)} wire fault(s) injected, standby "
+        f"applied {follower.applied} ticks, {leaves} state leaves "
+        "bit-identical")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0,
@@ -219,8 +378,18 @@ def main() -> int:
                          "journal recovery, and zero duplicated alert ids")
     ap.add_argument("--kills", type=int, default=2,
                     help="proc_exit faults scheduled with --supervise")
+    ap.add_argument("--replication", action="store_true",
+                    help="leader/standby mode (ISSUE 8): seeded "
+                         "conn_drop/stall_socket/corrupt_bytes faults on "
+                         "the replication wire; verify the standby's "
+                         "state stays bit-identical to the leader's")
     args = ap.parse_args()
     maybe_force_cpu()
+    if args.supervise and args.replication:
+        log("--supervise and --replication are separate drills")
+        return 2
+    if args.replication:
+        return run_replication(args)
     if args.supervise:
         return run_supervised(args)
 

@@ -711,6 +711,25 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                     "--startup-timeout", "900",
                     "--out", "reports/live_soak_ingest_r10.json"],
      2400.0),
+    # ---------------- round 11 (ISSUE 8: hot-standby failover) --------
+    # Real-clock failover soak at production cadence on the silicon
+    # host (the PAIR is cpu-oracle here — two serve processes cannot
+    # share the one chip; the device-mesh pair is ROADMAP-1's follow-
+    # up): 2 SIGKILLs of the live leader + the SIGSTOP fence round at
+    # 1 s cadence with a 5 s lease. The committed report carries the
+    # real-host takeover numbers the runbook cites: per-takeover
+    # detect_ticks (budget <= 10), promotion splice sizes
+    # (re_emitted/suppressed), and the fenced zombie's refused-write
+    # count. Budget covers the reference run + the HA run with three
+    # restart cycles at 1 s ticks.
+    ("r11_failover", [sys.executable, "scripts/failover_soak.py",
+                      "--seed", "8", "--kills", "2",
+                      "--streams", "96", "--group-size", "32",
+                      "--ticks", "420", "--cadence", "1.0",
+                      "--checkpoint-every", "30", "--backend", "cpu",
+                      "--lease-timeout", "5.0",
+                      "--out", "reports/failover_soak_r11.json"],
+     3600.0),
 ]
 
 

@@ -479,3 +479,59 @@ def test_journal_frame_records_roundtrip_and_torn_tail(tmp_path):
     assert j3.truncations == 1
     assert [r[0] for r in j3.recovered_ticks] == [0, 1]
     j3.close()
+
+
+def test_listener_close_joins_threads_deterministically():
+    """ISSUE 8 satellite: repeated open/close — with live producer
+    connections blocked in recv — must leave no listener or handler
+    thread behind (the conftest no-leaked-thread fixture's flake mode).
+    close() wakes every handler via socket shutdown and joins bounded."""
+    import socket
+    import threading
+    import time
+
+    for _ in range(3):
+        before = {t for t in threading.enumerate() if t.is_alive()}
+        reg = _reg(n=4, group_size=4)
+        src = BinaryBatchSource(reg.slot_map()).start()
+        conns = [socket.create_connection(src.address) for _ in range(3)]
+        # wait until every handler thread is up (it sends the MAP hello)
+        for c in conns:
+            c.settimeout(5.0)
+            assert c.recv(1 << 16)
+        src.close()
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            leaked = [t for t in threading.enumerate()
+                      if t.is_alive() and t not in before]
+            if not leaked:
+                break
+            time.sleep(0.02)
+        assert not leaked, f"listener close leaked threads: " \
+                           f"{[t.name for t in leaked]}"
+        for c in conns:
+            c.close()
+
+
+def test_announce_leader_repoints_producers():
+    """ISSUE 8: a fenced old leader pushes a MAP naming its successor;
+    connected producers pick up the __leader__ hint (and the epoch bump
+    makes their next stale-coded frame go loudly deaf here)."""
+    import time
+
+    from rtap_tpu.ingest.emit import BinaryFeedConnection
+
+    reg = _reg(n=4, group_size=4)
+    src = BinaryBatchSource(reg.slot_map()).start()
+    try:
+        with BinaryFeedConnection(src.address) as conn:
+            assert conn.leader_hint is None
+            e0 = conn.epoch
+            src.announce_leader("127.0.0.1:12345")
+            deadline = time.time() + 10
+            while time.time() < deadline and not conn.poll_map():
+                time.sleep(0.01)
+            assert conn.leader_hint == "127.0.0.1:12345"
+            assert conn.epoch == e0 + 1
+    finally:
+        src.close()
