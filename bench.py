@@ -580,13 +580,15 @@ def run_obs_bench() -> None:
     The obs subsystem instruments every serve-path tick; its acceptance bar
     is <= 1% of the tick budget (docs/TELEMETRY.md) — and so are the span
     ring + flight recorder (ISSUE 4), the write-ahead tick journal
-    (ISSUE 5), and the model-health fold path (ISSUE 6). Prints one JSON
+    (ISSUE 5), the model-health fold path (ISSUE 6), and the incident-
+    correlator fold at its alert-storm ceiling (ISSUE 9). Prints one JSON
     line per surface with per-op costs and the projected per-tick fraction
     at 1 s cadence; exits 1 if any bar is blown (so CI/harness runs fail
     loudly).
     """
     from rtap_tpu.obs.selfbench import (
-        measure, measure_health, measure_journal, measure_trace,
+        measure, measure_correlate, measure_health, measure_journal,
+        measure_trace,
     )
 
     res = measure()
@@ -601,8 +603,13 @@ def run_obs_bench() -> None:
     hres = measure_health()
     hres["pass_1pct_budget"] = hres["per_tick_overhead_frac"] <= 0.01
     print(json.dumps({"metric": "obs_health_overhead", **hres}), flush=True)
+    cres = measure_correlate()
+    cres["pass_1pct_budget"] = cres["per_tick_overhead_frac"] <= 0.01
+    print(json.dumps({"metric": "obs_correlate_overhead", **cres}),
+          flush=True)
     if not (res["pass_1pct_budget"] and tres["pass_1pct_budget"]
-            and jres["pass_1pct_budget"] and hres["pass_1pct_budget"]):
+            and jres["pass_1pct_budget"] and hres["pass_1pct_budget"]
+            and cres["pass_1pct_budget"]):
         sys.exit(1)
 
 

@@ -94,6 +94,30 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             return 2
         print(f"serve: chaos spec loaded ({len(chaos.spec.faults)} faults, "
               f"digest {chaos.spec.digest()})", file=sys.stderr)
+    # topology-aware incident correlation (ISSUE 9, rtap_tpu/correlate/,
+    # docs/WORKLOADS.md): parsed before any source/registry construction
+    # — a bad spec is a usage error, not a half-started serve
+    correlator = None
+    if args.topology:
+        from rtap_tpu.correlate import IncidentCorrelator, TopologyMap
+
+        try:
+            topo = TopologyMap.infer() if args.topology == "infer" \
+                else TopologyMap.from_spec(args.topology)
+            # only user-set knobs become kwargs — the class defaults
+            # (window 30s, min 3 streams) have ONE owner
+            knobs = {k: v for k, v in (
+                ("window_s", args.correlate_window),
+                ("min_streams", args.correlate_min_streams))
+                if v is not None}
+            correlator = IncidentCorrelator(topo, **knobs)
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            print(f"serve: bad --topology {args.topology}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"serve: incident correlation armed ({'inferred' if args.topology == 'infer' else args.topology}; "
+              f"window {correlator.window_s}s, min {correlator.min_streams} "
+              "streams)", file=sys.stderr)
     degradation = None
     if args.degrade:
         from rtap_tpu.resilience import DegradationController
@@ -177,8 +201,19 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             # heartbeat keeps the lease fresh through multi-second
             # synchronous work (checkpoint rounds)
             lease.start_heartbeat()
-    # (--columns + --preset nab rejected in main() before backend init)
-    cfg = nab_preset() if args.preset == "nab" else _sized_cluster(args)
+    # (--columns + non-cluster presets rejected in main() before backend init)
+    if args.preset == "nab":
+        cfg = nab_preset()
+    elif args.preset == "composite":
+        from rtap_tpu.config import composite_preset
+
+        cfg = composite_preset()
+    elif args.preset == "categorical":
+        from rtap_tpu.config import categorical_preset
+
+        cfg = categorical_preset()
+    else:
+        cfg = _sized_cluster(args)
     cfg = _apply_cadence(cfg, args)
     # many groups per chip is the at-scale serving shape (throughput peaks
     # at small G — SCALING.md); capping at len(ids) keeps small serves in
@@ -366,7 +401,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     obs_server = None
     if args.obs_port is not None:
         obs_server = ExpositionServer(port=args.obs_port, trace=trace,
-                                      flight=flight, health=health).start()
+                                      flight=flight, health=health,
+                                      correlator=correlator).start()
         ohost, oport = obs_server.address
         print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
               file=sys.stderr)
@@ -412,7 +448,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                               journal=journal,
                               health=health,
                               lease=lease,
-                              resume_suppression=resume_sup)
+                              resume_suppression=resume_sup,
+                              correlator=correlator)
         except BaseException as e:  # noqa: BLE001 — dump, then re-raise
             # crash black-box: an exception escaping serve dumps a
             # postmortem bundle BEFORE the traceback, so a dead soak
@@ -681,7 +718,15 @@ def main(argv: list[str] | None = None) -> int:
                         "semantics, the default)")
     p.add_argument("--ticks", type=int, default=60)
     p.add_argument("--cadence", type=float, default=1.0)
-    p.add_argument("--preset", choices=("cluster", "nab"), default="cluster")
+    p.add_argument("--preset", choices=("cluster", "nab", "composite",
+                                        "categorical"), default="cluster",
+                   help="model family: cluster (scalar RDSE, the "
+                        "default), nab (NAB-scale), composite (the "
+                        "ISSUE 9 multi-field encoder preset — each wire "
+                        "record is [value, delta, event-class] fused "
+                        "with the hour-of-day ring into one SDR), or "
+                        "categorical (single event-class/log-template "
+                        "field; docs/WORKLOADS.md encoder family)")
     p.add_argument("--backend", default="tpu")
     p.add_argument("--group-size", type=int, default=1024,
                    help="streams per device group; len(streams) above this "
@@ -954,6 +999,32 @@ def main(argv: list[str] | None = None) -> int:
                    help="scored ticks a group must fold before the drift "
                         "detector may fire (the slow EWMA baseline needs "
                         "weight before a distance to it means anything)")
+    p.add_argument("--topology", default=None,
+                   help="arm topology-aware incident correlation "
+                        "(rtap_tpu/correlate/, docs/WORKLOADS.md): a JSON "
+                        "topology spec path ({'services': {...}, 'links': "
+                        "[...]}) or the literal 'infer' to derive node/"
+                        "service adjacency from stream-name prefixes. "
+                        "Per-stream alerts on adjacent nodes fold into "
+                        "cluster-level 'incident' events on the alert "
+                        "stream (member alert_ids, blast-radius node set, "
+                        "onset tick, attributed fields), served live at "
+                        "GET /incidents. Needs --alerts (incidents ride "
+                        "the alert stream)")
+    p.add_argument("--correlate-window", type=int, default=None,
+                   help="incident correlation quiescence window in "
+                        "SECONDS of source timestamp (== ticks at the "
+                        "standard 1 s cadence): a cluster's window closes "
+                        "after this long without a new member alert — "
+                        "re-bursts inside it extend the same incident "
+                        "(hysteresis). Size it above the pipeline's alert "
+                        "staleness (pipeline_depth * micro_chunk ticks). "
+                        "Default 30; needs --topology")
+    p.add_argument("--correlate-min-streams", type=int, default=None,
+                   help="distinct alerting streams a closed window needs "
+                        "to emit an incident; below it the window expires "
+                        "silently (the per-stream alert lines already "
+                        "told that story). Default 3; needs --topology")
     p.add_argument("--alert-attribution", action="store_true",
                    help="per-alert provenance: alert JSONL lines gain a "
                         "top_fields block naming the encoder fields whose "
@@ -1076,11 +1147,33 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     # cheap flag-consistency checks BEFORE backend init: a usage error must
     # surface instantly, not after a 120 s wedged-tunnel watchdog
-    if getattr(args, "preset", None) == "nab" and \
+    if getattr(args, "preset", "cluster") != "cluster" and \
             getattr(args, "columns", None) is not None:
         print("serve: --columns applies to the cluster preset only "
-              "(the NAB family scales via scaled_nab_preset)",
+              "(the NAB family scales via scaled_nab_preset; the "
+              "composite/categorical presets fix their field geometry)",
               file=sys.stderr)
+        return 2
+    if (getattr(args, "correlate_window", None) is not None
+            or getattr(args, "correlate_min_streams", None) is not None) \
+            and not getattr(args, "topology", None):
+        print("serve: --correlate-window/--correlate-min-streams are "
+              "incident-correlation knobs; add --topology (a spec path "
+              "or 'infer')", file=sys.stderr)
+        return 2
+    if getattr(args, "topology", None) and not getattr(args, "alerts", None):
+        print("serve: --topology needs --alerts — incidents are emitted "
+              "on (and resume-recovered from) the alert stream",
+              file=sys.stderr)
+        return 2
+    if (getattr(args, "correlate_window", None) is not None
+            and args.correlate_window < 1):
+        print("serve: --correlate-window must be >= 1", file=sys.stderr)
+        return 2
+    if (getattr(args, "correlate_min_streams", None) is not None
+            and args.correlate_min_streams < 2):
+        print("serve: --correlate-min-streams must be >= 2 (one stream "
+              "is a per-stream alert, not an incident)", file=sys.stderr)
         return 2
     if getattr(args, "http", None) and (
             getattr(args, "ingest_port", None) is not None
@@ -1150,6 +1243,15 @@ def main(argv: list[str] | None = None) -> int:
               "mid-stream and the standby's slot addressing would "
               "diverge (elastic membership under replication is future "
               "work)", file=sys.stderr)
+        return 2
+    if (getattr(args, "standby", False)
+            or getattr(args, "replicate_to", None)) \
+            and getattr(args, "topology", None):
+        print("serve: --topology under replication is unsupported — the "
+              "standby buffers would-be alert lines without correlation "
+              "state, so a post-failover incident stream could not stay "
+              "identical to the leader's (correlation under replication "
+              "is future work)", file=sys.stderr)
         return 2
     if (getattr(args, "standby", False)
             or getattr(args, "replicate_to", None)) \

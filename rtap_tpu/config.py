@@ -76,6 +76,97 @@ class ScalarEncoderConfig:
     max_val: float = 100.0
 
 
+#: Valid per-field encoder kinds of a composite multi-field encoder
+#: ("Encoding Data for HTM Systems", PAPERS.md 1602.05925):
+#:   rdse        — the RDSE over the field's raw value (the default family)
+#:   delta       — RDSE over the FIRST DIFFERENCE of the value (NuPIC
+#:                 DeltaEncoder semantics: rate-of-change is the signal;
+#:                 the first sample, having no predecessor, encodes as
+#:                 missing). Needs per-stream prev-value state (enc_prev).
+#:   categorical — hash-bucketed enum: category id c activates bits
+#:                 {hash(seed, c*w + k) % size : k < w}. DISJOINT key
+#:                 ranges per category, so distinct categories share no
+#:                 hash keys and their SDRs overlap only by chance — the
+#:                 defining categorical property (no false similarity
+#:                 between adjacent ids), vs the RDSE's deliberate
+#:                 linear-decay overlap. Log-template ids (the drain-style
+#:                 miner in rtap_tpu/ingest/templates.py) ride this kind.
+FIELD_KINDS = ("rdse", "delta", "categorical")
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    """One field of a :class:`CompositeEncoderConfig` (name + kind + its
+    own encoder geometry). ``resolution`` applies to rdse/delta kinds;
+    categorical buckets are the (rounded) ids themselves."""
+
+    name: str
+    kind: str = "rdse"
+    size: int = 128
+    active_bits: int = 11
+    resolution: float = 0.5
+    seed: int = 42
+
+    def categorical_clamp(self) -> int:
+        """Category-id magnitude bound: ids clamp here on BOTH backends so
+        the device's int32 key arithmetic (c * active_bits + k) can never
+        wrap where the host's int64 would not (same contract as
+        RDSE_BUCKET_CLAMP)."""
+        return RDSE_BUCKET_CLAMP // max(self.active_bits, 1)
+
+
+@dataclass(frozen=True)
+class CompositeEncoderConfig:
+    """Composite multi-field encoder: fuse heterogeneous fields — e.g.
+    {value, delta, event-class} (+ the DateConfig hour-of-day ring, which
+    stays a ModelConfig-level field) — into ONE SDR per stream.
+
+    Each field owns a disjoint bit range (the per-field layout table,
+    ``ModelConfig.field_layout``), so SDR union semantics (PAPERS.md
+    1503.07469) carry the joint code and the RDSE key-space attribution
+    decode (service/attribution.py) can name which FIELD spiked. Wire
+    records stay [n_fields] f32 rows; categorical fields carry the
+    category id as a float (template ids from the log miner included).
+    """
+
+    fields: tuple[FieldSpec, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not self.fields:
+            raise ValueError("CompositeEncoderConfig needs >= 1 field")
+        # dict/JSON round-trips hand tuples back as lists; normalize so
+        # frozen-config hashing (the jit static key) stays stable
+        object.__setattr__(self, "fields", tuple(
+            f if isinstance(f, FieldSpec) else FieldSpec(**f)
+            for f in self.fields))
+        names = [f.name for f in self.fields]
+        if len(set(names)) != len(names) or any(not n for n in names):
+            raise ValueError(
+                f"composite field names must be non-empty and unique; got "
+                f"{names} (attribution reports fields BY NAME)")
+        for f in self.fields:
+            if f.kind not in FIELD_KINDS:
+                raise ValueError(
+                    f"field {f.name!r}: kind must be one of {FIELD_KINDS}; "
+                    f"got {f.kind!r}")
+            if not 0 < f.active_bits < f.size:
+                raise ValueError(
+                    f"field {f.name!r}: needs 0 < active_bits < size; got "
+                    f"w={f.active_bits}, n={f.size}")
+            if f.kind in ("rdse", "delta") and not f.resolution > 0:
+                raise ValueError(
+                    f"field {f.name!r}: resolution must be > 0; got "
+                    f"{f.resolution}")
+
+    @property
+    def size(self) -> int:
+        return sum(f.size for f in self.fields)
+
+    @property
+    def has_delta(self) -> bool:
+        return any(f.kind == "delta" for f in self.fields)
+
+
 @dataclass(frozen=True)
 class DateConfig:
     """Date/time encoder (SURVEY.md C2): periodic time-of-day + weekend bits.
@@ -279,6 +370,12 @@ class ModelConfig:
     # When set, value fields use the classic ScalarEncoder instead of the
     # RDSE (same layout position; date bits unchanged). None = RDSE default.
     scalar: ScalarEncoderConfig | None = None
+    # Composite multi-field encoder (ISSUE 9): when set, each of the
+    # n_fields wire fields encodes by ITS OWN FieldSpec (rdse / delta /
+    # categorical, per-field sizes) instead of the uniform RDSE/scalar
+    # family; date bits are unchanged. None = the uniform default — every
+    # pre-existing config/checkpoint/artifact is byte-identical.
+    composite: CompositeEncoderConfig | None = None
     # Learning cadence: learn on ticks where tm_iter % learn_every == 0 (or
     # tm_iter < learn_full_until — the maturity window learns every tick).
     # 1 = NuPIC-faithful continuous learning (default). The silicon A/B
@@ -402,6 +499,21 @@ class ModelConfig:
                 f"TMConfig.fanout_cap must be in [1, 32767] (fwd_pos is int16 at "
                 f"widest); got {self.tm.fanout_cap}"
             )
+        if self.composite is not None:
+            if self.scalar is not None:
+                raise ValueError(
+                    "composite and scalar encoder configs are exclusive "
+                    "(each field of a composite picks its own kind)")
+            if len(self.composite.fields) != self.n_fields:
+                raise ValueError(
+                    f"composite declares {len(self.composite.fields)} "
+                    f"field(s) but n_fields={self.n_fields}; the wire row "
+                    "and the layout table must agree")
+            if self.classifier.enabled:
+                raise ValueError(
+                    "the SDR classifier decodes the uniform RDSE bucket "
+                    "space of field 0 and is unsupported with a composite "
+                    "encoder (predict on a scalar-config model instead)")
         if self.scalar is not None:
             # An invalid scalar range corrupts SDRs silently (negative buckets
             # wrap on host but drop on device — parity breaks) — fail loudly.
@@ -452,12 +564,55 @@ class ModelConfig:
 
     @property
     def field_size(self) -> int:
-        """Bits one value field occupies in the SDR (RDSE or classic scalar)."""
+        """Bits one value field occupies in the SDR (RDSE or classic
+        scalar). Composite fields size individually — use
+        :meth:`field_layout` there (this property serves the uniform
+        family only and refuses to guess)."""
+        if self.composite is not None:
+            raise ValueError(
+                "composite fields have per-field sizes; use field_layout()")
         return self.scalar.size if self.scalar is not None else self.rdse.size
 
     @property
     def input_size(self) -> int:
+        if self.composite is not None:
+            return self.composite.size + self.date.size
         return self.field_size * self.n_fields + self.date.size
+
+    def field_resolutions(self) -> tuple[float, ...]:
+        """Per-field encoder resolution, wire order — what the per-stream
+        ``enc_resolution`` state row initializes from. Uniform configs
+        repeat the family resolution; composite rdse/delta fields carry
+        their FieldSpec's, and categorical fields use 1.0 (bucket ==
+        rounded category id — one shared bucket formula serves all
+        kinds)."""
+        if self.composite is not None:
+            return tuple(
+                f.resolution if f.kind in ("rdse", "delta") else 1.0
+                for f in self.composite.fields)
+        # uniform families share one resolution (the scalar family ignores
+        # enc_resolution entirely but the state row has always carried the
+        # rdse default — preserved bit-for-bit)
+        return (self.rdse.resolution,) * self.n_fields
+
+    def field_layout(self) -> list[tuple[str, str, int, int]]:
+        """The per-field SDR layout table: one (name, kind, offset, size)
+        row per value field, in wire order — the single source of truth
+        for encoder twins, attribution decode, and docs/WORKLOADS.md.
+        Uniform configs report kind 'scalar'/'rdse' with synthetic names
+        f0..fN-1; composite configs report the declared FieldSpec names."""
+        rows: list[tuple[str, str, int, int]] = []
+        off = 0
+        if self.composite is not None:
+            for f in self.composite.fields:
+                rows.append((f.name, f.kind, off, f.size))
+                off += f.size
+            return rows
+        kind = "scalar" if self.scalar is not None else "rdse"
+        for i in range(self.n_fields):
+            rows.append((f"f{i}", kind, off, self.field_size))
+            off += self.field_size
+        return rows
 
     @property
     def num_cells(self) -> int:
@@ -504,6 +659,13 @@ class ModelConfig:
             scalar=(
                 ScalarEncoderConfig(**known(ScalarEncoderConfig, d["scalar"]))
                 if d.get("scalar") is not None
+                else None
+            ),
+            composite=(
+                CompositeEncoderConfig(
+                    fields=tuple(FieldSpec(**known(FieldSpec, f))
+                                 for f in d["composite"]["fields"]))
+                if d.get("composite") is not None
                 else None
             ),
             # pre-cadence checkpoints default to full-rate learning
@@ -614,6 +776,72 @@ def node_preset(n_metrics: int = 3, perm_bits: int = 16) -> ModelConfig:
     """
     base = cluster_preset(perm_bits=perm_bits)
     return dataclasses.replace(base, n_fields=n_metrics)
+
+
+def composite_preset(perm_bits: int = 16, value_resolution: float = 0.5,
+                     n_event_classes_hint: int = 256) -> ModelConfig:
+    """Composite workload model (ISSUE 9; ROADMAP item 4): one stream fuses
+    {value, delta, event-class} + the hour-of-day ring into a single SDR.
+
+    Built on the cluster_preset footprint (only the SP potential/permanence
+    matrices grow with input_size; the TM pools — the dominant state — are
+    unchanged, same as node_preset). Field geometry keeps the preset's
+    ~8.6% per-field bit density (11/128):
+
+    - ``value``  — RDSE over the raw metric (the scalar component; its
+      encoding arithmetic is IDENTICAL to the scalar path's field 0, so
+      composite F1 on scalar faults is an apples comparison).
+    - ``delta``  — RDSE over the first difference (NuPIC DeltaEncoder):
+      rate-of-change anomalies (a slope flip inside the normal band) that
+      the absolute value hides.
+    - ``event_class`` — hash-bucketed categorical over event/template ids
+      (log-template ids from rtap_tpu/ingest/templates.py ride here).
+      ``n_event_classes_hint`` documents the expected id cardinality; the
+      encoder itself is table-free and unbounded.
+    - hour-of-day — the DateConfig ring at REDUCED weight (7 of the
+      54-bucket NAB ring, vs the NAB family's 21): date bits are context,
+      not signal, and at sub-hour horizons they are near-constant. At the
+      NAB width they are 21 of 54 active bits, so a full value-field
+      novelty flips only ~1/3 of the SP's input overlap and the anomaly
+      contrast of a scalar fault collapses (measured: composite F1 0.72
+      vs scalar 0.97 on eval/workload_eval.py's regression gate). At 7
+      bits the ring still gives the TM its seasonality context while the
+      {value, delta} pair dominates the code — the gate holds with F1
+      above the scalar baseline (reports/workloads_r09.json). This is the
+      paper's composite-encoder weighting rule: bits are allocated by
+      field importance, not uniformly.
+    """
+    base = cluster_preset(perm_bits=perm_bits)
+    del n_event_classes_hint  # documentation-only: the encoder is table-free
+    return dataclasses.replace(
+        base,
+        n_fields=3,
+        composite=CompositeEncoderConfig(fields=(
+            FieldSpec(name="value", kind="rdse", size=128, active_bits=11,
+                      resolution=value_resolution),
+            FieldSpec(name="delta", kind="delta", size=128, active_bits=11,
+                      resolution=value_resolution),
+            FieldSpec(name="event_class", kind="categorical", size=128,
+                      active_bits=11),
+        )),
+        date=DateConfig(time_of_day_width=7, time_of_day_size=54,
+                        weekend_width=0),
+    )
+
+
+def categorical_preset(perm_bits: int = 16) -> ModelConfig:
+    """Single-field categorical model (event-class / log-template streams):
+    the cluster_preset footprint with the one value field encoded as a
+    hash-bucketed categorical — the eval config for the categorical and
+    log-template NAB-style modalities (eval/workload_eval.py)."""
+    base = cluster_preset(perm_bits=perm_bits)
+    return dataclasses.replace(
+        base,
+        composite=CompositeEncoderConfig(fields=(
+            FieldSpec(name="event_class", kind="categorical", size=128,
+                      active_bits=11),
+        )),
+    )
 
 
 def cluster_preset(perm_bits: int = 16) -> ModelConfig:

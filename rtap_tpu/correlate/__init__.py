@@ -1,0 +1,25 @@
+"""Topology-aware incident correlation (ISSUE 9; ROADMAP item 4).
+
+Host-side, cheap, and downstream of the alert stream: per-stream alerts
+(keyed by their stable PR 5 ``alert_id``s) fold into cluster-level
+incident records — blast-radius detection over node/service adjacency,
+the scenario no per-stream detector covers.
+
+- :mod:`rtap_tpu.correlate.topology` — :class:`TopologyMap`: node ->
+  service assignment + service links -> connected correlation clusters,
+  loaded from a JSON spec (``serve --topology PATH``) or inferred from
+  stream-name prefixes (``--topology infer``).
+- :mod:`rtap_tpu.correlate.incidents` — :class:`IncidentCorrelator`:
+  quiescence-windowed fold of the alert line stream into ``incident``
+  events (member alert_ids, blast-radius node set, onset tick,
+  attributed fields), exactly-once across kill-9/journal-replay resume,
+  exposed at ``GET /incidents`` and via ``rtap_obs_incident_*``.
+
+docs/WORKLOADS.md carries the spec format, the incident schema, and the
+triage runbook.
+"""
+
+from rtap_tpu.correlate.incidents import IncidentCorrelator, incident_id_of
+from rtap_tpu.correlate.topology import TopologyMap
+
+__all__ = ["IncidentCorrelator", "TopologyMap", "incident_id_of"]

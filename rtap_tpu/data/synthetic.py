@@ -256,6 +256,224 @@ def generate_cluster(
 
 
 @dataclass
+class LogStream:
+    """One synthetic log-line stream (ISSUE 9 log-template modality):
+    raw lines + ground-truth anomaly windows. Feed ``lines`` through
+    :class:`rtap_tpu.ingest.TemplateMiner` to get the template-id value
+    stream a categorical composite field scores."""
+
+    stream_id: str
+    timestamps: np.ndarray  # int64 unix seconds, [T]
+    lines: list[str]
+    windows: list[tuple[int, int]] = field(default_factory=list)
+    events: list[FaultEvent] = field(default_factory=list)
+
+
+#: steady-state log-template pool: realistic shapes with numeric variable
+#: positions (the drain-style miner masks digit-bearing tokens), one
+#: format per template so mined ids are stable
+_LOG_TEMPLATES = (
+    "connected to host 10.0.{a}.{b} port {p}",
+    "request /api/v1/items served in {ms} ms status 200",
+    "heartbeat ok seq {n}",
+    "cache lookup key item-{n} hit ratio 0.{r}",
+    "gc pause {ms} ms heap {n} mb",
+    "scheduled job sync-{n} finished rc 0",
+)
+
+#: the anomalous burst template — a structure steady state never emits
+_LOG_BURST_TEMPLATE = "ERROR disk failure on volume {n} remounting read-only"
+
+
+def generate_log_stream(
+    stream_id: str, cfg: SyntheticStreamConfig, seed: int = 0,
+) -> LogStream:
+    """Seeded log-burst stream: one line per tick drawn from the steady
+    template pool (numeric fields re-drawn per line, so the miner's
+    masking is load-bearing), with ``cfg.n_anomalies`` bursts of the
+    ERROR template injected post-probation — the log-burst workload of
+    ROADMAP item 4. Windows label the burst spans NAB-style."""
+    rng = _rng_for(seed, stream_id)
+    T = cfg.length
+    t_unix = (cfg.start_unix + np.arange(T) * cfg.cadence_s).astype(np.int64)
+    # steady mix biased toward the first templates (realistic skew)
+    weights = np.array([2.0 ** -i for i in range(len(_LOG_TEMPLATES))])
+    weights /= weights.sum()
+    choices = rng.choice(len(_LOG_TEMPLATES), size=T, p=weights)
+
+    def render(i: int) -> str:
+        return _LOG_TEMPLATES[choices[i]].format(
+            a=rng.integers(256), b=rng.integers(256), p=rng.integers(1024, 65536),
+            ms=rng.integers(1, 500), n=rng.integers(1, 100000),
+            r=rng.integers(10, 99))
+
+    lines = [render(i) for i in range(T)]
+    windows: list[tuple[int, int]] = []
+    events: list[FaultEvent] = []
+    if cfg.n_anomalies > 0:
+        lo = int(T * cfg.inject_after_frac)
+        n_candidates = T - 50 - lo
+        if n_candidates < cfg.n_anomalies:
+            raise ValueError(
+                f"stream length {T} too short for {cfg.n_anomalies} log "
+                f"burst(s) past inject_after_frac={cfg.inject_after_frac}")
+        centers = np.sort(rng.choice(np.arange(lo, T - 50),
+                                     size=cfg.n_anomalies, replace=False))
+        for c in centers:
+            dur = int(rng.integers(5, 25))
+            s, e = int(c), min(int(c) + dur, T - 1)
+            for i in range(s, e):
+                lines[i] = _LOG_BURST_TEMPLATE.format(n=rng.integers(16))
+            margin = max(2, dur // 2)
+            win = (int(t_unix[max(0, s - margin)]),
+                   int(t_unix[min(T - 1, e + margin)]))
+            windows.append(win)
+            events.append(FaultEvent("log_burst", int(t_unix[s]),
+                                     int(t_unix[e]), win))
+    return LogStream(stream_id, t_unix, lines, windows, events)
+
+
+def generate_categorical_stream(
+    stream_id: str, cfg: SyntheticStreamConfig, seed: int = 0,
+    n_classes: int = 6,
+) -> LabeledStream:
+    """Seeded event-class stream (ISSUE 9 categorical modality): each tick
+    carries a category id drawn from a skewed steady distribution over
+    ``n_classes`` classes; anomalies are bursts of a NOVEL class (id ==
+    n_classes, never seen in steady state) — the shape a categorical
+    encoder must catch and a scalar RDSE treats as merely 'one bucket
+    further'. Values are float ids ready for a categorical field."""
+    rng = _rng_for(seed, stream_id)
+    T = cfg.length
+    t_unix = (cfg.start_unix + np.arange(T) * cfg.cadence_s).astype(np.int64)
+    weights = np.array([2.0 ** -i for i in range(n_classes)])
+    weights /= weights.sum()
+    values = rng.choice(n_classes, size=T, p=weights).astype(np.float32)
+    windows: list[tuple[int, int]] = []
+    events: list[FaultEvent] = []
+    if cfg.n_anomalies > 0:
+        lo = int(T * cfg.inject_after_frac)
+        n_candidates = T - 50 - lo
+        if n_candidates < cfg.n_anomalies:
+            raise ValueError(
+                f"stream length {T} too short for {cfg.n_anomalies} class "
+                f"burst(s) past inject_after_frac={cfg.inject_after_frac}")
+        centers = np.sort(rng.choice(np.arange(lo, T - 50),
+                                     size=cfg.n_anomalies, replace=False))
+        for c in centers:
+            dur = int(rng.integers(5, 25))
+            s, e = int(c), min(int(c) + dur, T - 1)
+            values[s:e] = float(n_classes)  # the novel class
+            margin = max(2, dur // 2)
+            win = (int(t_unix[max(0, s - margin)]),
+                   int(t_unix[min(T - 1, e + margin)]))
+            windows.append(win)
+            events.append(FaultEvent("class_burst", int(t_unix[s]),
+                                     int(t_unix[e]), win))
+    return LabeledStream(stream_id, t_unix, values, windows, events)
+
+
+@dataclass
+class TopologyWorkload:
+    """A seeded multi-service cluster with ONE cascading fault: the
+    correlation soak's ground truth (scripts/workload_soak.py,
+    chaos_soak.py --topology-burst)."""
+
+    streams: list[LabeledStream]
+    #: the faulted service name
+    burst_service: str
+    #: nodes hit, in cascade order
+    burst_nodes: list[str]
+    #: tick index each node's burst begins (cascade: onset + j * lag)
+    burst_onsets: dict[str, int]
+    #: burst duration in ticks (per node)
+    burst_dur: int
+    #: the topology spec dict ({"services": ...}) matching the stream ids
+    spec: dict
+
+
+def generate_topology_workload(
+    n_services: int = 3,
+    nodes_per_service: int = 3,
+    metrics: Sequence[str] = ("cpu", "mem"),
+    cfg: SyntheticStreamConfig | None = None,
+    seed: int = 0,
+    burst_at_frac: float = 0.75,
+    cascade_lag: int = 2,
+    burst_dur: int = 8,
+    burst_magnitude: float = 12.0,
+) -> TopologyWorkload:
+    """Seeded cascading-fault workload (ISSUE 9 acceptance): per-node
+    per-metric base signals (ids ``{svc}-{i:02d}.{metric}``, the
+    inference-friendly naming), plus ONE deterministic multi-node burst —
+    a seeded service is hit node by node (node j's burst begins
+    ``cascade_lag * j`` ticks after the first) across ALL its metrics,
+    the blast-radius shape exactly one cluster-level incident must
+    cover. All other services stay fault-free (the false-positive
+    control)."""
+    cfg = cfg or SyntheticStreamConfig(length=400, n_anomalies=0,
+                                      noise_phi=0.9, noise_scale=0.3)
+    if cfg.n_anomalies:
+        raise ValueError(
+            "generate_topology_workload owns its fault injection; pass a "
+            "cfg with n_anomalies=0")
+    rng = _rng_for(seed, "topology-workload")
+    svc_names = [f"svc{chr(ord('a') + i)}" for i in range(n_services)]
+    burst_service = svc_names[int(rng.integers(n_services))]
+    onset0 = int(cfg.length * burst_at_frac)
+    last_onset = onset0 + cascade_lag * (nodes_per_service - 1)
+    if last_onset + 2 > cfg.length - 1:
+        # the last cascaded node must still get a real burst (>= 2 ticks
+        # before the final tick) — fail loudly, like generate_log_stream,
+        # instead of IndexError-ing on timestamps or silently emitting a
+        # burst-less "burst node" that wrecks the soak's blast-radius check
+        raise ValueError(
+            f"cascade does not fit: last node's onset {last_onset} needs "
+            f">= 2 burst ticks inside length {cfg.length} (lower "
+            f"burst_at_frac/cascade_lag/nodes_per_service or raise length)")
+    streams: list[LabeledStream] = []
+    burst_nodes: list[str] = []
+    burst_onsets: dict[str, int] = {}
+    spec: dict = {"services": {}}
+    for svc in svc_names:
+        nodes = [f"{svc}-{i:02d}" for i in range(nodes_per_service)]
+        spec["services"][svc] = nodes
+        for j, node in enumerate(nodes):
+            onset = onset0 + cascade_lag * j
+            if svc == burst_service:
+                burst_nodes.append(node)
+                burst_onsets[node] = onset
+            for m in metrics:
+                scfg = replace(cfg, metric=m, n_anomalies=0)
+                s = generate_stream(f"{node}.{m}", scfg, seed=seed)
+                if svc == burst_service:
+                    sigma = METRIC_PROFILES.get(
+                        m, METRIC_PROFILES["cpu"])[2] * cfg.noise_scale
+                    e = min(onset + burst_dur, cfg.length - 1)
+                    sig = s.values.astype(np.float64)
+                    sig[onset:e] += burst_magnitude * sigma
+                    lo_c, hi_c = METRIC_PROFILES.get(
+                        m, METRIC_PROFILES["cpu"])[3]
+                    if lo_c is not None:
+                        sig = np.maximum(sig, lo_c)
+                    if hi_c is not None:
+                        sig = np.minimum(sig, hi_c)
+                    s.values = sig.astype(np.float32)
+                    margin = max(2, burst_dur // 2)
+                    win = (int(s.timestamps[max(0, onset - margin)]),
+                           int(s.timestamps[min(cfg.length - 1, e + margin)]))
+                    s.windows.append(win)
+                    s.events.append(FaultEvent(
+                        "cascade", int(s.timestamps[onset]),
+                        int(s.timestamps[e]), win))
+                streams.append(s)
+    return TopologyWorkload(
+        streams=streams, burst_service=burst_service,
+        burst_nodes=burst_nodes, burst_onsets=burst_onsets,
+        burst_dur=burst_dur, spec=spec)
+
+
+@dataclass
 class NodeStream:
     """One node's fused multivariate stream (SURVEY.md §6 benchmark config 4:
     'multivariate per-node cpu/mem/net fused RDSE'): values [T, F] feed ONE

@@ -18,6 +18,9 @@ Layers (each its own module, host-only — no accelerator dependency):
   ingest-side timestamp alignment/backfill, and admission control
   (per-tenant quotas, drop-oldest backpressure) wired into
   ``rtap_obs_ingest_*`` telemetry.
+- :mod:`rtap_tpu.ingest.templates` — the drain-style log-template miner
+  (ISSUE 9): log lines -> stable template ids at the ingest boundary,
+  feeding the categorical/log-template composite encoder fields.
 - :mod:`rtap_tpu.ingest.emit` — producer-side helpers
   (:func:`send_binary`, :class:`BinaryFeedConnection`), the
   ``send_jsonl`` twin the soak feeders use.
@@ -41,9 +44,11 @@ from rtap_tpu.ingest.protocol import (
 )
 from rtap_tpu.ingest.server import BinaryBatchSource
 from rtap_tpu.ingest.shm import ShmRing
+from rtap_tpu.ingest.templates import TemplateMiner
 
 __all__ = [
     "BinaryBatchSource",
+    "TemplateMiner",
     "BinaryFeedConnection",
     "DispatchTable",
     "FrameWalker",

@@ -49,7 +49,14 @@ def oracle_record_step(
         # must not poison the stream's bucket arithmetic forever)
         state["enc_offset"] = np.where(bind, values, state["enc_offset"]).astype(np.float32)
         state["enc_bound"] = state["enc_bound"] | bind
-    sdr = encode_record(cfg, values, int(ts_unix), state["enc_offset"], state["enc_resolution"])
+    enc_prev = state.get("enc_prev")  # composite delta fields only
+    sdr = encode_record(cfg, values, int(ts_unix), state["enc_offset"],
+                        state["enc_resolution"], enc_prev)
+    if enc_prev is not None:
+        # advance the delta predecessor AFTER encoding (device twin:
+        # ops/step.step_impl); NaN gaps keep the pre-gap baseline
+        state["enc_prev"] = np.where(
+            np.isfinite(values), values, enc_prev).astype(np.float32)
     # TM active cells at t-1: TMOracle rebinds (not mutates) prev_active, so
     # the snapshot needs no copy; only taken when a classifier will read it
     pattern_prev = state["prev_active"].reshape(-1) if classifier is not None else None

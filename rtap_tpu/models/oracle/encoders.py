@@ -15,6 +15,7 @@ import numpy as np
 from rtap_tpu.config import (
     RDSE_BUCKET_CLAMP,
     DateConfig,
+    FieldSpec,
     ModelConfig,
     RDSEConfig,
     ScalarEncoderConfig,
@@ -65,6 +66,52 @@ def scalar_bits(cfg: ScalarEncoderConfig, bucket: int) -> np.ndarray:
     return bucket + np.arange(cfg.width)
 
 
+def categorical_bits(spec: FieldSpec, category: int,
+                     field_index: int = 0) -> np.ndarray:
+    """Active bit indices for one category id (ISSUE 9 encoder family).
+
+    Unlike the RDSE, distinct categories must NOT look similar: category
+    ``c`` uses hash keys ``[c*w, c*w + w)`` — disjoint key ranges, so any
+    SDR overlap between two ids is pure hash coincidence (the categorical
+    property of "Encoding Data for HTM Systems"). Ids are clamped to
+    ``spec.categorical_clamp()`` so the device's int32 ``c*w + k`` can
+    never wrap where this host int64 path would not."""
+    w = spec.active_bits
+    clamp = spec.categorical_clamp()
+    c = int(np.clip(category, -clamp, clamp))
+    keys = c * w + np.arange(w, dtype=np.int64)
+    return hash_bits_np(keys, spec.seed + 0x1000 * field_index, spec.size)
+
+
+def _composite_field_bits(spec: FieldSpec, f: int, value: float, prev: float,
+                          offset: float, resolution: float) -> np.ndarray | None:
+    """One composite field's active bits (field base offset not yet
+    applied), or None for a missing sample. The bucket arithmetic is the
+    shared f32 rdse_bucket; what differs per kind is the encoded quantity
+    (value vs first difference vs category id), the bucket center (bound
+    offset for rdse; the natural 0 for delta/categorical), and the key
+    derivation (overlapping runs vs disjoint categorical ranges)."""
+    if not np.isfinite(value):
+        return None
+    if spec.kind == "delta":
+        # NuPIC DeltaEncoder: the signal is the first difference; the
+        # first sample of a stream (prev is NaN) has none -> missing
+        if not np.isfinite(prev):
+            return None
+        d = float(np.float32(value) - np.float32(prev))
+        b = int(rdse_bucket(d, 0.0, resolution))
+        keys = b + np.arange(spec.active_bits, dtype=np.int64)
+        return hash_bits_np(keys, spec.seed + 0x1000 * f, spec.size)
+    if spec.kind == "categorical":
+        cat = int(rdse_bucket(value, 0.0, resolution))  # res 1.0: round(id)
+        return categorical_bits(spec, cat, f)
+    # rdse: same arithmetic as the uniform family, per-field geometry;
+    # the offset binds at the stream's first finite value like every RDSE
+    b = int(rdse_bucket(value, offset, resolution))
+    keys = b + np.arange(spec.active_bits, dtype=np.int64)
+    return hash_bits_np(keys, spec.seed + 0x1000 * f, spec.size)
+
+
 def time_of_day_bits(cfg: DateConfig, ts_unix: int) -> np.ndarray:
     """Periodic encoder over the 24h ring: w contiguous (wrapping) bits
     centered on the current time of day."""
@@ -85,15 +132,38 @@ def encode_record(
     ts_unix: int,
     enc_offset: np.ndarray,
     enc_resolution: np.ndarray | None = None,
+    enc_prev: np.ndarray | None = None,
 ) -> np.ndarray:
     """Encode one record (n_fields scalars + timestamp) -> bool[input_size].
 
-    Layout: [field0 RDSE | field1 RDSE | ... | time-of-day ring | weekend].
+    Layout: [field0 | field1 | ... | time-of-day ring | weekend], each
+    field's bit range per ``cfg.field_layout()`` (uniform RDSE/scalar
+    runs, or the composite family's per-field kinds — ISSUE 9).
+    ``enc_prev`` is the per-field previous finite value (delta fields
+    only; None reads as "no predecessor yet" for every field).
     """
     sdr = np.zeros(cfg.input_size, bool)
     values = np.atleast_1d(np.asarray(values, np.float64))
     if len(values) != cfg.n_fields:
         raise ValueError(f"expected {cfg.n_fields} field value(s), got {len(values)}")
+    if cfg.composite is not None:
+        defaults = cfg.field_resolutions()
+        for f, (spec, (_n, _k, off, _sz)) in enumerate(
+                zip(cfg.composite.fields, cfg.field_layout())):
+            res = float(np.float32(defaults[f])) if enc_resolution is None \
+                else float(enc_resolution[f])
+            prev = float(enc_prev[f]) if enc_prev is not None else float("nan")
+            bits = _composite_field_bits(
+                spec, f, float(values[f]), prev, float(enc_offset[f]), res)
+            if bits is not None:
+                sdr[off + bits] = True
+        base = cfg.composite.size
+        if cfg.date.time_of_day_width:
+            sdr[base + time_of_day_bits(cfg.date, ts_unix)] = True
+            base += cfg.date.time_of_day_size
+        if cfg.date.weekend_width and is_weekend(ts_unix):
+            sdr[base : base + cfg.date.weekend_width] = True
+        return sdr
     for f in range(cfg.n_fields):
         if not np.isfinite(values[f]):
             continue  # missing/garbled sample -> no bits for this field (NuPIC behavior)

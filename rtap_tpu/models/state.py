@@ -116,10 +116,19 @@ def init_state(
         "tm_iter": np.int32(0),
         "tm_overflow": np.int32(0),  # device-kernel capacity overflow counter
 
-        # encoder (offset binds per field at the first *finite* value seen)
+        # encoder (offset binds per field at the first *finite* value seen;
+        # resolutions are per field — uniform configs repeat the family
+        # default bit-for-bit, composite fields carry their FieldSpec's)
         "enc_offset": np.zeros(cfg.n_fields, np.float32),
         "enc_bound": np.zeros(cfg.n_fields, bool),
-        "enc_resolution": np.full(cfg.n_fields, cfg.rdse.resolution, np.float32),
+        "enc_resolution": np.asarray(cfg.field_resolutions(), np.float32),
+        # delta-encoder predecessor (composite family only): last FINITE
+        # value per field, NaN = no predecessor yet (the first sample of
+        # a delta field encodes as missing — NuPIC DeltaEncoder). Absent
+        # for every non-delta config, so pre-ISSUE-9 state trees (and
+        # their checkpoints) are byte-identical.
+        **({"enc_prev": np.full(cfg.n_fields, np.nan, np.float32)}
+           if cfg.composite is not None and cfg.composite.has_delta else {}),
         # forward synapse index (derived; present only in forward dendrite mode)
         **(fwd_index_arrays(cfg) if include_fwd else {}),
         # SDR classifier (SURVEY.md C10), present only when enabled

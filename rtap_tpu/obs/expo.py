@@ -205,6 +205,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = (json.dumps(ht.snapshot()) + "\n").encode()
             ctype = "application/json"
+        elif path == "/incidents":
+            # cluster-level incident records + open correlation windows
+            # (ISSUE 9, rtap_tpu/correlate/): the correlator's point-in-
+            # time snapshot — same diagnostic-read contract as /health
+            co = getattr(self.server, "correlator", None)
+            if co is None:
+                self.send_error(404, "incident correlation not enabled "
+                                     "(serve --topology)")
+                return
+            body = (json.dumps(co.snapshot()) + "\n").encode()
+            ctype = "application/json"
         elif path == "/postmortem":
             # on-demand flight-recorder dump; returns the bundle path (or
             # null when throttled). GET because it is an operator poke on
@@ -244,7 +255,9 @@ class ExpositionServer:
     ``/snapshot`` for the JSON snapshot; with a ``trace`` recorder
     attached, ``/trace?last=N`` serves the Perfetto-loadable timeline,
     with a ``flight`` recorder, ``/postmortem`` dumps a bundle on
-    demand, and with a ``health`` tracker (obs/health.py),
+    demand, with a ``correlator`` (rtap_tpu/correlate/), ``/incidents``
+    serves recent cluster-level incidents + open correlation windows,
+    and with a ``health`` tracker (obs/health.py),
     ``/health`` serves the fleet rollup + per-group model scorecards
     (rings/scorecards are written lock-free by the loop, so a
     concurrent read is point-in-time diagnostic data, not a consistent
@@ -253,13 +266,14 @@ class ExpositionServer:
 
     def __init__(self, registry: TelemetryRegistry | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 trace=None, flight=None, health=None):
+                 trace=None, flight=None, health=None, correlator=None):
         self.registry = registry or get_registry()
         self._server = _Server((host, port), _Handler)
         self._server.registry = self.registry
         self._server.trace = trace
         self._server.flight = flight
         self._server.health = health
+        self._server.correlator = correlator
         self.address = self._server.server_address  # (host, bound port)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)

@@ -16,7 +16,8 @@ import time
 from rtap_tpu.obs.metrics import TelemetryRegistry
 
 __all__ = ["measure", "measure_trace", "measure_journal", "measure_health",
-           "OPS_PER_TICK", "TRACE_SPANS_PER_TICK", "HEALTH_FOLDS_PER_TICK"]
+           "measure_correlate", "OPS_PER_TICK", "TRACE_SPANS_PER_TICK",
+           "HEALTH_FOLDS_PER_TICK", "CORRELATE_ALERTS_PER_TICK"]
 
 #: instrument operations a serve tick costs at the production shape (six
 #: phase observes + tick latency observe + ticks/scored/alert counters +
@@ -31,6 +32,12 @@ TRACE_SPANS_PER_TICK = 40
 #: HealthTracker.fold calls a serve tick costs at the production
 #: multi-group shape: one per collected chunk per group, 16 groups
 HEALTH_FOLDS_PER_TICK = 16
+
+#: alert folds a correlating serve tick is budgeted for (ISSUE 9): an
+#: ACTIVE incident across a whole 16-node blast radius at 2 metrics per
+#: node pages ~32 streams at once; healthy ticks fold zero, so this is
+#: the storm-ceiling shape, not the steady state
+CORRELATE_ALERTS_PER_TICK = 32
 
 
 def _time_op(fn, n: int) -> float:
@@ -222,6 +229,49 @@ def measure_journal(n: int = 2000, cadence_s: float = 1.0,
         "row_bytes": int(row.nbytes),
         "segment_rotations": rotations,
         "fsync": "os",
+        "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
+        "per_tick_overhead_frac": per_tick_s / cadence_s,
+        "cadence_s": cadence_s,
+    }
+
+
+def measure_correlate(n: int = 20_000, cadence_s: float = 1.0,
+                      n_alerts: int = CORRELATE_ALERTS_PER_TICK,
+                      n_clusters: int = 8) -> dict:
+    """Incident-correlator hot-path cost (ISSUE 9), same protocol as
+    :func:`measure`: per-op nanoseconds of ``observe_alert`` (the fold)
+    and ``on_tick`` (the window-close scan) on a private correlator with
+    ``n_clusters`` clusters kept PERMANENTLY open — the storm ceiling,
+    where every tick both folds a full blast-radius worth of alerts and
+    scans every open window. A healthy tick pays one near-empty
+    ``on_tick`` only; this projects the worst case, and ``bench.py
+    --obs-bench`` gates it <= 1% of the tick budget alongside the
+    metric/trace/journal/health bars."""
+    from rtap_tpu.correlate import IncidentCorrelator, TopologyMap
+
+    co = IncidentCorrelator(
+        TopologyMap.infer(), window_s=3600, min_streams=3,
+        sink=lambda _rec: None, registry=TelemetryRegistry())
+    streams = [f"svc{c:02d}-{i:02d}.cpu"
+               for c in range(n_clusters) for i in range(4)]
+    i = [0]
+
+    def _fold():
+        i[0] += 1
+        co.observe_alert(f"a{i[0]}", streams[i[0] % len(streams)],
+                         1_700_000_000, top_fields=None)
+
+    _fold()  # open the windows / warm instrument shards out of the timing
+    fold_s = _time_op(_fold, n)
+    # the scan walks n_clusters open windows and closes none (window_s
+    # holds them open) — the recurring per-tick cost, not the rare close
+    tick_s = _time_op(lambda: co.on_tick(1_700_000_000), n)
+    per_tick_s = n_alerts * fold_s + tick_s
+    return {
+        "correlate_fold_us": round(fold_s * 1e6, 2),
+        "correlate_on_tick_us": round(tick_s * 1e6, 2),
+        "alerts_per_tick": n_alerts,
+        "open_clusters": n_clusters,
         "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
         "per_tick_overhead_frac": per_tick_s / cadence_s,
         "cadence_s": cadence_s,

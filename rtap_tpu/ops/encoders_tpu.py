@@ -21,20 +21,103 @@ SECONDS_PER_DAY = 86400
 _EPOCH_WEEKDAY_SHIFT = 3  # 1970-01-01 was a Thursday; weekday = (days+3) % 7
 
 
+def _composite_indices(
+    cfg: ModelConfig,
+    values: jnp.ndarray,  # [F] f32
+    enc_offset: jnp.ndarray,  # [F] f32
+    enc_resolution: jnp.ndarray,  # [F] f32
+    enc_prev: jnp.ndarray | None,  # [F] f32 (delta predecessor), or None
+) -> jnp.ndarray:
+    """Composite-family scatter indices (ISSUE 9), flattened across fields;
+    missing samples point at n_in (dropped). Static python loop over the
+    FieldSpec table — F is small and the per-field geometry (size, kind,
+    seed, offset) is config-static, so this traces to straight-line code.
+    Twin of the oracle's _composite_field_bits, bit-exact per field."""
+    n_in = cfg.input_size
+    parts = []
+    for f, (spec, (_name, _kind, off, _sz)) in enumerate(
+            zip(cfg.composite.fields, cfg.field_layout())):
+        w = spec.active_bits
+        vf = values[f]
+        res = enc_resolution[f].astype(jnp.float32)
+        finite = jnp.isfinite(vf)
+        v = jnp.where(finite, vf, jnp.float32(0.0))
+        if spec.kind == "delta":
+            # first difference; a stream's first sample (prev NaN) has
+            # none — same missing-sample drop as a NaN value
+            pf = enc_prev[f] if enc_prev is not None else jnp.float32(jnp.nan)
+            finite = finite & jnp.isfinite(pf)
+            p = jnp.where(jnp.isfinite(pf), pf, jnp.float32(0.0))
+            bucket = jnp.clip(jnp.round((v - p) / res),
+                              -RDSE_BUCKET_CLAMP,
+                              RDSE_BUCKET_CLAMP).astype(jnp.int32)
+            keys = bucket + jnp.arange(w, dtype=jnp.int32)
+        elif spec.kind == "categorical":
+            # rounded id, clamped FIRST in the f32 bucket domain (shared
+            # rdse_bucket arithmetic), then in the integer domain to the
+            # per-field categorical bound so c*w + k cannot wrap int32 —
+            # the same double clamp the host performs
+            b = jnp.clip(jnp.round(v / res), -RDSE_BUCKET_CLAMP,
+                         RDSE_BUCKET_CLAMP).astype(jnp.int32)
+            cclamp = jnp.int32(spec.categorical_clamp())
+            cat = jnp.clip(b, -cclamp, cclamp)
+            keys = cat * jnp.int32(w) + jnp.arange(w, dtype=jnp.int32)
+        else:  # rdse
+            bucket = jnp.clip(jnp.round((v - enc_offset[f]) / res),
+                              -RDSE_BUCKET_CLAMP,
+                              RDSE_BUCKET_CLAMP).astype(jnp.int32)
+            keys = bucket + jnp.arange(w, dtype=jnp.int32)
+        bits = hash_bits(keys, jnp.uint32(spec.seed)
+                         + jnp.uint32(0x1000) * jnp.uint32(f), spec.size)
+        idx = bits + jnp.int32(off)
+        parts.append(jnp.where(finite, idx, n_in))
+    return jnp.concatenate(parts)
+
+
 def encode_device(
     cfg: ModelConfig,
     values: jnp.ndarray,  # [F] f32
     ts_unix: jnp.ndarray,  # scalar i32
     enc_offset: jnp.ndarray,  # [F] f32
     enc_resolution: jnp.ndarray | None = None,  # [F] f32 (runtime, per stream)
+    enc_prev: jnp.ndarray | None = None,  # [F] f32 (delta fields' predecessor)
 ) -> jnp.ndarray:
     """Encode one record -> bool[input_size]. Layout matches the oracle:
-    [field0 RDSE | field1 RDSE | ... | time-of-day ring | weekend].
+    [field0 | field1 | ... | time-of-day ring | weekend] per
+    cfg.field_layout() (uniform RDSE/scalar, or the composite family's
+    per-field kinds).
 
     `enc_resolution` defaults to the config's static resolution (rounded
     through f32, exactly like the state-carried per-stream array)."""
-    F, R = cfg.n_fields, cfg.field_size
+    F = cfg.n_fields
     n_in = cfg.input_size
+    if cfg.composite is not None:
+        if enc_resolution is None:
+            enc_resolution = jnp.asarray(cfg.field_resolutions(), jnp.float32)
+        idx = _composite_indices(cfg, values, enc_offset, enc_resolution,
+                                 enc_prev)
+        sdr = jnp.zeros(n_in, bool).at[idx].set(True, mode="drop")
+        base = cfg.composite.size
+        if cfg.date.time_of_day_width:
+            center = (ts_unix % SECONDS_PER_DAY) * cfg.date.time_of_day_size \
+                // SECONDS_PER_DAY
+            tod = (
+                center
+                + jnp.arange(cfg.date.time_of_day_width, dtype=jnp.int32)
+                - cfg.date.time_of_day_width // 2
+            ) % cfg.date.time_of_day_size
+            sdr = sdr.at[base + tod].set(True)
+            base += cfg.date.time_of_day_size
+        if cfg.date.weekend_width:
+            weekend = ((ts_unix // SECONDS_PER_DAY + _EPOCH_WEEKDAY_SHIFT)
+                       % 7) >= 5
+            widx = jnp.where(
+                weekend,
+                base + jnp.arange(cfg.date.weekend_width, dtype=jnp.int32),
+                n_in)
+            sdr = sdr.at[widx].set(True, mode="drop")
+        return sdr
+    R = cfg.field_size
     finite = jnp.isfinite(values)
     v = jnp.where(finite, values, jnp.float32(0.0))
 

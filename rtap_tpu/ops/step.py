@@ -48,7 +48,14 @@ def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Model
     """
     enc_offset, enc_bound = bind_offsets(values, state["enc_offset"], state["enc_bound"])
     state = {**state, "enc_offset": enc_offset, "enc_bound": enc_bound}
-    sdr = encode_device(cfg, values, ts_unix, enc_offset, state["enc_resolution"])
+    enc_prev = state.get("enc_prev")  # composite delta fields only
+    sdr = encode_device(cfg, values, ts_unix, enc_offset,
+                        state["enc_resolution"], enc_prev)
+    if enc_prev is not None:
+        # the delta predecessor advances to the last FINITE value AFTER
+        # encoding (this tick encoded against the pre-tick predecessor);
+        # NaN gaps keep the pre-gap baseline, mirroring offset binding
+        state["enc_prev"] = jnp.where(jnp.isfinite(values), values, enc_prev)
     pattern_prev = state["prev_active"]  # TM active cells at t-1
     state, active = sp_step(state, sdr, cfg.sp, learn)
     state, raw = tm_step(state, active, cfg.tm, learn, inv=inv)

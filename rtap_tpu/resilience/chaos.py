@@ -39,6 +39,15 @@ Fault vocabulary (``Fault.kind``):
   where a wire seam exists, and adding them would shift existing
   seeds' digests); ``scripts/chaos_soak.py --replication`` exercises
   them against a live leader/standby pair.
+- ``topology_burst``      — the poll ADDS ``magnitude`` to the targeted
+  stream indices for the fault window: a correlated multi-stream fault
+  (a real blast radius, not one exporter misbehaving) for the incident-
+  correlation drill (ISSUE 9; ``scripts/chaos_soak.py
+  --topology-burst`` schedules one spanning multiple groups and asserts
+  exactly ONE cluster-level incident pages, not N per-stream alerts).
+  Excluded from generated schedules: an undirected burst has no
+  topology to correlate — schedule it explicitly with the stream
+  indices of the adjacent nodes it floods.
 
 A fault is active for ticks ``[tick, tick + duration)``. Group-targeted
 kinds apply to every group when ``group`` is None. The engine logs every
@@ -81,6 +90,10 @@ FAULT_KINDS = (
     "conn_drop",      # the wire send raises ConnectionResetError
     "stall_socket",   # the wire send blocks `seconds` (slow peer)
     "corrupt_bytes",  # bytes flip in flight (CRC must catch, never apply)
+    # correlated multi-stream burst (ISSUE 9): the source adds
+    # `magnitude` to the targeted stream indices for the window — the
+    # incident-correlation drill's blast-radius fault
+    "topology_burst",
 )
 
 #: kinds NOT in the default generated draw, in addition to keeping every
@@ -91,7 +104,10 @@ FAULT_KINDS = (
 #:   into a plain serve schedule would inject nothing, and adding them
 #:   to the draw would shift every existing seed's digest. Pass
 #:   kinds=(..., "corrupt_bytes", ...) to generate() to draw them.
-_UNGENERATED = ("proc_exit", "conn_drop", "stall_socket", "corrupt_bytes")
+#: - topology_burst needs explicit stream targeting (a random draw has
+#:   no topology to correlate) — schedule it by hand (ISSUE 9).
+_UNGENERATED = ("proc_exit", "conn_drop", "stall_socket", "corrupt_bytes",
+                "topology_burst")
 GENERATED_KINDS = tuple(k for k in FAULT_KINDS if k not in _UNGENERATED)
 
 #: exit code of an injected proc_exit death (distinguishable from real
@@ -117,6 +133,7 @@ class Fault:
     streams: tuple[int, ...] | None = None  # source faults: vector indices
     seconds: float = 0.25  # dispatch_hang block length
     ts_skew_s: int = 3600  # source_backwards_ts jump
+    magnitude: float = 12.0  # topology_burst value offset
 
     def __post_init__(self):
         if self.kind not in FAULT_KINDS:
@@ -200,8 +217,16 @@ class ChaosSpec:
         return cls(faults=faults, seed=seed)
 
     def to_dict(self) -> dict:
-        return {"seed": self.seed,
-                "faults": [asdict(f) for f in self.faults]}
+        # `magnitude` serializes only for the kind that reads it: every
+        # pre-ISSUE-9 spec keeps its exact dict shape, so existing seeds'
+        # digests stay pinned (tests/unit/test_replicate.py)
+        faults = []
+        for f in self.faults:
+            d = asdict(f)
+            if f.kind != "topology_burst":
+                del d["magnitude"]
+            faults.append(d)
+        return {"seed": self.seed, "faults": faults}
 
     def shifted(self, base: int) -> "ChaosSpec":
         """The schedule as seen by a RESTARTED process that resumes at
@@ -222,7 +247,7 @@ class ChaosSpec:
                 kind=f.kind, tick=start - base,
                 duration=f.tick + f.duration - start, group=f.group,
                 streams=f.streams, seconds=f.seconds,
-                ts_skew_s=f.ts_skew_s))
+                ts_skew_s=f.ts_skew_s, magnitude=f.magnitude))
         return ChaosSpec(faults=out, seed=self.seed)
 
     def digest(self) -> str:
@@ -410,6 +435,18 @@ class _ChaosSource:
         if f is not None:
             eng._record("source_backwards_ts", tick)
             ts = int(ts) - int(f.ts_skew_s)
+        f = eng._find("topology_burst", tick)
+        if f is not None:
+            # correlated multi-stream burst (ISSUE 9): flood the targeted
+            # indices (None = the whole fleet — a global brown-out). NaNs
+            # from an overlapping source_timeout stay NaN: a timed-out
+            # exporter reports nothing, burst or not.
+            eng._record("topology_burst", tick)
+            values = np.array(values, np.float32, copy=True)
+            if f.streams is None:
+                values += np.float32(f.magnitude)
+            else:
+                values[list(f.streams)] += np.float32(f.magnitude)
         return values, ts
 
     def __getattr__(self, name):

@@ -101,7 +101,8 @@ class AlertWriter:
     """
 
     def __init__(self, path: str | None = None, flush_every: int = 1,
-                 breaker=None, attributor=None, fence=None):
+                 breaker=None, attributor=None, fence=None,
+                 correlator=None):
         import os
 
         from rtap_tpu.resilience.policies import CircuitBreaker
@@ -123,6 +124,14 @@ class AlertWriter:
         # History advances on EVERY batch (attribution compares against
         # the previous tick), alert or not.
         self._attributor = attributor
+        # topology-aware incident correlation (ISSUE 9,
+        # rtap_tpu/correlate/): every NON-SUPPRESSED alert batch this
+        # writer lands on the sink also folds into the correlator's
+        # windows (suppressed ids were delivered by the crashed run —
+        # the correlator's resume scan of the sink tail already saw
+        # them, and dropped batches never fold, so the fold mirrors the
+        # DISK exactly once by construction).
+        self._correlator = correlator
         self._offset = 0  # bytes handed to the sink (the alert cursor)
         self.torn_heals = 0
         if path:
@@ -185,19 +194,23 @@ class AlertWriter:
         if self._fh is not None:
             self._fh = wrap(self._fh)
 
-    def _safe_write(self, lines: list[str], force_flush: bool = False) -> None:
+    def _safe_write(self, lines: list[str], force_flush: bool = False) -> bool:
         """Write + maybe flush, retry once, quarantine via the breaker.
-        Never raises; failed/skipped lines are counted in ``dropped``."""
+        Never raises; failed/skipped lines are counted in ``dropped``.
+        Returns True iff the lines were handed to the sink (the batch is
+        all-or-nothing: one writelines call) — consumers that must stay
+        consistent with the on-disk stream (the incident correlator's
+        fold) key on it."""
         if self._fh is None or not lines:
-            return
+            return False
         if self._fence is not None and not self._fence():
             self.fenced_drops += len(lines)
             self._obs_fenced.inc(len(lines))
-            return
+            return False
         if not self._breaker.allow():
             self.dropped += len(lines)
             self._obs_dropped.inc(len(lines))
-            return
+            return False
         was_closed = self._breaker.state == self._breaker.CLOSED
         wrote = False  # a flush-only failure must not re-write the lines
         # on retry (duplicated alert lines would corrupt bit-exactness
@@ -225,7 +238,7 @@ class AlertWriter:
                     self._obs_quarantined["alert_sink_restored"].inc()
                     self.emit_event({"event": "alert_sink_restored",
                                      "lines_dropped": self.dropped})
-                return
+                return True
             except OSError:
                 if attempt == 2:
                     self._obs_sink_errors.inc()
@@ -242,6 +255,9 @@ class AlertWriter:
                         # the thing that just died)
                         self.sink_quarantines += 1
                         self._obs_quarantined["alert_sink_quarantined"].inc()
+        # both attempts raised: the lines reached the sink only if the
+        # write itself landed and the failure was flush-only
+        return wrote
 
     def arm_suppression(self, alert_ids: set[str]) -> None:
         """Arm the resume suppression set: lines whose ``alert_id`` is in
@@ -306,6 +322,7 @@ class AlertWriter:
             # serialization stays per-line (each line is one JSON object)
             # but the file sees a single buffered call
             lines = []
+            folds = []
             for g in idx:
                 aid = f"{group}:{stream_ids[g]}:{int(tick)}" \
                     if with_id else None
@@ -318,12 +335,27 @@ class AlertWriter:
                     suppressed_this += 1
                     self._obs_suppressed.inc()
                     continue
+                tf = attr.get(int(g), []) if attr is not None else None
+                if self._correlator is not None:
+                    folds.append((aid, stream_ids[g], int(ts[g]), tf))
                 lines.append(format_alert_line(
                     aid, stream_ids[g], int(ts[g]), values[g],
                     float(raw[g]), float(log_likelihood[g]),
-                    top_fields=attr.get(int(g), [])
-                    if attr is not None else None))
-            self._safe_write(lines)
+                    top_fields=tf))
+            # fold into the correlator only AFTER the batch reached the
+            # sink: a dropped batch (fence lost, breaker open, double
+            # write failure) must not seed windows with alert_ids that
+            # exist nowhere on the stream — the resume re-fold reads the
+            # DISK, and the content-hash incident_id must agree with it.
+            # The pre-write offset anchors the correlator's crash-resume
+            # sidecar floor (every member of a window lives at/after its
+            # window's anchor).
+            off0 = self._offset
+            if self._safe_write(lines) and self._correlator is not None:
+                for aid, sid, tsi, tf in folds:
+                    self._correlator.observe_alert(aid, sid, tsi,
+                                                   top_fields=tf,
+                                                   sink_offset=off0)
         emitted = int(idx.size) - suppressed_this
         if emitted:
             # lines handed toward the sink this call: suppressed ids ride
@@ -359,6 +391,41 @@ class AlertWriter:
             self._fh = None
 
 
+def iter_alert_records(path: str, offset: int = 0):
+    """THE tolerant alert-stream line iterator — one walker for every
+    consumer of the shared alert/incident JSONL (the resume suppression
+    scan below, scripts/crash_soak.parse_alert_stream and everything
+    layered on it, and the incident correlator's resume scan —
+    rtap_tpu/correlate/incidents.py), so torn-fragment and event-vs-alert
+    semantics can never drift between them.
+
+    Yields ``(kind, record)`` pairs in file order starting at byte
+    ``offset``: kind ``"event"`` (a structured line carrying an "event"
+    key — dict), ``"alert"`` (a dict, possibly without an alert_id on
+    pre-ISSUE-5 streams), or ``"garbage"`` (record is the raw line: a
+    torn fragment from a kill mid-write, or a non-object). A missing/
+    unreadable file yields nothing — absence is an empty stream, the
+    callers' shared convention."""
+    try:
+        with open(path) as f:
+            f.seek(max(0, int(offset)))
+            for line in f:
+                stripped = line.strip()
+                if not stripped:
+                    continue
+                try:
+                    d = json.loads(stripped)
+                except ValueError:
+                    yield "garbage", line
+                    continue
+                if not isinstance(d, dict):
+                    yield "garbage", line
+                    continue
+                yield ("event" if "event" in d else "alert"), d
+    except OSError:
+        return
+
+
 def scan_alert_ids(path: str, offset: int = 0) -> set[str]:
     """Alert ids already on disk at/after byte `offset` — the resume
     suppression set. The checkpoint meta's alert cursor (recorded at a
@@ -367,21 +434,12 @@ def scan_alert_ids(path: str, offset: int = 0) -> set[str]:
     Event lines and torn/unparseable fragments are skipped (a torn line
     never fully delivered its alert — replay re-emits it properly)."""
     ids: set[str] = set()
-    try:
-        with open(path) as f:
-            f.seek(max(0, int(offset)))
-            for line in f:
-                if line.startswith('{"event"'):
-                    continue
-                try:
-                    d = json.loads(line)
-                except ValueError:
-                    continue
-                aid = d.get("alert_id") if isinstance(d, dict) else None
-                if aid:
-                    ids.add(aid)
-    except OSError:
-        return ids
+    for kind, d in iter_alert_records(path, offset):
+        if kind != "alert":
+            continue
+        aid = d.get("alert_id")
+        if aid:
+            ids.add(aid)
     return ids
 
 

@@ -66,13 +66,28 @@ class AlertAttributor:
             raise ValueError(f"top_k must be >= 1; got {top_k}")
         self.cfg = cfg
         self.top_k = int(top_k)
-        if cfg.scalar is not None:
+        if cfg.composite is not None:
+            # composite family (ISSUE 9): per-field kinds and geometry;
+            # alerts name the spiked FIELD by its declared name. Delta
+            # fields compare consecutive ENCODED deltas, which needs a
+            # 2-deep value history (base and base2 below).
+            self._names = [name for name, _k, _o, _s in cfg.field_layout()]
+            self._kinds = [f.kind for f in cfg.composite.fields]
+            self._ws = np.array([f.active_bits for f in cfg.composite.fields],
+                                np.int64)
+            self._ress = np.array(
+                [np.float32(r) for r in cfg.field_resolutions()], np.float32)
+            self._cclamps = np.array(
+                [f.categorical_clamp() for f in cfg.composite.fields],
+                np.int64)
+            self._w = int(self._ws.max())  # uniform-path fields unused
+        elif cfg.scalar is not None:
             self._w = int(cfg.scalar.width)
         else:
             self._w = int(cfg.rdse.active_bits)
             # same f32 rounding as the encoder's own resolution path
             self._res = float(np.float32(cfg.rdse.resolution))
-        self._prev: dict[tuple, tuple[np.ndarray, int]] = {}
+        self._prev: dict[tuple, tuple] = {}
         self._calls = 0
         #: evictions of recently-updated (plausibly live) routes — stays
         #: 0 unless the fleet exceeds _MAX_TRACKED_ROUTES groups
@@ -103,29 +118,35 @@ class AlertAttributor:
         indices whose alert fired. Returns {index: top_fields list}.
         """
         self._calls += 1
+        composite = self.cfg.composite is not None
         vals = np.asarray(values, np.float32)
         if vals.ndim == 1:
             vals = vals[:, None]
         key = tuple(stream_ids)
         entry = self._prev.get(key)
         prev = entry[0] if entry is not None else None
+        prev2 = entry[1] if (composite and entry is not None) else None
         if prev is not None and prev.shape != vals.shape:
-            prev = None  # field-shape change: restart history
+            prev = prev2 = None  # field-shape change: restart history
         # carry the last finite value forward per field: NaN gaps keep
         # the pre-gap baseline (the encoder's missing-sample semantics)
         if prev is None:
             carried = vals.copy()
         else:
             carried = np.where(np.isfinite(vals), vals, prev)
-        self._prev[key] = (carried, self._calls)
+        # composite keeps 2-deep history (delta fields compare consecutive
+        # ENCODED deltas, which needs the tick-before-base row too); the
+        # last tuple element is always the LRU clock
+        self._prev[key] = (carried, prev, self._calls) if composite \
+            else (carried, self._calls)
         if len(self._prev) > _MAX_TRACKED_ROUTES:
             # LRU prune (rare: only route churn beyond the cap reaches
             # here). An evicted entry updated within the last cap-worth
             # of calls was plausibly a LIVE group's — count it loudly.
-            items = sorted(self._prev.items(), key=lambda kv: kv[1][1])
+            items = sorted(self._prev.items(), key=lambda kv: kv[1][-1])
             drop = items[: len(items) - _MAX_TRACKED_ROUTES]
             floor = self._calls - _MAX_TRACKED_ROUTES
-            self.live_evictions += sum(1 for _, v in drop if v[1] >= floor)
+            self.live_evictions += sum(1 for _, v in drop if v[-1] >= floor)
             self._prev = dict(items[len(drop):])
         out: dict[int, list[dict]] = {}
         for g in np.asarray(alert_idx).ravel():
@@ -134,11 +155,17 @@ class AlertAttributor:
                 out[g] = []
                 continue
             cur, base = vals[g], prev[g]
-            finite = np.isfinite(cur) & np.isfinite(base)
-            db = np.zeros(cur.shape[0], np.int64)
-            if finite.any():
-                db[finite] = self._bucket_delta(cur[finite], base[finite])
-            novelty = np.minimum(np.abs(db), self._w) / float(self._w)
+            if composite:
+                base2 = prev2[g] if prev2 is not None else None
+                db, novelty = self._composite_novelty(cur, base, base2)
+                ws = self._ws
+            else:
+                finite = np.isfinite(cur) & np.isfinite(base)
+                db = np.zeros(cur.shape[0], np.int64)
+                if finite.any():
+                    db[finite] = self._bucket_delta(cur[finite], base[finite])
+                novelty = np.minimum(np.abs(db), self._w) / float(self._w)
+                ws = None
             total = float(novelty.sum())
             if total <= 0.0:
                 out[g] = []
@@ -146,8 +173,55 @@ class AlertAttributor:
             order = np.argsort(-novelty, kind="stable")[: self.top_k]
             out[g] = [
                 {"field": int(f),
+                 # composite alerts name the spiked FIELD, not just its
+                 # wire dimension — the operator-facing half of the
+                 # ISSUE 9 decode generalization
+                 **({"name": self._names[int(f)]} if composite else {}),
                  "contribution": round(float(novelty[f] / total), 4),
                  "bucket_delta": int(db[f])}
                 for f in order if novelty[f] > 0.0
             ]
         return out
+
+    def _composite_novelty(self, cur: np.ndarray, base: np.ndarray,
+                           base2: np.ndarray | None):
+        """Per-field (bucket_delta, lost-overlap novelty) for a composite
+        config: rdse fields decode exactly like the uniform family (at
+        their own resolution/width); CATEGORICAL fields are all-or-
+        nothing (distinct ids share no hash keys, so any id change is
+        full novelty); DELTA fields compare this tick's encoded first
+        difference against the previous tick's — which needs the
+        2-deep history (no base2 yet -> no verdict for that field)."""
+        F = len(self._kinds)
+        db = np.zeros(F, np.int64)
+        nov = np.zeros(F, np.float64)
+        for f, kind in enumerate(self._kinds):
+            c, b = float(cur[f]), float(base[f])
+            if not (np.isfinite(c) and np.isfinite(b)):
+                continue
+            w = int(self._ws[f])
+            res = float(self._ress[f])
+            if kind == "categorical":
+                # decode through the ENCODER's id clamp: two ids that
+                # clip to the same category produce bit-identical SDRs
+                # (categorical_bits), so they must not attribute as a
+                # field change
+                clamp = int(self._cclamps[f])
+                cc = min(max(int(rdse_bucket(c, 0.0, res)), -clamp), clamp)
+                bb = min(max(int(rdse_bucket(b, 0.0, res)), -clamp), clamp)
+                d = cc - bb
+                db[f] = d
+                nov[f] = 1.0 if d else 0.0
+            elif kind == "delta":
+                if base2 is None or not np.isfinite(base2[f]):
+                    continue
+                d_cur = float(np.float32(c) - np.float32(b))
+                d_prev = float(np.float32(b) - np.float32(base2[f]))
+                # subtract-first like the rdse path: the shared baseline
+                # term cancels exactly in f32
+                db[f] = int(rdse_bucket(d_cur, d_prev, res))
+                nov[f] = min(abs(int(db[f])), w) / float(w)
+            else:  # rdse
+                db[f] = int(rdse_bucket(c, b, res))
+                nov[f] = min(abs(int(db[f])), w) / float(w)
+        return db, nov

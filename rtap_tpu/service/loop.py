@@ -325,6 +325,7 @@ def live_loop(
     health=None,
     lease=None,
     resume_suppression=None,
+    correlator=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -511,6 +512,19 @@ def live_loop(
     flight-recorder postmortem dump like a quarantine does. The
     scorecards serve at ``GET /health`` and land in
     ``stats["health"]``. None = leaves (if any) are simply not folded.
+
+    `correlator` (a correlate.IncidentCorrelator, serve --topology;
+    ISSUE 9): every alert the writer emits folds into topology-cluster
+    correlation windows, and quiesced windows close into cluster-level
+    ``incident`` events on the same stream (member alert_ids, blast-
+    radius node set, onset tick, attributed fields) — blast-radius
+    detection over the per-stream alert stream. The fold keys on the
+    stable PR 5 alert_ids and the SOURCE clock, and on resume the
+    correlator re-folds the sink tail through the shared tolerant line
+    walker, so the incident stream is exactly-once across kill-9/
+    journal-replay/failover by construction (scripts/workload_soak.py
+    is the acceptance soak; docs/WORKLOADS.md the runbook). None = no
+    correlation and zero hot-path cost.
 
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
@@ -712,7 +726,37 @@ def live_loop(
     writer = AlertWriter(alert_path, flush_every=alert_flush_every,
                          attributor=attributor,
                          fence=lease.still_mine if lease is not None
-                         else None)
+                         else None,
+                         correlator=correlator)
+    correlator_resume = None
+    if correlator is not None:
+        # incident correlation (ISSUE 9, rtap_tpu/correlate/): incidents
+        # ride the alert stream like watchdog events, and a large-blast
+        # incident dumps a postmortem like a quarantine does
+        if correlator.sink is None:
+            correlator.sink = writer.emit_event
+        if correlator.flight is None:
+            correlator.flight = flight
+        if alert_path is not None:
+            # crash/replay safety: re-fold the sink tail BEFORE any
+            # replay/live emission — already-delivered alerts re-enter
+            # the windows from disk (their replays are suppressed
+            # upstream), already-emitted incident ids seed the dedupe
+            # set, and incidents that closed pre-crash without their
+            # event line landing re-emit (exactly-once incident stream
+            # across kill-9). The scan starts at the correlator's
+            # persisted sidecar floor, NOT the checkpoints' alert
+            # cursors: a checkpoint taken while a window was open has a
+            # cursor past that window's earlier members, and a re-fold
+            # missing them would hash a divergent incident_id.
+            if correlator.sidecar_path is None:
+                correlator.sidecar_path = alert_path + ".corr"
+            known = [off for off in (
+                getattr(g, "resume_alerts_offset", None) for g in groups)
+                if off is not None]
+            correlator_resume = correlator.resume_from(
+                alert_path,
+                correlator.resume_scan_offset(min(known) if known else 0))
     if lease is not None:
         # freshness lives on the heartbeat thread (idempotent when the
         # caller already started it); the loop itself only DETECTS the
@@ -1156,6 +1200,11 @@ def live_loop(
                     counter.add(n)
                     obs_scored.inc(n)
                 obs_jr.inc()
+                if correlator is not None:
+                    # the correlation clock advances on the REPLAYED
+                    # stream's own timestamps, so every close decision
+                    # reproduces the uninterrupted run's bit-for-bit
+                    correlator.on_tick(int(jts))
                 last_ts_seen = int(jts) if last_ts_seen is None \
                     else max(last_ts_seen, int(jts))
             journal_replay["replayed_ticks"] = \
@@ -1657,6 +1706,17 @@ def live_loop(
                                          or not chunk_stagger) else c + 1
                 if len(chunk_bufs[c]) >= target or k + 1 == n_ticks:
                     _flush_class(c)
+            if correlator is not None:
+                # after this tick's emission: close quiesced windows on
+                # the SOURCE clock (ts is the clamped tick timestamp, so
+                # a journal replay reproduces every close decision).
+                # Alerts lagging in the pipeline carry their own older
+                # ts — size --correlate-window above the staleness bound
+                # (pipeline_depth * micro_chunk ticks, docs/WORKLOADS.md).
+                # The writer offset lets an all-windows-closed tick
+                # advance the crash-resume sidecar floor to the sink end.
+                correlator.on_tick(ts, tick=k,
+                                   sink_offset=writer.sink_offset())
             ticks_run = k + 1
             if learn and checkpoint_every and checkpoint_dir \
                     and (not any(chunk_bufs) or chunk_stagger) \
@@ -1881,6 +1941,12 @@ def live_loop(
     if health is not None:
         # the model-health artifact: scorecard rollup + incident counts
         extra["health"] = health.stats()
+    if correlator is not None:
+        # the correlation artifact: incidents emitted, windows expired,
+        # resume re-fold summary (docs/WORKLOADS.md incident schema)
+        extra["incidents"] = correlator.stats()
+        if correlator_resume is not None:
+            extra["incidents"]["resume"] = correlator_resume
     if aot_warmup:
         extra["aot_programs_compiled"] = aot_programs
         # cold programs the loop still had to single-flight AFTER the AOT

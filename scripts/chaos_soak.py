@@ -25,6 +25,15 @@ completed its total tick budget, journal recovery actually ran
 (``journal_replayed`` events on the incident stream), and the alert
 stream carries zero duplicated ``alert_id``s.
 
+``--topology-burst`` (ISSUE 9) schedules one explicit ``topology_burst``
+fault — the source floods two adjacent nodes' streams (spanning multiple
+serve groups) with a correlated value burst — alongside seeded
+``source_timeout`` background noise, with topology-aware incident
+correlation armed (``TopologyMap.infer`` over the soak's node naming).
+The verdict: exactly ONE cluster-level incident pages (not N per-stream
+alerts), its blast-radius node set is exactly the flooded nodes, and
+every member alert_id is a real alert line on the stream.
+
 ``--replication`` (ISSUE 8) runs the seeded schedule against a LIVE
 leader/standby pair instead: a journaled leader loop ships every append
 to an in-process :class:`~rtap_tpu.resilience.StandbyFollower` over a
@@ -200,6 +209,128 @@ def run_supervised(args) -> int:
     log(f"OK: {sup.deaths} proc_exit death(s), {total} ticks completed, "
         f"{len(seen_ids)} alert ids unique, {replay_events} journal "
         "replays")
+    return 0
+
+
+def run_topology_burst(args) -> int:
+    """`--topology-burst`: a correlated multi-group value burst rides the
+    seeded schedule; the verdict is ONE cluster-level incident, not N
+    per-stream pages (ISSUE 9)."""
+    import dataclasses
+
+    import numpy as np
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.correlate import IncidentCorrelator, TopologyMap
+    from rtap_tpu.resilience import ChaosEngine, ChaosSpec, Fault
+    from rtap_tpu.service.loop import live_loop
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    if args.streams < 12:
+        log("--topology-burst floods nodes n1+n2 (stream indices 3..8) "
+            "and needs healthy bystanders; use --streams >= 12")
+        return 2
+    # short probation so the default 120-tick run has a mature burst
+    # window: burst at 3/4 of the run, likelihood ready at tick 60
+    probation = 40 + 20
+    burst_tick = args.ticks * 3 // 4
+    burst_dur = 8
+    if burst_tick <= probation + 5:
+        log(f"burst tick {burst_tick} inside the likelihood probation "
+            f"{probation} — raise --ticks (>= 96)")
+        return 2
+    ids = [f"n{i // 3}.m{i % 3}" for i in range(args.streams)]
+    cfg = cluster_preset()
+    cfg = dataclasses.replace(cfg, likelihood=dataclasses.replace(
+        cfg.likelihood, learning_period=40, estimation_samples=20))
+    reg = StreamGroupRegistry(cfg, group_size=args.group_size,
+                              backend=args.backend, threshold=0.1,
+                              debounce=2)
+    for sid in ids:
+        reg.add_stream(sid)
+    reg.finalize()
+
+    # the blast radius: every metric of nodes n1 and n2 — six streams
+    # whose indices straddle a group boundary at the default group size
+    burst_idx = tuple(range(3, 9))
+    burst_nodes = sorted({ids[i].split(".")[0] for i in burst_idx})
+    burst_groups = sorted({i // args.group_size for i in burst_idx})
+    if len(burst_groups) < 2:
+        log(f"burst indices {burst_idx} land in one group at "
+            f"--group-size {args.group_size}; use a size that splits "
+            "them (the point is a MULTI-group burst)")
+        return 2
+    base = ChaosSpec.generate(seed=args.seed, n_ticks=args.ticks,
+                              rate=args.rate, kinds=("source_timeout",))
+    burst = Fault(kind="topology_burst", tick=burst_tick,
+                  duration=burst_dur, streams=burst_idx)
+    spec = ChaosSpec(faults=sorted(base.faults + [burst],
+                                   key=lambda f: f.tick), seed=args.seed)
+    engine = ChaosEngine(spec)
+    log(f"schedule: burst on {burst_nodes} (groups {burst_groups}) at "
+        f"tick {burst_tick} + {len(base.faults)} background fault(s), "
+        f"digest {spec.digest()}")
+
+    correlator = IncidentCorrelator(TopologyMap.infer(), window_s=6,
+                                    min_streams=4)
+
+    def source(k: int):
+        rng = np.random.Generator(np.random.Philox(key=(args.seed, k)))
+        return (30 + 5 * rng.random(len(ids))).astype(np.float32), \
+            1_700_000_000 + k
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_topo_")
+    os.makedirs(workdir, exist_ok=True)
+    alerts_path = os.path.join(workdir, "alerts.jsonl")
+    stats = live_loop(
+        source, reg, n_ticks=args.ticks, cadence_s=args.cadence,
+        alert_path=alerts_path, chaos=engine, correlator=correlator)
+
+    failures: list[str] = []
+    if stats["ticks"] != args.ticks:
+        failures.append(
+            f"loop stopped at tick {stats['ticks']} of {args.ticks}")
+    if "topology_burst" not in {e["kind"] for e in engine.injected}:
+        failures.append("the scheduled topology_burst never injected")
+    # the incident contract is THE shared checker (one copy — a schema
+    # change cannot silently de-fang one of the two topology soaks)
+    from scripts.crash_soak import parse_alert_stream
+    from scripts.workload_soak import check_single_incident
+
+    parsed = parse_alert_stream(alerts_path)
+    incs = check_single_incident(alerts_path, burst_nodes,
+                                 correlator.min_streams, failures,
+                                 "topology-burst", parsed=parsed)
+
+    report = {
+        "mode": "topology_burst",
+        "seed": args.seed,
+        "schedule_digest": spec.digest(),
+        "burst_tick": burst_tick,
+        "burst_nodes": burst_nodes,
+        "burst_groups": burst_groups,
+        "faults_injected": engine.injected,
+        "alert_ids": len(set(parsed["alerts"])),
+        "incidents": len(incs),
+        "incident": incs[0] if len(incs) == 1 else None,
+        "correlator": correlator.stats(),
+        "verified": not failures,
+        "failures": failures,
+        "workdir": workdir,
+    }
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    print(json.dumps(report))
+    if failures:
+        for msg in failures:
+            log(f"FAIL: {msg}")
+        return VERIFY_FAILED_EXIT
+    log(f"OK: 1 incident across groups {burst_groups} "
+        f"({incs[0]['members']} members, {len(incs[0]['nodes'])} nodes) "
+        f"from {len(set(parsed['alerts']))} per-stream alert(s)")
     return 0
 
 
@@ -383,11 +514,19 @@ def main() -> int:
                          "conn_drop/stall_socket/corrupt_bytes faults on "
                          "the replication wire; verify the standby's "
                          "state stays bit-identical to the leader's")
+    ap.add_argument("--topology-burst", action="store_true",
+                    help="incident-correlation mode (ISSUE 9): inject a "
+                         "correlated multi-group value burst with "
+                         "correlation armed; verify exactly ONE cluster-"
+                         "level incident pages, not N per-stream alerts")
     args = ap.parse_args()
     maybe_force_cpu()
-    if args.supervise and args.replication:
-        log("--supervise and --replication are separate drills")
+    if sum((args.supervise, args.replication, args.topology_burst)) > 1:
+        log("--supervise, --replication and --topology-burst are "
+            "separate drills")
         return 2
+    if args.topology_burst:
+        return run_topology_burst(args)
     if args.replication:
         return run_replication(args)
     if args.supervise:

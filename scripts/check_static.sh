@@ -7,7 +7,7 @@
 #     import-time SyntaxError must fail CI even if no test imports the file.
 #  2. print-gate — AST-based (a line grep cannot see a multi-line call):
 #     - rtap_tpu/service/, rtap_tpu/obs/, rtap_tpu/resilience/,
-#       rtap_tpu/ingest/: NO print()
+#       rtap_tpu/ingest/, rtap_tpu/correlate/: NO print()
 #       at all. Telemetry and diagnostics go through rtap_tpu.obs (registry
 #       instruments, watchdog events, snapshots) or logging, never ad-hoc
 #       stdout lines the harness would have to scrape back out of logs.
@@ -31,6 +31,7 @@ STRICT_DIRS = (
     os.path.join("rtap_tpu", "obs"),
     os.path.join("rtap_tpu", "resilience"),
     os.path.join("rtap_tpu", "ingest"),
+    os.path.join("rtap_tpu", "correlate"),
 )
 
 

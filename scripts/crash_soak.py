@@ -267,37 +267,31 @@ def compare_states(ref_ck: str, got_ck: str, failures: list[str]) -> int:
 
 def parse_alert_stream(path: str) -> dict:
     """Split a JSONL incident stream into alert records by alert_id,
-    plus events, duplicates, and unparseable fragments (torn lines)."""
+    plus events, duplicates, and unparseable fragments (torn lines).
+    Line walking rides the ONE shared tolerant iterator
+    (service/alerts.iter_alert_records) so torn-fragment and
+    event-vs-alert semantics can never drift from the serve stack's own
+    resume scans (ISSUE 9 satellite)."""
+    from rtap_tpu.service.alerts import iter_alert_records
+
     alerts: dict = {}
     dup: list[str] = []
     events: list[dict] = []
     garbage = 0
-    if not os.path.isfile(path):
-        return {"alerts": alerts, "dup": dup, "events": events,
-                "garbage": 0}
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                d = json.loads(line)
-            except ValueError:
-                garbage += 1  # torn fragment from a kill mid-write
-                continue
-            if not isinstance(d, dict):
-                garbage += 1
-                continue
-            if "event" in d:
-                events.append(d)
-                continue
-            aid = d.get("alert_id")
-            if aid is None:
-                garbage += 1
-                continue
-            if aid in alerts:
-                dup.append(aid)
-            alerts[aid] = d
+    for kind, rec in iter_alert_records(path):
+        if kind == "garbage":
+            garbage += 1  # torn fragment from a kill mid-write
+            continue
+        if kind == "event":
+            events.append(rec)
+            continue
+        aid = rec.get("alert_id")
+        if aid is None:
+            garbage += 1
+            continue
+        if aid in alerts:
+            dup.append(aid)
+        alerts[aid] = rec
     return {"alerts": alerts, "dup": dup, "events": events,
             "garbage": garbage}
 

@@ -730,6 +730,29 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                       "--lease-timeout", "5.0",
                       "--out", "reports/failover_soak_r11.json"],
      3600.0),
+    # ---------------- round 12 (ISSUE 9: workload breadth) ------------
+    # The composite multi-field encoder on silicon with incident
+    # correlation armed: the seeded cascading-fault soak (exactly ONE
+    # cluster-level incident, kill-9 identical incident stream, bit-
+    # identical state) at a 4-service topology, scored through the
+    # {value, delta, event-class} fused-SDR device encoder. What only
+    # silicon can price: the per-field encode kernels (three disjoint
+    # layout segments vs one uniform RDSE) inside the compiled step at
+    # real cadence, and the correlator fold riding the 1 s tick on the
+    # hw host. --threshold 0.04 is the composite contrast point (the
+    # fused SDR spreads novelty over three fields, flattening the
+    # likelihood profile; see workload_soak --threshold help; cpu-
+    # measured burst ~0.07-0.09 vs healthy ~0.02). Budget covers the
+    # reference + crash runs at 1 s ticks plus compile.
+    ("r12_workloads", [sys.executable, "scripts/workload_soak.py",
+                       "--seed", "9", "--kills", "2",
+                       "--preset", "composite", "--threshold", "0.04",
+                       "--services", "4", "--nodes-per-service", "4",
+                       "--group-size", "16", "--ticks", "420",
+                       "--cadence", "1.0", "--checkpoint-every", "30",
+                       "--backend", "tpu",
+                       "--out", "reports/workload_soak_r12.json"],
+     3600.0),
 ]
 
 

@@ -1,0 +1,180 @@
+"""Oracle-vs-device parity for the ISSUE 9 composite encoder family.
+
+Every new encoder kind (categorical, delta, composite multi-field) must
+be bit-identical across host numpy and jitted JAX, exactly like the
+uniform RDSE family test_encoder_parity.py pins: the cpu oracle IS the
+reference for every committed eval artifact and the crash/replay
+bit-exactness story, so a single diverging bit breaks the repo's
+central contract.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rtap_tpu.config import (
+    CompositeEncoderConfig,
+    DateConfig,
+    FieldSpec,
+    ModelConfig,
+)
+from rtap_tpu.models.oracle.encoders import categorical_bits, encode_record
+from rtap_tpu.ops.encoders_tpu import encode_device
+
+#: one of each kind + per-field geometry that differs field to field, so
+#: a layout-offset bug cannot hide behind uniform sizes
+COMPOSITE = CompositeEncoderConfig(fields=(
+    FieldSpec(name="value", kind="rdse", size=96, active_bits=9,
+              resolution=0.5, seed=3),
+    FieldSpec(name="delta", kind="delta", size=64, active_bits=7,
+              resolution=0.25, seed=3),
+    FieldSpec(name="event_class", kind="categorical", size=80,
+              active_bits=5, seed=3),
+))
+
+
+def _cfg(date=DateConfig(time_of_day_width=5, time_of_day_size=13,
+                         weekend_width=3)) -> ModelConfig:
+    return ModelConfig(n_fields=3, composite=COMPOSITE, date=date)
+
+
+def _dev(cfg):
+    return jax.jit(lambda v, t, o, r, p: encode_device(cfg, v, t, o, r, p))
+
+
+def _host(cfg, values, ts, off, res, prev):
+    return encode_record(cfg, values.astype(np.float64), int(ts), off, res,
+                         prev)
+
+
+@pytest.mark.quick
+def test_composite_encode_parity_with_gaps():
+    """Random walk with NaN gaps: every record must encode bit-identically,
+    with the delta predecessor advanced by the SAME finite-hold rule on
+    both sides."""
+    cfg = _cfg()
+    enc = _dev(cfg)
+    rng = np.random.default_rng(7)
+    off = rng.normal(size=3).astype(np.float32)
+    res = np.asarray(cfg.field_resolutions(), np.float32)
+    prev = np.full(3, np.nan, np.float32)  # state.py init: no predecessor
+    for i in range(60):
+        values = (rng.normal(size=3) * 8).astype(np.float32)
+        values[2] = float(rng.integers(0, 40))  # category ids are whole
+        if i % 6 == 0:
+            values[rng.integers(3)] = np.nan  # missing sample
+        ts = int(rng.integers(0, 2_000_000_000))
+        host = _host(cfg, values, ts, off, res, prev)
+        dev = np.asarray(enc(jnp.asarray(values), jnp.int32(ts),
+                             jnp.asarray(off), jnp.asarray(res),
+                             jnp.asarray(prev)))
+        np.testing.assert_array_equal(host, dev, err_msg=f"record {i}")
+        # the device step's own predecessor-advance rule (ops/step.py)
+        prev = np.where(np.isfinite(values), values, prev).astype(np.float32)
+
+
+@pytest.mark.quick
+def test_delta_first_sample_encodes_as_missing_on_both_backends():
+    """NuPIC DeltaEncoder: the first sample has no predecessor — the delta
+    field contributes ZERO bits (on both backends), while the sibling
+    fields encode normally."""
+    cfg = _cfg(date=DateConfig(0, 0, 0))
+    enc = _dev(cfg)
+    values = np.asarray([5.0, 5.0, 2.0], np.float32)
+    off = np.zeros(3, np.float32)
+    res = np.asarray(cfg.field_resolutions(), np.float32)
+    prev = np.full(3, np.nan, np.float32)
+    host = _host(cfg, values, 0, off, res, prev)
+    dev = np.asarray(enc(jnp.asarray(values), jnp.int32(0), jnp.asarray(off),
+                         jnp.asarray(res), jnp.asarray(prev)))
+    np.testing.assert_array_equal(host, dev)
+    layout = cfg.field_layout()
+    _n, _k, d_off, d_size = layout[1]
+    assert host[d_off:d_off + d_size].sum() == 0, \
+        "delta field must be silent without a predecessor"
+    assert host.sum() > 0, "value/categorical fields must still encode"
+    # second sample: the delta field lights up
+    prev2 = values
+    host2 = _host(cfg, np.asarray([9.0, 9.0, 2.0], np.float32), 0, off, res,
+                  prev2)
+    assert host2[d_off:d_off + d_size].sum() > 0
+
+
+def test_categorical_extreme_ids_clamp_identically():
+    """Wild category ids (garbage joins, 1e30 sensor noise) must clamp
+    through the same double bound on both backends: the f32 bucket clamp,
+    then the per-field categorical clamp that keeps the device's int32
+    c*w + k from wrapping."""
+    cfg = ModelConfig(n_fields=1, composite=CompositeEncoderConfig(fields=(
+        FieldSpec(name="ev", kind="categorical", size=80, active_bits=5),)),
+        date=DateConfig(0, 0, 0))
+    enc = _dev(cfg)
+    off = np.zeros(1, np.float32)
+    res = np.asarray(cfg.field_resolutions(), np.float32)
+    prev = np.full(1, np.nan, np.float32)
+    for x in (0.0, 1.0, -1.0, 1e9, -1e9, 1e30, -1e30, 3.4e38):
+        values = np.asarray([x], np.float32)
+        host = _host(cfg, values, 0, off, res, prev)
+        dev = np.asarray(enc(jnp.asarray(values), jnp.int32(0),
+                             jnp.asarray(off), jnp.asarray(res),
+                             jnp.asarray(prev)))
+        np.testing.assert_array_equal(host, dev, err_msg=f"id {x}")
+
+
+def test_categorical_ids_are_pairwise_near_disjoint():
+    """The defining categorical property (vs the RDSE's deliberate
+    neighbor overlap): adjacent ids share no hash keys, so their SDRs
+    overlap only by hash coincidence."""
+    spec = FieldSpec(name="ev", kind="categorical", size=256, active_bits=11)
+    sdrs = []
+    for c in range(8):
+        s = np.zeros(spec.size, bool)
+        s[categorical_bits(spec, c)] = True
+        sdrs.append(s)
+    for i in range(8):
+        for j in range(i + 1, 8):
+            assert int((sdrs[i] & sdrs[j]).sum()) <= 2, (i, j)
+
+
+@pytest.mark.quick
+def test_composite_layout_bits_stay_inside_their_field():
+    """Layout round-trip: each field's active bits land inside its own
+    field_layout() range — the invariant attribution's per-field decode
+    and the docs/WORKLOADS.md layout table both rest on."""
+    cfg = _cfg(date=DateConfig(0, 0, 0))
+    layout = cfg.field_layout()
+    assert [r[3] for r in layout] == [96, 64, 80]
+    assert [r[2] for r in layout] == [0, 96, 160]
+    assert cfg.input_size == 240
+    off = np.zeros(3, np.float32)
+    res = np.asarray(cfg.field_resolutions(), np.float32)
+    prev = np.asarray([1.0, 1.0, 1.0], np.float32)
+    # one field at a time: the other two are NaN (no bits)
+    for f, (_name, _kind, f_off, f_size) in enumerate(layout):
+        values = np.full(3, np.nan, np.float32)
+        values[f] = 7.0
+        host = _host(cfg, values, 0, off, res, prev)
+        on = np.flatnonzero(host)
+        assert on.size > 0
+        assert on.min() >= f_off and on.max() < f_off + f_size, \
+            (f, on.min(), on.max())
+
+
+def test_uniform_config_unchanged_by_composite_support():
+    """The scalar path's guarantee: with composite=None the encode output
+    (and the per-field resolution row init) is byte-identical to the
+    pre-ISSUE-9 uniform family."""
+    cfg = ModelConfig(n_fields=2)
+    assert cfg.field_resolutions() == (cfg.rdse.resolution,) * 2
+    rows = cfg.field_layout()
+    assert [r[0] for r in rows] == ["f0", "f1"]
+    assert all(r[1] == "rdse" for r in rows)
+    values = np.asarray([3.0, 4.0], np.float32)
+    off = np.zeros(2, np.float32)
+    host_new = encode_record(cfg, values.astype(np.float64), 1234, off,
+                             None, None)
+    host_old = encode_record(cfg, values.astype(np.float64), 1234, off)
+    np.testing.assert_array_equal(host_new, host_old)

@@ -169,3 +169,124 @@ def test_routing_history_keeps_many_live_groups_and_bounds_churn(
                                 NO_ALERTS)
     assert len(at._prev) <= 64
     assert at.live_evictions > 0  # fresh evictions are visible, not silent
+
+
+# ---- composite per-field decode (ISSUE 9): alerts name the FIELD ----
+
+def _composite_cfg():
+    import dataclasses
+
+    from rtap_tpu.config import CompositeEncoderConfig, FieldSpec
+
+    return dataclasses.replace(
+        cluster_preset(), n_fields=3,
+        composite=CompositeEncoderConfig(fields=(
+            FieldSpec(name="value", kind="rdse", size=128, active_bits=11,
+                      resolution=0.5),
+            FieldSpec(name="delta", kind="delta", size=128, active_bits=11,
+                      resolution=0.5),
+            FieldSpec(name="event_class", kind="categorical", size=128,
+                      active_bits=11),
+        )))
+
+
+@pytest.mark.quick
+def test_composite_alert_names_the_spiked_field():
+    cfg = _composite_cfg()
+    at = AlertAttributor(cfg, top_k=3)
+    ids = ["svc-00"]
+    # two quiet ticks first: the delta field needs 2-deep history
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2.0]], np.float32),
+                            NO_ALERTS)
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2.0]], np.float32),
+                            NO_ALERTS)
+    # the value spikes; it carries the SAME wire value into the delta
+    # field (the composite wire convention), so both fire — the value
+    # by bucket distance, the delta by its encoded first difference
+    out = at.update_and_attribute(
+        ids, np.array([[60.0, 60.0, 2.0]], np.float32), np.array([0]))
+    top = out[0]
+    assert top, "a 100-bucket move must attribute"
+    names = [f["name"] for f in top]
+    assert "value" in names and "delta" in names
+    assert "event_class" not in names  # the category never changed
+    for f in top:
+        assert f["name"] == ("value", "delta", "event_class")[f["field"]]
+
+
+@pytest.mark.quick
+def test_categorical_field_is_all_or_nothing():
+    """Distinct category ids share no hash keys: ANY id change is full
+    novelty (1.0), and an unchanged id contributes zero — unlike the
+    rdse's graded bucket distance."""
+    cfg = _composite_cfg()
+    at = AlertAttributor(cfg, top_k=3)
+    ids = ["svc-00"]
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2.0]], np.float32),
+                            NO_ALERTS)
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2.0]], np.float32),
+                            NO_ALERTS)
+    # only the event class moves — by ONE id, the adjacency the rdse
+    # would score as a near-zero 1-bucket nudge
+    out = at.update_and_attribute(
+        ids, np.array([[10.0, 10.0, 3.0]], np.float32), np.array([0]))
+    top = out[0]
+    assert [f["name"] for f in top] == ["event_class"]
+    assert top[0]["contribution"] == pytest.approx(1.0)
+    assert top[0]["bucket_delta"] == 1
+
+
+@pytest.mark.quick
+def test_categorical_ids_beyond_the_encoder_clamp_do_not_attribute():
+    """Two raw wire ids past ``FieldSpec.categorical_clamp()`` clip to
+    the SAME category in the encoder (bit-identical SDR on both
+    backends), so the decode must not name the field as spiked — the
+    attribution mirrors the encoder's id clamp."""
+    cfg = _composite_cfg()
+    at = AlertAttributor(cfg, top_k=3)
+    ids = ["svc-00"]
+    # clamp = (1<<30)//11 ~= 97.6M: both ids below sit beyond it
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2e8]], np.float32),
+                            NO_ALERTS)
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2e8]], np.float32),
+                            NO_ALERTS)
+    out = at.update_and_attribute(
+        ids, np.array([[10.0, 10.0, 3e8]], np.float32), np.array([0]))
+    assert "event_class" not in [f["name"] for f in out[0]]
+
+
+@pytest.mark.quick
+def test_delta_field_fires_on_slope_flip_inside_the_band():
+    """The delta encoder's reason to exist: a rate-of-change anomaly at
+    an ordinary absolute level. The value field sees a small bucket
+    move; the delta field sees its encoded first difference jump."""
+    cfg = _composite_cfg()
+    at = AlertAttributor(cfg, top_k=3)
+    ids = ["svc-00"]
+    # steady +0.5/tick ramp: encoded delta constant at bucket +1
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2.0]], np.float32),
+                            NO_ALERTS)
+    at.update_and_attribute(ids, np.array([[10.5, 10.5, 2.0]], np.float32),
+                            NO_ALERTS)
+    # slope flips to -0.5/tick: |value| moves 2 buckets, the DELTA moves
+    # from +0.5 to -0.5 (2 buckets at res 0.5) — both report, and the
+    # delta's verdict needed the tick-before-base history row
+    out = at.update_and_attribute(
+        ids, np.array([[10.0, 10.0, 2.0]], np.float32), np.array([0]))
+    by_name = {f["name"]: f for f in out[0]}
+    assert "delta" in by_name
+    assert by_name["delta"]["bucket_delta"] == -2
+
+
+@pytest.mark.quick
+def test_delta_field_has_no_verdict_without_two_ticks_of_history():
+    cfg = _composite_cfg()
+    at = AlertAttributor(cfg, top_k=3)
+    ids = ["svc-00"]
+    at.update_and_attribute(ids, np.array([[10.0, 10.0, 2.0]], np.float32),
+                            NO_ALERTS)
+    # first attributable tick: base exists, base2 does not — the delta
+    # field must stay silent instead of fabricating a verdict
+    out = at.update_and_attribute(
+        ids, np.array([[60.0, 60.0, 2.0]], np.float32), np.array([0]))
+    assert [f["name"] for f in out[0]] == ["value"]

@@ -230,6 +230,47 @@ def test_chaos_engine_group_targeted_source_timeout_uses_routing():
     assert np.isnan(v2).all()
 
 
+def test_chaos_topology_burst_floods_targeted_streams_only():
+    """The ISSUE 9 blast-radius fault: targeted indices gain `magnitude`
+    for the window, bystanders stay bit-identical, and a co-firing
+    source_timeout NaN stays NaN (a dead exporter reports nothing,
+    burst or not)."""
+    eng = ChaosEngine(ChaosSpec(faults=[
+        Fault(kind="topology_burst", tick=1, duration=2, streams=(1, 2),
+              magnitude=7.5),
+        Fault(kind="source_timeout", tick=2, streams=(2,))]))
+    wrapped = eng.wrap_source(lambda t: (np.ones(4, np.float32), 5))
+    v0, _ = wrapped(0)          # before the window: untouched
+    assert (v0 == 1.0).all()
+    v1, _ = wrapped(1)
+    assert v1.tolist() == [1.0, 8.5, 8.5, 1.0]
+    v2, _ = wrapped(2)          # timeout wins on the overlapping index
+    assert v2[1] == 8.5 and np.isnan(v2[2])
+    v3, _ = wrapped(3)          # window over
+    assert (v3 == 1.0).all()
+    assert [e["kind"] for e in eng.injected].count("topology_burst") == 2
+
+
+def test_chaos_topology_burst_spec_round_trips_and_shifts():
+    """`magnitude` serializes for topology_burst only (pre-ISSUE-9 specs
+    keep their exact dict shape — the digest pin in test_replicate.py)
+    and survives both the JSON round-trip and a restart shift."""
+    spec = ChaosSpec(faults=[
+        Fault(kind="topology_burst", tick=4, duration=3, streams=(0, 1),
+              magnitude=3.25),
+        Fault(kind="source_malformed", tick=1)])
+    d = spec.to_dict()
+    assert d["faults"][0]["magnitude"] == 3.25
+    assert "magnitude" not in d["faults"][1]
+    back = ChaosSpec.from_dict(json.loads(json.dumps(d)))
+    assert back.digest() == spec.digest()
+    assert back.faults[0].magnitude == 3.25
+    shifted = spec.shifted(5)
+    assert shifted.faults == [Fault(kind="topology_burst", tick=0,
+                                    duration=2, streams=(0, 1),
+                                    magnitude=3.25)]
+
+
 def test_chaos_spec_rejects_unknown_kind():
     with pytest.raises(ValueError, match="unknown fault kind"):
         Fault(kind="meteor_strike", tick=0)

@@ -1,0 +1,93 @@
+"""Drain-style log-template miner units (ISSUE 9 encoder family): stable
+first-seen ids, variable masking, merge-vs-mint behavior, determinism
+across replay, and the bounded-overflow contract."""
+
+import pytest
+
+from rtap_tpu.ingest.templates import WILDCARD, TemplateMiner
+
+
+@pytest.mark.quick
+def test_same_structure_same_id_across_variables():
+    m = TemplateMiner()
+    a = m.observe("connected to host 10.0.3.7 port 443")
+    b = m.observe("connected to host 10.0.9.1 port 8080")
+    assert a == b
+    assert m.n_templates() == 1
+    assert WILDCARD in m.template(a)
+
+
+@pytest.mark.quick
+def test_different_structures_mint_different_ids():
+    m = TemplateMiner()
+    a = m.observe("heartbeat ok seq 1")
+    b = m.observe("ERROR disk failure on volume 3 remounting read-only")
+    assert a != b
+    assert m.n_templates() == 2
+
+
+def test_ids_are_dense_in_first_seen_order():
+    m = TemplateMiner()
+    lines = ["alpha event", "beta event happened", "alpha event",
+             "gamma thing done now", "beta event happened"]
+    ids = [m.observe(ln) for ln in lines]
+    assert ids == [0, 1, 0, 2, 1]
+
+
+def test_replay_determinism():
+    """The same line sequence mines the same ids — the property the
+    journal/crash replay story rests on."""
+    lines = [f"request /api/v1/items served in {i * 13 % 400} ms status 200"
+             if i % 3 else f"gc pause {i} ms heap {i * 7} mb"
+             for i in range(200)]
+    a = TemplateMiner().encode_values(lines)
+    b = TemplateMiner().encode_values(lines)
+    assert a == b
+
+
+def test_template_generalizes_variable_positions():
+    m = TemplateMiner(sim_threshold=0.5)
+    m.observe("job sync finished with status ok")
+    tid = m.observe("job sync finished with status failed")
+    assert m.template(tid) == f"job sync finished with status {WILDCARD}"
+
+
+def test_token_count_partitions():
+    """Drain's first split is token count: same words, different arity
+    never merge."""
+    m = TemplateMiner()
+    a = m.observe("cache miss")
+    b = m.observe("cache miss on shard primary")
+    assert a != b
+
+
+def test_overflow_folds_not_drops(caplog):
+    m = TemplateMiner(max_templates=4)
+    ids = [m.observe(f"structure{'x' * (i + 1)} one two") for i in range(8)]
+    assert max(ids) == m.overflow_id
+    assert m.overflow == 8 - 3  # 3 real templates + the overflow bucket
+    assert m.template(m.overflow_id) == "<overflow>"
+    assert m.stats()["overflow"] == m.overflow
+
+
+def test_empty_and_whitespace_lines():
+    m = TemplateMiner()
+    a = m.observe("")
+    b = m.observe("   ")
+    assert a == b  # both mask to the single-wildcard template
+
+
+def test_encode_values_returns_floats():
+    m = TemplateMiner()
+    out = m.encode_values(["heartbeat ok seq 5", "heartbeat ok seq 6"])
+    assert out == [0.0, 0.0]
+    assert all(isinstance(v, float) for v in out)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="depth"):
+        TemplateMiner(depth=0)
+    with pytest.raises(ValueError, match="sim_threshold"):
+        TemplateMiner(sim_threshold=0.0)
+    with pytest.raises(ValueError, match="max_templates"):
+        TemplateMiner(max_templates=1)
