@@ -577,39 +577,28 @@ def run_ingest_bench() -> None:
 def run_obs_bench() -> None:
     """`bench.py --obs-bench`: the telemetry-overhead self-benchmark.
 
-    The obs subsystem instruments every serve-path tick; its acceptance bar
-    is <= 1% of the tick budget (docs/TELEMETRY.md) — and so are the span
-    ring + flight recorder (ISSUE 4), the write-ahead tick journal
-    (ISSUE 5), the model-health fold path (ISSUE 6), and the incident-
-    correlator fold at its alert-storm ceiling (ISSUE 9). Prints one JSON
-    line per surface with per-op costs and the projected per-tick fraction
-    at 1 s cadence; exits 1 if any bar is blown (so CI/harness runs fail
-    loudly).
+    Table-driven over ``rtap_tpu.obs.selfbench.GATE_MEASURES`` (ISSUE 11
+    satellite): every self-benchmarked instrument surface — registry
+    metrics, span ring + flight recorder (ISSUE 4), write-ahead journal
+    (ISSUE 5), model-health fold (ISSUE 6), incident-correlator storm
+    ceiling (ISSUE 9), detection-latency sketches + SLO evaluation
+    (ISSUE 11) — is one registry row gated against the shared
+    ``GATE_BUDGET_FRAC`` (<= 1% of the tick budget, docs/TELEMETRY.md).
+    A new instrument registers a row or never gets a gate; prints one
+    JSON line per surface and exits 1 if any bar is blown (so CI/harness
+    runs fail loudly).
     """
-    from rtap_tpu.obs.selfbench import (
-        measure, measure_correlate, measure_health, measure_journal,
-        measure_trace,
-    )
+    from rtap_tpu.obs.selfbench import GATE_BUDGET_FRAC, GATE_MEASURES
 
-    res = measure()
-    res["pass_1pct_budget"] = res["per_tick_overhead_frac"] <= 0.01
-    print(json.dumps({"metric": "obs_overhead", **res}), flush=True)
-    tres = measure_trace()
-    tres["pass_1pct_budget"] = tres["per_tick_overhead_frac"] <= 0.01
-    print(json.dumps({"metric": "obs_trace_overhead", **tres}), flush=True)
-    jres = measure_journal()
-    jres["pass_1pct_budget"] = jres["per_tick_overhead_frac"] <= 0.01
-    print(json.dumps({"metric": "obs_journal_overhead", **jres}), flush=True)
-    hres = measure_health()
-    hres["pass_1pct_budget"] = hres["per_tick_overhead_frac"] <= 0.01
-    print(json.dumps({"metric": "obs_health_overhead", **hres}), flush=True)
-    cres = measure_correlate()
-    cres["pass_1pct_budget"] = cres["per_tick_overhead_frac"] <= 0.01
-    print(json.dumps({"metric": "obs_correlate_overhead", **cres}),
-          flush=True)
-    if not (res["pass_1pct_budget"] and tres["pass_1pct_budget"]
-            and jres["pass_1pct_budget"] and hres["pass_1pct_budget"]
-            and cres["pass_1pct_budget"]):
+    all_pass = True
+    for name, fn in GATE_MEASURES:
+        res = fn()
+        res["budget_frac"] = GATE_BUDGET_FRAC
+        res["pass_1pct_budget"] = \
+            res["per_tick_overhead_frac"] <= GATE_BUDGET_FRAC
+        all_pass = all_pass and res["pass_1pct_budget"]
+        print(json.dumps({"metric": name, **res}), flush=True)
+    if not all_pass:
         sys.exit(1)
 
 

@@ -118,6 +118,54 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print(f"serve: incident correlation armed ({'inferred' if args.topology == 'infer' else args.topology}; "
               f"window {correlator.window_s}s, min {correlator.min_streams} "
               "streams)", file=sys.stderr)
+    # detection-latency observability + SLOs (ISSUE 11, obs/latency.py,
+    # obs/slo.py, docs/SLO.md): specs parse BEFORE any source/registry
+    # construction — a malformed --slo is a usage error, not a
+    # half-started serve with a listener to clean up
+    slo_specs = []
+    if args.slo:
+        from rtap_tpu.obs import parse_slo
+
+        try:
+            slo_specs = [parse_slo(s) for s in args.slo]
+        except ValueError as e:
+            print(f"serve: bad --slo: {e}", file=sys.stderr)
+            return 2
+    latency = None
+    slo_tracker = None
+    if args.latency:
+        from rtap_tpu.obs import LatencyTracker
+
+        try:
+            latency = LatencyTracker(
+                window_ticks=args.latency_window
+                if args.latency_window is not None else 120,
+                cadence_s=args.cadence)
+        except ValueError as e:
+            print(f"serve: bad --latency-window: {e}", file=sys.stderr)
+            return 2
+        print("serve: detection-latency tracking armed (window "
+              f"{latency.window_ticks} ticks; GET /latency with "
+              "--obs-port)", file=sys.stderr)
+    if slo_specs:
+        from rtap_tpu.obs import SloTracker
+
+        try:
+            slo_tracker = SloTracker(
+                slo_specs, cadence_s=args.cadence,
+                fast_window=args.slo_fast_window
+                if args.slo_fast_window is not None else 60,
+                slow_window=args.slo_slow_window
+                if args.slo_slow_window is not None else 600,
+                quantile_source=latency.quantile)
+        except ValueError as e:
+            print(f"serve: bad --slo/--slo-*-window: {e}",
+                  file=sys.stderr)
+            return 2
+        print("serve: SLOs armed: "
+              + ", ".join(s.label() for s in slo_specs)
+              + f" (burn windows {slo_tracker.fast_window}/"
+              f"{slo_tracker.slow_window} ticks)", file=sys.stderr)
     degradation = None
     if args.degrade:
         from rtap_tpu.resilience import DegradationController
@@ -398,11 +446,23 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     from rtap_tpu.obs import bump_run_epoch
 
     bump_run_epoch(args.alerts)
+    if latency is not None:
+        # first-class lag gauges (ISSUE 11): polled once per tick into
+        # rtap_obs_latency_lag{lag=...} — replication-ack lag while a
+        # standby is attached, incident-close lag while correlating
+        if sender is not None:
+            latency.lag_providers["repl_ack_ticks"] = \
+                lambda _t, _ts: sender.ack_lag_ticks()
+        if correlator is not None:
+            latency.lag_providers["incident_close_s"] = \
+                lambda _t, ts: correlator.oldest_open_age_s(ts)
     obs_server = None
     if args.obs_port is not None:
-        obs_server = ExpositionServer(port=args.obs_port, trace=trace,
-                                      flight=flight, health=health,
-                                      correlator=correlator).start()
+        obs_server = ExpositionServer(
+            port=args.obs_port, trace=trace,
+            flight=flight, health=health,
+            correlator=correlator, latency=latency, slo=slo_tracker,
+            healthz_stale_after_s=max(30.0, 10 * args.cadence)).start()
         ohost, oport = obs_server.address
         print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
               file=sys.stderr)
@@ -449,7 +509,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                               health=health,
                               lease=lease,
                               resume_suppression=resume_sup,
-                              correlator=correlator)
+                              correlator=correlator,
+                              latency=latency,
+                              slo=slo_tracker)
         except BaseException as e:  # noqa: BLE001 — dump, then re-raise
             # crash black-box: an exception escaping serve dumps a
             # postmortem bundle BEFORE the traceback, so a dead soak
@@ -1025,6 +1087,42 @@ def main(argv: list[str] | None = None) -> int:
                         "to emit an incident; below it the window expires "
                         "silently (the per-stream alert lines already "
                         "told that story). Default 3; needs --topology")
+    p.add_argument("--latency", action="store_true",
+                   help="arm detection-latency observability (docs/SLO.md; "
+                        "docs/TELEMETRY.md latency section): per-tick "
+                        "stage waterfalls (source ts -> ingest arrival/"
+                        "backfill release -> dispatch -> collect -> "
+                        "alert-sink flush) folded into bounded windowed "
+                        "quantile sketches, a per-alert end-to-end detect "
+                        "sketch observed at sink-write time, and first-"
+                        "class replication-ack / incident-close lag "
+                        "gauges. Host wall clocks only — zero extra "
+                        "device fetches; alert stream and model state are "
+                        "byte/bit-identical with the flag off")
+    p.add_argument("--latency-window", type=int, default=None,
+                   help="quantile-sketch window in ticks (default 120): "
+                        "GET /latency reports p50/p95/p99/p99.9 over the "
+                        "last one-to-two windows, next to lifetime "
+                        "totals. Needs --latency")
+    p.add_argument("--slo", action="append", default=None,
+                   metavar="NAME=TARGET@pQ",
+                   help="declare a latency SLO (repeatable), e.g. "
+                        "detect=2s@p99 ('99%% of alerts within 2s of "
+                        "their row's source timestamp') or tick=500ms@p95. "
+                        "Stages: detect, tick, ingest, dispatch, collect, "
+                        "emit. Evaluated with fast/slow multi-window "
+                        "burn rates; edge-triggered slo_burn/"
+                        "slo_recovered/slo_budget_exhausted events ride "
+                        "the alert stream, a fast burn dumps a postmortem "
+                        "bundle, and the run's verdict lands in the stats "
+                        "line + GET /slo (docs/SLO.md). Needs --latency")
+    p.add_argument("--slo-fast-window", type=int, default=None,
+                   help="fast burn-rate window in ticks (default 60; "
+                        "1 min at 1 s cadence). Needs --slo")
+    p.add_argument("--slo-slow-window", type=int, default=None,
+                   help="slow burn-rate window in ticks (default 600; "
+                        "10 min at 1 s cadence; must be >= the fast "
+                        "window). Needs --slo")
     p.add_argument("--alert-attribution", action="store_true",
                    help="per-alert provenance: alert JSONL lines gain a "
                         "top_fields block naming the encoder fields whose "
@@ -1174,6 +1272,25 @@ def main(argv: list[str] | None = None) -> int:
             and args.correlate_min_streams < 2):
         print("serve: --correlate-min-streams must be >= 2 (one stream "
               "is a per-stream alert, not an incident)", file=sys.stderr)
+        return 2
+    if getattr(args, "slo", None) and not getattr(args, "latency", False):
+        print("serve: --slo declares an objective over the latency "
+              "tracker's measurements; add --latency", file=sys.stderr)
+        return 2
+    if getattr(args, "latency_window", None) is not None \
+            and not getattr(args, "latency", False):
+        print("serve: --latency-window sizes the quantile-sketch window; "
+              "add --latency", file=sys.stderr)
+        return 2
+    if (getattr(args, "slo_fast_window", None) is not None
+            or getattr(args, "slo_slow_window", None) is not None) \
+            and not getattr(args, "slo", None):
+        print("serve: --slo-fast-window/--slo-slow-window are burn-rate "
+              "knobs; add --slo NAME=TARGET@pQ", file=sys.stderr)
+        return 2
+    if getattr(args, "latency_window", None) is not None \
+            and args.latency_window < 1:
+        print("serve: --latency-window must be >= 1", file=sys.stderr)
         return 2
     if getattr(args, "http", None) and (
             getattr(args, "ingest_port", None) is not None

@@ -161,6 +161,7 @@ class IncidentCorrelator:
         self._recent: deque = deque(maxlen=256)
         self._replaying = False
         self._replay_pending: list[dict] = []
+        self._last_now_ts = 0  # the correlation clock's latest position
         # counters/gauges (docs/TELEMETRY.md incident section)
         obs = registry if registry is not None else get_registry()
         self._obs_incidents = obs.counter(
@@ -246,6 +247,7 @@ class IncidentCorrelator:
         if now_ts is None:
             return []
         now_ts = int(now_ts)
+        self._last_now_ts = max(self._last_now_ts, now_ts)
         emitted = []
         with self._lock:
             closed_any = False
@@ -262,6 +264,19 @@ class IncidentCorrelator:
                 self._obs_open.set(len(self._open))
                 self._update_sidecar(idle_offset=sink_offset)
         return emitted
+
+    def oldest_open_age_s(self, now_ts: int | None = None) -> float:
+        """Age (source-clock seconds) of the oldest OPEN correlation
+        window — the incident-close lag the latency layer exposes as a
+        first-class gauge (ISSUE 11): how far behind the incident stream
+        can be running relative to the per-stream alerts feeding it.
+        0.0 with no open windows."""
+        now = int(now_ts) if now_ts is not None else self._last_now_ts
+        with self._lock:
+            if not self._open:
+                return 0.0
+            first = min(w.first_ts for w in self._open.values())
+        return float(max(0, now - first))
 
     def _update_sidecar(self, idle_offset: int | None = None) -> None:
         """Persist the re-fold floor: the min start offset over open

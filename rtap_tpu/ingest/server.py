@@ -109,6 +109,14 @@ class BinaryBatchSource:
         self._tick_frame_rows = 0
         self._tick_pure = True  # emission == replay of _tick_frames
         self._last_tick_frames = None
+        # detection-latency stage surfaces (ISSUE 11, obs/latency.py):
+        # the latest DATA frame's wire-transit lag (arrival wall clock
+        # minus its freshest row ts) and, in backfill mode, the hold the
+        # horizon imposed on the last emitted tick. Plain floats the
+        # LatencyTracker getattr-probes once per tick; None until data.
+        self._arrival_wall: float | None = None
+        self._arrival_ts = 0
+        self._release_hold: float | None = None
         # map epoch 1..65535 (0 is reserved for epoch-unaware
         # producers): bumped on every membership change so a producer
         # still sending with a cached map goes loudly deaf instead of
@@ -511,6 +519,10 @@ class BinaryBatchSource:
         prev_max = self._max_row_ts
         if ts_rows.size:
             self._max_row_ts = max(self._max_row_ts, int(ts_rows.max()))
+            # stage surface: when THIS frame's freshest row arrived,
+            # in wall time (one clock read per frame, not per row)
+            self._arrival_wall = time.time()
+            self._arrival_ts = int(ts_rows.max())
         applied = int(valid.sum())
         if applied:
             if self.horizon == 0:
@@ -648,6 +660,9 @@ class BinaryBatchSource:
             merged[m] = vec[m]
         self._emit_floor = due[-1]
         self._latest_ts = max(self._latest_ts, due[-1])
+        # stage surface: the hold the horizon imposed on this emission
+        # (newest data seen minus the tick just released, ~= horizon)
+        self._release_hold = float(max(0, self._max_row_ts - due[-1]))
         return merged, due[-1]
 
     def _synth_frames(self, values, ts) -> list[bytes]:
@@ -673,6 +688,22 @@ class BinaryBatchSource:
             _tag, values, ts = out
             return self._synth_frames(values, ts)
         return out or []
+
+    # ---- detection-latency stage surfaces (obs/latency.py probes) ----
+    @property
+    def last_arrival_lag_s(self) -> float | None:
+        """Wire-transit lag of the freshest DATA frame (arrival wall
+        clock minus its newest row's source ts, clamped >= 0); None
+        before any data arrived."""
+        if self._arrival_wall is None:
+            return None
+        return max(0.0, self._arrival_wall - self._arrival_ts)
+
+    @property
+    def last_release_hold_s(self) -> float | None:
+        """Backfill hold of the last emitted tick (newest row ts seen
+        minus the released tick's ts); None in latest-wins mode."""
+        return self._release_hold
 
     # ---- health surface (serve stats line parity with TcpJsonlSource)
     @property

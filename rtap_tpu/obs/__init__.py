@@ -9,7 +9,9 @@ events (obs/watchdog.py); a per-tick span recorder exporting
 Perfetto-loadable Chrome trace JSON (obs/trace.py); and a black-box
 flight recorder that auto-dumps atomic postmortem bundles on
 quarantine/degradation/miss-burst/crash (obs/flight.py,
-docs/POSTMORTEM.md). The serve hot paths (service/loop.py,
+docs/POSTMORTEM.md); detection-latency quantile sketches + stage
+waterfalls (obs/latency.py) with operator-declared SLO burn-rate
+alerting (obs/slo.py, docs/SLO.md). The serve hot paths (service/loop.py,
 service/alerts.py, service/sources.py, service/checkpoint.py) emit
 through this seam; docs/TELEMETRY.md catalogs every metric.
 """
@@ -32,6 +34,8 @@ from rtap_tpu.obs.metrics import (
 )
 from rtap_tpu.obs.flight import FlightRecorder, validate_bundle
 from rtap_tpu.obs.health import HealthTracker, bump_run_epoch
+from rtap_tpu.obs.latency import LatencyTracker, QuantileSketch
+from rtap_tpu.obs.slo import SloSpec, SloTracker, parse_slo
 from rtap_tpu.obs.trace import TraceRecorder
 from rtap_tpu.obs.watchdog import TickWatchdog
 
@@ -42,6 +46,10 @@ __all__ = [
     "Gauge",
     "HealthTracker",
     "Histogram",
+    "LatencyTracker",
+    "QuantileSketch",
+    "SloSpec",
+    "SloTracker",
     "TelemetryRegistry",
     "TickWatchdog",
     "TraceRecorder",
@@ -49,6 +57,7 @@ __all__ = [
     "default_snapshot_path",
     "get_registry",
     "log_buckets",
+    "parse_slo",
     "read_last_snapshot",
     "render_prometheus",
     "summarize_snapshot",

@@ -103,16 +103,50 @@ def render_prometheus(registry: TelemetryRegistry | None = None) -> str:
 def write_snapshot(path: str | None = None,
                    registry: TelemetryRegistry | None = None) -> dict | None:
     """Append one JSON snapshot line to `path` (default: $RTAP_OBS_SNAPSHOT;
-    no-op returning None when neither is set). Returns the snapshot dict."""
+    no-op returning None when neither is set). Returns the snapshot dict.
+
+    The append is tmp-file + atomic rename (read the existing bytes,
+    write them plus the new line to a temp sibling, ``os.replace``):
+    a scraper or soak harness polling the file mid-write can never read
+    a torn half-line — the same discipline as postmortem bundles and
+    the correlator sidecar. Snapshot files are one line per serve exit
+    (plus per-step session lines), so the copy is a few KB, not a log.
+    """
     path = path or default_snapshot_path()
     if not path:
         return None
     snap = (registry or get_registry()).snapshot()
-    d = os.path.dirname(os.path.abspath(path))
+    line = (json.dumps(snap) + "\n").encode()
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "a") as f:
-        f.write(json.dumps(snap) + "\n")
+    # an flock sidecar serializes concurrent writers (two serve
+    # processes sharing an ambient $RTAP_OBS_SNAPSHOT — e.g. an HA
+    # pair on one host — must not read-modify-replace over each other
+    # and silently drop an exit line the old O_APPEND write kept)
+    import fcntl
+
+    with open(path + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            with open(path, "rb") as f:
+                prior = f.read()
+            if prior and not prior.endswith(b"\n"):
+                prior += b"\n"  # heal a torn pre-atomic writer's tail
+        except FileNotFoundError:
+            prior = b""
+        except OSError:
+            # the file EXISTS but won't read (transient EIO/EACCES):
+            # fall back to a plain append — a possibly-torn extra line
+            # beats replacing the accumulated history with nothing
+            with open(path, "ab") as f:
+                f.write(line)
+            return snap
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(prior + line)
+        os.replace(tmp, path)
     return snap
 
 
@@ -216,6 +250,63 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = (json.dumps(co.snapshot()) + "\n").encode()
             ctype = "application/json"
+        elif path == "/latency":
+            # detection-latency stage waterfalls + windowed quantile
+            # sketches (ISSUE 11, obs/latency.py): the tracker's point-
+            # in-time snapshot — diagnostic read, same contract as
+            # /health (the loop thread folds concurrently)
+            lt = getattr(self.server, "latency", None)
+            if lt is None:
+                self.send_error(404, "latency tracking not enabled "
+                                     "(serve --latency)")
+                return
+            body = (json.dumps(lt.snapshot()) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/slo":
+            # declared SLOs, live burn rates, and the current verdict
+            # (obs/slo.py; docs/SLO.md is the runbook)
+            sl = getattr(self.server, "slo", None)
+            if sl is None:
+                self.send_error(404, "no SLOs declared (serve --slo "
+                                     "NAME=TARGET@pQ)")
+                return
+            body = (json.dumps(sl.snapshot()) + "\n").encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            # liveness for external supervision probes (k8s-style):
+            # 200 with {"ok": true} while the loop ticked within
+            # stale_after_s; 503 before the first tick and once the
+            # last-tick age exceeds it (docs/TELEMETRY.md contract).
+            # Reads registry gauges only — never perturbs state.
+            import time as _time
+
+            vals = {}
+            for inst in self.server.registry.collect():
+                if inst.kind == "gauge" and inst.name in (
+                        "rtap_obs_last_tick_unixtime",
+                        "rtap_obs_run_epoch",
+                        "rtap_obs_degradation_level"):
+                    vals[inst.name] = inst.value
+            stale_after = float(getattr(
+                self.server, "healthz_stale_after_s", 30.0))
+            last = vals.get("rtap_obs_last_tick_unixtime")
+            age = (_time.time() - last) if last else None
+            ok = age is not None and age <= stale_after
+            body = (json.dumps({
+                "ok": ok,
+                "run_epoch": int(vals.get("rtap_obs_run_epoch", 0)),
+                "last_tick_age_s": round(age, 3)
+                if age is not None else None,
+                "degradation_level": int(vals.get(
+                    "rtap_obs_degradation_level", 0)),
+                "stale_after_s": stale_after,
+            }) + "\n").encode()
+            self.send_response(200 if ok else 503)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         elif path == "/postmortem":
             # on-demand flight-recorder dump; returns the bundle path (or
             # null when throttled). GET because it is an operator poke on
@@ -261,12 +352,20 @@ class ExpositionServer:
     ``/health`` serves the fleet rollup + per-group model scorecards
     (rings/scorecards are written lock-free by the loop, so a
     concurrent read is point-in-time diagnostic data, not a consistent
-    snapshot).
+    snapshot). With a ``latency`` tracker (obs/latency.py),
+    ``/latency`` serves the stage waterfalls + windowed quantiles, and
+    with an ``slo`` tracker (obs/slo.py), ``/slo`` serves the declared
+    SLOs' live burn rates and verdict. ``/healthz`` is always routed:
+    a liveness probe returning 200 while the loop ticked within
+    ``healthz_stale_after_s`` seconds, 503 otherwise
+    (docs/TELEMETRY.md documents the contract).
     """
 
     def __init__(self, registry: TelemetryRegistry | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 trace=None, flight=None, health=None, correlator=None):
+                 trace=None, flight=None, health=None, correlator=None,
+                 latency=None, slo=None,
+                 healthz_stale_after_s: float = 30.0):
         self.registry = registry or get_registry()
         self._server = _Server((host, port), _Handler)
         self._server.registry = self.registry
@@ -274,6 +373,9 @@ class ExpositionServer:
         self._server.flight = flight
         self._server.health = health
         self._server.correlator = correlator
+        self._server.latency = latency
+        self._server.slo = slo
+        self._server.healthz_stale_after_s = float(healthz_stale_after_s)
         self.address = self._server.server_address  # (host, bound port)
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True)

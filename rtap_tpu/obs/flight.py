@@ -66,7 +66,7 @@ class FlightRecorder:
                  n_events: int = 512, max_event_bytes: int = 1024,
                  miss_burst: int = 5, min_dump_gap_ticks: int = 120,
                  max_bundles: int = 16, info: dict | None = None,
-                 health_provider=None):
+                 health_provider=None, latency_provider=None):
         if n_ticks < 1:
             raise ValueError(f"n_ticks must be >= 1; got {n_ticks}")
         if miss_burst < 1:
@@ -85,6 +85,11 @@ class FlightRecorder:
         # bundle's summary.json so triage gets model state, not just
         # timing. live_loop wires the HealthTracker's snapshot in.
         self.health_provider = health_provider
+        # optional detection-latency source (obs/latency.py ISSUE 11):
+        # same contract — the latest stage waterfall + windowed
+        # quantiles land in every bundle's summary, so an slo_burn (or
+        # any other) postmortem names the stage that ate the budget
+        self.latency_provider = latency_provider
         # tick rings (preallocated; the scored ring is sized on first use
         # because the group count is the loop's to know)
         self._tick = np.full(self.n_ticks, -1, np.int64)
@@ -270,6 +275,11 @@ class FlightRecorder:
                 out["health"] = self.health_provider()
             except Exception:  # noqa: BLE001 — must not kill a dump
                 out["health"] = None
+        if self.latency_provider is not None:
+            try:
+                out["latency"] = self.latency_provider()
+            except Exception:  # noqa: BLE001 — must not kill a dump
+                out["latency"] = None
         return out
 
     def dump(self, reason: str, tick: int | None = None) -> str | None:

@@ -16,8 +16,11 @@ import time
 from rtap_tpu.obs.metrics import TelemetryRegistry
 
 __all__ = ["measure", "measure_trace", "measure_journal", "measure_health",
-           "measure_correlate", "OPS_PER_TICK", "TRACE_SPANS_PER_TICK",
-           "HEALTH_FOLDS_PER_TICK", "CORRELATE_ALERTS_PER_TICK"]
+           "measure_correlate", "measure_latency", "GATE_MEASURES",
+           "GATE_BUDGET_FRAC",
+           "OPS_PER_TICK", "TRACE_SPANS_PER_TICK",
+           "HEALTH_FOLDS_PER_TICK", "CORRELATE_ALERTS_PER_TICK",
+           "LATENCY_OBSERVES_PER_TICK"]
 
 #: instrument operations a serve tick costs at the production shape (six
 #: phase observes + tick latency observe + ticks/scored/alert counters +
@@ -38,6 +41,11 @@ HEALTH_FOLDS_PER_TICK = 16
 #: node pages ~32 streams at once; healthy ticks fold zero, so this is
 #: the storm-ceiling shape, not the steady state
 CORRELATE_ALERTS_PER_TICK = 32
+
+#: per-alert detect observations a latency-tracking tick is budgeted
+#: for (ISSUE 11): the same 32-stream alert-storm ceiling as the
+#: correlator, on top of the per-tick record_tick + SLO evaluation
+LATENCY_OBSERVES_PER_TICK = 32
 
 
 def _time_op(fn, n: int) -> float:
@@ -276,3 +284,75 @@ def measure_correlate(n: int = 20_000, cadence_s: float = 1.0,
         "per_tick_overhead_frac": per_tick_s / cadence_s,
         "cadence_s": cadence_s,
     }
+
+
+def measure_latency(n: int = 20_000, cadence_s: float = 1.0,
+                    n_alerts: int = LATENCY_OBSERVES_PER_TICK) -> dict:
+    """Detection-latency instrumentation cost (ISSUE 11), same protocol
+    as :func:`measure`: per-op nanoseconds of the quantile-sketch
+    observe (the per-alert detect path) and the full per-tick fold
+    (``LatencyTracker.record_tick`` + ``SloTracker.on_tick`` with two
+    declared SLOs — the stage sketches, the waterfall build, the lag
+    probes, and the burn-rate evaluation), projected to a tick at the
+    alert-storm ceiling. Registered in :data:`GATE_MEASURES`, so
+    ``bench.py --obs-bench`` gates it <= 1% of the tick budget alongside
+    every other obs instrument."""
+    import numpy as np
+
+    from rtap_tpu.obs.latency import LatencyTracker
+    from rtap_tpu.obs.slo import SloTracker, parse_slo
+
+    reg = TelemetryRegistry()
+    tracker = LatencyTracker(window_ticks=120, cadence_s=cadence_s,
+                             registry=reg)
+    slo = SloTracker([parse_slo("detect=2s@p99"),
+                      parse_slo("tick=1s@p99")],
+                     cadence_s=cadence_s, registry=reg,
+                     quantile_source=tracker.quantile)
+    tracker.slo = slo
+    tracker.lag_providers["repl_ack_ticks"] = lambda _t, _ts: 3.0
+    lags = np.full(1, 0.123)
+    phases = {p: 0.001 for p in ("source", "membership", "dispatch",
+                                 "collect", "emit", "checkpoint")}
+    tick = [0]
+
+    def _rt():
+        tick[0] += 1
+        tracker.record_tick(tick[0], 1_700_000_000 + tick[0], phases,
+                            0.01, poll_wall=1_700_000_000.5 + tick[0])
+        slo.on_tick(tick[0])
+
+    # warm the sketch shards / instrument cells out of the measurement
+    tracker.observe_detect(lags)
+    _rt()
+    observe_s = _time_op(lambda: tracker.observe_detect(lags), n)
+    rt_s = _time_op(_rt, max(1, n // 10))
+    per_tick_s = n_alerts * observe_s + rt_s
+    return {
+        "latency_observe_ns": round(observe_s * 1e9, 1),
+        "latency_record_tick_us": round(rt_s * 1e6, 2),
+        "alerts_per_tick": n_alerts,
+        "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
+        "per_tick_overhead_frac": per_tick_s / cadence_s,
+        "cadence_s": cadence_s,
+    }
+
+
+#: THE obs-bench gate registry (ISSUE 11 satellite): every self-
+#: benchmarked instrument surface, each gated <= ``budget_frac`` of the
+#: tick budget by ``bench.py --obs-bench`` and the tier-1 overhead
+#: tests. Adding an instrument = adding a row here — a new surface
+#: cannot ship ungated, and the five historical ad-hoc gate lines
+#: collapsed into this table.
+GATE_MEASURES: tuple = (
+    ("obs_overhead", measure),
+    ("obs_trace_overhead", measure_trace),
+    ("obs_journal_overhead", measure_journal),
+    ("obs_health_overhead", measure_health),
+    ("obs_correlate_overhead", measure_correlate),
+    ("obs_latency_overhead", measure_latency),
+)
+
+#: the shared acceptance bar: each surface's projected per-tick cost
+#: must stay under this fraction of the cadence budget
+GATE_BUDGET_FRAC = 0.01

@@ -450,6 +450,15 @@ class ReplicationSender:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
 
+    def ack_lag_ticks(self) -> float | None:
+        """Leader-side replication-ack lag in ticks (the journal's next
+        write position minus the standby's last ack) — the first-class
+        lag gauge the latency layer polls (ISSUE 11). None until a
+        standby has acked at least once (no standby = no lag story)."""
+        if self.acked_tick < 0:
+            return None
+        return float(max(0, self.journal.next_tick - 1 - self.acked_tick))
+
     def stats(self) -> dict:
         return {
             "connected": self.connected,

@@ -102,7 +102,7 @@ class AlertWriter:
 
     def __init__(self, path: str | None = None, flush_every: int = 1,
                  breaker=None, attributor=None, fence=None,
-                 correlator=None):
+                 correlator=None, latency=None):
         import os
 
         from rtap_tpu.resilience.policies import CircuitBreaker
@@ -132,6 +132,13 @@ class AlertWriter:
         # them, and dropped batches never fold, so the fold mirrors the
         # DISK exactly once by construction).
         self._correlator = correlator
+        # detection-latency observability (ISSUE 11, obs/latency.py):
+        # every batch that reached the sink observes wall-clock-minus-
+        # source-ts per alert into the e2e detect sketch — the sink
+        # write IS the delivery moment the paper's real-time claim is
+        # judged by. Pure observation: bytes on the stream are identical
+        # with the tracker armed or absent.
+        self._latency = latency
         self._offset = 0  # bytes handed to the sink (the alert cursor)
         self.torn_heals = 0
         if path:
@@ -323,6 +330,7 @@ class AlertWriter:
             # but the file sees a single buffered call
             lines = []
             folds = []
+            lat_ts = [] if self._latency is not None else None
             for g in idx:
                 aid = f"{group}:{stream_ids[g]}:{int(tick)}" \
                     if with_id else None
@@ -338,6 +346,8 @@ class AlertWriter:
                 tf = attr.get(int(g), []) if attr is not None else None
                 if self._correlator is not None:
                     folds.append((aid, stream_ids[g], int(ts[g]), tf))
+                if lat_ts is not None:
+                    lat_ts.append(int(ts[g]))
                 lines.append(format_alert_line(
                     aid, stream_ids[g], int(ts[g]), values[g],
                     float(raw[g]), float(log_likelihood[g]),
@@ -351,11 +361,19 @@ class AlertWriter:
             # sidecar floor (every member of a window lives at/after its
             # window's anchor).
             off0 = self._offset
-            if self._safe_write(lines) and self._correlator is not None:
-                for aid, sid, tsi, tf in folds:
-                    self._correlator.observe_alert(aid, sid, tsi,
-                                                   top_fields=tf,
-                                                   sink_offset=off0)
+            if self._safe_write(lines):
+                if self._correlator is not None:
+                    for aid, sid, tsi, tf in folds:
+                        self._correlator.observe_alert(aid, sid, tsi,
+                                                       top_fields=tf,
+                                                       sink_offset=off0)
+                if lat_ts:
+                    # e2e detect latency at the delivery moment: wall
+                    # clock minus each alert's SOURCE timestamp (clamped
+                    # >= 0 in the sketch) — pipeline depth, micro-chunk
+                    # staleness and backfill hold all show up honestly
+                    self._latency.observe_detect(
+                        time.time() - np.asarray(lat_ts, np.float64))
         emitted = int(idx.size) - suppressed_this
         if emitted:
             # lines handed toward the sink this call: suppressed ids ride

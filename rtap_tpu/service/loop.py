@@ -326,6 +326,8 @@ def live_loop(
     lease=None,
     resume_suppression=None,
     correlator=None,
+    latency=None,
+    slo=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -526,6 +528,26 @@ def live_loop(
     is the acceptance soak; docs/WORKLOADS.md the runbook). None = no
     correlation and zero hot-path cost.
 
+    `latency` (an obs.LatencyTracker, serve --latency; ISSUE 11): the
+    detection-latency observability layer. Each tick folds the stage
+    waterfall (source ts -> poll -> dispatch -> collect -> emit) into
+    bounded windowed quantile sketches and polls the wired lag
+    providers (replication-ack lag, incident-close lag); the
+    AlertWriter feeds the per-alert end-to-end ``detect`` sketch at
+    sink-write time. Pure observation — host wall clocks and
+    timestamps already riding the rows, zero extra device↔host
+    fetches, and the alert stream + model state are byte/bit-identical
+    with the tracker on or off (tests/integration/
+    test_latency_serve.py pins it). None = zero hot-path cost.
+
+    `slo` (an obs.SloTracker, serve --slo NAME=TARGET@pQ): operator-
+    declared latency SLOs evaluated per tick with fast/slow multi-
+    window burn rates; edge-triggered ``slo_burn``/``slo_recovered``/
+    ``slo_budget_exhausted`` events ride the alert stream like
+    watchdog events, a fast burn requests a flight-recorder postmortem
+    dump, and the run's verdict lands in ``stats["slo"]``
+    (docs/SLO.md). Requires `latency` (it is the measurement source).
+
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
     state is saved atomically every k ticks (the in-flight pipeline is
@@ -693,6 +715,10 @@ def live_loop(
     obs_rebuilds = obs.counter(
         "rtap_obs_routing_rebuilds_total",
         "emission-routing rebuilds after membership version bumps")
+    obs_last_tick_wall = obs.gauge(
+        "rtap_obs_last_tick_unixtime",
+        "wall-clock unix time the last tick completed — the GET /healthz "
+        "liveness source (age > stale_after_s reads 503)")
     obs_warm_compiles = obs.counter(
         "rtap_obs_warm_compiles_total",
         "cold (chunk length, group config) programs dispatched serially "
@@ -723,11 +749,15 @@ def live_loop(
             f"auto_release_after must be >= 0; got {auto_release_after}")
     if auto_release_after and reg is None:
         raise ValueError("auto_release_after needs a StreamGroupRegistry")
+    if slo is not None and latency is None:
+        raise ValueError(
+            "slo needs latency: the SLO tracker judges the latency "
+            "tracker's observations (serve --slo requires --latency)")
     writer = AlertWriter(alert_path, flush_every=alert_flush_every,
                          attributor=attributor,
                          fence=lease.still_mine if lease is not None
                          else None,
-                         correlator=correlator)
+                         correlator=correlator, latency=latency)
     correlator_resume = None
     if correlator is not None:
         # incident correlation (ISSUE 9, rtap_tpu/correlate/): incidents
@@ -801,6 +831,21 @@ def live_loop(
             health.flight = flight
         if flight is not None and flight.health_provider is None:
             flight.health_provider = health.snapshot
+    if slo is not None:
+        # SLO guardrail wiring (ISSUE 11, obs/slo.py): burn events ride
+        # the alert stream, a fast burn dumps a postmortem, and the
+        # latency tracker feeds it per observation
+        if slo.sink is None:
+            slo.sink = writer.emit_event
+        if slo.flight is None:
+            slo.flight = flight
+        if latency.slo is None:
+            latency.slo = slo
+    if latency is not None and flight is not None \
+            and flight.latency_provider is None:
+        # every postmortem bundle's summary embeds the latest stage
+        # waterfall + windowed quantiles (the slo_burn triage surface)
+        flight.latency_provider = latency.snapshot
     eff_cadence = cadence_s  # widened by the degradation ladder's level 3
     quarantined: dict[int, dict] = {}  # gi -> {tick, phase, error, restore_at}
     quarantine_log: list[dict] = []  # full quarantine/restore history, in
@@ -1639,6 +1684,9 @@ def live_loop(
             phase_s["source"] += _src_t1 - now
             if trace is not None:
                 trace.add_span("source", k, now, _src_t1 - now)
+            # the poll-done wall instant anchors the tick's ingest-lag
+            # measurement (source ts -> loop); perf_counter has no epoch
+            lat_poll_wall = time.time() if latency is not None else 0.0
             values = np.asarray(values, np.float32)
             watchdog.observe_source(k, values)
             if len(values) != n_expected:
@@ -1797,6 +1845,7 @@ def live_loop(
             elapsed = time.perf_counter() - t_start
             latencies[k] = elapsed
             obs_ticks.inc()
+            obs_last_tick_wall.set(time.time())
             obs_tick_seconds.observe(elapsed)
             for p in _PHASES:
                 obs_phase[p].observe(phase_s[p] - phase_tick0[p])
@@ -1821,6 +1870,16 @@ def live_loop(
                 if new_cadence != eff_cadence:
                     eff_cadence = new_cadence
                     watchdog.set_cadence(eff_cadence)
+            if latency is not None:
+                # fold the tick's stage waterfall + lag probes; the SLO
+                # evaluation runs after, so any slo_burn dump it queues
+                # is flushed by THIS tick's flush_pending below
+                latency.record_tick(
+                    k, ts, {p: phase_s[p] - phase_tick0[p]
+                            for p in _PHASES},
+                    elapsed, poll_wall=lat_poll_wall, source=source)
+                if slo is not None:
+                    slo.on_tick(k)
             if flight is not None:
                 flight.record_tick(
                     k, elapsed,
@@ -1947,6 +2006,14 @@ def live_loop(
         extra["incidents"] = correlator.stats()
         if correlator_resume is not None:
             extra["incidents"]["resume"] = correlator_resume
+    if latency is not None:
+        # the detection-latency artifact: per-stage quantiles, the last
+        # waterfall, lag gauges (docs/SLO.md triage order starts here)
+        extra["latency"] = latency.stats()
+    if slo is not None:
+        # the SLO verdict the soaks commit: met/bad-frac/budget per
+        # declared SLO plus burn-episode counts
+        extra["slo"] = slo.verdict()
     if aot_warmup:
         extra["aot_programs_compiled"] = aot_programs
         # cold programs the loop still had to single-flight AFTER the AOT

@@ -54,6 +54,26 @@ for root in ("rtap_tpu", "scripts"):
         targets += [os.path.join(dp, f) for f in fns if f.endswith(".py")]
 targets.append("bench.py")
 
+# coverage pin (ISSUE 11 satellite): the serve-path instrumentation
+# modules MUST sit under a strict dir — a rename/move that silently
+# dropped them out of no-print coverage would let stdout lines creep
+# back into the hot path. Extend this list with every new module.
+MUST_BE_STRICT = (
+    os.path.join("rtap_tpu", "obs", "latency.py"),
+    os.path.join("rtap_tpu", "obs", "slo.py"),
+    os.path.join("rtap_tpu", "obs", "metrics.py"),
+    os.path.join("rtap_tpu", "service", "loop.py"),
+)
+for p in MUST_BE_STRICT:
+    if not os.path.isfile(p):
+        print(f"check_static: expected strict module missing: {p}",
+              file=sys.stderr)
+        sys.exit(1)
+    if not any(p.startswith(d + os.sep) for d in STRICT_DIRS):
+        print(f"check_static: {p} fell out of strict no-print coverage",
+              file=sys.stderr)
+        sys.exit(1)
+
 bad = []
 for path in sorted(targets):
     with open(path) as fh:

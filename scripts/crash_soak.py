@@ -130,16 +130,27 @@ def run_child(args) -> int:
         # live_loop journals raw frames when the source exposes them
         source.take_tick_frames = bsrc.take_tick_frames
 
+    # SLO verdict (ISSUE 11): the seeded feed runs on a synthetic epoch,
+    # so the wall-clock-anchored detect SLO is meaningless here — the
+    # crash soak contracts on per-tick HOST latency instead (docs/SLO.md
+    # clock contract). Pure observation: the bit-identity verdict is
+    # judged on alert RECORDS, which the tracker never touches.
+    latency = slo = None
+    if args.slo != "off":
+        from rtap_tpu.obs.slo import tick_slo_pair
+
+        latency, slo = tick_slo_pair(args.cadence, args.slo)
     stats = live_loop(
         source, reg, n_ticks=n_eff, cadence_s=args.cadence,
         alert_path=os.path.join(w, "alerts.jsonl"),
         checkpoint_dir=ckdir, checkpoint_every=args.checkpoint_every,
-        journal=journal, chaos=chaos)
+        journal=journal, chaos=chaos, latency=latency, slo=slo)
     journal.close()
     line = {"base": base, "ran": stats["ticks"],
             "alerts": stats["alerts"],
             "scored": stats["scored"],
-            "journal": stats.get("journal", {})}
+            "journal": stats.get("journal", {}),
+            "slo": stats.get("slo")}
     if args.stats_out:
         with open(args.stats_out, "a") as f:
             f.write(json.dumps(line) + "\n")
@@ -160,6 +171,8 @@ def child_cmd(args, workdir: str, spec: str | None) -> list[str]:
            "--journal-fsync", args.journal_fsync,
            "--spike-every", str(args.spike_every),
            "--stats-out", os.path.join(workdir, "stats.jsonl")]
+    if args.slo is not None:
+        cmd += ["--slo", args.slo]
     if args.binary_ingest:
         cmd.append("--binary-ingest")
     if spec:
@@ -342,11 +355,15 @@ def verify(args, ref_dir: str, crash_dir: str, sup, observed_kills: list,
     # children, which would under-report K-1 of K catch-ups)
     stats_path = os.path.join(crash_dir, "stats.jsonl")
     total_ran = 0
+    slo_verdict = None
     if os.path.isfile(stats_path):
         with open(stats_path) as f:
             for line in f:
                 s = json.loads(line)
                 total_ran = max(total_ran, s["base"] + s["ran"])
+                # the final completing child's verdict covers the run's
+                # tail; per-restart verdicts ride each stats line
+                slo_verdict = s.get("slo") or slo_verdict
     trunc_events = [e for e in got_alerts["events"]
                     if e.get("event") == "journal_tail_truncated"]
     replay_events = [e for e in got_alerts["events"]
@@ -373,6 +390,7 @@ def verify(args, ref_dir: str, crash_dir: str, sup, observed_kills: list,
         "catch_up": catch_up,
         "journal_truncation_events": len(trunc_events),
         "journal_replay_events": len(replay_events),
+        "slo_verdict": slo_verdict,
     }
 
 
@@ -405,6 +423,13 @@ def main() -> int:
                          "decode — same bit-identity + exactly-once "
                          "verdict, over the new path (docs/INGEST.md)")
     ap.add_argument("--spike-every", type=int, default=13)
+    ap.add_argument("--slo", default=None, metavar="NAME=TARGET@pQ",
+                    help="latency SLO the children defend and the report "
+                         "records a verdict for (default: tick=<cadence>"
+                         "s@p99 — per-tick host latency; the seeded feed "
+                         "runs on a synthetic epoch so wall-anchored "
+                         "detect SLOs don't apply here, docs/SLO.md). "
+                         "'off' disables")
     ap.add_argument("--restart-backoff", type=float, default=0.05)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--out", default=None, help="report JSON path")

@@ -167,11 +167,24 @@ def run_child(args) -> int:
             v[(g // args.spike_every) % len(ids)] += 30.0
         return v, 1_700_000_000 + g
 
+    # SLO verdict (ISSUE 11): per-tick host latency — the seeded feed's
+    # synthetic epoch rules out the wall-anchored detect SLO here
+    # (docs/SLO.md clock contract). The replication-ack lag rides the
+    # tracker as a first-class gauge while this child leads.
+    latency = slo = None
+    if args.slo != "off":
+        from rtap_tpu.obs.slo import tick_slo_pair
+
+        latency, slo = tick_slo_pair(args.cadence, args.slo)
+        if sender is not None:
+            latency.lag_providers["repl_ack_ticks"] = \
+                lambda _t, _ts: sender.ack_lag_ticks()
     stats = live_loop(
         source, reg, n_ticks=n_eff, cadence_s=args.cadence,
         alert_path=alerts, checkpoint_dir=ckdir,
         checkpoint_every=args.checkpoint_every, journal=journal,
-        lease=lease, stop_event=stop, resume_suppression=resume_sup)
+        lease=lease, stop_event=stop, resume_suppression=resume_sup,
+        latency=latency, slo=slo)
     if sender is not None:
         sender.close()
         journal.tee = None
@@ -182,7 +195,9 @@ def run_child(args) -> int:
             "ran": stats["ticks"], "alerts": stats["alerts"],
             "fenced": bool(stats.get("fenced")),
             "fenced_line_drops": stats.get("fenced_line_drops", 0),
-            "promoted": promote_info}
+            "promoted": promote_info,
+            "slo": stats.get("slo"),
+            "repl_ack_lag": (stats.get("latency") or {}).get("lags")}
     if args.stats_out:
         with open(args.stats_out, "a") as f:
             f.write(json.dumps(line) + "\n")
@@ -219,6 +234,8 @@ def child_cmd(args, workdir: str, name: str | None = None,
            "--lease-timeout", str(args.lease_timeout),
            "--spike-every", str(args.spike_every),
            "--stats-out", os.path.join(workdir, "stats.jsonl")]
+    if args.slo is not None:
+        cmd += ["--slo", args.slo]
     if ref:
         cmd.append("--ref")
     else:
@@ -274,6 +291,12 @@ def main() -> int:
     ap.add_argument("--takeover-budget", type=int, default=10,
                     help="max takeover detection latency in ticks")
     ap.add_argument("--spike-every", type=int, default=13)
+    ap.add_argument("--slo", default=None, metavar="NAME=TARGET@pQ",
+                    help="latency SLO every serving child defends and "
+                         "the report records a verdict for (default: "
+                         "tick=<cadence>s@p99; 'off' disables — see "
+                         "docs/SLO.md clock contract for why detect "
+                         "SLOs don't apply to the seeded feed)")
     ap.add_argument("--fence-round",
                     action=argparse.BooleanOptionalAction, default=True,
                     help="add a SIGSTOP/SIGCONT round proving a paused "
@@ -560,6 +583,11 @@ def main() -> int:
     if fence_report and not fenced_stats:
         failures.append("fence round ran but no child reported a fenced "
                         "exit in stats.jsonl")
+    # the SLO verdict (ISSUE 11): the completing leader's verdict covers
+    # the run's tail; every serving child's rides its own stats line
+    slo_verdict = next(
+        (s.get("slo") for s in reversed(fenced_lines) if s.get("slo")),
+        None)
 
     report = {
         "seed": args.seed,
@@ -584,6 +612,7 @@ def main() -> int:
         "completed_by": done.get("name"),
         "unscheduled_fences": unscheduled_fences,
         "fenced_exits": fenced_stats,
+        "slo_verdict": slo_verdict,
         "wall_s": round(time.monotonic() - t_all, 1),
         "verified": not failures,
         "failures": failures,

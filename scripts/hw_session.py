@@ -753,6 +753,32 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                        "--backend", "tpu",
                        "--out", "reports/workload_soak_r12.json"],
      3600.0),
+    # ---------------- round 13 (ISSUE 11: detection-latency SLOs) -----
+    # The real-time headline on silicon: the r9/r10 production soak
+    # shape with detection-latency tracking + declared SLOs armed. The
+    # live feeder stamps rows with the host wall clock, so the e2e
+    # detect sketch (source ts -> alert-sink flush) is the TRUE
+    # detection latency of the served fleet at 1 s cadence — the first
+    # measured number behind ROADMAP-2's "sub-second detection" premium
+    # tier (the fault-eval's median 1-2 s is model latency; this is the
+    # whole pipeline). detect=2s@p99 is the launch contract, tick=1s@p99
+    # the cadence contract; a burn dumps a postmortem whose summary
+    # embeds the waterfall, and the committed report carries the full
+    # per-stage quantiles + the SLO verdict. --threshold 0.35 densifies
+    # alert traffic enough to fill the detect sketch without drowning
+    # the sink (cpu-measured alert rate at the sine feed).
+    ("r13_latency", [sys.executable, "scripts/live_soak.py",
+                     "--streams", "4096", "--group-size", "1024",
+                     "--columns", "32", "--learn-every", "2",
+                     "--stagger-learn", "--ticks", "300",
+                     "--pipeline-depth", "2", "--dispatch-threads", "4",
+                     "--threshold", "0.35",
+                     "--latency", "--slo", "detect=2s@p99",
+                     "--slo", "tick=1s@p99",
+                     "--postmortem-dir", "hw_results/postmortems_r13",
+                     "--startup-timeout", "900",
+                     "--out", "reports/live_soak_latency_r13.json"],
+     2400.0),
 ]
 
 

@@ -296,6 +296,29 @@ def main() -> int:
                          "sparsity/score aggregates + scorecards; the "
                          "fleet gauges land in the obs snapshot this "
                          "soak reads back")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="passed through to serve: alert threshold "
+                         "(lower it to densify alert traffic when the "
+                         "detect-latency sketch needs samples)")
+    ap.add_argument("--latency", action="store_true",
+                    help="arm the serve child's detection-latency "
+                         "tracking (serve --latency): stage waterfalls, "
+                         "windowed quantile sketches, lag gauges — the "
+                         "latency/slo blocks land in this soak's report")
+    ap.add_argument("--latency-window", type=int, default=None,
+                    help="passed through to serve: sketch window ticks")
+    ap.add_argument("--slo", action="append", default=None,
+                    metavar="NAME=TARGET@pQ",
+                    help="passed through to serve (repeatable): declare "
+                         "a latency SLO, e.g. detect=2s@p99; the run's "
+                         "SLO verdict is recorded in the report "
+                         "(slo_verdict) and a burn dumps a postmortem "
+                         "when --postmortem-dir is armed. Implies "
+                         "--latency")
+    ap.add_argument("--slo-fast-window", type=int, default=None,
+                    help="passed through to serve: fast burn window ticks")
+    ap.add_argument("--slo-slow-window", type=int, default=None,
+                    help="passed through to serve: slow burn window ticks")
     ap.add_argument("--jax-trace", default=None,
                     help="passed through to serve: wrap the soak window in "
                          "jax.profiler.trace writing the XLA device trace "
@@ -367,6 +390,18 @@ def main() -> int:
         cmd += ["--freeze"]
     if args.health:
         cmd += ["--health"]
+    if args.threshold is not None:
+        cmd += ["--threshold", str(args.threshold)]
+    if args.latency or args.slo:
+        cmd += ["--latency"]
+    if args.latency_window is not None:
+        cmd += ["--latency-window", str(args.latency_window)]
+    for spec in args.slo or ():
+        cmd += ["--slo", spec]
+    if args.slo_fast_window is not None:
+        cmd += ["--slo-fast-window", str(args.slo_fast_window)]
+    if args.slo_slow_window is not None:
+        cmd += ["--slo-slow-window", str(args.slo_slow_window)]
     if args.jax_trace:
         cmd += ["--jax-trace", args.jax_trace]
     if args.trace_out:
@@ -455,6 +490,9 @@ def main() -> int:
         "event_lines": n_event_lines,
         "feeder_ticks_pushed": feeder.ticks_pushed,
         "feeder_error": feeder.error, **stats,
+        # the SLO verdict under a stable key (ISSUE 11): **stats already
+        # carries "slo"/"latency" when armed, but harnesses key on this
+        "slo_verdict": stats.get("slo"),
         "obs": obs_summary,
     }
     with open(args.out, "w") as f:

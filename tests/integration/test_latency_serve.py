@@ -1,0 +1,254 @@
+"""Detection-latency observability through the real serve stack (ISSUE 11).
+
+Acceptance tests: (1) serving with latency tracking + SLOs armed is
+BYTE/BIT-EXACT against serving without them — final model state and the
+alert RECORDS are identical (the tracker is pure observation; only
+``slo_*`` event lines may additionally appear), and flags-off equals
+flagless trivially; (2) ``GET /latency`` / ``GET /slo`` serve the
+tracker snapshots and ``GET /healthz`` honors the 200/503 liveness
+contract; (3) a seeded burn raises ``slo_burn`` onto the alert stream
+and auto-dumps a postmortem bundle whose summary embeds the waterfall;
+(4) the serve CLI flag-validation sweep — malformed SLO specs and
+knobs without their prerequisites are instant usage errors.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import scaled_cluster_preset
+from rtap_tpu.obs import (
+    ExpositionServer,
+    FlightRecorder,
+    LatencyTracker,
+    SloTracker,
+    TelemetryRegistry,
+    parse_slo,
+    validate_bundle,
+)
+from rtap_tpu.service.loop import live_loop
+from rtap_tpu.service.registry import StreamGroupRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+ENV = {**os.environ, "RTAP_FORCE_CPU": "1"}
+
+CFG = scaled_cluster_preset(32)
+N_STREAMS = 6
+GROUP_SIZE = 3
+N_TICKS = 8
+
+
+def _registry():
+    reg = StreamGroupRegistry(CFG, group_size=GROUP_SIZE, backend="tpu",
+                              threshold=0.0, debounce=1)
+    for i in range(N_STREAMS):
+        reg.add_stream(f"s{i}")
+    reg.finalize()
+    return reg
+
+
+def _feed(k):
+    rng = np.random.Generator(np.random.Philox(key=(73, k)))
+    return (30 + 5 * rng.random(N_STREAMS)).astype(np.float32), \
+        1_700_000_000 + k
+
+
+def _trackers(burn: bool = False):
+    """A latency tracker + an SLO pair; ``burn=True`` declares a tick
+    SLO no real tick can meet (1 ms@p99) with tiny burn windows, so the
+    seeded run burns deterministically."""
+    reg = TelemetryRegistry()
+    lat = LatencyTracker(window_ticks=4, registry=reg)
+    spec = "tick=1ms@p99" if burn else "tick=30s@p99"
+    slo = SloTracker([parse_slo(spec)], fast_window=3, slow_window=6,
+                     registry=reg, quantile_source=lat.quantile)
+    return lat, slo
+
+
+def _split_lines(path):
+    alerts, events = [], []
+    with open(path) as f:
+        for ln in f.read().splitlines():
+            if not ln:
+                continue
+            (events if ln.startswith('{"event"') else alerts).append(ln)
+    return alerts, events
+
+
+@pytest.mark.quick
+def test_latency_on_vs_off_byte_exact_state_and_alert_records(tmp_path):
+    """The ISSUE 11 neutrality bar (PR 6 health-flag discipline): the
+    tracker observes, never perturbs — alert records and final model
+    state are identical with the flags on or off."""
+    finals = {}
+    stats_by_mode = {}
+    for mode in (False, True):
+        reg = _registry()
+        alerts = tmp_path / f"alerts_{mode}.jsonl"
+        lat, slo = _trackers() if mode else (None, None)
+        stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.005,
+                          alert_path=str(alerts), micro_chunk=2,
+                          latency=lat, slo=slo)
+        assert stats["ticks"] == N_TICKS
+        stats_by_mode[mode] = stats
+        finals[mode] = [
+            {k: np.asarray(v) for k, v in g.state.items()}
+            for g in reg.groups
+        ]
+    for g_off, g_on in zip(finals[False], finals[True]):
+        assert sorted(g_off) == sorted(g_on)
+        for k in g_off:
+            np.testing.assert_array_equal(g_off[k], g_on[k], err_msg=k)
+    # threshold 0 + debounce 1: every (stream, tick) alerted — the
+    # alert RECORDS must agree byte for byte (events may differ: the
+    # armed run may carry slo_* lines, the bare run cannot)
+    rec_off, _ = _split_lines(tmp_path / "alerts_False.jsonl")
+    rec_on, _ = _split_lines(tmp_path / "alerts_True.jsonl")
+    assert rec_off and rec_off == rec_on
+    # the armed run's stats carry the latency + SLO artifacts
+    on = stats_by_mode[True]
+    assert on["latency"]["ticks"] == N_TICKS
+    assert on["latency"]["detect"]["count"] == N_TICKS * N_STREAMS
+    assert on["latency"]["waterfall"]["tick"] == N_TICKS - 1
+    assert on["slo"]["met"] is True
+    assert on["slo"]["slos"][0]["samples"] == N_TICKS
+    assert "latency" not in stats_by_mode[False]
+
+
+@pytest.mark.quick
+def test_latency_slo_healthz_routes(tmp_path):
+    reg = _registry()
+    lat, slo = _trackers()
+    live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.005,
+              alert_path=str(tmp_path / "a.jsonl"), latency=lat, slo=slo)
+    with ExpositionServer(latency=lat, slo=slo) as srv:
+        host, port = srv.address
+        base = f"http://{host}:{port}"
+        body = json.loads(urllib.request.urlopen(
+            base + "/latency", timeout=10).read())
+        assert body["ticks"] == N_TICKS
+        assert set(body["stages"]) == {"ingest", "dispatch", "collect",
+                                       "emit", "tick", "detect"}
+        assert body["stages"]["tick"]["window"]["count"] > 0
+        assert body["waterfall"]["ingest_lag_s"] is not None
+        sbody = json.loads(urllib.request.urlopen(
+            base + "/slo", timeout=10).read())
+        assert sbody["met"] is True and len(sbody["slos"]) == 1
+        # /healthz against the PROCESS registry the loop wrote into:
+        # the last tick just completed -> 200 ok
+        hz = urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert hz.status == 200
+        hbody = json.loads(hz.read())
+        assert hbody["ok"] is True
+        assert hbody["last_tick_age_s"] < 30.0
+    # a server over a fresh registry (no tick ever) answers 503, body
+    # intact — the supervision-probe contract (docs/TELEMETRY.md)
+    with ExpositionServer(registry=TelemetryRegistry()) as srv:
+        host, port = srv.address
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{host}:{port}/healthz",
+                                   timeout=10)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["ok"] is False
+    # unarmed trackers 404 loudly, not 500
+    with ExpositionServer(registry=TelemetryRegistry()) as srv:
+        host, port = srv.address
+        for route in ("/latency", "/slo"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"http://{host}:{port}{route}",
+                                       timeout=10)
+            assert ei.value.code == 404
+
+
+@pytest.mark.quick
+def test_seeded_burn_emits_event_and_postmortem_with_waterfall(tmp_path):
+    """An unmeetable tick SLO burns deterministically: one slo_burn
+    event line on the alert stream, a valid postmortem bundle with the
+    latency waterfall embedded in its summary."""
+    reg = _registry()
+    lat, slo = _trackers(burn=True)
+    pm_dir = tmp_path / "pm"
+    os.makedirs(pm_dir)
+    flight = FlightRecorder(n_ticks=32, out_dir=str(pm_dir),
+                            registry=TelemetryRegistry())
+    alerts = tmp_path / "alerts.jsonl"
+    stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.005,
+                      alert_path=str(alerts), flight=flight,
+                      latency=lat, slo=slo)
+    v = stats["slo"]
+    assert v["met"] is False
+    assert v["slos"][0]["burn_events"] >= 1
+    _, events = _split_lines(alerts)
+    burns = [json.loads(e) for e in events
+             if json.loads(e).get("event") == "slo_burn"]
+    assert len(burns) == 1  # edge-triggered: one line per episode
+    assert burns[0]["stage"] == "tick"
+    bundles = [p for p in flight.bundles if "slo_burn" in p]
+    assert len(bundles) == 1
+    res = validate_bundle(bundles[0])
+    assert res["ok"], res["problems"]
+    assert res["reason"] == "slo_burn"
+    with open(os.path.join(bundles[0], "summary.json")) as f:
+        summary = json.load(f)
+    assert summary["latency"]["waterfall"] is not None
+    assert summary["latency"]["stages"]["tick"]["total"]["count"] > 0
+
+
+@pytest.mark.quick
+def test_serve_cli_flag_validation_sweep(capsys):
+    """The --slo/--latency-* knob family fails fast on usage errors —
+    before any backend init or listener (ISSUE 11 satellite). In-process
+    main() calls: every case returns 2 from the cheap-check block (or
+    the pre-listener spec parse), so no subprocess/backend cost."""
+    from rtap_tpu.__main__ import main
+
+    def run(*args):
+        rc = main(["serve", "--streams", "a", "--backend", "cpu", *args])
+        return rc, capsys.readouterr().err
+
+    rc, err = run("--slo", "detect=2s@p99")
+    assert rc == 2 and "add --latency" in err
+    rc, err = run("--latency-window", "64")
+    assert rc == 2 and "add --latency" in err
+    rc, err = run("--slo-fast-window", "30")
+    assert rc == 2 and "add --slo" in err
+    rc, err = run("--latency", "--latency-window", "0")
+    assert rc == 2 and "--latency-window" in err
+    for bad in ("detect=2m@p99", "nonsense", "foo=2s@p99",
+                "detect=2s@p100"):
+        rc, err = run("--latency", "--slo", bad)
+        assert rc == 2, (bad, err)
+        assert "bad --slo" in err, (bad, err)
+    # windows inverted: caught at tracker construction, still rc 2
+    rc, err = run("--latency", "--slo", "detect=2s@p99",
+                  "--slo-fast-window", "100", "--slo-slow-window", "10")
+    assert rc == 2 and "--slo-*-window" in err
+
+
+def test_serve_cli_latency_end_to_end(tmp_path):
+    """Operator surface: serve --latency --slo through the real CLI —
+    stats carry the latency block + SLO verdict, stderr announces the
+    armed trackers."""
+    alerts = tmp_path / "alerts.jsonl"
+    ids = "a,b,c"
+    p = subprocess.run(
+        [sys.executable, "-m", "rtap_tpu", "serve", "--streams", ids,
+         "--ticks", "6", "--cadence", "0.05", "--backend", "cpu",
+         "--port", "0", "--threshold", "0.0", "--debounce", "1",
+         "--alerts", str(alerts),
+         "--latency", "--latency-window", "4",
+         "--slo", "tick=30s@p99"],
+        cwd=REPO, env=ENV, capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "detection-latency tracking armed" in p.stderr
+    assert "SLOs armed: tick=30s@p99" in p.stderr
+    stats = json.loads(p.stdout.strip().splitlines()[-1])
+    assert stats["latency"]["ticks"] == 6
+    assert stats["slo"]["met"] is True

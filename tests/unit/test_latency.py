@@ -1,0 +1,358 @@
+"""Detection-latency primitives (ISSUE 11): quantile sketch + SLO burn.
+
+Coverage pins the tentpole's two new primitives:
+
+- QuantileSketch: fuzz vs ``numpy.percentile`` across distributions
+  (relative error bounded by the log-bucket ratio), window-roll
+  semantics, bounded memory regardless of observation count, clamping.
+- SloTracker: spec grammar, multi-window burn gating, edge-triggered
+  hysteresis (no flapping at the threshold, re-arm after recovery),
+  and the budget-exhausted edge.
+"""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.obs.latency import DEFAULT_QS, LatencyTracker, QuantileSketch
+from rtap_tpu.obs.metrics import TelemetryRegistry
+from rtap_tpu.obs.slo import SloTracker, parse_slo
+
+pytestmark = pytest.mark.quick
+
+
+# ------------------------------------------------------------- sketch --
+@pytest.mark.parametrize("dist", ["uniform", "lognormal", "exponential",
+                                  "bimodal"])
+def test_sketch_quantiles_fuzz_vs_numpy(dist):
+    """Interpolated quantiles track numpy.percentile within the bucket
+    ratio (per_decade=20 -> 10^(1/20) ~ 12%) across distribution shapes
+    spanning the sketch's range."""
+    rng = np.random.default_rng(hash(dist) % 2**32)
+    n = 30_000
+    if dist == "uniform":
+        vals = rng.uniform(1e-3, 5.0, n)
+    elif dist == "lognormal":
+        vals = rng.lognormal(-2.0, 1.2, n)
+    elif dist == "exponential":
+        vals = rng.exponential(0.25, n)
+    else:  # bimodal: fast path + slow tail (the serve-shape failure).
+        # 60/40 mix keeps every tested quantile INSIDE a mode — a
+        # quantile landing in the inter-mode gap is ill-conditioned for
+        # any sketch (and for numpy's own interpolation)
+        vals = np.concatenate([rng.normal(0.01, 0.002, 3 * n // 5),
+                               rng.normal(2.0, 0.3, 2 * n // 5)])
+    vals = np.clip(vals, 1e-4, 99.0)
+    sk = QuantileSketch()
+    sk.observe_many(vals)
+    for q in DEFAULT_QS:
+        exact = float(np.percentile(vals, q * 100))
+        est = sk.quantile(q)
+        assert est is not None
+        # one bucket ratio of slack either side (geometric buckets)
+        ratio = 10 ** (1 / 20)
+        assert exact / ratio <= est <= exact * ratio, (
+            f"{dist} p{q * 100}: exact {exact}, sketch {est}")
+
+
+def test_sketch_quantiles_monotone_and_scalar_observe():
+    sk = QuantileSketch()
+    for v in (0.01, 0.1, 0.5, 1.0, 3.0):
+        sk.observe(v)
+    qs = [sk.quantile(q) for q in (0.1, 0.5, 0.9, 0.99)]
+    assert qs == sorted(qs)
+    assert sk.count() == 5
+
+
+def test_sketch_window_roll_retires_and_total_persists():
+    sk = QuantileSketch()
+    sk.observe_many(np.full(100, 0.01))
+    assert sk.count("window") == 100
+    sk.roll()
+    # one roll: the window still covers the retired (prev) counts
+    assert sk.count("window") == 100
+    sk.observe_many(np.full(50, 1.0))
+    assert sk.count("window") == 150
+    sk.roll()
+    # the 0.01 cohort aged out; the 1.0 cohort is now prev
+    assert sk.count("window") == 50
+    assert sk.quantile(0.5, "window") == pytest.approx(1.0, rel=0.15)
+    sk.roll()
+    assert sk.count("window") == 0
+    assert sk.quantile(0.5, "window") is None
+    # lifetime totals never age out
+    assert sk.count("total") == 150
+    assert sk.rolls == 3
+
+
+def test_sketch_memory_bounded_and_clamps():
+    sk = QuantileSketch()
+    base = None
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        sk.observe_many(rng.uniform(0, 10, 5000))
+        sk.observe(-5.0)  # negative clamps to 0, never raises
+        sk.observe(1e9)  # overflow clamps into the top bucket
+        if base is None:
+            base = sk.nbytes()
+    assert sk.nbytes() == base  # constant after the first observe
+    assert base < 16_384  # one thread: 3 int64 arrays of ~122 buckets
+    assert sk.quantile(0.999) <= sk.edges[-1]  # overflow saturates at hi
+    # the clamped negatives live in the first bucket
+    assert sk.quantile(1e-9) <= sk.edges[0]
+
+
+def test_sketch_rejects_bad_geometry():
+    with pytest.raises(ValueError):
+        QuantileSketch(lo=0.0)
+    with pytest.raises(ValueError):
+        QuantileSketch(lo=1.0, hi=0.5)
+    with pytest.raises(ValueError):
+        QuantileSketch(per_decade=0)
+    with pytest.raises(ValueError):
+        LatencyTracker(window_ticks=0, registry=TelemetryRegistry())
+
+
+# ---------------------------------------------------------------- slo --
+def test_parse_slo_grammar():
+    s = parse_slo("detect=2s@p99")
+    assert (s.name, s.target_s, s.quantile) == ("detect", 2.0, 0.99)
+    assert s.budget_frac == pytest.approx(0.01)
+    assert s.label() == "detect=2s@p99"
+    s = parse_slo("tick=500ms@p95")
+    assert (s.name, s.target_s, s.quantile) == ("tick", 0.5, 0.95)
+    s = parse_slo("detect=1.5s@p99.9")
+    assert s.target_s == 1.5 and s.quantile == pytest.approx(0.999)
+    for bad in ("", "detect", "detect=2s", "detect=2m@p99", "foo=2s@p99",
+                "detect=0s@p99", "detect=2s@p0", "detect=2s@p100",
+                "detect=2s@p101", "DETECT=2s@p99"):
+        with pytest.raises(ValueError):
+            parse_slo(bad)
+
+
+def _tracker(sink, fast=5, slow=20, flight=None):
+    return SloTracker([parse_slo("tick=100ms@p90")], fast_window=fast,
+                      slow_window=slow, fast_burn=2.0, slow_burn=1.5,
+                      registry=TelemetryRegistry(), sink=sink,
+                      flight=flight)
+
+
+def test_slo_burn_fires_once_per_episode_and_rearms():
+    """Edge-triggered with hysteresis: a sustained violation emits ONE
+    slo_burn, recovery emits ONE slo_recovered, and a fresh violation
+    opens a new episode."""
+    events = []
+    t = _tracker(events.append)
+    k = 0
+    # sustained violation: every tick bad (burn = 10 >> thresholds)
+    for _ in range(30):
+        t.observe("tick", 0.5)
+        t.on_tick(k)
+        k += 1
+    burns = [e for e in events if e["event"] == "slo_burn"]
+    assert len(burns) == 1  # no flapping while the burn persists
+    assert burns[0]["stage"] == "tick"
+    # recovery: good ticks age the violation out of both windows
+    for _ in range(40):
+        t.observe("tick", 0.01)
+        t.on_tick(k)
+        k += 1
+    recs = [e for e in events if e["event"] == "slo_recovered"]
+    assert len(recs) == 1
+    # a NEW violation re-arms a NEW episode
+    for _ in range(30):
+        t.observe("tick", 0.5)
+        t.on_tick(k)
+        k += 1
+    burns = [e for e in events if e["event"] == "slo_burn"]
+    assert len(burns) == 2
+
+
+def test_slo_no_flap_at_exact_budget_rate():
+    """Burning exactly AT budget (burn rate ~1) never pages: the
+    thresholds demand a multiple of budget, and hovering at the line
+    must not flap the edge trigger."""
+    events = []
+    # windows aligned to the 1-in-10 pattern so every full window holds
+    # exactly its budget's worth of bad ticks (burn rate exactly 1.0)
+    t = _tracker(events.append, fast=10, slow=20)
+    # 10% bad = exactly the p90 budget -> burn rate 1.0 < 2.0 threshold
+    for k in range(200):
+        t.observe("tick", 0.5 if k % 10 == 0 else 0.01)
+        t.on_tick(k)
+    assert [e for e in events if e["event"] == "slo_burn"] == []
+
+
+def test_slo_multiwindow_and_gate():
+    """A spike shorter than the slow window's appetite trips the fast
+    burn alone — and must NOT page (the multi-window AND)."""
+    events = []
+    # slow window long enough that 3 bad ticks stay under slow_burn
+    t = _tracker(events.append, fast=3, slow=60)
+    k = 0
+    for _ in range(50):  # healthy baseline fills the slow window
+        t.observe("tick", 0.01)
+        t.on_tick(k)
+        k += 1
+    for _ in range(3):  # brief spike: fast burn 10, slow burn ~0.5
+        t.observe("tick", 0.5)
+        t.on_tick(k)
+        k += 1
+    assert [e for e in events if e["event"] == "slo_burn"] == []
+
+
+def test_slo_budget_exhausted_edge():
+    events = []
+    t = _tracker(events.append)
+    # p90 budget = 10%: 30 straight bad ticks overdraw it immediately
+    for k in range(30):
+        t.observe("tick", 0.5)
+        t.on_tick(k)
+    ex = [e for e in events if e["event"] == "slo_budget_exhausted"]
+    assert len(ex) == 1  # fires once, not per tick
+    v = t.verdict()
+    assert v["met"] is False
+    one = v["slos"][0]
+    assert one["bad"] == 30 and one["samples"] == 30
+    assert one["budget_remaining"] < 0  # overdrawn reads negative
+
+
+def test_slo_verdict_met_with_clean_run_and_quantile_source():
+    events = []
+    lat = LatencyTracker(window_ticks=10, registry=TelemetryRegistry())
+    t = SloTracker([parse_slo("tick=100ms@p90")], fast_window=5,
+                   slow_window=20, registry=TelemetryRegistry(),
+                   sink=events.append, quantile_source=lat.quantile)
+    lat.slo = t
+    phases = {p: 0.001 for p in ("source", "membership", "dispatch",
+                                 "collect", "emit", "checkpoint")}
+    for k in range(25):
+        lat.record_tick(k, 1_700_000_000 + k, phases, 0.02)
+        t.on_tick(k)
+    v = t.verdict()
+    assert v["met"] is True and events == []
+    one = v["slos"][0]
+    assert one["samples"] == 25 and one["bad"] == 0
+    assert one["observed_quantile_s"] == pytest.approx(0.02, rel=0.2)
+
+
+def test_slo_burn_requests_postmortem_dump():
+    class _Flight:
+        def __init__(self):
+            self.dumps = []
+            self.events = []
+
+        def request_dump(self, reason, tick):
+            self.dumps.append((reason, tick))
+
+        def record_event(self, ev):
+            self.events.append(ev)
+
+    fl = _Flight()
+    t = _tracker(lambda e: None, flight=fl)
+    for k in range(30):
+        t.observe("tick", 0.5)
+        t.on_tick(k)
+    assert ("slo_burn" in [r for r, _ in fl.dumps])
+    assert any(e["event"] == "slo_burn" for e in fl.events)
+
+
+def test_slo_tracker_rejects_bad_config():
+    spec = parse_slo("tick=1s@p99")
+    reg = TelemetryRegistry()
+    with pytest.raises(ValueError):
+        SloTracker([], registry=reg)
+    with pytest.raises(ValueError):
+        SloTracker([spec], fast_window=10, slow_window=5, registry=reg)
+    with pytest.raises(ValueError):
+        SloTracker([spec], rearm_frac=1.5, registry=reg)
+    with pytest.raises(ValueError):
+        SloTracker([spec, parse_slo("tick=2s@p95")], registry=reg)
+
+
+def test_stage_slo_is_fed_by_record_tick_and_can_burn():
+    """Every advertised SLO stage (ingest/dispatch/collect/emit/tick)
+    receives observations from the per-tick fold — a declared emit SLO
+    must judge and burn, never sit inert (code-review regression)."""
+    from rtap_tpu.obs.latency import LatencyTracker
+
+    events = []
+    reg = TelemetryRegistry()
+    lat = LatencyTracker(window_ticks=10, registry=reg)
+    slo = SloTracker([parse_slo("emit=1ms@p90"),
+                      parse_slo("ingest=10s@p90")],
+                     fast_window=5, slow_window=10, fast_burn=2.0,
+                     slow_burn=1.5, registry=reg, sink=events.append,
+                     quantile_source=lat.quantile)
+    lat.slo = slo
+    phases = {"dispatch": 0.001, "collect": 0.001, "emit": 0.05}
+    now = 1_700_000_000
+    for k in range(20):
+        lat.record_tick(k, now + k, phases, 0.06, poll_wall=now + k + 0.4)
+        slo.on_tick(k)
+    v = {s["stage"]: s for s in slo.verdict()["slos"]}
+    assert v["emit"]["samples"] == 20 and v["emit"]["bad"] == 20
+    assert v["emit"]["met"] is False
+    assert v["ingest"]["samples"] == 20 and v["ingest"]["met"] is True
+    assert any(e["event"] == "slo_burn" and e["stage"] == "emit"
+               for e in events)
+
+
+def test_low_quantile_slo_can_still_page_with_default_thresholds():
+    """Burn rate tops out at 1/budget: a p90 SLO's ceiling (10) sits
+    BELOW the default fast threshold (14), so without the per-spec
+    clamp a totally-violated p90 SLO could never page (found driving
+    the real CLI). A total violation must always page."""
+    events = []
+    t = SloTracker([parse_slo("tick=1ms@p90")], fast_window=5,
+                   slow_window=10, registry=TelemetryRegistry(),
+                   sink=events.append)  # default 14/6 burn thresholds
+    for k in range(20):
+        t.observe("tick", 0.5)  # every tick bad: burn = ceiling = 10
+        t.on_tick(k)
+    assert any(e["event"] == "slo_burn" for e in events)
+
+
+def test_tick_slo_pair_helper():
+    """The shared seeded-soak arming helper: default spec formats tiny
+    cadences safely and the pair comes pre-wired."""
+    from rtap_tpu.obs.slo import tick_slo_pair
+
+    lat, slo = tick_slo_pair(0.00001)  # str() would render 1e-05
+    assert lat.slo is None and slo.quantile_source == lat.quantile
+    assert slo.specs[0].name == "tick"
+    assert slo.specs[0].target_s == pytest.approx(1e-5)
+    lat2, slo2 = tick_slo_pair(1.0, "tick=2s@p95")
+    assert slo2.specs[0].target_s == 2.0
+
+
+# ------------------------------------------------- tracker integration --
+def test_latency_tracker_waterfall_and_lag_providers():
+    reg = TelemetryRegistry()
+    t = LatencyTracker(window_ticks=4, registry=reg)
+    t.lag_providers["repl_ack_ticks"] = lambda _k, _ts: 7.0
+    t.lag_providers["broken"] = lambda _k, _ts: (_ for _ in ()).throw(
+        RuntimeError("probe died"))  # must not kill the tick
+
+    class _Src:
+        last_arrival_lag_s = 0.25
+        last_release_hold_s = 2.0
+
+    phases = {"dispatch": 0.003, "collect": 0.004, "emit": 0.001}
+    now = 1_700_000_000
+    for k in range(9):
+        t.observe_detect(np.array([0.2]))
+        t.record_tick(k, now + k, phases, 0.01,
+                      poll_wall=now + k + 0.5, source=_Src())
+    wf = t.last_waterfall
+    assert wf["ingest_lag_s"] == pytest.approx(0.5)
+    assert wf["arrival_lag_s"] == pytest.approx(0.25)
+    assert wf["backfill_hold_s"] == pytest.approx(2.0)
+    assert wf["lags"] == {"repl_ack_ticks": 7.0}
+    assert t.sketches["detect"].count("total") == 9
+    # 9 ticks at window 4 -> 2 rolls
+    assert t.sketches["tick"].rolls == 2
+    snap = t.snapshot()
+    assert snap["stages"]["dispatch"]["total"]["count"] == 9
+    stats = t.stats()
+    assert stats["detect"]["count"] == 9
+    assert stats["waterfall"]["tick"] == 8
