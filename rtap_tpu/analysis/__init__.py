@@ -1,0 +1,51 @@
+"""rtap-lint: AST-based invariant analysis for the serve stack (ISSUE 12).
+
+The repo's correctness story rests on contracts no test fully covers —
+bit-exact device/oracle twins, exactly-once alert delivery, and a lock
+discipline across ~10 daemon-threaded modules. Three review passes
+found the same latent-bug classes by hand; this package machine-checks
+them:
+
+==================  ====================================================
+pass (module)       rules
+==================  ====================================================
+races               ``race`` (thread-shared-state write/write races with
+                    interprocedural lock inference), ``thread-name``
+                    (anonymous serve-stack threads)
+purity              ``purity-nondet``, ``purity-fetch``,
+                    ``purity-isfinite`` (hot-path determinism, no
+                    device fetches, not-NaN presence contract)
+excepts             ``except-silent`` (bare-pass handlers in the serve
+                    stack)
+flags               ``flag-docs`` (serve flags absent from README/docs —
+                    the metric-catalog gate's dual)
+prints              ``print-strict``, ``print-bare``,
+                    ``strict-coverage`` (the check_static.sh gate,
+                    ported; non-suppressible)
+==================  ====================================================
+
+CLI: ``python -m rtap_tpu.analysis`` (human report, exit 0 iff zero
+unsuppressed findings; ``--json`` emits one artifact line for soaks).
+``scripts/check_static.sh`` is a thin wrapper (compileall + one analyzer
+invocation) and rides tier-1 via tests/unit/test_static_checks.py.
+Suppression/baseline syntax and the triage runbook: docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from rtap_tpu.analysis import excepts, flags, prints, purity, races
+from rtap_tpu.analysis.core import (  # noqa: F401
+    AnalysisContext,
+    Baseline,
+    Finding,
+    Report,
+    SourceFile,
+    run_analysis,
+)
+
+#: execution order: cheap syntactic passes first, the interprocedural
+#: race pass last (ordering is cosmetic — every pass always runs)
+PASSES = (prints, excepts, flags, purity, races)
+
+#: rule id -> description, across every pass (the CLI's --list-passes)
+ALL_RULES = {rid: desc for mod in PASSES for rid, desc in mod.RULES.items()}

@@ -1,0 +1,82 @@
+"""CLI: ``python -m rtap_tpu.analysis [--json] [--rules ...]``.
+
+Exit codes: 0 = zero unsuppressed findings (the gate), 1 = findings or
+baseline format errors, 2 = usage error. The human report goes to
+stderr; ``--json`` prints exactly one JSON artifact line to stdout (the
+soak/hw_session archival surface — same one-JSON-line stdout contract
+as bench.py), so both can be combined in one invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from rtap_tpu.analysis import ALL_RULES
+from rtap_tpu.analysis.core import (
+    BASELINE_NAME,
+    Baseline,
+    render_human,
+    run_analysis,
+)
+
+
+def _default_root() -> str:
+    """The repo root: the cwd when it holds rtap_tpu/, else the package's
+    grandparent (so the module runs from anywhere inside the checkout)."""
+    cwd = os.getcwd()
+    if os.path.isdir(os.path.join(cwd, "rtap_tpu")):
+        return cwd
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m rtap_tpu.analysis",
+        description="rtap-lint: AST-based invariant analysis "
+                    "(docs/ANALYSIS.md)")
+    ap.add_argument("--root", default=None,
+                    help="repo root to analyze (default: auto-detected)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON artifact line on stdout "
+                         "(findings, counts, timings)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_NAME})")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default: all)")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="list rule ids + descriptions and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for rid, desc in sorted(ALL_RULES.items()):
+            print(f"{rid:18s} {desc}", file=sys.stderr)
+        return 0
+
+    root = args.root or _default_root()
+    if not os.path.isdir(os.path.join(root, "rtap_tpu")):
+        print(f"rtap-lint: {root} does not look like the repo root "
+              "(no rtap_tpu/)", file=sys.stderr)
+        return 2
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(ALL_RULES) - {"parse-error"}
+        if unknown:
+            print(f"rtap-lint: unknown rule(s): {sorted(unknown)} "
+                  f"(known: {sorted(ALL_RULES)})", file=sys.stderr)
+            return 2
+    baseline = Baseline.load(
+        args.baseline or os.path.join(root, BASELINE_NAME))
+    report = run_analysis(root, baseline=baseline, rules=rules)
+    print(render_human(report), file=sys.stderr)
+    if args.json:
+        print(json.dumps(report.to_dict()))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,360 @@
+"""Analyzer framework: findings, suppressions, baseline, the runner.
+
+The contracts this package enforces are the ones three separate review
+passes kept re-discovering by hand (ISSUE 12): lock discipline across the
+daemon-threaded serve modules, hot-path purity (device code must stay
+deterministic and fetch-free; presence checks are not-NaN, never
+isfinite), exception discipline in the serve stack, flag↔docs drift, and
+the print gate. Each invariant is a *pass* (one module under
+``rtap_tpu/analysis/``) producing :class:`Finding`s; this module owns
+everything shared — file discovery/parsing, the per-finding suppression
+comments, the committed baseline for grandfathered findings, and the
+report the CLI renders.
+
+Suppression syntax (docs/ANALYSIS.md):
+
+    some_code()  # rtap: allow[rule-id] — one-line justification
+
+A suppression covers findings of that rule on its own line and on the
+line directly below (so a comment-only line can annotate the statement
+it precedes). Several rules separate with commas:
+``# rtap: allow[race,except-silent] — why``.
+
+Baseline (``analysis_baseline.json`` at the repo root): grandfathered
+findings keyed by ``(rule, path, symbol)`` — symbols are stable
+(``Class.attr``, ``func:except OSError#2``), never line numbers, so
+unrelated edits don't churn the file. Every entry MUST carry a
+non-empty ``why``; a why-less entry is itself a finding. Entries that
+no longer match anything are reported as stale (non-fatal — delete
+them when you see them).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import time
+import tokenize
+from dataclasses import dataclass, field
+
+__all__ = [
+    "AnalysisContext",
+    "Baseline",
+    "Finding",
+    "Report",
+    "SourceFile",
+    "discover_files",
+    "render_human",
+    "run_analysis",
+]
+
+#: the suppression comment grammar (see module docstring)
+_SUPPRESS_RE = re.compile(r"#\s*rtap:\s*allow\[([A-Za-z0-9_,\s-]+)\]")
+
+#: default baseline filename at the analysis root
+BASELINE_NAME = "analysis_baseline.json"
+
+#: gate-critical rules that neither inline suppressions nor the baseline
+#: may silence — the print gate is plumbing other gates stand on, and a
+#: suppressible guard is no guard (the canary tests pin this)
+NON_SUPPRESSIBLE = frozenset({"print-strict", "strict-coverage",
+                              "parse-error"})
+
+
+@dataclass
+class Finding:
+    """One invariant violation at one site."""
+
+    rule: str          # pass rule id, e.g. "race", "except-silent"
+    path: str          # repo-relative posix path
+    line: int          # 1-based line of the offending node
+    symbol: str        # stable key within the file (line-insensitive)
+    message: str       # human explanation with the fix direction
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+
+class SourceFile:
+    """One parsed python file + its suppression comments.
+
+    ``path`` is repo-relative (posix separators) — it decides pass scope
+    (tests build synthetic paths to land fixture snippets in scope).
+    Files that fail to parse record ``parse_error`` instead of a tree;
+    the runner turns that into a finding (compileall would catch it too,
+    but the analyzer must never crash on a torn working tree).
+    """
+
+    def __init__(self, path: str, text: str):
+        self.path = path.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.parse_error: str | None = None
+        try:
+            self.tree: ast.AST | None = ast.parse(text, filename=path)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = f"{type(e).__name__}: {e}"
+        # line -> set of rule ids suppressed there (comments live outside
+        # the AST: tokenize finds them, including trailing ones)
+        self.suppressions: dict[int, set[str]] = {}
+        if self.parse_error is None:
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(text).readline):
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = _SUPPRESS_RE.search(tok.string)
+                    if m is None:
+                        continue
+                    rules = {r.strip() for r in m.group(1).split(",")
+                             if r.strip()}
+                    self.suppressions.setdefault(
+                        tok.start[0], set()).update(rules)
+            except tokenize.TokenError:
+                pass  # ast accepted it; worst case this file's
+                # suppression comments are not honored (fails loud)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding is suppressed by a comment on its line or on the
+        line directly above (the comment-on-its-own-line form)."""
+        for ln in (line, line - 1):
+            if rule in self.suppressions.get(ln, ()):
+                return True
+        return False
+
+
+@dataclass
+class AnalysisContext:
+    """Everything a pass may consult."""
+
+    root: str
+    files: list[SourceFile]
+    #: README + docs/**.md concatenated (flag↔docs pass); lazily loaded,
+    #: overridable by tests
+    docs_text: str | None = None
+
+    def files_under(self, *prefixes: str) -> list[SourceFile]:
+        return [f for f in self.files
+                if any(f.path.startswith(p) for p in prefixes)]
+
+    def file(self, path: str) -> SourceFile | None:
+        for f in self.files:
+            if f.path == path:
+                return f
+        return None
+
+    def docs(self) -> str:
+        if self.docs_text is None:
+            chunks = []
+            for name in ("README.md",):
+                p = os.path.join(self.root, name)
+                if os.path.isfile(p):
+                    with open(p, encoding="utf-8") as fh:
+                        chunks.append(fh.read())
+            docs_dir = os.path.join(self.root, "docs")
+            if os.path.isdir(docs_dir):
+                for fn in sorted(os.listdir(docs_dir)):
+                    if fn.endswith(".md"):
+                        with open(os.path.join(docs_dir, fn),
+                                  encoding="utf-8") as fh:
+                            chunks.append(fh.read())
+            self.docs_text = "\n".join(chunks)
+        return self.docs_text
+
+
+class Baseline:
+    """The committed grandfathered-findings file (see module docstring)."""
+
+    def __init__(self, entries: list[dict], path: str | None = None):
+        self.path = path
+        self.entries = entries
+        self.format_errors: list[str] = []
+        self._index: dict[tuple[str, str, str], dict] = {}
+        self._used: set[tuple[str, str, str]] = set()
+        for i, e in enumerate(entries):
+            rule, p, sym = (e.get("rule"), e.get("path"), e.get("symbol"))
+            if not (rule and p and sym):
+                self.format_errors.append(
+                    f"entry #{i} missing rule/path/symbol: {e!r}")
+                continue
+            if not str(e.get("why", "")).strip():
+                self.format_errors.append(
+                    f"entry #{i} ({rule}:{p}:{sym}) has no 'why' — every "
+                    "baseline entry must carry a justification")
+                continue
+            self._index[(rule, p, sym)] = e
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except FileNotFoundError:
+            return cls([], path)
+        except (OSError, ValueError) as e:
+            b = cls([], path)
+            b.format_errors.append(f"unreadable baseline {path}: {e}")
+            return b
+        entries = data.get("entries", []) if isinstance(data, dict) else []
+        if not isinstance(entries, list):
+            b = cls([], path)
+            b.format_errors.append(
+                f"baseline {path}: 'entries' must be a list")
+            return b
+        return cls(entries, path)
+
+    def matches(self, finding: Finding) -> bool:
+        k = finding.key()
+        if k in self._index:
+            self._used.add(k)
+            return True
+        return False
+
+    def stale_entries(self) -> list[dict]:
+        return [e for k, e in sorted(self._index.items())
+                if k not in self._used]
+
+
+def discover_files(root: str) -> list[SourceFile]:
+    """The analysis surface: every .py under rtap_tpu/ and scripts/,
+    plus bench.py — the same set the old check_static.sh walked, so the
+    print gate's coverage is unchanged by the port."""
+    out: list[SourceFile] = []
+    for top in ("rtap_tpu", "scripts"):
+        base = os.path.join(root, top)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root)
+                with open(full, encoding="utf-8") as fh:
+                    out.append(SourceFile(rel, fh.read()))
+    bench = os.path.join(root, "bench.py")
+    if os.path.isfile(bench):
+        with open(bench, encoding="utf-8") as fh:
+            out.append(SourceFile("bench.py", fh.read()))
+    return out
+
+
+@dataclass
+class Report:
+    """The runner's result: what the CLI renders and the gate asserts."""
+
+    findings: list[Finding]          # unsuppressed, the gate's subject
+    suppressed: list[Finding]        # silenced by inline comments
+    baselined: list[Finding]         # silenced by the baseline file
+    stale_baseline: list[dict]       # baseline entries matching nothing
+    baseline_errors: list[str]       # malformed baseline entries (fatal)
+    per_pass: dict = field(default_factory=dict)  # pass -> raw count
+    elapsed_s: float = 0.0
+    files_scanned: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.baseline_errors
+
+    def to_dict(self) -> dict:
+        """The --json artifact line (soaks/hw_session archive this)."""
+        return {
+            "analysis": {
+                "ok": self.ok,
+                "files_scanned": self.files_scanned,
+                "elapsed_s": round(self.elapsed_s, 3),
+                "findings": [f.to_dict() for f in self.findings],
+                "suppressed": len(self.suppressed),
+                "baselined": len(self.baselined),
+                "stale_baseline": self.stale_baseline,
+                "baseline_errors": self.baseline_errors,
+                "per_pass": dict(sorted(self.per_pass.items())),
+            }
+        }
+
+
+def run_analysis(root: str, files: list[SourceFile] | None = None,
+                 baseline: Baseline | None = None,
+                 rules: set[str] | None = None,
+                 ctx: AnalysisContext | None = None) -> Report:
+    """Run every pass over the tree; classify findings against inline
+    suppressions and the baseline. `files`/`ctx` injection is the unit
+    tests' fixture seam; `rules` filters to a subset of rule ids."""
+    from rtap_tpu.analysis import PASSES
+
+    t0 = time.perf_counter()
+    if ctx is None:
+        if files is None:
+            files = discover_files(root)
+        ctx = AnalysisContext(root=root, files=files)
+    if baseline is None:
+        baseline = Baseline.load(os.path.join(root, BASELINE_NAME))
+
+    raw: list[Finding] = []
+    per_pass: dict[str, int] = {}
+    for mod in PASSES:
+        found = mod.run(ctx)
+        per_pass[mod.PASS_NAME] = len(found)
+        raw.extend(found)
+    # a file that does not parse is a finding too (the analyzer must
+    # degrade loudly, not crash or silently skip)
+    for f in ctx.files:
+        if f.parse_error is not None:
+            raw.append(Finding(
+                rule="parse-error", path=f.path, line=1,
+                symbol="module", message=f.parse_error))
+
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    baselined: list[Finding] = []
+    for fi in raw:
+        if rules is not None and fi.rule not in rules:
+            continue
+        sf = ctx.file(fi.path)
+        if fi.rule in NON_SUPPRESSIBLE:
+            findings.append(fi)
+        elif sf is not None and sf.suppressed(fi.rule, fi.line):
+            suppressed.append(fi)
+        elif baseline.matches(fi):
+            baselined.append(fi)
+        else:
+            findings.append(fi)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # staleness is only judgeable on a FULL run: a --rules subset never
+    # consults the baseline for the unselected rules, and reporting
+    # their (valid) entries as stale would advise deleting them
+    return Report(
+        findings=findings, suppressed=suppressed, baselined=baselined,
+        stale_baseline=baseline.stale_entries() if rules is None else [],
+        baseline_errors=list(baseline.format_errors),
+        per_pass=per_pass, elapsed_s=time.perf_counter() - t0,
+        files_scanned=len(ctx.files))
+
+
+def render_human(report: Report) -> str:
+    """The stderr report: one line per finding, then the tallies."""
+    lines: list[str] = []
+    for f in report.findings:
+        lines.append(f"{f.path}:{f.line}: [{f.rule}] {f.symbol}: "
+                     f"{f.message}")
+    for e in report.baseline_errors:
+        lines.append(f"analysis_baseline.json: [baseline-format] {e}")
+    for e in report.stale_baseline:
+        lines.append(
+            f"analysis_baseline.json: stale entry "
+            f"{e.get('rule')}:{e.get('path')}:{e.get('symbol')} matches "
+            "nothing — delete it (non-fatal)")
+    lines.append(
+        f"rtap-lint: {len(report.findings)} finding(s), "
+        f"{len(report.suppressed)} suppressed, "
+        f"{len(report.baselined)} baselined, "
+        f"{report.files_scanned} files in {report.elapsed_s:.2f}s "
+        f"({'OK' if report.ok else 'FAIL'})")
+    return "\n".join(lines)

@@ -1,0 +1,153 @@
+"""Silent-exception discipline in the serve stack.
+
+Rule ``except-silent`` — every ``except`` in ``service/``, ``obs/``,
+``resilience/``, ``ingest/``, ``correlate/`` must DO something:
+re-raise, log, bump an obs instrument, or at minimum bind an outcome
+(assign a fallback, return, continue/break). A handler whose body is
+nothing but ``pass`` swallows the fault with no trace — at 1M streams
+that is an invisible outage, and the incident stream exists precisely
+so faults narrate themselves.
+
+One narrow carve-out: the universal cleanup idiom
+
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+is allowed when (a) the handler catches only OSError-family exceptions
+and (b) the try body is a single teardown call (``close``/``shutdown``/
+``unlink``/``terminate``/``kill``) — a failing close has no outcome
+worth narrating. Everything else bare needs a suppression with a
+justification or a baseline entry (grandfathered sites carry their
+"why" there; see docs/ANALYSIS.md).
+
+Symbols are ``<qualname>:except <types>[#n]`` — stable under line
+drift, disambiguated by ordinal when one function has several identical
+handlers.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+
+PASS_NAME = "excepts"
+RULES = {
+    "except-silent": "except handler in the serve stack whose body is "
+                     "a bare pass (no re-raise, log, instrument bump, "
+                     "or bound outcome)",
+}
+
+SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/")
+
+#: teardown calls whose failure has no narratable outcome
+_CLEANUP_CALLS = frozenset({
+    "close", "shutdown", "unlink", "terminate", "kill", "server_close",
+})
+
+#: exception names admissible for the cleanup carve-out
+_OS_ERRORS = frozenset({
+    "OSError", "IOError", "ConnectionError", "ConnectionResetError",
+    "BrokenPipeError", "FileNotFoundError", "TimeoutError",
+    "socket.timeout", "socket.error",
+})
+
+
+def _inert(body: list[ast.stmt]) -> bool:
+    """True when the handler body does nothing observable."""
+    for st in body:
+        if isinstance(st, ast.Pass):
+            continue
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Constant):
+            continue  # docstring/ellipsis
+        return False
+    return True
+
+
+def _exc_names(h: ast.ExceptHandler) -> list[str]:
+    if h.type is None:
+        return ["<bare>"]
+    nodes = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    out = []
+    for n in nodes:
+        try:
+            out.append(ast.unparse(n))
+        except Exception:  # pragma: no cover — unparse is total on exprs
+            out.append("?")
+    return out
+
+
+def _cleanup_shaped(try_node: ast.Try, h: ast.ExceptHandler) -> bool:
+    if not all(n in _OS_ERRORS for n in _exc_names(h)):
+        return False
+    if len(try_node.body) != 1:
+        return False
+    st = try_node.body[0]
+    return (isinstance(st, ast.Expr) and isinstance(st.value, ast.Call)
+            and isinstance(st.value.func, ast.Attribute)
+            and st.value.func.attr in _CLEANUP_CALLS)
+
+
+def _qualname_index(tree: ast.AST) -> dict[int, str]:
+    """lineno -> enclosing function qualname (best-effort, for symbols)."""
+    spans: list[tuple[int, int, str]] = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                end = getattr(child, "end_lineno", child.lineno)
+                spans.append((child.lineno, end, q))
+                walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return spans
+
+
+def _qual_of(spans, line: int) -> str:
+    """Innermost enclosing function qualname (smallest covering span)."""
+    best = "<module>"
+    best_size = None
+    for lo, hi, q in spans:
+        if lo <= line <= hi:
+            size = hi - lo
+            if best_size is None or size < best_size:
+                best, best_size = q, size
+    return best
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in ctx.files_under(*SCOPE):
+        if sf.tree is None:
+            continue
+        index = _qualname_index(sf.tree)
+        seen_symbols: dict[str, int] = {}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                if not _inert(h.body):
+                    continue
+                if _cleanup_shaped(node, h):
+                    continue
+                qual = _qual_of(index, h.lineno)
+                base = f"{qual}:except {', '.join(_exc_names(h))}"
+                n = seen_symbols.get(base, 0)
+                seen_symbols[base] = n + 1
+                symbol = base if n == 0 else f"{base}#{n + 1}"
+                out.append(Finding(
+                    rule="except-silent", path=sf.path, line=h.lineno,
+                    symbol=symbol,
+                    message="bare-pass handler in the serve stack — "
+                            "re-raise, log, bump an obs instrument, or "
+                            "bind a fallback outcome; if the swallow is "
+                            "deliberate, suppress with the reason"))
+    return out

@@ -1,0 +1,87 @@
+"""Flag↔docs drift: every serve flag must be documented.
+
+Rule ``flag-docs`` — the dual of the metric-catalog drift gate
+(tests/unit/test_metric_catalog.py, docs/TELEMETRY.md): every
+``--flag`` the ``serve`` argparse surface declares in
+``rtap_tpu/__main__.py`` must appear somewhere in README.md or
+``docs/*.md``. An operator flag nobody documented is a feature nobody
+can operate — and three PRs in a row added flags whose docs rode along
+only because a reviewer asked.
+
+Detection is AST + line ranges: ``add_parser("serve")`` opens the serve
+range (closed by the next ``add_parser``), and every
+``add_argument("--x", ...)`` inside it contributes a flag. The docs
+check is substring presence of the literal flag text — prose, tables,
+and fenced command examples all count.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+
+PASS_NAME = "flags"
+RULES = {
+    "flag-docs": "serve argparse flag absent from README.md and "
+                 "docs/*.md",
+}
+
+MAIN = "rtap_tpu/__main__.py"
+SUBCOMMAND = "serve"
+
+
+def serve_flags(sf) -> list[tuple[str, int]]:
+    """(flag, lineno) for every serve-subparser --flag."""
+    if sf is None or sf.tree is None:
+        return []
+    parser_lines: list[tuple[int, str]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "add_parser" \
+                and node.args and isinstance(node.args[0], ast.Constant):
+            parser_lines.append((node.lineno, str(node.args[0].value)))
+    parser_lines.sort()
+    lo = hi = None
+    for i, (ln, name) in enumerate(parser_lines):
+        if name == SUBCOMMAND:
+            lo = ln
+            hi = parser_lines[i + 1][0] if i + 1 < len(parser_lines) \
+                else 10 ** 9
+            break
+    if lo is None:
+        return []
+    out = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "add_argument" \
+                and lo <= node.lineno < hi \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and str(node.args[0].value).startswith("--"):
+            out.append((str(node.args[0].value), node.lineno))
+    return out
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    sf = ctx.file(MAIN)
+    flags = serve_flags(sf)
+    if not flags:
+        return []
+    docs = ctx.docs()
+    out = []
+    for flag, line in flags:
+        # word-boundary match, not substring: `--health` must not ride
+        # on a documented `--health-drift-threshold` (the serve surface
+        # has ~11 such prefix pairs — exactly the masking this gate
+        # exists to catch)
+        if not re.search(re.escape(flag) + r"(?![\w-])", docs):
+            out.append(Finding(
+                rule="flag-docs", path=MAIN, line=line, symbol=flag,
+                message=f"serve flag {flag} appears nowhere in README.md "
+                        "or docs/*.md — document it (a flag row, a "
+                        "runbook mention, or a command example all "
+                        "count)"))
+    return out

@@ -1,0 +1,220 @@
+"""Hot-path purity: determinism, no device fetches, not-NaN presence.
+
+Three rules over the fused-step kernels (``rtap_tpu/ops/``) and the
+live-loop tick path (``rtap_tpu/service/loop.py``):
+
+``purity-nondet`` — host nondeterminism inside device code. A fused
+step that reads ``time.time()``, ``random``, or an argless
+``datetime.now()`` cannot be bit-exact against its oracle twin, and the
+journal's replay contract (bit-identical resume) dies with it. In
+``ops/`` every wall-clock/random source is forbidden; in ``loop.py``
+the wall clock IS the pacer (cadence sleeps, deadline accounting) so
+only the genuinely nondeterministic sources (random, datetime.now,
+uuid, secrets) are forbidden — timestamps entering scoring must come
+from the SOURCE clock (the monotonic clamp), never be minted mid-path.
+
+``purity-fetch`` — device→host fetches inside kernel code. A function
+in ``ops/`` that traces with ``jnp``/``lax`` must not call
+``np.asarray``/``np.array``/``.item()``/``jax.device_get`` on its
+values: under jit that is a concrete-value fetch (TracerError at best,
+a silent sync at worst). Host-side twins (pure-numpy functions) are out
+of scope by construction — the rule only fires inside functions that
+also touch ``jnp``/``lax``.
+
+``purity-isfinite`` — presence checks in the wire/journal/sink layer.
+The repo contract is presence == not-NaN: a producer may push ``inf``
+(legal f32) and it must survive ingest merges, journal frame synthesis,
+and replay bit-exactly (the PR 7 class of bug: ``isfinite`` silently
+turned a wire inf into a missing sample on one path and not another,
+breaking journal bit-exactness). ``isfinite`` is forbidden in
+``ingest/``, ``resilience/``, ``correlate/`` and the serve loop/source/
+sink modules; model-layer encoders (``ops/``, ``models/``) keep their
+deliberate isfinite semantics — both twins implement it identically.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+
+PASS_NAME = "purity"
+RULES = {
+    "purity-nondet": "host nondeterminism (time/random/datetime.now) in "
+                     "device-kernel or tick-path code",
+    "purity-fetch": "device->host fetch (np.asarray/.item()/device_get) "
+                    "inside a jnp/lax-tracing function in ops/",
+    "purity-isfinite": "isfinite presence check where the wire/journal "
+                       "contract is not-NaN (inf must survive replay)",
+}
+
+#: wall-clock reads — banned in ops/ (twins must replay), legitimate in
+#: loop.py (the pacer), where only the _nondet_reason sources apply
+_TIME_CALLS = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+})
+
+_ISFINITE_SCOPE = (
+    "rtap_tpu/ingest/", "rtap_tpu/resilience/", "rtap_tpu/correlate/",
+    "rtap_tpu/service/loop.py", "rtap_tpu/service/sources.py",
+    "rtap_tpu/service/alerts.py",
+)
+
+_FETCH_CALLS = frozenset({
+    "np.asarray", "np.array", "np.asanyarray", "numpy.asarray",
+    "numpy.array", "jax.device_get",
+})
+
+
+def _dotted(node: ast.AST) -> str | None:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _nondet_reason(call: ast.Call, allow_time: bool) -> str | None:
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    if not allow_time and d in _TIME_CALLS:
+        return f"{d}() — the device/oracle twins cannot replay a wall " \
+               "clock; thread timestamps in from the caller"
+    if d == "random" or d.startswith("random.") or ".random." in d \
+            or d.endswith(".random") or d.startswith("np.random") \
+            or d.startswith("numpy.random"):
+        # jax.random is keyed/deterministic and exempt
+        if d.startswith("jax.random"):
+            return None
+        return f"{d}() — unseeded host randomness breaks bit-exact " \
+               "twins and journal replay; use a keyed jax.random or " \
+               "seed threaded from config"
+    if d.endswith("datetime.now") or d.endswith("datetime.utcnow") \
+            or d.endswith("date.today"):
+        if not call.args:
+            return f"{d}() — an argless now() mints a nondeterministic " \
+                   "timestamp mid-path; use the row's source ts"
+    if d == "os.urandom" or d.startswith("uuid.") \
+            or d.startswith("secrets."):
+        return f"{d}() — nondeterministic identity on the hot path"
+    return None
+
+
+def _functions(tree: ast.AST):
+    """(qualname, FunctionDef) for every function/method, outer-first."""
+    out = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child))
+                walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _own_body_nodes(fn: ast.FunctionDef):
+    """Walk a function's body excluding nested function/class defs
+    (those are reported under their own qualnames)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _uses_tracing(fn: ast.FunctionDef) -> bool:
+    for node in _own_body_nodes(fn):
+        if isinstance(node, ast.Name) and node.id in ("jnp", "lax"):
+            return True
+        if isinstance(node, ast.Attribute):
+            d = _dotted(node)
+            if d and (d.startswith("jnp.") or d.startswith("lax.")
+                      or d.startswith("jax.lax.")):
+                return True
+    return False
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    out: list[Finding] = []
+
+    # ---- ops/: nondeterminism + device fetches -----------------------
+    for sf in ctx.files_under("rtap_tpu/ops/"):
+        if sf.tree is None:
+            continue
+        for qual, fn in _functions(sf.tree):
+            tracing = _uses_tracing(fn)
+            for node in _own_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _nondet_reason(node, allow_time=False)
+                if reason is not None:
+                    out.append(Finding(
+                        rule="purity-nondet", path=sf.path,
+                        line=node.lineno, symbol=qual, message=reason))
+                if tracing:
+                    d = _dotted(node.func)
+                    if d in _FETCH_CALLS:
+                        out.append(Finding(
+                            rule="purity-fetch", path=sf.path,
+                            line=node.lineno, symbol=qual,
+                            message=f"{d}() inside a jnp/lax-tracing "
+                                    "function — a device->host fetch "
+                                    "under jit; keep kernel values on "
+                                    "device (jnp.asarray) or move the "
+                                    "conversion to the host boundary"))
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "item" \
+                            and not node.args and not node.keywords:
+                        out.append(Finding(
+                            rule="purity-fetch", path=sf.path,
+                            line=node.lineno, symbol=qual,
+                            message=".item() inside a jnp/lax-tracing "
+                                    "function — a synchronous device "
+                                    "fetch; return the array and let "
+                                    "the host boundary convert"))
+
+    # ---- loop.py tick path: genuine nondeterminism only --------------
+    loop = ctx.file("rtap_tpu/service/loop.py")
+    if loop is not None and loop.tree is not None:
+        for qual, fn in _functions(loop.tree):
+            for node in _own_body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = _nondet_reason(node, allow_time=True)
+                if reason is not None:
+                    out.append(Finding(
+                        rule="purity-nondet", path=loop.path,
+                        line=node.lineno, symbol=qual, message=reason))
+
+    # ---- wire/journal/sink layer: presence == not-NaN ----------------
+    for sf in ctx.files_under(*_ISFINITE_SCOPE):
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "isfinite":
+                out.append(Finding(
+                    rule="purity-isfinite", path=sf.path,
+                    line=node.lineno, symbol="isfinite",
+                    message="presence checks in the ingest/journal/sink "
+                            "layer are not-NaN, never isfinite: a wire "
+                            "inf is a legal value and must survive "
+                            "merges, frame synthesis, and replay "
+                            "bit-exactly (use ~np.isnan)"))
+    return out

@@ -1,0 +1,439 @@
+"""Thread-shared-state race detection + thread-naming discipline.
+
+Rule ``race`` — for each class in the serve stack that runs code on its
+own thread (a ``threading.Thread(target=self.m)`` / ``target=<nested
+function>`` spawn, or a nested ``socketserver``-style request-handler
+class whose methods run per-connection), compute the ``self.*``
+attributes WRITTEN from the thread-entry call graph and from the
+main-side (public) methods, and flag attributes mutated on both sides
+whose write paths do not all share a common ``with self._lock``-style
+guard. Request-handler threads are concurrent with THEMSELVES (one per
+connection), so any unguarded handler-side write is a race even without
+a main-side writer — exactly the ``outer._py_parse_errors += 1``
+lost-update class this pass was built from.
+
+Guard reasoning is interprocedural within the class: a private method
+whose every in-class call site sits inside ``with self._lock`` inherits
+the guard (the ``BinaryBatchSource._apply`` idiom — callers hold the
+lock), computed as the intersection of guards over all call paths from
+the side's entry points (a method reachable both with and without the
+lock counts as unguarded).
+
+What this pass deliberately does NOT flag (docs/ANALYSIS.md triage):
+single-writer attributes read unguarded from the other side (GIL-atomic
+scalar reads are the serve stack's documented telemetry tolerance), and
+cross-OBJECT sharing (HealthTracker.fold vs the obs server's snapshot
+thread — those contracts are audited by hand and documented on the
+class). Writes in ``__init__`` are construction-time (before any thread
+starts) and ignored.
+
+Rule ``thread-name`` — every ``threading.Thread``/``Timer`` spawned in
+the serve stack must carry ``name="rtap-<module>-<role>"`` so race
+findings, the conftest thread-leak fixture, and stuck-session triage
+attribute threads to owners.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+
+PASS_NAME = "races"
+RULES = {
+    "race": "self.* attribute mutated from both a spawned thread and "
+            "main-side methods without a common lock guard on every "
+            "write path",
+    "thread-name": "threading.Thread spawned in the serve stack without "
+                   'a name="rtap-<module>-<role>"',
+}
+
+#: the serve stack (same scope as the strict print gate)
+SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/")
+
+#: attribute-method calls that mutate their receiver in place
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "update", "setdefault", "pop", "popitem", "popleft",
+    "clear", "sort", "reverse",
+})
+
+#: a ``with self.<g>`` guards writes when <g> smells like a lock
+GUARD_HINTS = ("lock", "cond", "mutex")
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    return d in ("threading.Thread", "Thread",
+                 "threading.Timer", "Timer")
+
+
+@dataclass
+class _Write:
+    attr: str
+    line: int
+    guards: frozenset  # lexical guards at the write site
+
+
+@dataclass
+class _MethodInfo:
+    name: str
+    writes: list[_Write] = field(default_factory=list)
+    #: (callee method name, lexical guards at the call site)
+    calls: list[tuple[str, frozenset]] = field(default_factory=list)
+
+
+class _BodyScanner(ast.NodeVisitor):
+    """Scan one method/function body for self-attr writes, self-method
+    calls, and the lexical ``with <self-ish>.<lock>`` guard stack.
+    Nested function/class definitions are NOT descended into (they run
+    later, on whoever calls them — thread-target nested functions are
+    scanned separately as thread entries)."""
+
+    def __init__(self, self_names: set[str], method_names: set[str]):
+        self.self_names = self_names
+        self.method_names = method_names
+        self.info = _MethodInfo(name="")
+        self._guards: list[str] = []
+
+    # -- structure we do not descend into ------------------------------
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        pass
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    # -- guards --------------------------------------------------------
+    def _guard_of(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id in self.self_names \
+                and any(h in expr.attr.lower() for h in GUARD_HINTS):
+            return expr.attr
+        return None
+
+    def visit_With(self, node):  # noqa: N802
+        names = [g for g in (self._guard_of(it.context_expr)
+                             for it in node.items) if g is not None]
+        self._guards.extend(names)
+        for st in node.body:
+            self.visit(st)
+        if names:
+            del self._guards[-len(names):]
+
+    # -- writes --------------------------------------------------------
+    def _self_attr_of_target(self, t: ast.AST) -> str | None:
+        # self.x = / self.x[...] = / del self.x
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id in self.self_names:
+            return t.attr
+        if isinstance(t, ast.Subscript):
+            return self._self_attr_of_target(t.value)
+        return None
+
+    def _record_write(self, attr: str | None, line: int) -> None:
+        if attr is not None:
+            self.info.writes.append(
+                _Write(attr, line, frozenset(self._guards)))
+
+    def visit_Assign(self, node):  # noqa: N802
+        for t in node.targets:
+            for el in ast.walk(t) if isinstance(
+                    t, (ast.Tuple, ast.List)) else (t,):
+                self._record_write(self._self_attr_of_target(el),
+                                   node.lineno)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node):  # noqa: N802
+        self._record_write(self._self_attr_of_target(node.target),
+                           node.lineno)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node):  # noqa: N802
+        if node.value is not None:
+            self._record_write(self._self_attr_of_target(node.target),
+                               node.lineno)
+            self.visit(node.value)
+
+    def visit_Delete(self, node):  # noqa: N802
+        for t in node.targets:
+            self._record_write(self._self_attr_of_target(t), node.lineno)
+
+    # -- calls ---------------------------------------------------------
+    def visit_Call(self, node):  # noqa: N802
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            # self.m(...) — in-class call edge
+            if isinstance(f.value, ast.Name) \
+                    and f.value.id in self.self_names \
+                    and f.attr in self.method_names:
+                self.info.calls.append((f.attr, frozenset(self._guards)))
+            # self.attr.append(...) — in-place mutation of self.attr
+            elif f.attr in MUTATORS:
+                self._record_write(self._self_attr_of_target(f.value),
+                                   node.lineno)
+        self.generic_visit(node)
+
+
+def _scan(body_owner, self_names: set[str],
+          method_names: set[str]) -> _MethodInfo:
+    sc = _BodyScanner(self_names, method_names)
+    sc.info.name = body_owner.name
+    for st in body_owner.body:
+        sc.visit(st)
+    return sc.info
+
+
+def _self_aliases(method: ast.FunctionDef, self_name: str) -> set[str]:
+    """Names bound to self inside a method (``outer = self``) — the
+    nested-request-handler closure idiom."""
+    out = {self_name}
+    for node in ast.walk(method):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == self_name:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _inherited_guards(entries: dict[str, frozenset],
+                      infos: dict[str, _MethodInfo]) -> dict[str, frozenset]:
+    """Worklist fixed point: guard set guaranteed held whenever each
+    reachable method runs on this side = intersection over call paths of
+    (caller's guarantee ∪ call-site guards). Monotone decreasing."""
+    state: dict[str, frozenset] = dict(entries)
+    work = list(entries)
+    while work:
+        m = work.pop()
+        base = state[m]
+        for callee, site in infos.get(m, _MethodInfo(m)).calls:
+            cand = base | site
+            cur = state.get(callee)
+            new = cand if cur is None else (cur & cand)
+            if cur is None or new != cur:
+                state[callee] = new
+                work.append(callee)
+    return state
+
+
+def _nested_defs(method: ast.FunctionDef):
+    """Directly nested FunctionDefs and ClassDefs (recursively, so a
+    handler class inside a with-block is still found)."""
+    funcs: dict[str, ast.FunctionDef] = {}
+    classes: list[ast.ClassDef] = []
+    stack = list(method.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+            continue  # do not look inside nested funcs for more
+        if isinstance(node, ast.ClassDef):
+            classes.append(node)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return funcs, classes
+
+
+def _analyze_class(sf, cls: ast.ClassDef) -> list[Finding]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    method_names = set(methods)
+
+    # ---- find thread-side code ---------------------------------------
+    #: entry method names spawned via Thread(target=self.m)
+    entry_methods: set[str] = set()
+    #: (nested function node, self-alias names) spawned via
+    #: Thread(target=nested)
+    nested_entries: list[tuple[ast.FunctionDef, set[str]]] = []
+    #: request-handler classes: (handler ClassDef, outer-alias names);
+    #: these run one thread PER CONNECTION — self-concurrent
+    handler_classes: list[tuple[ast.ClassDef, set[str]]] = []
+
+    for m in methods.values():
+        if not m.args.args:
+            continue
+        self_name = m.args.args[0].arg
+        aliases = _self_aliases(m, self_name)
+        funcs, classes = _nested_defs(m)
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    tgt = kw.value
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id in aliases \
+                            and tgt.attr in method_names:
+                        entry_methods.add(tgt.attr)
+                    elif isinstance(tgt, ast.Name) and tgt.id in funcs:
+                        nested_entries.append((funcs[tgt.id], aliases))
+        for nested_cls in classes:
+            if any("RequestHandler" in (_dotted(b) or "")
+                   for b in nested_cls.bases):
+                handler_classes.append((nested_cls, aliases - {self_name}))
+
+    if not (entry_methods or nested_entries or handler_classes):
+        return []
+
+    # ---- per-method write/call info ----------------------------------
+    infos: dict[str, _MethodInfo] = {}
+    for name, m in methods.items():
+        if not m.args.args:
+            infos[name] = _MethodInfo(name)
+            continue
+        infos[name] = _scan(m, {m.args.args[0].arg}, method_names)
+
+    # ---- thread side -------------------------------------------------
+    thread_writes: dict[str, list[tuple[_Write, bool]]] = {}
+    concurrent_attrs: set[str] = set()
+
+    def _fold_side(side_infos, inherited, concurrent, into):
+        for name, info in side_infos.items():
+            inh = inherited.get(name)
+            if inh is None:
+                continue
+            for w in info.writes:
+                eff = _Write(w.attr, w.line, w.guards | inh)
+                into.setdefault(w.attr, []).append((eff, concurrent))
+                if concurrent:
+                    concurrent_attrs.add(w.attr)
+
+    # entry methods + everything they reach
+    inh_thread = _inherited_guards(
+        {m: frozenset() for m in entry_methods}, infos)
+    _fold_side({n: infos[n] for n in inh_thread if n in infos},
+               inh_thread, False, thread_writes)
+    # nested thread-target functions (scan with the enclosing self names)
+    for idx, (fn, aliases) in enumerate(nested_entries):
+        info = _scan(fn, aliases, method_names)
+        key = f"<nested:{fn.name}:{idx}>"
+        infos[key] = info
+        inh = _inherited_guards({key: frozenset()}, infos)
+        _fold_side({n: infos[n] for n in inh if n in infos},
+                   inh, False, thread_writes)
+    # request-handler classes: concurrent with themselves
+    for idx, (hcls, outer_aliases) in enumerate(handler_classes):
+        if not outer_aliases:
+            continue
+        hentries = {}
+        for hm in hcls.body:
+            if isinstance(hm, ast.FunctionDef):
+                key = f"<handler:{hcls.name}.{hm.name}:{idx}>"
+                infos[key] = _scan(hm, set(outer_aliases), method_names)
+                hentries[key] = frozenset()
+        inh = _inherited_guards(hentries, infos)
+        _fold_side({n: infos[n] for n in inh if n in infos},
+                   inh, True, thread_writes)
+
+    # ---- main side ---------------------------------------------------
+    # entries: public methods (incl. the dunder protocol surface), plus
+    # private methods no in-class caller reaches (could be called from
+    # outside). __init__ runs before any thread exists — excluded.
+    called_by_someone = {callee for info in infos.values()
+                         for callee, _ in info.calls}
+    main_entries = {}
+    for name in methods:
+        if name == "__init__" or name in entry_methods:
+            # __init__ runs before any thread exists; a thread-entry
+            # method is the thread's code, not a main-side surface
+            continue
+        public = not name.startswith("_") or name in (
+            "__call__", "__enter__", "__exit__", "__iter__", "__next__")
+        if public or name not in called_by_someone:
+            main_entries[name] = frozenset()
+    inh_main = _inherited_guards(main_entries, infos)
+    main_writes: dict[str, list[tuple[_Write, bool]]] = {}
+    _fold_side({n: infos[n] for n in inh_main if n in infos},
+               inh_main, False, main_writes)
+
+    # ---- verdicts ----------------------------------------------------
+    out: list[Finding] = []
+    for attr in sorted(set(thread_writes) | set(main_writes)):
+        tw = thread_writes.get(attr, [])
+        mw = main_writes.get(attr, [])
+        all_writes = [w for w, _c in tw + mw]
+        common = None
+        for w in all_writes:
+            common = w.guards if common is None else (common & w.guards)
+        guarded_everywhere = bool(common)
+        both_sides = bool(tw) and bool(mw)
+        concurrent_unguarded = attr in concurrent_attrs and any(
+            not w.guards for w, c in tw if c)
+        if (both_sides and not guarded_everywhere) or concurrent_unguarded:
+            bad = next((w for w in all_writes if not w.guards),
+                       all_writes[0])
+            sides = ("handler-thread (self-concurrent)"
+                     if concurrent_unguarded and not both_sides else
+                     "thread and main")
+            out.append(Finding(
+                rule="race", path=sf.path, line=bad.line,
+                symbol=f"{cls.name}.{attr}",
+                message=(
+                    f"written from {sides} without a common lock guard "
+                    f"on every write path (thread writes: "
+                    f"{sorted({w.line for w, _ in tw})}, main writes: "
+                    f"{sorted({w.line for w, _ in mw})}) — guard every "
+                    f"write with the same 'with self._lock', or suppress "
+                    f"with a justification if the tolerance is "
+                    f"documented")))
+    return out
+
+
+def _thread_name_findings(sf) -> list[Finding]:
+    out = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        name_kw = next((kw for kw in node.keywords if kw.arg == "name"),
+                       None)
+        if name_kw is None:
+            out.append(Finding(
+                rule="thread-name", path=sf.path, line=node.lineno,
+                symbol="Thread",
+                message='anonymous thread in the serve stack — spawn '
+                        'with name="rtap-<module>-<role>" so leak '
+                        'fixtures and stuck-session triage can '
+                        'attribute it'))
+        elif isinstance(name_kw.value, ast.Constant) \
+                and isinstance(name_kw.value.value, str) \
+                and not name_kw.value.value.startswith("rtap-"):
+            out.append(Finding(
+                rule="thread-name", path=sf.path, line=node.lineno,
+                symbol=f"Thread:{name_kw.value.value}",
+                message=f'thread name "{name_kw.value.value}" does not '
+                        'follow the rtap-<module>-<role> convention'))
+    return out
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in ctx.files_under(*SCOPE):
+        if sf.tree is None:
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                out.extend(_analyze_class(sf, node))
+        out.extend(_thread_name_findings(sf))
+    return out

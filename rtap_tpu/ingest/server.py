@@ -112,10 +112,13 @@ class BinaryBatchSource:
         # detection-latency stage surfaces (ISSUE 11, obs/latency.py):
         # the latest DATA frame's wire-transit lag (arrival wall clock
         # minus its freshest row ts) and, in backfill mode, the hold the
-        # horizon imposed on the last emitted tick. Plain floats the
-        # LatencyTracker getattr-probes once per tick; None until data.
-        self._arrival_wall: float | None = None
-        self._arrival_ts = 0
+        # horizon imposed on the last emitted tick. The LatencyTracker
+        # getattr-probes once per tick WITHOUT the lock, so the
+        # (wall, ts) pair lives in ONE tuple rebound atomically — two
+        # separate attributes could tear between a handler's write and
+        # the loop's read and report a lag computed from mismatched
+        # halves (rtap-lint race-audit fix, docs/ANALYSIS.md).
+        self._arrival: tuple[float, int] | None = None  # (wall, row ts)
         self._release_hold: float | None = None
         # map epoch 1..65535 (0 is reserved for epoch-unaware
         # producers): bumped on every membership change so a producer
@@ -268,7 +271,9 @@ class BinaryBatchSource:
                             with outer._lock:
                                 for fr in frames:
                                     outer._apply(fr)
-                    except OSError:
+                    except OSError:  # rtap: allow[except-silent] —
+                        # connection death is a producer's normal end;
+                        # the finally below books the disconnect
                         pass
                     finally:
                         with outer._lock:
@@ -285,7 +290,8 @@ class BinaryBatchSource:
             self._server = Server((host, port), Handler)
             self.address = self._server.server_address
             self._thread = threading.Thread(
-                target=self._server.serve_forever, daemon=True)
+                target=self._server.serve_forever,
+                name="rtap-ingest-accept", daemon=True)
 
     # ---- lifecycle ---------------------------------------------------
     def start(self) -> "BinaryBatchSource":
@@ -349,7 +355,9 @@ class BinaryBatchSource:
             self._dead_skew = getattr(self, "_dead_skew", 0) + w.version_skew
             try:
                 self._walkers.remove(w)
-            except ValueError:
+            except ValueError:  # rtap: allow[except-silent] — a
+                # double-drop in the close() race; tallies above
+                # already folded once
                 pass
 
     def _walker_sum(self, attr: str, dead: str) -> int:
@@ -520,9 +528,9 @@ class BinaryBatchSource:
         if ts_rows.size:
             self._max_row_ts = max(self._max_row_ts, int(ts_rows.max()))
             # stage surface: when THIS frame's freshest row arrived,
-            # in wall time (one clock read per frame, not per row)
-            self._arrival_wall = time.time()
-            self._arrival_ts = int(ts_rows.max())
+            # in wall time (one clock read per frame, not per row);
+            # one tuple rebind — the unlocked reader sees a coherent pair
+            self._arrival = (time.time(), int(ts_rows.max()))
         applied = int(valid.sum())
         if applied:
             if self.horizon == 0:
@@ -694,10 +702,14 @@ class BinaryBatchSource:
     def last_arrival_lag_s(self) -> float | None:
         """Wire-transit lag of the freshest DATA frame (arrival wall
         clock minus its newest row's source ts, clamped >= 0); None
-        before any data arrived."""
-        if self._arrival_wall is None:
+        before any data arrived. Lock-free: the (wall, ts) pair is one
+        atomically-rebound tuple, so a concurrent handler write can at
+        worst make this one frame stale, never mismatched."""
+        pair = self._arrival
+        if pair is None:
             return None
-        return max(0.0, self._arrival_wall - self._arrival_ts)
+        wall, ts = pair
+        return max(0.0, wall - ts)
 
     @property
     def last_release_hold_s(self) -> float | None:

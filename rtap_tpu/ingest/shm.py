@@ -81,8 +81,10 @@ class ShmRing:
             from multiprocessing import resource_tracker
 
             resource_tracker.unregister(shm._name, "shared_memory")
-        except Exception:
-            pass  # tracker API moved (3.13+ track=False) or absent
+        except Exception:  # rtap: allow[except-silent] — tracker API
+            # moved (3.13+ track=False) or absent; unregister is a
+            # CPython-version workaround, never load-bearing
+            pass
         return cls(shm, owner=False)
 
     @property
@@ -94,8 +96,8 @@ class ShmRing:
             self._shm.close()
             if self._owner:
                 self._shm.unlink()
-        except (OSError, FileNotFoundError):
-            pass
+        except (OSError, FileNotFoundError):  # rtap: allow[except-silent]
+            pass  # teardown of an already-vanished ring (peer unlinked)
 
     # ---- cursors -----------------------------------------------------
     def _head(self) -> int:

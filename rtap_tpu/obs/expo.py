@@ -378,7 +378,8 @@ class ExpositionServer:
         self._server.healthz_stale_after_s = float(healthz_stale_after_s)
         self.address = self._server.server_address  # (host, bound port)
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True)
+            target=self._server.serve_forever,
+            name="rtap-obs-http", daemon=True)
 
     def start(self) -> "ExpositionServer":
         self._thread.start()

@@ -205,6 +205,11 @@ class Lease:
         self._probe_interval = min(0.2, self.timeout_s / 4.0)
         self._last_probe = 0.0
         self._lock = threading.Lock()
+        # the seen-epoch floor gets its OWN lock: read() runs both
+        # inside self._lock (refresh/still_mine) and without it
+        # (is_stale, holder — the follower's stale probe), so reusing
+        # self._lock here would deadlock the locked callers
+        self._seen_lock = threading.Lock()
         self._hb_stop: threading.Event | None = None
         self._hb_thread: threading.Thread | None = None
 
@@ -214,10 +219,19 @@ class Lease:
         except (OSError, ValueError):
             return None
         try:
-            self._seen_epoch = max(self._seen_epoch,
-                                   int(cur.get("epoch", 0)))
+            seen = int(cur.get("epoch", 0))
         except (TypeError, ValueError):
-            pass
+            # a malformed epoch field cannot advance the floor; the
+            # entry itself still serves the caller's staleness logic
+            return cur
+        # the floor update is a read-modify-write shared between the
+        # heartbeat thread (refresh -> read) and unlocked main-side
+        # probes (is_stale/holder): unguarded, an interleaving could
+        # REGRESS the floor (T2 loads the old floor, T1 stores a higher
+        # one, T2 stores the stale max) — and a regressed floor at
+        # promotion re-inverts the fence the floor exists to prevent
+        with self._seen_lock:
+            self._seen_epoch = max(self._seen_epoch, seen)
         return cur
 
     def _stale(self, cur: dict) -> bool:
@@ -276,8 +290,8 @@ class Lease:
                 return False
             try:
                 self._write()
-            except OSError:
-                # an unwritable lease is an infrastructure fault, not a
+            except OSError:  # rtap: allow[except-silent] — an
+                # unwritable lease is an infrastructure fault, not a
                 # fence; keep serving (the standby will promote on
                 # staleness and THEN we fence — the safe order)
                 pass
@@ -305,7 +319,7 @@ class Lease:
                     return
 
         self._hb_thread = threading.Thread(
-            target=_beat, name="lease-heartbeat", daemon=True)
+            target=_beat, name="rtap-replicate-heartbeat", daemon=True)
         self._hb_thread.start()
         return self
 
@@ -439,7 +453,7 @@ class ReplicationSender:
     # ---- lifecycle ----------------------------------------------------
     def start(self) -> "ReplicationSender":
         self._thread = threading.Thread(
-            target=self._run, name="repl-sender", daemon=True)
+            target=self._run, name="rtap-replicate-sender", daemon=True)
         self._thread.start()
         return self
 
@@ -1072,8 +1086,10 @@ class StandbyFollower:
                         "suppressed": suppressed,
                     }) + "\n")
                     f.flush()
-            except OSError:
-                pass  # non-fatal sink discipline, like the live loop's
+            except OSError:  # rtap: allow[except-silent] —
+                # non-fatal sink discipline, like the live loop's:
+                # the splice is retried by the next resume scan
+                pass
             try:
                 sink_size = os.path.getsize(self.alert_path)
             except OSError:

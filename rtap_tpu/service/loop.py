@@ -966,7 +966,8 @@ def live_loop(
         from concurrent.futures import ThreadPoolExecutor
 
         eff_threads = min(dispatch_threads, len(groups))
-        pool = ThreadPoolExecutor(max_workers=eff_threads)
+        pool = ThreadPoolExecutor(max_workers=eff_threads,
+                                  thread_name_prefix="rtap-loop-dispatch")
 
     cur_tick = 0  # the loop's tick clock, read by the fault-capture paths
 

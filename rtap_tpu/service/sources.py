@@ -123,7 +123,7 @@ class HttpPollSource:
                     continue
                 try:
                     values[i] = np.float32(v)
-                except (TypeError, ValueError):
+                except (TypeError, ValueError):  # rtap: allow[except-silent]
                     # one unconvertible metric (a version string, say) is
                     # THAT stream's missing sample, not a poll failure —
                     # the rest of the vector must still fill
@@ -285,7 +285,13 @@ class TcpJsonlSource:
                             # the native-parity fuzz)
                             outer._py_records += 1
                     except Exception:
-                        outer._py_parse_errors += 1
+                        # under the lock like every other tally: handler
+                        # threads are one-per-connection, and an
+                        # unguarded += across N malformed producers
+                        # loses increments (read-modify-write race the
+                        # analyzer's race pass flags)
+                        with outer._lock:
+                            outer._py_parse_errors += 1
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -293,7 +299,9 @@ class TcpJsonlSource:
 
         self._server = Server((host, port), Handler)
         self.address = self._server.server_address  # (host, bound port)
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="rtap-sources-accept",
+                                        daemon=True)
 
     def start(self) -> "TcpJsonlSource":
         self._thread.start()

@@ -83,7 +83,8 @@ def init_backend_or_die(timeout_s: float = 120.0) -> None:
             )
             os._exit(INIT_WATCHDOG_EXIT)
 
-    t = threading.Thread(target=watchdog, daemon=True)
+    t = threading.Thread(target=watchdog, name="rtap-platform-watchdog",
+                         daemon=True)
     t.start()
     import jax
 

@@ -3,97 +3,23 @@
 # tests/unit/test_static_checks.py):
 #
 #  1. compileall — every rtap_tpu module AND every scripts/ entry point
-#     (profiler harness included) must at least parse/compile; an
-#     import-time SyntaxError must fail CI even if no test imports the file.
-#  2. print-gate — AST-based (a line grep cannot see a multi-line call):
-#     - rtap_tpu/service/, rtap_tpu/obs/, rtap_tpu/resilience/,
-#       rtap_tpu/ingest/, rtap_tpu/correlate/: NO print()
-#       at all. Telemetry and diagnostics go through rtap_tpu.obs (registry
-#       instruments, watchdog events, snapshots) or logging, never ad-hoc
-#       stdout lines the harness would have to scrape back out of logs.
-#     - everywhere else in rtap_tpu/, scripts/, bench.py: print() must
-#       either target an explicit stream (file=...) or be the sanctioned
-#       one-JSON-line stdout emission (a single json.dumps(...)/.to_json()
-#       argument — the bench/eval artifact contract). Anything else is a
-#       bare print and fails.
+#     must at least parse/compile; an import-time SyntaxError must fail
+#     CI even if no test imports the file.
+#  2. rtap-lint (python -m rtap_tpu.analysis) — the AST invariant
+#     analyzer (ISSUE 12, docs/ANALYSIS.md): the print gate and
+#     MUST_BE_STRICT coverage pin live there now, alongside the race,
+#     purity, exception-discipline, and flag↔docs passes. Exit 0 iff
+#     zero unsuppressed findings against the committed
+#     analysis_baseline.json.
+#
+# This script is deliberately a thin wrapper: the checking logic has ONE
+# home (rtap_tpu/analysis/), testable as a library, with a --json
+# artifact surface for soaks (`python -m rtap_tpu.analysis --json`).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python -m compileall -q rtap_tpu scripts bench.py
 
-python - <<'PYEOF'
-import ast
-import os
-import sys
-
-STRICT_DIRS = (
-    os.path.join("rtap_tpu", "service"),
-    os.path.join("rtap_tpu", "obs"),
-    os.path.join("rtap_tpu", "resilience"),
-    os.path.join("rtap_tpu", "ingest"),
-    os.path.join("rtap_tpu", "correlate"),
-)
-
-
-def allowed_outside_strict(call: ast.Call) -> bool:
-    for kw in call.keywords:
-        if kw.arg == "file":
-            return True  # explicit stream: stderr diagnostics
-    if len(call.args) == 1 and isinstance(call.args[0], ast.Call):
-        f = call.args[0].func
-        if isinstance(f, ast.Attribute) and f.attr in ("dumps", "to_json"):
-            return True  # the one-JSON-line stdout artifact contract
-    return False
-
-
-targets = []
-for root in ("rtap_tpu", "scripts"):
-    for dp, _dirs, fns in os.walk(root):
-        if "__pycache__" in dp:
-            continue
-        targets += [os.path.join(dp, f) for f in fns if f.endswith(".py")]
-targets.append("bench.py")
-
-# coverage pin (ISSUE 11 satellite): the serve-path instrumentation
-# modules MUST sit under a strict dir — a rename/move that silently
-# dropped them out of no-print coverage would let stdout lines creep
-# back into the hot path. Extend this list with every new module.
-MUST_BE_STRICT = (
-    os.path.join("rtap_tpu", "obs", "latency.py"),
-    os.path.join("rtap_tpu", "obs", "slo.py"),
-    os.path.join("rtap_tpu", "obs", "metrics.py"),
-    os.path.join("rtap_tpu", "service", "loop.py"),
-)
-for p in MUST_BE_STRICT:
-    if not os.path.isfile(p):
-        print(f"check_static: expected strict module missing: {p}",
-              file=sys.stderr)
-        sys.exit(1)
-    if not any(p.startswith(d + os.sep) for d in STRICT_DIRS):
-        print(f"check_static: {p} fell out of strict no-print coverage",
-              file=sys.stderr)
-        sys.exit(1)
-
-bad = []
-for path in sorted(targets):
-    with open(path) as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    strict = any(path.startswith(d + os.sep) for d in STRICT_DIRS)
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            continue
-        if strict:
-            bad.append(f"{path}:{node.lineno}: print() in the serve stack — "
-                       "emit through rtap_tpu.obs (or logging) instead")
-        elif not allowed_outside_strict(node):
-            bad.append(f"{path}:{node.lineno}: bare print() — route to "
-                       "stderr (file=) or emit a JSON artifact line")
-
-if bad:
-    print("\n".join(bad), file=sys.stderr)
-    sys.exit(1)
-PYEOF
+python -m rtap_tpu.analysis
 
 echo "check_static: OK"

@@ -1,0 +1,416 @@
+"""rtap-lint (rtap_tpu/analysis, ISSUE 12): per-pass fixture coverage.
+
+Every pass gets a positive (deliberately-bad snippet fails), a negative
+(idiomatic-good snippet passes), and a suppressed fixture (the inline
+``# rtap: allow[rule]`` comment silences exactly that rule) — mirroring
+the print-gate canary discipline of test_static_checks.py, but at the
+library layer (in-memory SourceFiles, no subprocess) so the whole file
+stays fast. Baseline mechanics (match / why-less entry / stale entry)
+are covered here too; the end-to-end gate (real repo, real baseline,
+wall budget, --json artifact) lives in test_static_checks.py.
+"""
+
+import pytest
+
+from rtap_tpu.analysis import run_analysis
+from rtap_tpu.analysis.core import (
+    AnalysisContext,
+    Baseline,
+    Finding,
+    SourceFile,
+)
+
+pytestmark = pytest.mark.quick
+
+
+def lint(path, code, rules=None, docs="", extra=(), baseline=None):
+    """Run the analyzer over in-memory fixtures, filtered to `rules`
+    (None = a full run, as the gate does it)."""
+    files = [SourceFile(path, code)]
+    files += [SourceFile(p, c) for p, c in extra]
+    ctx = AnalysisContext(root="/__fixture__", files=files, docs_text=docs)
+    return run_analysis("/__fixture__", baseline=baseline or Baseline([]),
+                        rules=set(rules) if rules is not None else None,
+                        ctx=ctx)
+
+
+#: stubs for the MUST_BE_STRICT pin so full (rules=None) fixture runs
+#: don't trip strict-coverage on the synthetic context
+PIN_STUBS = tuple((p, "x = 1\n") for p in (
+    "rtap_tpu/obs/latency.py", "rtap_tpu/obs/slo.py",
+    "rtap_tpu/obs/metrics.py", "rtap_tpu/service/loop.py"))
+
+
+def rules_of(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# ---------------------------------------------------------------- races --
+RACY = """
+import threading
+
+class Racy:
+    def __init__(self):
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._run, name="rtap-t", daemon=True).start()
+
+    def _run(self):
+        self.n += 1
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+"""
+
+GUARDED = RACY.replace(
+    "    def _run(self):\n        self.n += 1\n",
+    "    def _run(self):\n        with self._lock:\n            self.n += 1\n")
+
+
+def test_race_positive_and_symbol():
+    r = lint("rtap_tpu/obs/_fx.py", RACY, ["race"])
+    assert [f.symbol for f in r.findings] == ["Racy.n"]
+    assert not r.ok
+
+
+def test_race_negative_when_both_sides_guarded():
+    r = lint("rtap_tpu/obs/_fx.py", GUARDED, ["race"])
+    assert r.findings == [] and r.ok
+
+
+def test_race_out_of_scope_dir_ignored():
+    # models/ is not serve stack — the pass only covers the strict dirs
+    r = lint("rtap_tpu/models/_fx.py", RACY, ["race"])
+    assert r.findings == []
+
+
+def test_race_suppression_comment():
+    code = RACY.replace(
+        "        self.n += 1\n\n    def bump",
+        "        self.n += 1  # rtap: allow[race] — test tolerance\n\n"
+        "    def bump")
+    r = lint("rtap_tpu/obs/_fx.py", code, ["race"])
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+def test_race_interprocedural_guard_inheritance():
+    """A private method whose EVERY call site (both sides) holds the
+    lock inherits the guard — the BinaryBatchSource._apply idiom."""
+    code = """
+import threading
+
+class C:
+    def __init__(self):
+        self.n = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._run, name="rtap-t").start()
+
+    def _run(self):
+        with self._lock:
+            self._bump()
+
+    def public(self):
+        with self._lock:
+            self._bump()
+
+    def _bump(self):
+        self.n += 1
+"""
+    r = lint("rtap_tpu/ingest/_fx.py", code, ["race"])
+    assert r.findings == []
+    # ... but one unlocked call path from either side breaks the
+    # inheritance (intersection over paths, not union)
+    leaky = code.replace(
+        "    def public(self):\n        with self._lock:\n"
+        "            self._bump()\n",
+        "    def public(self):\n        self._bump()\n")
+    r2 = lint("rtap_tpu/ingest/_fx.py", leaky, ["race"])
+    assert [f.symbol for f in r2.findings] == ["C.n"]
+
+
+def test_race_nested_thread_target_function():
+    """The Lease.start_heartbeat idiom: a nested function handed to
+    Thread(target=...) is thread-side code."""
+    code = """
+import threading
+
+class C:
+    def __init__(self):
+        self.state = 0
+        self._lock = threading.Lock()
+
+    def go(self):
+        def _beat():
+            self.state = 1
+        threading.Thread(target=_beat, name="rtap-t").start()
+
+    def poke(self):
+        self.state = 2
+"""
+    r = lint("rtap_tpu/resilience/_fx.py", code, ["race"])
+    assert [f.symbol for f in r.findings] == ["C.state"]
+
+
+def test_race_request_handler_self_concurrency():
+    """A nested RequestHandler class runs one thread PER CONNECTION:
+    an unguarded write to an outer attr races with ITSELF — the
+    TcpJsonlSource._py_parse_errors lost-update class."""
+    code = """
+import socketserver
+import threading
+
+class Src:
+    def __init__(self):
+        self.errors = 0
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                outer.errors += 1
+"""
+    r = lint("rtap_tpu/service/_fx.py", code, ["race"])
+    assert [f.symbol for f in r.findings] == ["Src.errors"]
+    guarded = code.replace(
+        "                outer.errors += 1",
+        "                with outer._lock:\n"
+        "                    outer.errors += 1")
+    assert lint("rtap_tpu/service/_fx.py", guarded, ["race"]).findings == []
+
+
+def test_race_init_writes_are_construction_time():
+    """__init__ runs before any thread exists: a thread-side writer plus
+    only-__init__ main writes is single-writer, not a race."""
+    code = """
+import threading
+
+class C:
+    def __init__(self):
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._run, name="rtap-t").start()
+
+    def _run(self):
+        self.n += 1
+"""
+    r = lint("rtap_tpu/obs/_fx.py", code, ["race"])
+    assert r.findings == []
+
+
+def test_thread_name_rule():
+    anon = ("import threading\n"
+            "t = threading.Thread(target=print, daemon=True)\n")
+    r = lint("rtap_tpu/obs/_fx.py", anon, ["thread-name"])
+    assert rules_of(r) == ["thread-name"]
+    named = anon.replace("daemon=True", 'daemon=True, name="rtap-x-y"')
+    assert lint("rtap_tpu/obs/_fx.py", named, ["thread-name"]).findings == []
+    offform = anon.replace("daemon=True", 'daemon=True, name="worker"')
+    assert len(lint("rtap_tpu/obs/_fx.py", offform,
+                    ["thread-name"]).findings) == 1
+    # out of the serve stack: utils/ threads are not gated
+    assert lint("rtap_tpu/utils/_fx.py", anon, ["thread-name"]).findings == []
+
+
+# --------------------------------------------------------------- purity --
+def test_purity_nondet_in_ops():
+    code = "import time\n\ndef kernel(x):\n    return x + time.time()\n"
+    r = lint("rtap_tpu/ops/_fx.py", code, ["purity-nondet"])
+    assert rules_of(r) == ["purity-nondet"]
+    # the loop module may read the wall clock (it IS the pacer)...
+    assert lint("rtap_tpu/service/loop.py", code,
+                ["purity-nondet"]).findings == []
+    # ...but never mint randomness mid-path
+    rnd = "import random\n\ndef f():\n    return random.random()\n"
+    assert len(lint("rtap_tpu/service/loop.py", rnd,
+                    ["purity-nondet"]).findings) == 1
+    # keyed jax.random is deterministic and exempt everywhere
+    jr = "import jax\n\ndef f(k):\n    return jax.random.uniform(k)\n"
+    assert lint("rtap_tpu/ops/_fx.py", jr, ["purity-nondet"]).findings == []
+
+
+def test_purity_fetch_only_in_tracing_functions():
+    fetch = ("import numpy as np\nimport jax.numpy as jnp\n\n"
+             "def kernel(x):\n    y = jnp.sum(x)\n"
+             "    return np.asarray(y)\n")
+    r = lint("rtap_tpu/ops/_fx.py", fetch, ["purity-fetch"])
+    assert rules_of(r) == ["purity-fetch"]
+    item = ("import jax.numpy as jnp\n\n"
+            "def kernel(x):\n    return jnp.sum(x).item()\n")
+    assert len(lint("rtap_tpu/ops/_fx.py", item,
+                    ["purity-fetch"]).findings) == 1
+    # a pure-numpy host twin is out of the rule by construction
+    twin = ("import numpy as np\n\n"
+            "def host_twin(x):\n    return np.asarray(x).sum()\n")
+    assert lint("rtap_tpu/ops/_fx.py", twin, ["purity-fetch"]).findings == []
+
+
+def test_purity_isfinite_presence_contract():
+    code = ("import numpy as np\n\n"
+            "def merge(vec):\n    return vec[np.isfinite(vec)]\n")
+    r = lint("rtap_tpu/ingest/_fx.py", code, ["purity-isfinite"])
+    assert rules_of(r) == ["purity-isfinite"]
+    # model-layer encoders keep their deliberate isfinite semantics
+    assert lint("rtap_tpu/ops/_fx.py", code,
+                ["purity-isfinite"]).findings == []
+    ok = code.replace("np.isfinite(vec)", "~np.isnan(vec)")
+    assert lint("rtap_tpu/ingest/_fx.py", ok,
+                ["purity-isfinite"]).findings == []
+    supp = code.replace(
+        "np.isfinite(vec)]",
+        "np.isfinite(vec)]  # rtap: allow[purity-isfinite] — fixture")
+    r3 = lint("rtap_tpu/ingest/_fx.py", supp, ["purity-isfinite"])
+    assert r3.findings == [] and len(r3.suppressed) == 1
+
+
+# -------------------------------------------------------------- excepts --
+def test_except_silent_positive_negative_suppressed():
+    bad = ("def f(path):\n    try:\n        load(path)\n"
+           "    except Exception:\n        pass\n")
+    r = lint("rtap_tpu/service/_fx.py", bad, ["except-silent"])
+    assert rules_of(r) == ["except-silent"]
+    assert "f:except Exception" in r.findings[0].symbol
+    # binding an outcome is handling
+    ok = bad.replace("        pass\n", "        result = None\n")
+    assert lint("rtap_tpu/service/_fx.py", ok,
+                ["except-silent"]).findings == []
+    # the cleanup carve-out: single teardown call + OSError family
+    cleanup = ("def f(sock):\n    try:\n        sock.close()\n"
+               "    except OSError:\n        pass\n")
+    assert lint("rtap_tpu/service/_fx.py", cleanup,
+                ["except-silent"]).findings == []
+    # ... but a broad catch does NOT get the carve-out
+    broad = cleanup.replace("except OSError", "except Exception")
+    assert len(lint("rtap_tpu/service/_fx.py", broad,
+                    ["except-silent"]).findings) == 1
+    supp = bad.replace("    except Exception:",
+                       "    except Exception:  # rtap: allow[except-silent]")
+    r2 = lint("rtap_tpu/service/_fx.py", supp, ["except-silent"])
+    assert r2.findings == [] and len(r2.suppressed) == 1
+    # out of the serve stack: no rule
+    assert lint("rtap_tpu/models/_fx.py", bad,
+                ["except-silent"]).findings == []
+
+
+# ---------------------------------------------------------------- flags --
+_MAIN_FIXTURE = """
+import argparse
+
+def build():
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers()
+    p = sub.add_parser("serve")
+    p.add_argument("--documented-flag")
+    p.add_argument("--ghost-flag")
+    p = sub.add_parser("replay")
+    p.add_argument("--replay-only-flag")
+"""
+
+
+def test_flag_docs_drift():
+    r = lint("rtap_tpu/__main__.py", _MAIN_FIXTURE, ["flag-docs"],
+             docs="serve takes `--documented-flag` (see runbook)")
+    assert [f.symbol for f in r.findings] == ["--ghost-flag"]
+    # flags of OTHER subcommands are out of this gate's scope
+    assert all("--replay-only-flag" != f.symbol for f in r.findings)
+    r2 = lint("rtap_tpu/__main__.py", _MAIN_FIXTURE, ["flag-docs"],
+              docs="`--documented-flag` and `--ghost-flag`")
+    assert r2.findings == []
+
+
+def test_flag_docs_prefix_is_not_documentation():
+    """Word-boundary matching: a documented `--ghost-flag-extra` must
+    NOT satisfy the gate for an undocumented `--ghost-flag` (the serve
+    surface has ~11 such prefix pairs — the masking this gate exists
+    to catch)."""
+    r = lint("rtap_tpu/__main__.py", _MAIN_FIXTURE, ["flag-docs"],
+             docs="`--documented-flag`; also `--ghost-flag-extra` exists")
+    assert [f.symbol for f in r.findings] == ["--ghost-flag"]
+
+
+# --------------------------------------------------------------- prints --
+def test_print_rules_and_non_suppressibility():
+    strict = 'import sys\nprint("x", file=sys.stderr)\n'
+    r = lint("rtap_tpu/service/_fx.py", strict, ["print-strict"])
+    assert rules_of(r) == ["print-strict"]
+    # an allow comment must NOT silence the print gate (guard the guard)
+    supp = strict.replace(")\n", ")  # rtap: allow[print-strict]\n")
+    r2 = lint("rtap_tpu/service/_fx.py", supp, ["print-strict"])
+    assert rules_of(r2) == ["print-strict"]
+    # outside the serve stack: file= and single-json.dumps are legal,
+    # bare stdout is not
+    outside = ('import json, sys\nprint("d", file=sys.stderr)\n'
+               'print(json.dumps({"a": 1}))\nprint("bare")\n')
+    r3 = lint("rtap_tpu/eval/_fx.py", outside, ["print-bare"])
+    assert len(r3.findings) == 1 and r3.findings[0].line == 4
+
+
+def test_strict_coverage_pin():
+    # a context missing the pinned modules reports each as out of
+    # coverage — the rename/move tripwire
+    r = lint("rtap_tpu/eval/_fx.py", "x = 1\n", ["strict-coverage"])
+    assert len(r.findings) == 4
+    assert all(f.rule == "strict-coverage" for f in r.findings)
+
+
+# ------------------------------------------------------------- baseline --
+def test_baseline_match_whyless_and_stale():
+    bad = ("def f(p):\n    try:\n        load(p)\n"
+           "    except Exception:\n        pass\n")
+    ent = {"rule": "except-silent", "path": "rtap_tpu/service/_fx.py",
+           "symbol": "f:except Exception", "why": "fixture legacy"}
+    r = lint("rtap_tpu/service/_fx.py", bad, ["except-silent"],
+             baseline=Baseline([ent]))
+    assert r.ok and len(r.baselined) == 1 and r.stale_baseline == []
+    # a why-less entry is itself a gate failure
+    whyless = {k: v for k, v in ent.items() if k != "why"}
+    r2 = lint("rtap_tpu/service/_fx.py", bad, ["except-silent"],
+              baseline=Baseline([whyless]))
+    assert not r2.ok and r2.baseline_errors
+    # the finding the why-less entry failed to cover is a real finding
+    assert len(r2.findings) == 1
+
+
+def test_baseline_stale_entry_is_nonfatal():
+    # staleness is only judged on a FULL run (rules=None), so the
+    # fixture context carries the strict-pin stubs
+    bad = ("def f(p):\n    try:\n        load(p)\n"
+           "    except Exception:\n        pass\n")
+    ent = {"rule": "except-silent", "path": "rtap_tpu/service/_fx.py",
+           "symbol": "f:except Exception", "why": "fixture legacy"}
+    stale = dict(ent, symbol="gone:except OSError")
+    r = lint("rtap_tpu/service/_fx.py", bad, extra=PIN_STUBS,
+             baseline=Baseline([ent, stale]))
+    assert r.ok and len(r.stale_baseline) == 1
+    assert r.stale_baseline[0]["symbol"] == "gone:except OSError"
+
+
+def test_rules_subset_never_reports_stale_baseline():
+    """A --rules subset run skips the baseline for unselected rules, so
+    their (valid) entries must NOT be advised stale — only a full run
+    can judge staleness."""
+    bad = ("def f(p):\n    try:\n        load(p)\n"
+           "    except Exception:\n        pass\n")
+    ent = {"rule": "except-silent", "path": "rtap_tpu/service/_fx.py",
+           "symbol": "f:except Exception", "why": "fixture legacy"}
+    r = lint("rtap_tpu/service/_fx.py", bad, ["race"],
+             baseline=Baseline([ent]))
+    assert r.ok and r.stale_baseline == []
+
+
+def test_finding_json_shape():
+    f = Finding(rule="race", path="a.py", line=3, symbol="C.x",
+                message="m")
+    d = f.to_dict()
+    assert d == {"rule": "race", "path": "a.py", "line": 3,
+                 "symbol": "C.x", "message": "m"}
+
+
+def test_parse_error_is_a_finding():
+    r = lint("rtap_tpu/service/_fx.py", "def broken(:\n", ["parse-error"])
+    assert rules_of(r) == ["parse-error"]

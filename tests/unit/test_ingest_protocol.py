@@ -535,3 +535,56 @@ def test_announce_leader_repoints_producers():
             assert conn.epoch == e0 + 1
     finally:
         src.close()
+
+
+def test_arrival_lag_pair_is_one_atomic_tuple():
+    """rtap-lint race-audit fix (ISSUE 12, docs/ANALYSIS.md): the
+    latency tracker probes ``last_arrival_lag_s`` from the loop thread
+    WITHOUT the source lock while handler threads record arrivals. As
+    two separate attributes the (wall, ts) pair could tear — a fresh
+    wall clock against a stale row ts reports a lag the wire never had —
+    so the pair lives in ONE tuple rebound atomically; the property
+    computes from a single snapshot."""
+    import threading
+    import time as _time
+
+    reg = _reg(n=4, group_size=4)
+    src = BinaryBatchSource(reg.slot_map(), port=None)
+    codes = src._table.codes
+    assert src.last_arrival_lag_s is None  # no data yet
+    now = int(_time.time())
+    src.feed_frames([data_frame(codes[:1], [1.0], now - 3)])
+    lag = src.last_arrival_lag_s
+    assert lag is not None and 2.0 <= lag < 60.0
+    # a future-stamped producer clamps at 0, never goes negative
+    src.feed_frames([data_frame(codes[:1], [2.0], now + 3600)])
+    assert src.last_arrival_lag_s == 0.0
+    # the surface stays a coherent snapshot under concurrent feeders:
+    # every observed lag must be explainable by ONE frame's pair
+    # (~0 for the future-stamped feeder, ~600 for the lagged one) —
+    # a torn wall/ts mix would land far outside both bands
+    stop = threading.Event()
+    errs = []
+
+    def feed(offset):
+        while not stop.is_set():
+            src.feed_frames([data_frame(
+                codes[:1], [1.0], int(_time.time()) + offset)])
+
+    threads = [threading.Thread(target=feed, args=(off,),
+                                name=f"rtap-test-feed{off}")
+               for off in (-600, 3600)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(2000):
+            lag = src.last_arrival_lag_s
+            ok = lag == 0.0 or 590.0 <= lag <= 610.0
+            if not ok:
+                errs.append(lag)
+                break
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    assert not errs, f"torn arrival pair produced impossible lag: {errs}"

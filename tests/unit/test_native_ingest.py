@@ -398,3 +398,42 @@ def test_native_set_ids_swaps_table_mid_connection():
         assert values[1] == np.float32(7.0)   # a: carried over BY ID
     finally:
         src.close()
+
+
+def test_python_fallback_parse_error_count_is_exact_under_concurrency():
+    """rtap-lint race-pass fix (ISSUE 12): the Python fallback handler
+    bumped ``_py_parse_errors`` OUTSIDE the chunk lock — one
+    read-modify-write per malformed line across N concurrent producer
+    threads loses increments (the classic += lost update; every other
+    tally already sat under the lock). The fix moves the bump under
+    the lock; this pins the count exact across concurrent garbage
+    producers on the fallback path."""
+    import sys
+    import threading
+
+    src = TcpJsonlSource(["a", "b"], native=False)
+    n_threads, n_bad = 6, 250
+
+    def produce():
+        with socket.create_connection(src.address, timeout=5.0) as s:
+            payload = b"".join(b"not json at all\n" for _ in range(n_bad))
+            s.sendall(payload)
+
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # widen the lost-update window
+    try:
+        with src:
+            threads = [threading.Thread(target=produce)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            deadline = time.time() + 10
+            want = n_threads * n_bad
+            while time.time() < deadline and src.parse_errors < want:
+                time.sleep(0.02)
+            assert src.parse_errors == want
+            assert src.records_parsed == 0
+    finally:
+        sys.setswitchinterval(old_interval)

@@ -472,6 +472,12 @@ def test_live_loop_breaks_and_skips_final_save_when_fenced(tmp_path):
             cur["owner"] = "usurper"
             cur["ts"] = time.time()
             lease_path.write_text(json.dumps(cur))
+            # expire the still_mine() probe cache: at cadence 0 on a
+            # fast host the remaining ticks can all land inside the
+            # min(0.2, timeout/4) s cache window and the run finishes
+            # un-fenced (observed-flake class, reproduced at HEAD) —
+            # the test pins the FENCE logic, not the cache cadence
+            mine._last_probe = -1e9
         return _row(7, k, 4)
 
     stats = live_loop(source, reg, n_ticks=20, cadence_s=0.0,
@@ -493,3 +499,50 @@ def test_serve_cli_has_replication_flags():
     for flag in ("--replicate-to", "--standby", "--replicate-listen",
                  "--lease-file", "--lease-timeout"):
         assert flag in src
+
+
+def test_lease_seen_epoch_floor_is_race_safe(tmp_path):
+    """rtap-lint race-pass fix (ISSUE 12): read() updates the seen-epoch
+    floor from BOTH the heartbeat thread (under self._lock) and unlocked
+    main-side probes (is_stale/holder). Unguarded, the read-modify-write
+    max() could REGRESS the floor (T2 loads the old floor, T1 stores a
+    higher one, T2 stores its stale max) — and a regressed floor at a
+    promotion whose lease read fails restarts epochs low and re-inverts
+    the fence. The fix serializes the update under a dedicated lock;
+    this hammer pins the floor's monotonicity under contention."""
+    import sys
+
+    path = tmp_path / "lease"
+    lease = Lease(path, "B", timeout_s=5.0)
+    stop = threading.Event()
+    regressions = []
+
+    def probe():
+        last = 0
+        while not stop.is_set():
+            lease.read()
+            cur = lease._seen_epoch
+            if cur < last:
+                regressions.append((last, cur))
+                return
+            last = cur
+
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # widen the interleaving window
+    try:
+        threads = [threading.Thread(target=probe, name=f"rtap-test-{i}")
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for epoch in range(1, 300):
+            path.write_text(json.dumps(
+                {"epoch": epoch, "owner": "A", "ts": time.time()}))
+            lease.read()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    finally:
+        sys.setswitchinterval(old_interval)
+    assert not regressions, (
+        f"seen-epoch floor regressed under concurrent reads: {regressions}")
+    assert lease._seen_epoch == 299
