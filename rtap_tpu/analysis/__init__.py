@@ -1,10 +1,13 @@
-"""rtap-lint: AST-based invariant analysis for the serve stack (ISSUE 12).
+"""rtap-lint: AST-based invariant analysis for the serve stack
+(ISSUEs 12 + 13).
 
 The repo's correctness story rests on contracts no test fully covers —
 bit-exact device/oracle twins, exactly-once alert delivery, and a lock
 discipline across ~10 daemon-threaded modules. Three review passes
 found the same latent-bug classes by hand; this package machine-checks
-them:
+them. v1 (ISSUE 12) was per-class/intra-module; v2 (ISSUE 13) adds
+whole-program passes over the shared model in
+``rtap_tpu/analysis/program.py``:
 
 ==================  ====================================================
 pass (module)       rules
@@ -22,18 +25,45 @@ flags               ``flag-docs`` (serve flags absent from README/docs —
 prints              ``print-strict``, ``print-bare``,
                     ``strict-coverage`` (the check_static.sh gate,
                     ported; non-suppressible)
+lockorder           ``lock-order`` (cycles in the global
+                    lock-acquisition graph — static deadlock detection,
+                    interprocedural across classes and modules)
+crossshare          ``cross-share`` (objects handed to both a
+                    thread-running class and another consumer, mutated
+                    in place on one side and read on the other —
+                    the retired docs/ANALYSIS.md hand-audit list)
+determinism         ``replay-determinism`` (unsorted set/listdir
+                    iteration or float reductions feeding
+                    serialization/hashing paths)
+lifecycle           ``resource-lifecycle`` (class-owned threads/sockets/
+                    shm/files with no reachable bounded-join/close on
+                    the teardown path)
 ==================  ====================================================
 
 CLI: ``python -m rtap_tpu.analysis`` (human report, exit 0 iff zero
-unsuppressed findings; ``--json`` emits one artifact line for soaks).
-``scripts/check_static.sh`` is a thin wrapper (compileall + one analyzer
-invocation) and rides tier-1 via tests/unit/test_static_checks.py.
-Suppression/baseline syntax and the triage runbook: docs/ANALYSIS.md.
+unsuppressed findings; ``--json`` emits one artifact line for soaks,
+``--sarif PATH`` writes a SARIF 2.1.0 log for CI/editor rendering).
+Incremental runs are served from a per-file content-hash findings cache
+(``--no-cache`` forces a cold run; cached and cold runs are
+finding-identical by test). ``scripts/check_static.sh`` is a thin
+wrapper (compileall + one analyzer invocation) and rides tier-1 via
+tests/unit/test_static_checks.py. Suppression/baseline syntax and the
+triage runbook: docs/ANALYSIS.md.
 """
 
 from __future__ import annotations
 
-from rtap_tpu.analysis import excepts, flags, prints, purity, races
+from rtap_tpu.analysis import (
+    crossshare,
+    determinism,
+    excepts,
+    flags,
+    lifecycle,
+    lockorder,
+    prints,
+    purity,
+    races,
+)
 from rtap_tpu.analysis.core import (  # noqa: F401
     AnalysisContext,
     Baseline,
@@ -43,9 +73,11 @@ from rtap_tpu.analysis.core import (  # noqa: F401
     run_analysis,
 )
 
-#: execution order: cheap syntactic passes first, the interprocedural
-#: race pass last (ordering is cosmetic — every pass always runs)
-PASSES = (prints, excepts, flags, purity, races)
+#: execution order: cheap syntactic passes first, then the
+#: interprocedural per-class pass, then the whole-program v2 passes
+#: (ordering is cosmetic — every pass always runs)
+PASSES = (prints, excepts, flags, purity, races,
+          determinism, lifecycle, lockorder, crossshare)
 
 #: rule id -> description, across every pass (the CLI's --list-passes)
 ALL_RULES = {rid: desc for mod in PASSES for rid, desc in mod.RULES.items()}

@@ -1,10 +1,19 @@
-"""CLI: ``python -m rtap_tpu.analysis [--json] [--rules ...]``.
+"""CLI: ``python -m rtap_tpu.analysis [--json] [--sarif PATH]
+[--rules ...] [--no-cache]``.
 
 Exit codes: 0 = zero unsuppressed findings (the gate), 1 = findings or
 baseline format errors, 2 = usage error. The human report goes to
 stderr; ``--json`` prints exactly one JSON artifact line to stdout (the
 soak/hw_session archival surface — same one-JSON-line stdout contract
-as bench.py), so both can be combined in one invocation.
+as bench.py), so both can be combined in one invocation. ``--sarif``
+writes a SARIF 2.1.0 log to a FILE (never stdout — the one-line
+contract stays intact) for CI/editor rendering.
+
+Full runs are served from the per-file content-hash findings cache
+(``<root>/.rtap_lint_cache.json``, gitignored): any file edit, add,
+delete, docs change, baseline change, or analyzer change re-runs cold;
+an untouched tree replays the identical report sub-second. ``--rules``
+subsets bypass the cache entirely, ``--no-cache`` forces a cold run.
 """
 
 from __future__ import annotations
@@ -20,6 +29,7 @@ from rtap_tpu.analysis.core import (
     Baseline,
     render_human,
     run_analysis,
+    run_analysis_cached,
 )
 
 
@@ -46,7 +56,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: <root>/{BASELINE_NAME})")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated rule ids to run (default: all)")
+                    help="comma-separated rule ids to run (default: all; "
+                         "subsets bypass the findings cache)")
+    ap.add_argument("--sarif", default=None, metavar="PATH",
+                    help="also write a SARIF 2.1.0 log to PATH (CI/"
+                         "editor rendering; stdout keeps the one-line "
+                         "--json contract)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the findings cache "
+                         "(forces a cold run)")
+    ap.add_argument("--cache-path", default=None, metavar="PATH",
+                    help="findings cache location (default: "
+                         "<root>/.rtap_lint_cache.json)")
     ap.add_argument("--list-passes", action="store_true",
                     help="list rule ids + descriptions and exit")
     args = ap.parse_args(argv)
@@ -69,12 +90,22 @@ def main(argv: list[str] | None = None) -> int:
             print(f"rtap-lint: unknown rule(s): {sorted(unknown)} "
                   f"(known: {sorted(ALL_RULES)})", file=sys.stderr)
             return 2
-    baseline = Baseline.load(
-        args.baseline or os.path.join(root, BASELINE_NAME))
-    report = run_analysis(root, baseline=baseline, rules=rules)
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if rules is None and not args.no_cache:
+        report = run_analysis_cached(root, baseline_path=baseline_path,
+                                     cache_path=args.cache_path)
+    else:
+        report = run_analysis(root, baseline=Baseline.load(baseline_path),
+                              rules=rules)
     print(render_human(report), file=sys.stderr)
     if args.json:
         print(json.dumps(report.to_dict()))
+    if args.sarif:
+        from rtap_tpu.analysis.sarif import to_sarif
+
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(to_sarif(report), fh, indent=2)
+            fh.write("\n")
     return 0 if report.ok else 1
 
 

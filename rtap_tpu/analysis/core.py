@@ -32,6 +32,7 @@ them when you see them).
 from __future__ import annotations
 
 import ast
+import hashlib
 import io
 import json
 import os
@@ -56,6 +57,17 @@ _SUPPRESS_RE = re.compile(r"#\s*rtap:\s*allow\[([A-Za-z0-9_,\s-]+)\]")
 
 #: default baseline filename at the analysis root
 BASELINE_NAME = "analysis_baseline.json"
+
+#: the --json artifact's schema version (ISSUE 13). Bump on any shape
+#: change to the artifact dict — soaks/hw_session archive these lines
+#: across months and the reader must be able to dispatch on shape.
+SCHEMA_VERSION = 2
+
+#: default findings-cache filename at the analysis root (gitignored)
+CACHE_NAME = ".rtap_lint_cache.json"
+
+#: bump to orphan every existing cache when the cache format changes
+_CACHE_FORMAT = 1
 
 #: gate-critical rules that neither inline suppressions nor the baseline
 #: may silence — the print gate is plumbing other gates stand on, and a
@@ -152,21 +164,11 @@ class AnalysisContext:
         return None
 
     def docs(self) -> str:
+        # ONE loader shared with the cache key (_docs_text): the flags
+        # pass must analyze exactly the text the cache hashed, or a
+        # docs-only edit could be served a stale green hit
         if self.docs_text is None:
-            chunks = []
-            for name in ("README.md",):
-                p = os.path.join(self.root, name)
-                if os.path.isfile(p):
-                    with open(p, encoding="utf-8") as fh:
-                        chunks.append(fh.read())
-            docs_dir = os.path.join(self.root, "docs")
-            if os.path.isdir(docs_dir):
-                for fn in sorted(os.listdir(docs_dir)):
-                    if fn.endswith(".md"):
-                        with open(os.path.join(docs_dir, fn),
-                                  encoding="utf-8") as fh:
-                            chunks.append(fh.read())
-            self.docs_text = "\n".join(chunks)
+            self.docs_text = _docs_text(self.root)
         return self.docs_text
 
 
@@ -223,27 +225,39 @@ class Baseline:
                 if k not in self._used]
 
 
-def discover_files(root: str) -> list[SourceFile]:
-    """The analysis surface: every .py under rtap_tpu/ and scripts/,
-    plus bench.py — the same set the old check_static.sh walked, so the
-    print gate's coverage is unchanged by the port."""
-    out: list[SourceFile] = []
+def discover_texts(root: str) -> list[tuple[str, str]]:
+    """(repo-relative path, text) for the analysis surface: every .py
+    under rtap_tpu/ and scripts/, plus bench.py — the same set the old
+    check_static.sh walked, so the print gate's coverage is unchanged.
+    Split from parsing so the findings cache can judge freshness from
+    content hashes WITHOUT paying ~100 ast.parse calls on a hit."""
+    out: list[tuple[str, str]] = []
     for top in ("rtap_tpu", "scripts"):
         base = os.path.join(root, top)
         for dirpath, dirnames, filenames in os.walk(base):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            # sorted: os.walk's subdir order is filesystem-arbitrary,
+            # and the whole-program model's first-definition-wins (and
+            # finding/report order generally) must not vary across
+            # hosts — the analyzer holds itself to its own
+            # replay-determinism rule
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
             for fn in sorted(filenames):
                 if not fn.endswith(".py"):
                     continue
                 full = os.path.join(dirpath, fn)
                 rel = os.path.relpath(full, root)
                 with open(full, encoding="utf-8") as fh:
-                    out.append(SourceFile(rel, fh.read()))
+                    out.append((rel, fh.read()))
     bench = os.path.join(root, "bench.py")
     if os.path.isfile(bench):
         with open(bench, encoding="utf-8") as fh:
-            out.append(SourceFile("bench.py", fh.read()))
+            out.append(("bench.py", fh.read()))
     return out
+
+
+def discover_files(root: str) -> list[SourceFile]:
+    return [SourceFile(p, t) for p, t in discover_texts(root)]
 
 
 @dataclass
@@ -258,6 +272,10 @@ class Report:
     per_pass: dict = field(default_factory=dict)  # pass -> raw count
     elapsed_s: float = 0.0
     files_scanned: int = 0
+    #: "cold" (full run, cache written), "hit" (replayed from the
+    #: content-hash cache), "off" (cache not engaged: fixtures, --rules
+    #: subsets, --no-cache)
+    cache_mode: str = "off"
 
     @property
     def ok(self) -> bool:
@@ -267,9 +285,11 @@ class Report:
         """The --json artifact line (soaks/hw_session archive this)."""
         return {
             "analysis": {
+                "schema_version": SCHEMA_VERSION,
                 "ok": self.ok,
                 "files_scanned": self.files_scanned,
                 "elapsed_s": round(self.elapsed_s, 3),
+                "cache": self.cache_mode,
                 "findings": [f.to_dict() for f in self.findings],
                 "suppressed": len(self.suppressed),
                 "baselined": len(self.baselined),
@@ -278,6 +298,133 @@ class Report:
                 "per_pass": dict(sorted(self.per_pass.items())),
             }
         }
+
+
+# --------------------------------------------------------------- cache --
+# The per-file content-hash findings cache (ISSUE 13). Whole-program
+# passes (lock-order, cross-share) make per-file findings REUSE unsound
+# — one edited file can add or remove a deadlock edge whose finding
+# anchors in another file — so the cache replays the full classified
+# report if and only if EVERY input is byte-identical: the per-file
+# content hashes (any edit, add, or delete misses), the docs text
+# (flag-docs input), the baseline file, and the analyzer's own sources.
+# A hit skips all parsing and every pass: incremental runs are
+# sub-second while a cold run stays bit-identical (both pinned by
+# tests/unit/test_static_checks.py).
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:20]
+
+
+def _analyzer_fingerprint() -> str:
+    """Hash of the analysis package's own sources: editing a pass must
+    orphan the cache, or a tightened rule would silently not re-run."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for fn in sorted(os.listdir(here)):
+        if fn.endswith(".py"):
+            with open(os.path.join(here, fn), "rb") as fh:
+                h.update(fn.encode() + b"\0")
+                h.update(fh.read() + b"\0")
+    return h.hexdigest()[:20]
+
+
+def _docs_text(root: str) -> str:
+    chunks = []
+    p = os.path.join(root, "README.md")
+    if os.path.isfile(p):
+        with open(p, encoding="utf-8") as fh:
+            chunks.append(fh.read())
+    docs_dir = os.path.join(root, "docs")
+    if os.path.isdir(docs_dir):
+        for fn in sorted(os.listdir(docs_dir)):
+            if fn.endswith(".md"):
+                with open(os.path.join(docs_dir, fn),
+                          encoding="utf-8") as fh:
+                    chunks.append(fh.read())
+    return "\n".join(chunks)
+
+
+def _cache_key(texts: list[tuple[str, str]], docs: str,
+               baseline_path: str) -> dict:
+    try:
+        with open(baseline_path, encoding="utf-8") as fh:
+            baseline_hash = _sha(fh.read())
+    except OSError:
+        baseline_hash = "absent"
+    return {
+        "format": _CACHE_FORMAT,
+        "analyzer": _analyzer_fingerprint(),
+        "files": {p: _sha(t) for p, t in texts},
+        "docs": _sha(docs),
+        "baseline": baseline_hash,
+    }
+
+
+def _report_to_cache(report: Report) -> dict:
+    return {
+        "findings": [f.to_dict() for f in report.findings],
+        "suppressed": [f.to_dict() for f in report.suppressed],
+        "baselined": [f.to_dict() for f in report.baselined],
+        "stale_baseline": report.stale_baseline,
+        "baseline_errors": report.baseline_errors,
+        "per_pass": report.per_pass,
+        "files_scanned": report.files_scanned,
+    }
+
+
+def _report_from_cache(data: dict, elapsed_s: float) -> Report:
+    def fs(key):
+        return [Finding(**d) for d in data[key]]
+
+    return Report(
+        findings=fs("findings"), suppressed=fs("suppressed"),
+        baselined=fs("baselined"),
+        stale_baseline=data["stale_baseline"],
+        baseline_errors=data["baseline_errors"],
+        per_pass=data["per_pass"], elapsed_s=elapsed_s,
+        files_scanned=data["files_scanned"], cache_mode="hit")
+
+
+def run_analysis_cached(root: str, baseline_path: str | None = None,
+                        cache_path: str | None = None) -> Report:
+    """The CLI's full-run entry point: replay the findings cache when
+    every content hash matches, otherwise run cold and rewrite it.
+    ``--rules`` subsets and fixture contexts never come through here —
+    the cache only ever holds full-tree reports."""
+    t0 = time.perf_counter()
+    baseline_path = baseline_path or os.path.join(root, BASELINE_NAME)
+    cache_path = cache_path or os.path.join(root, CACHE_NAME)
+    texts = discover_texts(root)
+    docs = _docs_text(root)
+    key = _cache_key(texts, docs, baseline_path)
+    try:
+        with open(cache_path, encoding="utf-8") as fh:
+            cached = json.load(fh)
+    except (OSError, ValueError):
+        cached = None
+    if isinstance(cached, dict) and cached.get("key") == key:
+        return _report_from_cache(
+            cached["report"], time.perf_counter() - t0)
+    files = [SourceFile(p, t) for p, t in texts]
+    ctx = AnalysisContext(root=root, files=files, docs_text=docs)
+    report = run_analysis(root, baseline=Baseline.load(baseline_path),
+                          ctx=ctx)
+    report.cache_mode = "cold"
+    tmp = f"{cache_path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"key": key, "report": _report_to_cache(report)},
+                      fh)
+        os.replace(tmp, cache_path)
+    except OSError:
+        # an unwritable cache (read-only checkout) costs the NEXT run
+        # its speedup, never this run its correctness
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+    return report
 
 
 def run_analysis(root: str, files: list[SourceFile] | None = None,

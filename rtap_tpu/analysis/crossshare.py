@@ -1,0 +1,259 @@
+"""Cross-object sharing: objects handed to another thread's world.
+
+Rule ``cross-share`` — the races pass (PR 11) deliberately stopped at
+the class boundary: it sees a class race with ITS OWN thread, but not
+the ``live_loop``-plus-obs-HTTP pattern where one scope constructs an
+object (``health = HealthTracker(...)``) and hands it BOTH to a
+thread-running class (``ExpositionServer(health=health)`` — whose HTTP
+handler threads read it) and to another consumer (``live_loop(...,
+health=health)`` — the loop thread writes it). Those surfaces were
+"audited by hand" in docs/ANALYSIS.md; this pass automates the audit
+and retires the list.
+
+Detection, in two halves over the whole-program model
+(rtap_tpu/analysis/program.py):
+
+1. **Sharing** — a local bound to a known-class constructor that is
+   handed to two or more distinct consumers (constructor/function
+   calls, or direct method use by the constructing scope), at least one
+   of which is a thread-running class (spawns ``threading.Thread`` /
+   subclasses a ``Threading*`` server — its handler/background threads
+   will touch the object). Every such class is *cross-thread shared*.
+
+2. **Verdict per attribute** — inside a shared class, a ``self.*``
+   attribute that is MUTATED IN PLACE (``+=``, ``self.x[k] = v``,
+   ``.append``/``.update``/…) outside ``__init__`` on a write path that
+   does not hold a lock guard, while some OTHER method reads it, is
+   flagged. Atomic REBINDS (``self.x = fresh``) are exempt: rebinding a
+   fully-built dict/array is the serve stack's documented snapshot
+   idiom (readers see old-or-new, never torn) — exactly the line the
+   hand audits drew between HealthTracker's rebound scorecards (fine)
+   and the ``Lease.set_meta`` in-place insert (the PR 8 bug). Guard
+   inheritance is interprocedural within the class, same intersection
+   semantics as the races pass: a helper reached both with and without
+   the lock counts as unguarded.
+
+Deliberate tolerances (single-writer diagnostic counters read torn —
+the obs idiom) belong in ``analysis_baseline.json`` with a why; that is
+the hand-audit list's retirement home, not a reason to weaken the pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.program import build_program
+from rtap_tpu.analysis.races import (
+    GUARD_HINTS,
+    MUTATORS,
+    _inherited_guards,
+    _MethodInfo,
+    _Write,
+)
+
+PASS_NAME = "cross-share"
+RULES = {
+    "cross-share": "object shared between a thread-running class and "
+                   "another consumer has an attribute mutated in place "
+                   "without a guard while other methods read it",
+}
+
+#: where shared objects get WIRED (constructors + the CLI) — the scan
+#: scope for construction sites; the shared class itself may live
+#: anywhere under rtap_tpu/
+SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/__main__.py")
+
+
+class _AttrScan(ast.NodeVisitor):
+    """One method body: in-place mutations, reads, calls — with the
+    lexical lock-guard stack (the races-pass discipline, pointed at
+    reads as well as writes)."""
+
+    def __init__(self, self_name: str, method_names: set[str]):
+        self.self_name = self_name
+        self.method_names = method_names
+        self._guards: list[str] = []
+        self.info = _MethodInfo(name="")
+        self.reads: set[str] = set()
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        pass
+
+    def _guard_of(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == self.self_name \
+                and any(h in expr.attr.lower() for h in GUARD_HINTS):
+            return expr.attr
+        return None
+
+    def visit_With(self, node):  # noqa: N802
+        names = [g for g in (self._guard_of(it.context_expr)
+                             for it in node.items) if g is not None]
+        self._guards.extend(names)
+        for st in node.body:
+            self.visit(st)
+        if names:
+            del self._guards[-len(names):]
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == self.self_name:
+            return node.attr
+        return None
+
+    def _mutation(self, attr: str | None, line: int) -> None:
+        if attr is not None:
+            self.info.writes.append(
+                _Write(attr, line, frozenset(self._guards)))
+
+    def visit_AugAssign(self, node):  # noqa: N802
+        t = node.target
+        self._mutation(self._self_attr(t), node.lineno)
+        if isinstance(t, ast.Subscript):
+            self._mutation(self._self_attr(t.value), node.lineno)
+        self.visit(node.value)
+
+    def visit_Assign(self, node):  # noqa: N802
+        # ONLY subscript-stores are mutations; a plain rebind
+        # (self.x = fresh) is the atomic snapshot idiom and exempt
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._mutation(self._self_attr(t.value), node.lineno)
+        self.visit(node.value)
+
+    def visit_Delete(self, node):  # noqa: N802
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                self._mutation(self._self_attr(t.value), node.lineno)
+
+    def visit_Call(self, node):  # noqa: N802
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            attr = self._self_attr(f.value)
+            if attr is not None and f.attr in MUTATORS:
+                self._mutation(attr, node.lineno)
+            elif isinstance(f.value, ast.Name) \
+                    and f.value.id == self.self_name \
+                    and f.attr in self.method_names:
+                self.info.calls.append((f.attr, frozenset(self._guards)))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):  # noqa: N802
+        if isinstance(node.ctx, ast.Load):
+            attr = self._self_attr(node)
+            if attr is not None:
+                self.reads.add(attr)
+        self.generic_visit(node)
+
+
+def _shared_classes(prog, scope_paths):
+    """class name -> one representative construction site proving the
+    instance crosses a thread boundary."""
+    out: dict[str, tuple[str, int, str]] = {}
+    for rec in prog.constructed:
+        if rec.path not in scope_paths:
+            continue
+        consumers = set(rec.consumers)
+        if rec.direct_calls:
+            consumers.add(f"<{rec.func_qual}>")
+        if len(consumers) < 2:
+            continue
+        threaded = any(
+            (ci := prog.classes.get(c.rsplit(".", 1)[-1])) is not None
+            and ci.spawns_thread
+            for c in rec.consumers)
+        if threaded and rec.cls not in out:
+            out[rec.cls] = (rec.path, rec.line, rec.var)
+    return out
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    prog = build_program(ctx)
+    scope_paths = {sf.path for sf in ctx.files_under(*SCOPE)}
+    shared = _shared_classes(prog, scope_paths)
+
+    out: list[Finding] = []
+    for cname in sorted(shared):
+        ci = prog.classes.get(cname)
+        if ci is None:
+            continue
+        where_path, where_line, var = shared[cname]
+        # a class that spawns its own threads is the races pass's beat;
+        # double-reporting the same attrs under two rules helps nobody
+        if ci.spawns_thread:
+            continue
+        method_names = set(ci.methods)
+        scans: dict[str, _AttrScan] = {}
+        infos: dict[str, _MethodInfo] = {}
+        for mname, m in ci.methods.items():
+            if not m.args.args:
+                continue
+            sc = _AttrScan(m.args.args[0].arg, method_names)
+            sc.info.name = mname
+            for st in m.body:
+                sc.visit(st)
+            scans[mname] = sc
+            infos[mname] = sc.info
+        # interprocedural guard inheritance, races-pass entry logic:
+        # entries are the PUBLIC surface (either side may call in) plus
+        # private methods no in-class caller reaches; a private helper
+        # whose every call site holds the lock inherits it
+        # (intersection over paths)
+        called = {callee for info in infos.values()
+                  for callee, _g in info.calls}
+        entries = {}
+        for n in scans:
+            if n == "__init__":
+                continue
+            public = not n.startswith("_") or n in (
+                "__call__", "__enter__", "__exit__", "__iter__",
+                "__next__")
+            if public or n not in called:
+                entries[n] = frozenset()
+        inherited = _inherited_guards(entries, infos)
+        writers: dict[str, list[tuple[str, _Write, frozenset]]] = {}
+        readers: dict[str, set[str]] = {}
+        for mname, sc in scans.items():
+            if mname == "__init__" or mname not in inherited:
+                # not reachable from the post-construction surface:
+                # construction-time code, not a shared-state side
+                continue
+            inh = inherited[mname]
+            for w in sc.info.writes:
+                writers.setdefault(w.attr, []).append(
+                    (mname, w, w.guards | inh))
+            for a in sc.reads:
+                readers.setdefault(a, set()).add(mname)
+        for attr in sorted(writers):
+            wlist = writers[attr]
+            common = None
+            for _m, _w, g in wlist:
+                common = g if common is None else (common & g)
+            if common:
+                continue  # every mutation path holds a common guard
+            writing = {m for m, _w, _g in wlist}
+            other_readers = sorted(readers.get(attr, set()) - writing)
+            if not other_readers:
+                continue  # nobody on the other side looks at it
+            bad = next((w for _m, w, g in wlist if not g), wlist[0][1])
+            out.append(Finding(
+                rule="cross-share", path=ci.path, line=bad.line,
+                symbol=f"{cname}.{attr}",
+                message=(
+                    f"{cname} instances are shared across threads "
+                    f"(constructed as '{var}' at {where_path}:"
+                    f"{where_line} and handed to a thread-running "
+                    f"consumer); '{attr}' is mutated in place without "
+                    f"a common guard while {', '.join(other_readers)} "
+                    "read(s) it — rebind atomically, guard both sides, "
+                    "or expose a locked snapshot")))
+    return out

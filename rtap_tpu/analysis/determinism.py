@@ -1,0 +1,264 @@
+"""Replay determinism: no iteration-order-dependent serialized output.
+
+Rule ``replay-determinism`` — the static dual of the bit-exactness
+soaks. The durability contracts (bit-identical journal replay,
+byte-equal standby mirrors, exactly-once alert splice) die the moment a
+serialization or hashing path iterates something whose order the
+runtime does not pin:
+
+* **set iteration** — ``for x in self._seen:`` where ``_seen`` is a
+  ``set``: CPython randomizes str hashes per process, so two runs (or a
+  leader and its standby) emit different orders. Wrap in ``sorted()``.
+* **directory listings** — ``os.listdir`` / ``glob.glob`` /
+  ``Path.iterdir`` / ``os.scandir`` order is filesystem-arbitrary; a
+  recovery or checkpoint scan that folds over it unsorted can replay
+  differently on two hosts. Wrap in ``sorted()``.
+* **float reductions over unordered containers** — ``sum(<set>)``:
+  float addition does not associate, so an order change is a VALUE
+  change that survives into digests.
+
+Scope is the serialization/hashing surface only: the journal,
+checkpoints, alert sinks, replication, correlation, and the hashing
+util. Model/ops code is free to iterate sets (device reductions have
+their own bit-exactness tests); pulling every module in would bury the
+signal this gate exists to send.
+
+Order-insensitive folds over listings (``max`` over mtimes, membership
+probes) do exist — those are suppression material with a one-line why,
+not a reason to exempt the shape: the next edit to the loop body makes
+the fold order-sensitive and nobody re-reviews an exempted line.
+
+Symbols are ``<qualname>:<kind> <iterable text>`` (plus ``#n`` on
+collision) — line-insensitive, so baselining survives edits.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.program import _functions, dotted as _dotted
+
+PASS_NAME = "replay-determinism"
+RULES = {
+    "replay-determinism": "iteration-order-dependent output in a "
+                          "serialization/hashing path (unsorted set or "
+                          "listdir/glob iteration, float sum over an "
+                          "unordered container)",
+}
+
+#: the serialization + hashing surface (journal/checkpoint/alerts/
+#: correlate/replication); the durability contracts live here
+SCOPE = (
+    "rtap_tpu/resilience/journal.py",
+    "rtap_tpu/resilience/replicate.py",
+    "rtap_tpu/service/checkpoint.py",
+    "rtap_tpu/service/alerts.py",
+    "rtap_tpu/correlate/",
+    "rtap_tpu/utils/hashing.py",
+)
+
+#: calls whose result order is filesystem-arbitrary
+_FS_LISTING = frozenset({
+    "os.listdir", "listdir", "os.scandir", "scandir",
+    "glob.glob", "glob.iglob",
+})
+
+#: attribute-call forms of the same (receiver-typed, name is enough)
+_FS_LISTING_METHODS = frozenset({"iterdir", "glob", "rglob"})
+
+#: sorted()/list-sort wrappers that pin an order
+_ORDER_FIXERS = frozenset({"sorted", "min", "max", "len", "set",
+                           "frozenset", "any", "all"})
+
+
+def _is_set_expr(node: ast.AST, set_names: set[str]) -> bool:
+    """The iterable is statically known to be a set."""
+    if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+        return True
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d in ("set", "frozenset"):
+            return True
+        # a dict.keys()/.items() view ITERATED is insertion-ordered
+        # (deterministic given a deterministic insert order) — not
+        # flagged on its own; the BinOp branch below treats views as
+        # set-like, because set OPS on them (a.keys() - b.keys())
+        # return real hash-ordered sets
+        return False
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        return _is_setlike_operand(node.left, set_names) \
+            or _is_setlike_operand(node.right, set_names)
+    d = _dotted(node) if isinstance(node, (ast.Name, ast.Attribute)) \
+        else None
+    return d is not None and d in set_names
+
+
+def _is_setlike_operand(node: ast.AST, set_names: set[str]) -> bool:
+    """A BinOp operand that makes the whole expression a set: a set
+    expression, or a dict view (``.keys()``/``.items()``) — view ops
+    return real sets."""
+    if _is_set_expr(node, set_names):
+        return True
+    return isinstance(node, ast.Call) \
+        and isinstance(node.func, ast.Attribute) \
+        and node.func.attr in ("keys", "items") \
+        and not node.args and not node.keywords
+
+
+def _set_names_in(tree: ast.AST) -> set[str]:
+    """Dotted names (locals and self attrs) assigned a set anywhere in
+    the file — flow-insensitive on purpose: a name that is EVER a set
+    iterates as one somewhere."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            if value is None:
+                continue
+            is_set = isinstance(value, (ast.Set, ast.SetComp)) or (
+                isinstance(value, ast.Call)
+                and _dotted(value.func) in ("set", "frozenset"))
+            if not is_set:
+                continue
+            for t in targets:
+                d = _dotted(t)
+                if d is not None:
+                    names.add(d)
+    return names
+
+
+def _fs_listing_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = _dotted(node.func)
+    if d in _FS_LISTING:
+        return True
+    return isinstance(node.func, ast.Attribute) \
+        and node.func.attr in _FS_LISTING_METHODS \
+        and d not in _FS_LISTING  # path.iterdir()/glob() method forms
+
+
+def _parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    out = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _order_pinned(node: ast.AST, parents) -> bool:
+    """Some ancestor within the statement pins (or forgives) the order:
+    sorted(...)/min/max/len/set()/membership, or the value is compared
+    for membership (`x in listing`)."""
+    cur = parents.get(node)
+    while cur is not None and not isinstance(cur, ast.stmt):
+        if isinstance(cur, ast.Call):
+            d = _dotted(cur.func)
+            if d in _ORDER_FIXERS:
+                return True
+        if isinstance(cur, ast.Compare) and any(
+                isinstance(op, (ast.In, ast.NotIn)) for op in cur.ops):
+            return True
+        cur = parents.get(cur)
+    return False
+
+
+def _iter_sites(fn: ast.FunctionDef):
+    """(iterable expr, lineno, kind) for every iteration point in the
+    function's own body: for loops and comprehension generators."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield node.iter, node.lineno, "for"
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                yield gen.iter, getattr(gen.iter, "lineno", node.lineno), \
+                    "comp"
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in ctx.files_under(*SCOPE):
+        if sf.tree is None:
+            continue
+        set_names = _set_names_in(sf.tree)
+        parents = _parents(sf.tree)
+        seen_symbols: dict[str, int] = {}
+
+        def emit(qual, line, kind, expr_node, msg):
+            try:
+                text = ast.unparse(expr_node)
+            except Exception:  # pragma: no cover — unparse total on exprs
+                text = "?"
+            base = f"{qual}:{kind} {text}"
+            n = seen_symbols.get(base, 0)
+            seen_symbols[base] = n + 1
+            symbol = base if n == 0 else f"{base}#{n + 1}"
+            out.append(Finding(
+                rule="replay-determinism", path=sf.path, line=line,
+                symbol=symbol, message=msg))
+
+        for qual, fn in _functions(sf.tree):
+            for it, line, _k in _iter_sites(fn):
+                if _order_pinned(it, parents):
+                    continue
+                if _is_set_expr(it, set_names):
+                    emit(qual, line, "set-iter", it,
+                         "iterating a set in a serialization/hashing "
+                         "path: CPython hash randomization makes the "
+                         "order differ across processes, so replayed or "
+                         "mirrored output diverges — wrap in sorted()")
+                elif _fs_listing_call(it):
+                    emit(qual, line, "fs-iter", it,
+                         "iterating a directory listing unsorted in a "
+                         "serialization/hashing path: listdir/glob/"
+                         "iterdir order is filesystem-arbitrary and "
+                         "replays differently across hosts — wrap in "
+                         "sorted()")
+            # float reductions + direct set consumption (a set handed
+            # whole to join/list/str/...: serialized in hash order
+            # without any for-loop for the iteration check to see)
+            stack = list(fn.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    leaf = (node.func.attr
+                            if isinstance(node.func, ast.Attribute)
+                            else d)
+                    if d in ("sum", "math.fsum") and node.args \
+                            and _is_set_expr(node.args[0], set_names) \
+                            and not _order_pinned(node, parents):
+                        emit(qual, node.lineno, "float-sum",
+                             node.args[0],
+                             "float reduction over an unordered "
+                             "container: addition order changes the "
+                             "value, which survives into digests — "
+                             "sum(sorted(...)) or use an ordered "
+                             "container")
+                    elif d not in ("sum", "math.fsum") \
+                            and leaf not in _ORDER_FIXERS:
+                        for a in node.args:
+                            if _is_set_expr(a, set_names) \
+                                    and not _order_pinned(a, parents):
+                                emit(qual, a.lineno, "set-consume", a,
+                                     "a set handed whole to "
+                                     f"{leaf or '?'}() is consumed in "
+                                     "hash-randomized order — pass "
+                                     "sorted(...) instead (or suppress "
+                                     "with the order-free argument)")
+                stack.extend(ast.iter_child_nodes(node))
+    return out

@@ -1,0 +1,218 @@
+"""Resource lifecycle: every owned thread/socket/shm/file can be torn
+down.
+
+Rule ``resource-lifecycle`` — a serve-stack class that stores a
+``threading.Thread``/``Timer``, a socket, a ``SharedMemory`` segment,
+or an ``open()`` file handle on ``self`` OWNS that resource, and its
+teardown surface (``close()``/``__exit__``/``stop*()``/``shutdown()``)
+must reach a matching release — ``join`` for threads (with a timeout:
+an unbounded join turns one wedged thread into a wedged process, the
+exact hang class the kill-9 soaks exist to rule out), ``close`` for
+sockets/files, ``close``/``unlink`` for shm. The BinaryBatchSource
+leak class (PR 7: handler sockets and the accept thread outliving
+``close()``) is this pass's reason to exist; the conftest thread-leak
+fixture catches leaks a test HAPPENS to exercise, this catches the
+path that exists but is not wired.
+
+Reachability is interprocedural within the class: the teardown entry
+points are the methods named ``close``, ``shutdown``, ``stop``,
+``__exit__``, ``__del__`` or starting with ``stop_``/``close_``, plus
+everything they call (in-class call graph, worklist closure). A
+release seen anywhere in that closure clears the attribute.
+
+Out of scope by design: resources bound to locals (the ``with
+socket.create_connection(...)`` idiom scopes them lexically — storing
+on ``self`` is what creates an ownership obligation this pass can
+check), and fire-and-forget ``Thread(...).start()`` expressions (the
+races/thread-name passes already force those to be nameable; daemon
+threads without state to flush are legal there).
+
+Symbols are ``Class.attr`` (and ``Class.attr:unbounded-join`` for the
+timeout variant) — line-insensitive for baselining.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.program import (
+    _self_attr_target,
+    dotted,
+    is_thread_ctor,
+)
+
+PASS_NAME = "resource-lifecycle"
+RULES = {
+    "resource-lifecycle": "class-owned thread/socket/shm/file with no "
+                          "reachable release (join-with-timeout/close/"
+                          "unlink) on the close()/__exit__ teardown "
+                          "path",
+}
+
+SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/")
+
+#: resource kind -> (constructor dotted-name suffixes, release method
+#: names, human name)
+_KINDS = {
+    "thread": ((), ("join",), "thread"),
+    "socket": (("socket.socket", "socket.create_connection",
+                "create_connection", "socket.socketpair"),
+               ("close", "shutdown", "detach"), "socket"),
+    "shm": (("shared_memory.SharedMemory", "SharedMemory"),
+            ("close", "unlink"), "shared-memory segment"),
+    "file": (("open", "io.open", "os.fdopen", "gzip.open", "lzma.open"),
+             ("close",), "file handle"),
+}
+
+#: teardown surface: these methods (plus their in-class call closure)
+#: are where releases must live
+_TEARDOWN_EXACT = ("close", "shutdown", "stop", "__exit__", "__del__")
+_TEARDOWN_PREFIX = ("stop_", "close_")
+
+
+def _kind_of_ctor(call: ast.Call) -> str | None:
+    if is_thread_ctor(call):
+        return "thread"
+    d = dotted(call.func)
+    if d is None:
+        return None
+    for kind, (ctors, _rel, _h) in _KINDS.items():
+        if d in ctors:
+            return kind
+    return None
+
+
+def _is_teardown(name: str) -> bool:
+    return name in _TEARDOWN_EXACT \
+        or any(name.startswith(p) for p in _TEARDOWN_PREFIX)
+
+
+def _analyze_class(sf, cls: ast.ClassDef) -> list[Finding]:
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+
+    #: attr -> (kind, line of the creating assignment)
+    resources: dict[str, tuple[str, int]] = {}
+    #: method -> set of in-class callees
+    calls: dict[str, set[str]] = {m: set() for m in methods}
+    #: method -> list of (attr, release method name, has-timeout)
+    releases: dict[str, list[tuple[str, str, bool]]] = \
+        {m: [] for m in methods}
+
+    def _own_nodes(m):
+        # skip nested function/class defs: a nested handler class is
+        # analyzed as its own class (run() walks every ClassDef), and
+        # its self is NOT this method's self
+        stack = list(m.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    for mname, m in methods.items():
+        if not m.args.args:
+            continue
+        self_name = m.args.args[0].arg
+        for node in _own_nodes(m):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                kind = _kind_of_ctor(node.value)
+                if kind is not None:
+                    for t in node.targets:
+                        attr = _self_attr_target(t, self_name)
+                        if attr is not None and attr not in resources:
+                            resources[attr] = (kind, node.lineno)
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if isinstance(f.value, ast.Name) \
+                    and f.value.id == self_name \
+                    and f.attr in methods:
+                calls[mname].add(f.attr)
+            recv_attr = None
+            if isinstance(f.value, ast.Attribute) \
+                    and isinstance(f.value.value, ast.Name) \
+                    and f.value.value.id == self_name:
+                recv_attr = f.value.attr
+            if recv_attr is not None:
+                def _bounded(v):
+                    # an explicit None is the UNbounded spelling
+                    return not (isinstance(v, ast.Constant)
+                                and v.value is None)
+
+                has_timeout = (
+                    bool(node.args) and _bounded(node.args[0])) or any(
+                    kw.arg == "timeout" and _bounded(kw.value)
+                    for kw in node.keywords)
+                releases[mname].append((recv_attr, f.attr, has_timeout))
+
+    if not resources:
+        return []
+
+    # teardown closure: entry methods + everything they reach in-class
+    entry = {m for m in methods if _is_teardown(m)}
+    reach = set(entry)
+    work = list(entry)
+    while work:
+        m = work.pop()
+        for callee in calls.get(m, ()):
+            if callee not in reach:
+                reach.add(callee)
+                work.append(callee)
+
+    out: list[Finding] = []
+    for attr in sorted(resources):
+        kind, line = resources[attr]
+        rel_names = _KINDS[kind][1]
+        human = _KINDS[kind][2]
+        hits = [(m, rel, to) for m in sorted(reach)
+                for a, rel, to in releases.get(m, ())
+                if a == attr and rel in rel_names]
+        if not hits:
+            if not entry:
+                why = (f"{cls.name} has no teardown surface at all "
+                       "(no close/stop/shutdown/__exit__)")
+            else:
+                why = (f"nothing reachable from "
+                       f"{'/'.join(sorted(entry))} releases it")
+            out.append(Finding(
+                rule="resource-lifecycle", path=sf.path, line=line,
+                symbol=f"{cls.name}.{attr}",
+                message=(
+                    f"{human} self.{attr} is created here but {why} — "
+                    f"add a {'bounded join' if kind == 'thread' else rel_names[0]} "
+                    "on the close()/__exit__ path (leaked "
+                    f"{human}s are the BinaryBatchSource PR 7 bug "
+                    "class)")))
+        elif kind == "thread" and not any(to for _m, _r, to in hits):
+            jm = sorted({m for m, _r, _to in hits})
+            out.append(Finding(
+                rule="resource-lifecycle", path=sf.path, line=line,
+                symbol=f"{cls.name}.{attr}:unbounded-join",
+                message=(
+                    f"thread self.{attr} is joined in "
+                    f"{', '.join(jm)} without a timeout — one wedged "
+                    "thread wedges the whole teardown; join with a "
+                    "bounded timeout and let the daemon flag cover "
+                    "the remainder")))
+    return out
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in ctx.files_under(*SCOPE):
+        if sf.tree is None:
+            continue
+        # ast.walk, not tree.body: nested classes (the in-method
+        # request-handler idiom) own per-connection resources too
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ClassDef):
+                out.extend(_analyze_class(sf, node))
+    return out

@@ -1,0 +1,334 @@
+"""Static deadlock detection: the global lock-acquisition graph.
+
+Rule ``lock-order`` — build the program-wide graph whose nodes are lock
+attributes (``Class.attr``, one node per class lock — instances are
+conflated, the conservative direction) and whose edges L1 -> L2 mean
+"somewhere, L2 is acquired while L1 is held". Any cycle is a potential
+deadlock: two threads entering the cycle from different edges can each
+hold one lock and wait forever on the other. The ``Lease``
+``_lock``/``_seen_lock`` nesting (PR 8) was exactly this class of bug,
+caught by hand in review; this pass is that reviewer, made permanent.
+
+Edges come from three site shapes, all interprocedural:
+
+* lexical nesting — ``with self.a: ... with self.b:`` adds a -> b;
+* in-class calls — ``with self.a: self._m()`` adds a -> every lock in
+  ``_m``'s *acquisition closure* (every lock the call graph under
+  ``_m`` can take, computed as a worklist fixed point — the races-pass
+  worklist idea, pointed at acquisitions instead of guards);
+* cross-class calls — ``with self.a: self.worker.push()`` adds a ->
+  every lock in ``Worker.push``'s closure, where ``self.worker``'s
+  candidate classes come from the whole-program model's
+  constructor-injection typing (rtap_tpu/analysis/program.py). Every
+  candidate contributes edges: a may-analysis that guessed one class
+  would silently drop real deadlock edges.
+
+A *self*-edge — re-acquiring a lock already held on some path — is
+reported only when the lock is known non-reentrant
+(``threading.Lock``): with an ``RLock``/``Condition`` the nesting is
+legal. That is the ``Lease.read``-inside-``refresh`` near-miss: had
+``read()`` taken ``self._lock`` (which ``refresh`` already holds), this
+pass would have flagged the exact line.
+
+Findings carry the cycle as their symbol (``A._x->B._y->A._x``,
+canonicalized to start at the smallest node so the symbol is stable no
+matter which edge the walker found first) and anchor on one
+acquisition site inside the cycle, so a suppression lands where a human
+would look first.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.program import (
+    ClassInfo,
+    build_program,
+    dotted,
+)
+
+PASS_NAME = "lock-order"
+RULES = {
+    "lock-order": "cycle in the global lock-acquisition graph (or a "
+                  "non-reentrant lock re-acquired on a path that "
+                  "already holds it) — a static deadlock",
+}
+
+#: whole serve stack + the CLI wiring that constructs it
+SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/__main__.py")
+
+
+@dataclass(frozen=True)
+class _Edge:
+    src: str            # lock id "Class.attr"
+    dst: str
+    path: str           # file of the acquisition/call site
+    line: int
+    why: str            # human fragment for the message
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method body: lock acquisitions, self-calls and collaborator
+    calls, each annotated with the lexically-held lock set."""
+
+    def __init__(self, ci: ClassInfo, self_names: set[str]):
+        self.ci = ci
+        self.self_names = self_names
+        self._held: list[str] = []          # lock ATTR names, lexical
+        #: (lock_attr, line, held-before frozenset of attrs)
+        self.acquisitions: list[tuple[str, int, frozenset]] = []
+        #: (callee method name, line, held frozenset)
+        self.self_calls: list[tuple[str, int, frozenset]] = []
+        #: (collab attr, callee method name, line, held frozenset)
+        self.collab_calls: list[tuple[str, str, int, frozenset]] = []
+
+    # nested defs run later, on other stacks — not this method's order
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):  # noqa: N802
+        pass
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    def _lock_attr_of(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id in self.self_names \
+                and expr.attr in self.ci.lock_attrs:
+            return expr.attr
+        return None
+
+    def visit_With(self, node):  # noqa: N802
+        taken = []
+        for it in node.items:
+            attr = self._lock_attr_of(it.context_expr)
+            if attr is not None:
+                self.acquisitions.append(
+                    (attr, it.context_expr.lineno, frozenset(self._held)))
+                self._held.append(attr)
+                taken.append(attr)
+        for st in node.body:
+            self.visit(st)
+        if taken:
+            del self._held[-len(taken):]
+
+    def visit_Call(self, node):  # noqa: N802
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            # self.<lock>.acquire()/.release() — the explicit form.
+            # acquire EXTENDS the held set for the rest of the scan
+            # (release pops it): lexically approximate, but without it
+            # every ordering edge OUT of an explicitly-acquired lock is
+            # invisible and explicit-acquire code bypasses the gate
+            attr = self._lock_attr_of(f.value)
+            if attr is not None and f.attr == "acquire":
+                self.acquisitions.append(
+                    (attr, node.lineno, frozenset(self._held)))
+                self._held.append(attr)
+            elif attr is not None and f.attr == "release":
+                for i in range(len(self._held) - 1, -1, -1):
+                    if self._held[i] == attr:
+                        del self._held[i]
+                        break
+            elif isinstance(f.value, ast.Name) \
+                    and f.value.id in self.self_names \
+                    and f.attr in self.ci.methods:
+                self.self_calls.append(
+                    (f.attr, node.lineno, frozenset(self._held)))
+            elif isinstance(f.value, ast.Attribute) \
+                    and isinstance(f.value.value, ast.Name) \
+                    and f.value.value.id in self.self_names \
+                    and f.value.attr in self.ci.collab_attrs:
+                self.collab_calls.append(
+                    (f.value.attr, f.attr, node.lineno,
+                     frozenset(self._held)))
+        self.generic_visit(node)
+
+
+def _scan_method(ci: ClassInfo, m: ast.FunctionDef) -> _MethodScan:
+    self_names = {m.args.args[0].arg} if m.args.args else set()
+    sc = _MethodScan(ci, self_names)
+    for st in m.body:
+        sc.visit(st)
+    return sc
+
+
+def _closures(scans: dict[tuple[str, str], _MethodScan],
+              prog) -> dict[tuple[str, str], frozenset]:
+    """Acquisition closure per (class, method): every lock id the call
+    graph under that method may take. Union fixed point (monotone
+    increasing over a finite lattice, so it terminates)."""
+    clo: dict[tuple[str, str], set] = {}
+    for key, sc in scans.items():
+        cname = key[0]
+        clo[key] = {f"{cname}.{a}" for a, _l, _h in sc.acquisitions}
+    changed = True
+    while changed:
+        changed = False
+        for (cname, mname), sc in scans.items():
+            cur = clo[(cname, mname)]
+            before = len(cur)
+            for callee, _l, _h in sc.self_calls:
+                cur |= clo.get((cname, callee), set())
+            for cattr, callee, _l, _h in sc.collab_calls:
+                ci = prog.classes.get(cname)
+                for tname in sorted(ci.collab_attrs.get(cattr, ())):
+                    cur |= clo.get((tname, callee), set())
+            if len(cur) != before:
+                changed = True
+    return {k: frozenset(v) for k, v in clo.items()}
+
+
+def _canonical_cycle(nodes: list[str]) -> str:
+    """Rotate the cycle to start at its smallest node: a stable symbol
+    regardless of traversal order."""
+    i = nodes.index(min(nodes))
+    rot = nodes[i:] + nodes[:i]
+    return "->".join(rot + [rot[0]])
+
+
+def _find_cycles(edges: list[_Edge]) -> list[list[str]]:
+    """Elementary cycles via DFS over the (small) lock graph. One cycle
+    reported per distinct node set — enough to name the knot without
+    enumerating every rotation."""
+    graph: dict[str, set[str]] = {}
+    for e in edges:
+        if e.src != e.dst:
+            graph.setdefault(e.src, set()).add(e.dst)
+            graph.setdefault(e.dst, set())
+    cycles: list[list[str]] = []
+    seen_sets: set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: list[str], on_path: set[str]):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in seen_sets:
+                    seen_sets.add(key)
+                    cycles.append(list(path))
+            elif nxt not in on_path and nxt > start:
+                # only walk nodes > start: each cycle is found exactly
+                # once, rooted at its smallest node
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    prog = build_program(ctx)
+    scope_paths = set()
+    for sf in ctx.files_under(*SCOPE):
+        scope_paths.add(sf.path)
+
+    scans: dict[tuple[str, str], _MethodScan] = {}
+    lines: dict[tuple[str, str], int] = {}  # method def line, for anchors
+    for ci in prog.classes.values():
+        if ci.path not in scope_paths or not ci.lock_attrs \
+                and not ci.collab_attrs:
+            continue
+        for mname, m in ci.methods.items():
+            scans[(ci.name, mname)] = _scan_method(ci, m)
+            lines[(ci.name, mname)] = m.lineno
+
+    clo = _closures(scans, prog)
+
+    edges: list[_Edge] = []
+    out: list[Finding] = []
+    reported_self: set[tuple[str, str]] = set()  # (lock id, site key)
+    for (cname, mname), sc in sorted(scans.items()):
+        ci = prog.classes[cname]
+        # lexical/explicit acquisitions while other locks held
+        for attr, line, held in sc.acquisitions:
+            dst = ci.lock_id(attr)
+            for h in sorted(held):
+                src = ci.lock_id(h)
+                if src == dst:
+                    if not ci.lock_attrs.get(attr, True) \
+                            and (dst, f"{ci.path}:{line}") \
+                            not in reported_self:
+                        reported_self.add((dst, f"{ci.path}:{line}"))
+                        out.append(Finding(
+                            rule="lock-order", path=ci.path, line=line,
+                            symbol=f"{dst}->{dst}",
+                            message=f"{dst} is a non-reentrant "
+                                    "threading.Lock re-acquired on a "
+                                    "path that already holds it — a "
+                                    "guaranteed self-deadlock; use an "
+                                    "RLock or split the inner state "
+                                    "onto its own lock (the "
+                                    "Lease._seen_lock fix)"))
+                else:
+                    edges.append(_Edge(
+                        src, dst, ci.path, line,
+                        f"{cname}.{mname} acquires {dst} while "
+                        f"holding {src}"))
+        # calls made while holding locks: edges into the callee closure
+        call_sites = [
+            ((cname, callee), line, held)
+            for callee, line, held in sc.self_calls] + [
+            ((tname, callee), line, held)
+            for cattr, callee, line, held in sc.collab_calls
+            for tname in sorted(ci.collab_attrs.get(cattr, ()))]
+        for key, line, held in call_sites:
+            if not held or key not in clo:
+                continue
+            for h in sorted(held):
+                src = ci.lock_id(h)
+                for dst in sorted(clo[key]):
+                    if dst == src:
+                        # reentrancy is a property of the lock's OWNING
+                        # class (dst's prefix), not of the callee: the
+                        # re-acquisition may be reached through a
+                        # collaborator round-trip (A -> B -> A)
+                        dcls, dattr = dst.split(".", 1)
+                        owner = prog.classes.get(dcls)
+                        reent = owner.lock_attrs.get(dattr, True) \
+                            if owner is not None else True
+                        if not reent and (dst, f"{ci.path}:{line}") \
+                                not in reported_self:
+                            reported_self.add((dst, f"{ci.path}:{line}"))
+                            out.append(Finding(
+                                rule="lock-order", path=ci.path,
+                                line=line, symbol=f"{dst}->{dst}",
+                                message=f"call from {cname}.{mname} "
+                                        f"(holding {src}) reaches a "
+                                        f"re-acquisition of the same "
+                                        "non-reentrant lock in "
+                                        f"{key[0]}.{key[1]} — a "
+                                        "self-deadlock on this path"))
+                    else:
+                        edges.append(_Edge(
+                            src, dst, ci.path, line,
+                            f"{cname}.{mname} calls {key[0]}.{key[1]} "
+                            f"(which may take {dst}) while holding "
+                            f"{src}"))
+
+    for cyc in _find_cycles(edges):
+        symbol = _canonical_cycle(cyc)
+        nodes = set(cyc)
+        # anchor on the smallest (path, line) edge inside the cycle so
+        # the finding (and any suppression) lands deterministically
+        in_cycle = [e for e in edges if e.src in nodes and e.dst in nodes]
+        anchor = min(in_cycle, key=lambda e: (e.path, e.line))
+        detail = "; ".join(sorted({e.why for e in in_cycle})[:4])
+        out.append(Finding(
+            rule="lock-order", path=anchor.path, line=anchor.line,
+            symbol=symbol,
+            message=f"lock-order cycle {symbol}: {detail} — two threads "
+                    "entering from different edges deadlock; impose one "
+                    "global order (acquire in symbol order) or collapse "
+                    "to a single lock"))
+    return out

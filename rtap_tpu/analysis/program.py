@@ -1,0 +1,358 @@
+"""The whole-program model the cross-module passes share (ISSUE 13).
+
+PR 11's passes were per-class by design: every fact they needed lived
+inside one ``ClassDef``. The v2 passes (lock-order, cross-share) reason
+about facts that only exist BETWEEN classes — which collaborator an
+attribute holds, which classes run code on their own threads, who
+constructs what and hands it to whom. This module builds that model
+once per analysis run and memoizes it on the context:
+
+* a **class registry** over every scope file (name -> :class:`ClassInfo`
+  with methods, lock attributes, thread-spawn evidence);
+* **collaborator typing**: ``self.attr -> {candidate class names}``,
+  resolved three ways — direct construction (``self.x = Tracker(...)``),
+  annotated ``__init__`` params (``tracker: HealthTracker``) stored to
+  attrs, and call-site inference (every ``C(...)`` construction in the
+  program matched to ``C.__init__``'s params, with argument expressions
+  resolved through same-function locals). Candidates are SETS — an
+  ambiguous name keeps every candidate, because a may-analysis that
+  guessed one would silently drop real deadlock edges;
+* **construction/handoff sites**: for every function in the program,
+  locals bound to known-class constructors and the calls each local is
+  later handed to — the ``health = HealthTracker(...)`` /
+  ``ExpositionServer(health=health)`` / ``live_loop(..., health=health)``
+  wiring the cross-share pass exists to see.
+
+Everything here is pure AST: no imports are resolved, classes are keyed
+by bare name. The repo has no duplicate public class names across the
+serve stack; if one ever appears, the FIRST definition in sorted-path
+discovery order wins the registry slot (deterministic — discovery
+sorts both dirs and files) and the per-class passes still analyze
+every definition. A collision therefore narrows the whole-program
+model rather than corrupting it; renaming the newcomer is the fix.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from rtap_tpu.analysis.core import AnalysisContext
+
+__all__ = ["ClassInfo", "ConstructedLocal", "Program", "build_program"]
+
+#: lock-ish constructors: ``self.x = threading.Lock()`` makes x a lock
+#: attribute; RLock/Condition(RLock) are re-entrant (self-edges legal)
+_LOCK_CTORS = {"Lock": False, "RLock": True, "Condition": True,
+               "Semaphore": False, "BoundedSemaphore": False}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_thread_ctor(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    return d in ("threading.Thread", "Thread", "threading.Timer", "Timer")
+
+
+@dataclass
+class ClassInfo:
+    """Everything the cross-module passes need to know about one class."""
+
+    name: str
+    path: str               # repo-relative posix path of the defining file
+    node: ast.ClassDef
+    methods: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    #: self attrs assigned a lock constructor -> reentrant?
+    lock_attrs: dict[str, bool] = field(default_factory=dict)
+    #: self attrs holding collaborators -> candidate class names
+    collab_attrs: dict[str, set[str]] = field(default_factory=dict)
+    #: the class spawns threads (Thread/Timer ctor anywhere in a method,
+    #: or subclasses a Threading* server) — the cross-share pass's
+    #: "runs code on its own thread" side
+    spawns_thread: bool = False
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+
+@dataclass
+class ConstructedLocal:
+    """One ``v = KnownClass(...)`` local + everywhere v is handed on."""
+
+    var: str
+    cls: str                # constructed class name
+    path: str
+    line: int
+    func_qual: str          # qualname of the constructing function
+    #: callables this local was passed INTO (dotted callee names)
+    consumers: list[str] = field(default_factory=list)
+    #: methods invoked directly on the local (``v.m()``)
+    direct_calls: list[str] = field(default_factory=list)
+
+
+@dataclass
+class Program:
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    constructed: list[ConstructedLocal] = field(default_factory=list)
+
+    def resolve(self, name: str) -> ClassInfo | None:
+        return self.classes.get(name)
+
+
+def _functions(tree: ast.AST):
+    """(qualname, node) for every function/method, outer-first."""
+    out = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child))
+                walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def _classes_in(tree: ast.AST):
+    """Every ClassDef, including nested ones (handler classes)."""
+    return [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+
+
+def _own_body_nodes(fn: ast.FunctionDef):
+    """Walk a function's body IN SOURCE ORDER, excluding nested
+    function/class defs — those are yielded by _functions under their
+    own qualnames, and walking them twice would double-record
+    constructions with the wrong enclosing scope. Order matters: the
+    construction sweep must see ``v = C()`` before v's consumers."""
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            yield child
+            yield from rec(child)
+
+    for st in fn.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        yield st
+        yield from rec(st)
+
+
+def _lock_ctor_kind(value: ast.AST) -> bool | None:
+    """reentrant? for a lock-constructor value expression, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    d = dotted(value.func)
+    if d is None:
+        return None
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf in _LOCK_CTORS and (d == leaf or d.startswith("threading.")):
+        return _LOCK_CTORS[leaf]
+    return None
+
+
+def _self_attr_target(t: ast.AST, self_name: str) -> str | None:
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == self_name:
+        return t.attr
+    return None
+
+
+def _harvest_class(ci: ClassInfo, registry: dict[str, ClassInfo]) -> None:
+    """Fill lock_attrs / collab_attrs / spawns_thread for one class.
+    Collaborator typing via direct construction and annotated params;
+    call-site inference happens in a later whole-program sweep."""
+    for base in ci.node.bases:
+        d = dotted(base) or ""
+        if "Threading" in d or "RequestHandler" in d:
+            ci.spawns_thread = True
+    for m in ci.node.body:
+        if not isinstance(m, ast.FunctionDef) or not m.args.args:
+            continue
+        self_name = m.args.args[0].arg
+        #: annotated __init__ params: name -> class name
+        ann: dict[str, str] = {}
+        if m.name == "__init__":
+            for a in m.args.args[1:] + m.args.kwonlyargs:
+                if a.annotation is not None:
+                    for n in ast.walk(a.annotation):
+                        nm = None
+                        if isinstance(n, (ast.Name, ast.Attribute)):
+                            nm = dotted(n)
+                        elif isinstance(n, ast.Constant) \
+                                and isinstance(n.value, str):
+                            nm = n.value  # forward-ref string annotation
+                        if nm and nm.rsplit(".", 1)[-1] in registry:
+                            ann[a.arg] = nm.rsplit(".", 1)[-1]
+        for node in ast.walk(m):
+            if isinstance(node, ast.Call) and is_thread_ctor(node):
+                ci.spawns_thread = True
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            if value is None:
+                continue
+            for t in targets:
+                attr = _self_attr_target(t, self_name)
+                if attr is None:
+                    continue
+                reent = _lock_ctor_kind(value)
+                if reent is not None:
+                    ci.lock_attrs[attr] = reent
+                    continue
+                if isinstance(value, ast.Call):
+                    d = dotted(value.func)
+                    leaf = d.rsplit(".", 1)[-1] if d else None
+                    if leaf in registry:
+                        ci.collab_attrs.setdefault(attr, set()).add(leaf)
+                        continue
+                if isinstance(value, ast.Name) and value.id in ann:
+                    ci.collab_attrs.setdefault(attr, set()).add(
+                        ann[value.id])
+
+
+def _init_param_names(ci: ClassInfo) -> list[str]:
+    init = ci.methods.get("__init__")
+    if init is None:
+        return []
+    return [a.arg for a in init.args.args[1:]]
+
+
+def _sweep_constructions(prog: Program, ctx: AnalysisContext) -> None:
+    """Whole-program sweep: for every function, find locals bound to
+    known-class constructors, where they are handed on, and — for
+    constructor calls — bind argument types back onto the callee's
+    ``__init__`` params (call-site collaborator inference)."""
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        for qual, fn in _functions(sf.tree):
+            #: local name -> constructed class name (last binding wins;
+            #: good enough for the linear wiring code this models)
+            local_types: dict[str, str] = {}
+            records: dict[str, ConstructedLocal] = {}
+            for node in _own_body_nodes(fn):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    d = dotted(node.value.func)
+                    leaf = d.rsplit(".", 1)[-1] if d else None
+                    if leaf in prog.classes:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local_types[t.id] = leaf
+                                records[t.id] = ConstructedLocal(
+                                    var=t.id, cls=leaf, path=sf.path,
+                                    line=node.lineno, func_qual=qual)
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = dotted(node.func)
+                if callee is None:
+                    continue
+                leaf = callee.rsplit(".", 1)[-1]
+                callee_ci = prog.classes.get(leaf)
+                # ---- handoff tracking --------------------------------
+                handed = []
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        handed.append((None, a.id))
+                for kw in node.keywords:
+                    if isinstance(kw.value, ast.Name) and kw.arg:
+                        handed.append((kw.arg, kw.value.id))
+                for _slot, name in handed:
+                    if name in records:
+                        records[name].consumers.append(callee)
+                # v.m(...) — the constructing scope itself uses v
+                if isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in records:
+                    records[node.func.value.id].direct_calls.append(
+                        node.func.attr)
+                # ---- call-site param typing --------------------------
+                if callee_ci is None:
+                    continue
+                params = _init_param_names(callee_ci)
+                init = callee_ci.methods.get("__init__")
+                kwonly = {a.arg for a in init.args.kwonlyargs} \
+                    if init is not None else set()
+
+                def _type_of(expr) -> str | None:
+                    if isinstance(expr, ast.Call):
+                        d2 = dotted(expr.func)
+                        lf = d2.rsplit(".", 1)[-1] if d2 else None
+                        return lf if lf in prog.classes else None
+                    if isinstance(expr, ast.Name):
+                        return local_types.get(expr.id)
+                    return None
+
+                bindings: dict[str, str] = {}
+                for i, a in enumerate(node.args):
+                    ty = _type_of(a)
+                    if ty is not None and i < len(params):
+                        bindings[params[i]] = ty
+                for kw in node.keywords:
+                    ty = _type_of(kw.value)
+                    if ty is not None and kw.arg \
+                            and (kw.arg in params or kw.arg in kwonly):
+                        bindings[kw.arg] = ty
+                if not bindings:
+                    continue
+                # park param->type on the callee: any __init__ body
+                # ``self.x = <param>`` adopts the binding
+                if init is not None:
+                    self_name = init.args.args[0].arg \
+                        if init.args.args else "self"
+                    for st in ast.walk(init):
+                        if isinstance(st, ast.Assign) \
+                                and isinstance(st.value, ast.Name) \
+                                and st.value.id in bindings:
+                            for t in st.targets:
+                                attr = _self_attr_target(t, self_name)
+                                if attr is not None:
+                                    callee_ci.collab_attrs.setdefault(
+                                        attr, set()).add(
+                                            bindings[st.value.id])
+            prog.constructed.extend(records.values())
+
+
+def build_program(ctx: AnalysisContext) -> Program:
+    """Build (or return the memoized) whole-program model for this
+    context. Memoized on the context object: lock-order and cross-share
+    both consume it and the model must be built exactly once per run."""
+    cached = getattr(ctx, "_program", None)
+    if cached is not None:
+        return cached
+    prog = Program()
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        for cls in _classes_in(sf.tree):
+            ci = ClassInfo(name=cls.name, path=sf.path, node=cls)
+            ci.methods = {n.name: n for n in cls.body
+                          if isinstance(n, ast.FunctionDef)}
+            # first definition wins; later same-name classes still get
+            # analyzed per-file by the per-class passes
+            prog.classes.setdefault(cls.name, ci)
+    for ci in prog.classes.values():
+        _harvest_class(ci, prog.classes)
+    _sweep_constructions(prog, ctx)
+    ctx._program = prog
+    return prog

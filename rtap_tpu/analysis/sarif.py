@@ -1,0 +1,90 @@
+"""SARIF 2.1.0 output: findings rendered where reviewers live.
+
+``python -m rtap_tpu.analysis --sarif PATH`` writes one standard SARIF
+log beside the existing ``--json`` artifact line (which keeps its
+one-line stdout contract untouched — SARIF goes to a file). SARIF is
+what CI annotators and editors already speak: the same findings the
+gate prints as ``path:line: [rule] symbol: message`` become inline PR
+annotations and editor squiggles with zero glue.
+
+Mapping choices (shape-pinned by tests/unit/test_static_checks.py):
+
+* every rule (plus the synthetic ``parse-error``) becomes a
+  ``tool.driver.rules`` entry, so viewers can render rule metadata;
+* unsuppressed findings are ``level: error`` results — the gate's
+  subject, exactly what ``ok`` is false about;
+* inline-suppressed and baselined findings are emitted too, carrying a
+  ``suppressions`` entry (``kind: inSource`` for ``# rtap: allow``
+  comments, ``kind: external`` for ``analysis_baseline.json``) so a
+  viewer shows them greyed out instead of not at all — an auditor can
+  SEE the tolerances without reading the baseline file;
+* the stable ``(rule, path, symbol)`` key rides in
+  ``partialFingerprints`` so result tracking survives line drift, same
+  property the baseline relies on.
+"""
+
+from __future__ import annotations
+
+from rtap_tpu.analysis.core import Finding, Report
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def _result(f: Finding, level: str,
+            suppression_kind: str | None) -> dict:
+    out = {
+        "ruleId": f.rule,
+        "level": level,
+        "message": {"text": f"{f.symbol}: {f.message}"},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(f.line, 1)},
+            }
+        }],
+        "partialFingerprints": {
+            "rtapLintKey/v1": f"{f.rule}:{f.path}:{f.symbol}",
+        },
+    }
+    if suppression_kind is not None:
+        out["suppressions"] = [{"kind": suppression_kind}]
+    return out
+
+
+def to_sarif(report: Report) -> dict:
+    """One SARIF 2.1.0 log for one analyzer run."""
+    from rtap_tpu.analysis import ALL_RULES
+
+    rules = dict(ALL_RULES)
+    rules["parse-error"] = "file failed to parse (the analyzer " \
+        "degrades loudly, never silently skips)"
+    results = [_result(f, "error", None) for f in report.findings]
+    results += [_result(f, "note", "inSource") for f in report.suppressed]
+    results += [_result(f, "note", "external") for f in report.baselined]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "rtap-lint",
+                    "informationUri":
+                        "docs/ANALYSIS.md",
+                    "rules": [
+                        {"id": rid,
+                         "shortDescription": {"text": desc}}
+                        for rid, desc in sorted(rules.items())
+                    ],
+                }
+            },
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+            "properties": {
+                "filesScanned": report.files_scanned,
+                "cache": report.cache_mode,
+                "perPass": dict(sorted(report.per_pass.items())),
+            },
+        }],
+    }

@@ -153,7 +153,7 @@ class TopologyMap:
                 x = parent[x]
             return x
 
-        for svc in set(self.services.values()):
+        for svc in sorted(set(self.services.values())):
             find(svc)
         for a, b in self.links:
             ra, rb = find(a), find(b)

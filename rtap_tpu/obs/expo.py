@@ -388,6 +388,11 @@ class ExpositionServer:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # shutdown() returns once serve_forever exits, so the join is
+        # immediate — but without it the thread object outlives close()
+        # and the conftest leak fixture (rightly) calls that a leak
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def __enter__(self) -> "ExpositionServer":
         return self.start()

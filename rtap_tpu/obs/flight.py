@@ -315,7 +315,11 @@ class FlightRecorder:
                 with open(os.path.join(tmp, "trace.json"), "w") as f:
                     json.dump(self.trace.chrome_trace(last_ticks=span_ticks), f)
             with open(os.path.join(tmp, "events.jsonl"), "w") as f:
-                for line in self._events:
+                # materialize first: list(deque) is one C-level copy
+                # (GIL-atomic), while iterating the live deque races
+                # the loop thread's record_event appends — a concurrent
+                # mutation raises RuntimeError mid-dump
+                for line in list(self._events):
                     f.write(line + "\n")
             with open(os.path.join(tmp, "summary.json"), "w") as f:
                 json.dump(self.summary(reason, tick), f, indent=2)

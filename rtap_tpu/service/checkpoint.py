@@ -133,9 +133,11 @@ def save_group(grp: StreamGroup, path: str | Path,
     # Sweep residue from PRIOR interrupted saves only after this save fully
     # landed: a complete `.old-*`/`.tmp-*` sibling is load_group's crash
     # fallback and must never be deleted before a newer complete copy exists.
+    # rtap: allow[replay-determinism] — every match is deleted; order-free
     for stale in path.parent.glob(f".{path.name}.tmp-*"):
         if stale != tmp:
             shutil.rmtree(stale, ignore_errors=True)
+    # rtap: allow[replay-determinism] — every match is deleted; order-free
     for stale in path.parent.glob(f".{path.name}.old-*"):
         shutil.rmtree(stale, ignore_errors=True)
     obs.counter("rtap_obs_checkpoint_saves_total",
@@ -152,12 +154,14 @@ def _recover_residue(path: Path) -> Path:
     the underlying error)."""
     if (path / "meta.json").exists():
         return path
-    candidates = [
+    # sorted so an mtime TIE between two residue dirs resolves to the
+    # same winner on every host (max keeps the first of equal keys)
+    candidates = sorted(
         p
         for pattern in (f".{path.name}.old-*", f".{path.name}.tmp-*")
         for p in path.parent.glob(pattern)
         if (p / "meta.json").exists()
-    ]
+    )
     if candidates:
         import logging
 
@@ -260,7 +264,7 @@ def peek_resume_ticks(checkpoint_dir: str | Path) -> int:
     root = Path(checkpoint_dir)
     if not root.is_dir():
         return 0
-    for d in root.iterdir():
+    for d in sorted(root.iterdir()):
         if not d.name.startswith("group") or not d.is_dir():
             continue
         try:

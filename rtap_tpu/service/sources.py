@@ -310,6 +310,8 @@ class TcpJsonlSource:
     def close(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
     def __enter__(self) -> "TcpJsonlSource":
         return self.start()

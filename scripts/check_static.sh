@@ -6,11 +6,14 @@
 #     must at least parse/compile; an import-time SyntaxError must fail
 #     CI even if no test imports the file.
 #  2. rtap-lint (python -m rtap_tpu.analysis) — the AST invariant
-#     analyzer (ISSUE 12, docs/ANALYSIS.md): the print gate and
-#     MUST_BE_STRICT coverage pin live there now, alongside the race,
-#     purity, exception-discipline, and flag↔docs passes. Exit 0 iff
+#     analyzer (ISSUEs 12+13, docs/ANALYSIS.md): nine passes — the
+#     print gate and MUST_BE_STRICT coverage pin, the race, purity,
+#     exception-discipline, and flag↔docs passes, plus the
+#     whole-program v2 passes (lock-order deadlock cycles, cross-object
+#     sharing, replay determinism, resource lifecycle). Exit 0 iff
 #     zero unsuppressed findings against the committed
-#     analysis_baseline.json.
+#     analysis_baseline.json. Untouched-tree reruns are served from the
+#     content-hash findings cache (finding-identical by test).
 #
 # This script is deliberately a thin wrapper: the checking logic has ONE
 # home (rtap_tpu/analysis/), testable as a library, with a --json

@@ -140,3 +140,14 @@ def test_external_membership_bump_mid_chunk_plain_micro_chunk():
     assert after["rtap_obs_routing_rebuilds_total"] \
         - before.get("rtap_obs_routing_rebuilds_total", 0) >= 1
     assert after["rtap_obs_streams_active"] == G_TOTAL + 1
+
+
+def test_exposition_server_close_joins_http_thread():
+    """ISSUE 13 resource-lifecycle regression: close() must join the
+    HTTP thread (bounded) so no rtap-obs-http thread outlives the
+    server object it served."""
+    from rtap_tpu.obs.metrics import TelemetryRegistry
+
+    srv = ExpositionServer(registry=TelemetryRegistry()).start()
+    srv.close()
+    assert not srv._thread.is_alive()

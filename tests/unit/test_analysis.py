@@ -414,3 +414,538 @@ def test_finding_json_shape():
 def test_parse_error_is_a_finding():
     r = lint("rtap_tpu/service/_fx.py", "def broken(:\n", ["parse-error"])
     assert rules_of(r) == ["parse-error"]
+
+
+# ----------------------------------------------------------- lock-order --
+LOCK_CYCLE = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+
+
+def test_lock_order_cycle_positive_and_canonical_symbol():
+    r = lint("rtap_tpu/resilience/_fx.py", LOCK_CYCLE, ["lock-order"])
+    assert [f.symbol for f in r.findings] == \
+        ["C._a_lock->C._b_lock->C._a_lock"]
+    assert not r.ok
+
+
+def test_lock_order_consistent_nesting_is_clean():
+    ordered = LOCK_CYCLE.replace(
+        "    def two(self):\n        with self._b_lock:\n"
+        "            with self._a_lock:\n",
+        "    def two(self):\n        with self._a_lock:\n"
+        "            with self._b_lock:\n")
+    r = lint("rtap_tpu/resilience/_fx.py", ordered, ["lock-order"])
+    assert r.findings == [] and r.ok
+
+
+def test_lock_order_interprocedural_cycle_through_call():
+    """One side nests lexically, the other reaches the reverse order
+    through a method call — the acquisition-closure worklist must see
+    through the call."""
+    code = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def two(self):
+        with self._b_lock:
+            self._grab_a()
+
+    def _grab_a(self):
+        with self._a_lock:
+            pass
+"""
+    r = lint("rtap_tpu/ingest/_fx.py", code, ["lock-order"])
+    assert [f.symbol for f in r.findings] == \
+        ["C._a_lock->C._b_lock->C._a_lock"]
+
+
+def test_lock_order_cross_class_cycle_via_collaborators():
+    """The whole-program shape: A holds its lock and calls into B,
+    B holds its lock and calls back into A — no single class shows a
+    cycle, only the global graph does (constructor-injection typing)."""
+    code = """
+import threading
+
+class A:
+    def __init__(self, b: "B"):
+        self._a_lock = threading.Lock()
+        self.b = b
+
+    def m(self):
+        with self._a_lock:
+            self.b.push()
+
+    def poke(self):
+        with self._a_lock:
+            pass
+
+class B:
+    def __init__(self, a: "A"):
+        self._b_lock = threading.Lock()
+        self.a = a
+
+    def push(self):
+        with self._b_lock:
+            self.a.poke()
+"""
+    r = lint("rtap_tpu/obs/_fx.py", code, ["lock-order"])
+    # TWO distinct deadlocks live here: the A->B->A ordering cycle
+    # (two threads entering from different edges), and the
+    # single-thread self-deadlock (A.m's call reaches A.poke, which
+    # re-acquires the non-reentrant lock A.m already holds)
+    assert sorted(f.symbol for f in r.findings) == \
+        ["A._a_lock->A._a_lock", "A._a_lock->B._b_lock->A._a_lock"]
+    # ... and breaking one direction (B no longer calls back) is clean
+    oneway = code.replace("            self.a.poke()\n",
+                          "            pass\n")
+    assert lint("rtap_tpu/obs/_fx.py", oneway, ["lock-order"]).findings == []
+
+
+def test_lock_order_nonreentrant_self_deadlock():
+    """Re-acquiring a plain threading.Lock on a path that already holds
+    it — the Lease.read-inside-refresh near-miss (PR 8)."""
+    code = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self._inner()
+
+    def _inner(self):
+        with self._lock:
+            pass
+"""
+    r = lint("rtap_tpu/resilience/_fx.py", code, ["lock-order"])
+    assert [f.symbol for f in r.findings] == ["C._lock->C._lock"]
+    # an RLock makes the same nesting legal
+    rl = code.replace("threading.Lock()", "threading.RLock()")
+    assert lint("rtap_tpu/resilience/_fx.py", rl,
+                ["lock-order"]).findings == []
+
+
+def test_lock_order_self_deadlock_via_collaborator_roundtrip():
+    """A holds its plain Lock and calls into B, which calls straight
+    back into A re-acquiring the same lock: the re-acquisition is
+    reached through a collaborator, so reentrancy must be judged by
+    the lock's OWNING class, not the callee."""
+    code = """
+import threading
+
+class A:
+    def __init__(self, b: "B"):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def m(self):
+        with self._lock:
+            self.b.push()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+class B:
+    def __init__(self, a: "A"):
+        self.a = a
+
+    def push(self):
+        self.a.poke()
+"""
+    r = lint("rtap_tpu/obs/_fx.py", code, ["lock-order"])
+    assert [f.symbol for f in r.findings] == ["A._lock->A._lock"]
+    # with an RLock the round-trip is legal
+    rl = code.replace("threading.Lock()", "threading.RLock()")
+    assert lint("rtap_tpu/obs/_fx.py", rl, ["lock-order"]).findings == []
+
+
+def test_lock_order_explicit_acquire_extends_held_set():
+    """self.<lock>.acquire() must contribute ordering edges exactly
+    like the with-form: explicit acquire/release code (conditional
+    locking) must not bypass the deadlock gate."""
+    code = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def one(self):
+        self._a_lock.acquire()
+        with self._b_lock:
+            pass
+        self._a_lock.release()
+
+    def two(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+    r = lint("rtap_tpu/resilience/_fx.py", code, ["lock-order"])
+    assert [f.symbol for f in r.findings] == \
+        ["C._a_lock->C._b_lock->C._a_lock"]
+    # release before the nested acquisition breaks the edge (and the
+    # cycle): the held-set tracking honors release, not just acquire
+    released = code.replace(
+        "        self._a_lock.acquire()\n        with self._b_lock:\n"
+        "            pass\n        self._a_lock.release()\n",
+        "        self._a_lock.acquire()\n        self._a_lock.release()\n"
+        "        with self._b_lock:\n            pass\n")
+    assert lint("rtap_tpu/resilience/_fx.py", released,
+                ["lock-order"]).findings == []
+
+
+def test_lock_order_suppression_comment():
+    # the cycle finding anchors on the FIRST in-cycle acquisition site
+    # (smallest path/line) — that is where the suppression must sit
+    supp = LOCK_CYCLE.replace(
+        "        with self._a_lock:\n            with self._b_lock:",
+        "        with self._a_lock:\n"
+        "            # rtap: allow[lock-order] — fixture\n"
+        "            with self._b_lock:")
+    r = lint("rtap_tpu/resilience/_fx.py", supp, ["lock-order"])
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------- cross-share --
+_TRACKER = """
+import threading
+
+class Tracker:
+    def __init__(self):
+        self.n = 0
+        self.samples = {}
+        self._lock = threading.Lock()
+
+    def fold(self, k):
+        self.samples[k] = self.samples.get(k, 0) + 1
+        self.n += 1
+
+    def snapshot(self):
+        return dict(self.samples), self.n
+
+class Runner:
+    def __init__(self, tracker):
+        self.tracker = tracker
+
+    def start(self):
+        threading.Thread(target=self._run, name="rtap-t",
+                         daemon=True).start()
+
+    def _run(self):
+        pass
+"""
+
+_WIRE = """
+def wire():
+    t = Tracker()
+    r = Runner(t)
+    consume(t)
+    return r
+"""
+
+
+def cross_lint(tracker_code, wire_code=_WIRE):
+    """Two-module fixture: the tracker lives in obs/, the wiring (and
+    the thread-running consumer handoff) in service/ — the pass must
+    cross the module boundary to connect them."""
+    return lint("rtap_tpu/obs/_fx.py", tracker_code, ["cross-share"],
+                extra=(("rtap_tpu/service/_wire.py", wire_code),))
+
+
+def test_cross_share_positive_across_modules():
+    r = cross_lint(_TRACKER)
+    assert sorted(f.symbol for f in r.findings) == ["Tracker.n",
+                                                    "Tracker.samples"]
+    assert "thread-running" in r.findings[0].message
+
+
+def test_cross_share_guarded_writes_are_clean():
+    guarded = _TRACKER.replace(
+        "    def fold(self, k):\n"
+        "        self.samples[k] = self.samples.get(k, 0) + 1\n"
+        "        self.n += 1\n",
+        "    def fold(self, k):\n"
+        "        with self._lock:\n"
+        "            self.samples[k] = self.samples.get(k, 0) + 1\n"
+        "            self.n += 1\n")
+    assert cross_lint(guarded).findings == []
+
+
+def test_cross_share_interprocedural_guard_inheritance():
+    """A private helper whose every call site holds the lock inherits
+    it — the IncidentCorrelator shape that a naive every-method-is-an-
+    entry analysis would falsely flag."""
+    code = _TRACKER.replace(
+        "    def fold(self, k):\n"
+        "        self.samples[k] = self.samples.get(k, 0) + 1\n"
+        "        self.n += 1\n",
+        "    def fold(self, k):\n"
+        "        with self._lock:\n"
+        "            self._bump(k)\n\n"
+        "    def _bump(self, k):\n"
+        "        self.samples[k] = self.samples.get(k, 0) + 1\n"
+        "        self.n += 1\n")
+    assert cross_lint(code).findings == []
+
+
+def test_cross_share_atomic_rebind_is_the_snapshot_idiom():
+    rebind = _TRACKER.replace(
+        "        self.samples[k] = self.samples.get(k, 0) + 1\n"
+        "        self.n += 1\n",
+        "        self.samples = {**self.samples, k: 1}\n")
+    assert cross_lint(rebind).findings == []
+
+
+def test_cross_share_needs_a_threaded_consumer():
+    """Handing the tracker to two PLAIN consumers is single-threaded
+    wiring — not this pass's business."""
+    wire = _WIRE.replace("    r = Runner(t)\n", "    r = consume2(t)\n")
+    assert cross_lint(_TRACKER, wire).findings == []
+
+
+def test_cross_share_suppression_comment():
+    supp = _TRACKER.replace(
+        "        self.n += 1\n",
+        "        self.n += 1  # rtap: allow[cross-share] — fixture\n")
+    r = cross_lint(supp)
+    assert [f.symbol for f in r.findings] == ["Tracker.samples"]
+    assert len(r.suppressed) == 1
+
+
+# ---------------------------------------------- replay-determinism --
+def test_replay_det_set_iteration():
+    code = ("def emit(fh):\n"
+            "    acc = set()\n"
+            "    acc.add(1)\n"
+            "    for x in acc:\n"
+            "        fh.write(str(x))\n")
+    r = lint("rtap_tpu/correlate/_fx.py", code, ["replay-determinism"])
+    assert len(r.findings) == 1 and "set-iter" in r.findings[0].symbol
+    ok = code.replace("for x in acc:", "for x in sorted(acc):")
+    assert lint("rtap_tpu/correlate/_fx.py", ok,
+                ["replay-determinism"]).findings == []
+    # model/ops code may iterate sets freely — scope is the
+    # serialization surface only
+    assert lint("rtap_tpu/ops/_fx.py", code,
+                ["replay-determinism"]).findings == []
+
+
+def test_replay_det_self_attr_set_and_comprehension():
+    code = ("class J:\n"
+            "    def __init__(self):\n"
+            "        self._seen = set()\n\n"
+            "    def digest(self):\n"
+            "        return ''.join(str(x) for x in self._seen)\n")
+    r = lint("rtap_tpu/resilience/journal.py", code,
+             ["replay-determinism"])
+    assert len(r.findings) == 1
+    assert "J.digest" in r.findings[0].symbol
+
+
+def test_replay_det_unsorted_listing():
+    code = ("import os\n\n"
+            "def walk(d, fh):\n"
+            "    for n in os.listdir(d):\n"
+            "        fh.write(n)\n")
+    r = lint("rtap_tpu/service/checkpoint.py", code,
+             ["replay-determinism"])
+    assert len(r.findings) == 1 and "fs-iter" in r.findings[0].symbol
+    ok = code.replace("os.listdir(d):", "sorted(os.listdir(d)):")
+    assert lint("rtap_tpu/service/checkpoint.py", ok,
+                ["replay-determinism"]).findings == []
+    # Path.iterdir()/glob() method forms count too
+    meth = ("def walk(p, fh):\n"
+            "    for n in p.iterdir():\n"
+            "        fh.write(str(n))\n")
+    assert len(lint("rtap_tpu/service/checkpoint.py", meth,
+                    ["replay-determinism"]).findings) == 1
+
+
+def test_replay_det_dict_view_set_ops():
+    """a.keys() - b.keys() returns a REAL set (hash-ordered) even
+    though iterating a bare .keys() view is insertion-ordered —
+    the BinOp branch must treat dict views as set-like."""
+    code = ("def diff(a, b, fh):\n"
+            "    for k in a.keys() - b.keys():\n"
+            "        fh.write(k)\n")
+    r = lint("rtap_tpu/correlate/_fx.py", code, ["replay-determinism"])
+    assert len(r.findings) == 1 and "set-iter" in r.findings[0].symbol
+    # a bare .keys() iteration stays legal (insertion-ordered)
+    plain = ("def emit(a, fh):\n"
+             "    for k in a.keys():\n"
+             "        fh.write(k)\n")
+    assert lint("rtap_tpu/correlate/_fx.py", plain,
+                ["replay-determinism"]).findings == []
+
+
+def test_replay_det_float_sum_over_set():
+    code = ("def tot(vals):\n"
+            "    s = set(vals)\n"
+            "    return sum(s)\n")
+    r = lint("rtap_tpu/correlate/_fx.py", code, ["replay-determinism"])
+    assert len(r.findings) == 1 and "float-sum" in r.findings[0].symbol
+    ok = code.replace("sum(s)", "sum(sorted(s))")
+    assert lint("rtap_tpu/correlate/_fx.py", ok,
+                ["replay-determinism"]).findings == []
+
+
+def test_replay_det_direct_set_consumption():
+    """','.join(set) serializes in hash order with no for-loop for the
+    iteration check to see — direct consumption is flagged too."""
+    code = ("def emit(fh):\n"
+            "    acc = set()\n"
+            "    acc.add('x')\n"
+            "    fh.write(','.join(acc))\n")
+    r = lint("rtap_tpu/correlate/_fx.py", code, ["replay-determinism"])
+    assert len(r.findings) == 1
+    assert "set-consume" in r.findings[0].symbol
+    ok = code.replace("','.join(acc)", "','.join(sorted(acc))")
+    assert lint("rtap_tpu/correlate/_fx.py", ok,
+                ["replay-determinism"]).findings == []
+
+
+def test_replay_det_suppression_comment():
+    code = ("import os\n\n"
+            "def sweep(d):\n"
+            "    # rtap: allow[replay-determinism] — all deleted\n"
+            "    for n in os.listdir(d):\n"
+            "        os.remove(n)\n")
+    r = lint("rtap_tpu/service/checkpoint.py", code,
+             ["replay-determinism"])
+    assert r.findings == [] and len(r.suppressed) == 1
+
+
+# ---------------------------------------------- resource-lifecycle --
+_LEAKY_THREAD = """
+import threading
+
+class R:
+    def start(self):
+        self._t = threading.Thread(target=self._run, name="rtap-x",
+                                   daemon=True)
+        self._t.start()
+
+    def _run(self):
+        pass
+"""
+
+
+def test_lifecycle_thread_without_teardown():
+    r = lint("rtap_tpu/obs/_fx.py", _LEAKY_THREAD, ["resource-lifecycle"])
+    assert [f.symbol for f in r.findings] == ["R._t"]
+    assert "no teardown surface" in r.findings[0].message
+
+
+def test_lifecycle_bounded_join_is_clean_and_unbounded_flagged():
+    closed = _LEAKY_THREAD + (
+        "\n    def close(self):\n"
+        "        self._t.join(timeout=2.0)\n")
+    assert lint("rtap_tpu/obs/_fx.py", closed,
+                ["resource-lifecycle"]).findings == []
+    unbounded = _LEAKY_THREAD + (
+        "\n    def close(self):\n"
+        "        self._t.join()\n")
+    r = lint("rtap_tpu/obs/_fx.py", unbounded, ["resource-lifecycle"])
+    assert [f.symbol for f in r.findings] == ["R._t:unbounded-join"]
+
+
+def test_lifecycle_release_reached_through_helper():
+    """close() -> _stop() -> join: reachability is the in-class call
+    closure, not a literal scan of close()'s own body."""
+    code = _LEAKY_THREAD + (
+        "\n    def close(self):\n"
+        "        self._stop()\n"
+        "\n    def _stop(self):\n"
+        "        self._t.join(timeout=1.0)\n")
+    assert lint("rtap_tpu/obs/_fx.py", code,
+                ["resource-lifecycle"]).findings == []
+
+
+def test_lifecycle_socket_and_scope():
+    sock = ("import socket\n\n"
+            "class S:\n"
+            "    def connect(self, addr):\n"
+            "        self._sock = socket.create_connection(addr)\n")
+    r = lint("rtap_tpu/ingest/_fx.py", sock, ["resource-lifecycle"])
+    assert [f.symbol for f in r.findings] == ["S._sock"]
+    closed = sock + ("\n    def close(self):\n"
+                     "        self._sock.close()\n")
+    assert lint("rtap_tpu/ingest/_fx.py", closed,
+                ["resource-lifecycle"]).findings == []
+    # outside the serve stack: not gated
+    assert lint("rtap_tpu/models/_fx.py", sock,
+                ["resource-lifecycle"]).findings == []
+
+
+def test_lifecycle_join_timeout_none_is_unbounded():
+    """join(timeout=None) / join(None) are the UNbounded spellings —
+    the keyword's mere presence must not count as bounded."""
+    kw_none = _LEAKY_THREAD + (
+        "\n    def close(self):\n"
+        "        self._t.join(timeout=None)\n")
+    r = lint("rtap_tpu/obs/_fx.py", kw_none, ["resource-lifecycle"])
+    assert [f.symbol for f in r.findings] == ["R._t:unbounded-join"]
+    pos_none = _LEAKY_THREAD + (
+        "\n    def close(self):\n"
+        "        self._t.join(None)\n")
+    r2 = lint("rtap_tpu/obs/_fx.py", pos_none, ["resource-lifecycle"])
+    assert [f.symbol for f in r2.findings] == ["R._t:unbounded-join"]
+
+
+def test_lifecycle_covers_nested_handler_classes():
+    """A class nested inside a method (the request-handler idiom) owns
+    per-connection resources too — top-level-only scanning would
+    exempt exactly the BinaryBatchSource leak class."""
+    code = """
+import socket
+
+class Outer:
+    def build(self):
+        class Handler:
+            def setup(self):
+                self._peer = socket.create_connection(("h", 1))
+        return Handler
+"""
+    r = lint("rtap_tpu/ingest/_fx.py", code, ["resource-lifecycle"])
+    assert [f.symbol for f in r.findings] == ["Handler._peer"]
+
+
+def test_lifecycle_suppression_comment():
+    supp = _LEAKY_THREAD.replace(
+        "        self._t = threading.Thread(target=self._run, "
+        'name="rtap-x",\n',
+        "        # rtap: allow[resource-lifecycle] — fixture daemon\n"
+        "        self._t = threading.Thread(target=self._run, "
+        'name="rtap-x",\n')
+    r = lint("rtap_tpu/obs/_fx.py", supp, ["resource-lifecycle"])
+    assert r.findings == [] and len(r.suppressed) == 1

@@ -57,6 +57,35 @@ class TestTopologyMap:
         b = TopologyMap.from_spec(spec2)
         assert a.cluster_of("web-00.cpu") == b.cluster_of("web-00.cpu") == "db"
 
+    def test_cluster_keys_deterministic_across_hash_seeds(self):
+        """ISSUE 13 replay-determinism pin: component keys must be
+        byte-identical across PROCESSES, not just within one — CPython
+        randomizes str hashes per process, so any surviving unsorted
+        set iteration in _rebuild_components would diverge here."""
+        import os
+        import subprocess
+        import sys
+
+        prog = (
+            "import json\n"
+            "from rtap_tpu.correlate import TopologyMap\n"
+            "spec = {'services': {chr(97 + i) * 3: ['n%d' % i]\n"
+            "                     for i in range(12)},\n"
+            "        'links': [[chr(97 + i) * 3, chr(98 + i) * 3]\n"
+            "                  for i in range(0, 10, 2)]}\n"
+            "t = TopologyMap.from_spec(spec)\n"
+            "print(json.dumps(t._component, sort_keys=True))\n")
+        outs = set()
+        for seed in ("0", "1"):
+            env = dict(os.environ, PYTHONHASHSEED=seed,
+                       JAX_PLATFORMS="cpu")
+            p = subprocess.run([sys.executable, "-c", prog], env=env,
+                               capture_output=True, text=True,
+                               timeout=120)
+            assert p.returncode == 0, p.stderr
+            outs.add(p.stdout.strip())
+        assert len(outs) == 1, f"component map diverged: {outs}"
+
     def test_spec_accepts_json_string_and_rejects_bad_shapes(self):
         topo = TopologyMap.from_spec(json.dumps(SPEC))
         assert topo.cluster_of("db-00.x") == "db"

@@ -174,3 +174,42 @@ def test_validate_bundle_rejects_garbage(tmp_path):
     assert not v["ok"]
     assert any("summary.json" in p for p in v["problems"])
     assert any("no spans" in p for p in v["problems"])
+
+
+@pytest.mark.quick
+def test_dump_concurrent_with_event_appends(tmp_path):
+    """ISSUE 13 cross-share regression: the dump path must materialize
+    the event deque (one C-level list() copy) before iterating —
+    iterating the LIVE deque while the loop thread appends raises
+    RuntimeError mid-dump and kills the postmortem it was writing. A
+    tiny GIL switch interval makes the pre-fix race land reliably."""
+    import sys
+    import threading
+
+    fl = FlightRecorder(n_ticks=16, out_dir=str(tmp_path),
+                        registry=TelemetryRegistry(), n_events=4096,
+                        min_dump_gap_ticks=0, max_bundles=10_000)
+    _fill(fl, 4)
+    for k in range(2000):  # pre-load so every dump iterates a long ring
+        fl.record_event({"event": f"k{k % 17}", "n": k})
+    stop = threading.Event()
+
+    def _writer():
+        k = 0
+        while not stop.is_set():
+            fl.record_event({"event": f"w{k % 13}", "n": k})
+            k += 1
+
+    t = threading.Thread(target=_writer, name="rtap-test-eventwriter",
+                         daemon=True)
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    try:
+        t.start()
+        for i in range(30):
+            assert fl.dump("concurrency", i) is not None
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+        sys.setswitchinterval(old)
+    assert len(fl.bundles) == 30

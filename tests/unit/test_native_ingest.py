@@ -437,3 +437,13 @@ def test_python_fallback_parse_error_count_is_exact_under_concurrency():
             assert src.records_parsed == 0
     finally:
         sys.setswitchinterval(old_interval)
+
+
+def test_tcp_source_close_joins_accept_thread():
+    """ISSUE 13 resource-lifecycle regression: close() must join the
+    accept thread (bounded) — before the fix the Thread object outlived
+    close(), which the conftest leak fixture only caught when a test
+    happened to observe the window."""
+    src = TcpJsonlSource(["s0"], port=0).start()
+    src.close()
+    assert not src._thread.is_alive()
