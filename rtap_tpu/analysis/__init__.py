@@ -1,13 +1,17 @@
-"""rtap-lint: AST-based invariant analysis for the serve stack
-(ISSUEs 12 + 13).
+"""rtap-lint: AST-based invariant analysis for the serve stack AND the
+device-kernel surface (ISSUEs 12 + 13 + 14).
 
 The repo's correctness story rests on contracts no test fully covers —
 bit-exact device/oracle twins, exactly-once alert delivery, and a lock
 discipline across ~10 daemon-threaded modules. Three review passes
 found the same latent-bug classes by hand; this package machine-checks
-them. v1 (ISSUE 12) was per-class/intra-module; v2 (ISSUE 13) adds
+them. v1 (ISSUE 12) was per-class/intra-module; v2 (ISSUE 13) added
 whole-program passes over the shared model in
-``rtap_tpu/analysis/program.py``:
+``rtap_tpu/analysis/program.py``; v3 (ISSUE 14) crosses the
+host/device boundary with a kernel model
+(``rtap_tpu/analysis/kernels.py``: jit-wrapper discovery with
+static/donate extraction, the ops/ ↔ oracle/ twin registry) feeding
+six device passes:
 
 ==================  ====================================================
 pass (module)       rules
@@ -38,17 +42,35 @@ determinism         ``replay-determinism`` (unsorted set/listdir
 lifecycle           ``resource-lifecycle`` (class-owned threads/sockets/
                     shm/files with no reachable bounded-join/close on
                     the teardown path)
+twinparity          ``twin-parity`` (every public ops/ kernel resolves
+                    to an oracle twin with a compatible signature AND
+                    appears in a tests/parity/ file)
+tracesafety         ``trace-safety`` (no data-dependent Python control
+                    flow, py-casts, host calls, or value-dependent
+                    output shapes inside traced kernels)
+donation            ``donate-read`` (no read of a jit-donated buffer
+                    after the donating dispatch)
+statichash          ``static-hash``, ``jit-churn`` (hashable/frozen
+                    static args naming live params; no jax.jit built
+                    inside loops or over lambdas)
+dtypedomain         ``dtype-domain`` (declared u8|u16|i32-key domains:
+                    no silent cross-grid mixes, unclamped i32-key
+                    multiplies, or undeclared quantized casts)
+wirecontract        ``wire-contract`` (RB1/RJ struct formats, magics,
+                    and type codes cross-checked against the wire docs)
 ==================  ====================================================
 
 CLI: ``python -m rtap_tpu.analysis`` (human report, exit 0 iff zero
 unsuppressed findings; ``--json`` emits one artifact line for soaks,
-``--sarif PATH`` writes a SARIF 2.1.0 log for CI/editor rendering).
-Incremental runs are served from a per-file content-hash findings cache
-(``--no-cache`` forces a cold run; cached and cold runs are
-finding-identical by test). ``scripts/check_static.sh`` is a thin
-wrapper (compileall + one analyzer invocation) and rides tier-1 via
-tests/unit/test_static_checks.py. Suppression/baseline syntax and the
-triage runbook: docs/ANALYSIS.md.
+``--sarif PATH`` writes a SARIF 2.1.0 log for CI/editor rendering,
+``--update-baseline`` does mechanical baseline maintenance without
+ever minting a why-less entry). Incremental runs are served from the
+pass-partitioned content-hash findings cache (``--no-cache`` forces a
+cold run; cold/warm/hit runs are finding-identical by test).
+``scripts/check_static.sh`` is a thin wrapper (compileall + one
+analyzer invocation) and rides tier-1 via
+tests/unit/test_static_checks.py. Suppression/annotation/baseline
+syntax and the triage runbook: docs/ANALYSIS.md.
 """
 
 from __future__ import annotations
@@ -56,6 +78,8 @@ from __future__ import annotations
 from rtap_tpu.analysis import (
     crossshare,
     determinism,
+    donation,
+    dtypedomain,
     excepts,
     flags,
     lifecycle,
@@ -63,6 +87,10 @@ from rtap_tpu.analysis import (
     prints,
     purity,
     races,
+    statichash,
+    tracesafety,
+    twinparity,
+    wirecontract,
 )
 from rtap_tpu.analysis.core import (  # noqa: F401
     AnalysisContext,
@@ -74,10 +102,17 @@ from rtap_tpu.analysis.core import (  # noqa: F401
 )
 
 #: execution order: cheap syntactic passes first, then the
-#: interprocedural per-class pass, then the whole-program v2 passes
-#: (ordering is cosmetic — every pass always runs)
+#: interprocedural per-class pass, then the whole-program v2 passes,
+#: then the device-kernel v3 family (ordering is cosmetic — every pass
+#: always runs). Each pass declares PARTITION = "file" (findings
+#: depend only on one file's bytes — eligible for warm-cache per-file
+#: reuse) or "program" (cross-file inputs — all-or-nothing). NB: the
+#: name SCOPE is already taken in several pass modules for their
+#: path-prefix tuples — core.py reads PARTITION, nothing else.
 PASSES = (prints, excepts, flags, purity, races,
-          determinism, lifecycle, lockorder, crossshare)
+          determinism, lifecycle, lockorder, crossshare,
+          tracesafety, statichash, dtypedomain,
+          twinparity, donation, wirecontract)
 
 #: rule id -> description, across every pass (the CLI's --list-passes)
 ALL_RULES = {rid: desc for mod in PASSES for rid, desc in mod.RULES.items()}

@@ -70,6 +70,12 @@ def main(argv: list[str] | None = None) -> int:
                          "<root>/.rtap_lint_cache.json)")
     ap.add_argument("--list-passes", action="store_true",
                     help="list rule ids + descriptions and exit")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="mechanical baseline maintenance: re-key moved "
+                         "symbols (whys preserved verbatim), drop stale "
+                         "entries; REFUSES to mint entries for new "
+                         "findings (a why-less entry is a gate failure "
+                         "by design)")
     args = ap.parse_args(argv)
 
     if args.list_passes:
@@ -91,6 +97,28 @@ def main(argv: list[str] | None = None) -> int:
                   f"(known: {sorted(ALL_RULES)})", file=sys.stderr)
             return 2
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.update_baseline:
+        from rtap_tpu.analysis.baseline_update import update_baseline
+
+        summary = update_baseline(root, baseline_path=baseline_path)
+        for old, new in summary["rekeyed"]:
+            print(f"rekeyed: {':'.join(old)} -> {':'.join(new)}",
+                  file=sys.stderr)
+        for key in summary["dropped"]:
+            print(f"dropped stale: {':'.join(key)}", file=sys.stderr)
+        for key in summary["unmatched"]:
+            print(f"NOT baselined (write the why yourself): "
+                  f"{':'.join(key)}", file=sys.stderr)
+        for e in summary["format_errors"]:
+            print(f"left malformed entry for a human: {e}",
+                  file=sys.stderr)
+        print(f"--update-baseline: {len(summary['rekeyed'])} rekeyed, "
+              f"{len(summary['dropped'])} dropped, "
+              f"{len(summary['unmatched'])} refused, "
+              f"{'wrote' if summary['wrote'] else 'no change to'} "
+              f"{baseline_path}", file=sys.stderr)
+        return 1 if summary["unmatched"] or summary["format_errors"] \
+            else 0
     if rules is None and not args.no_cache:
         report = run_analysis_cached(root, baseline_path=baseline_path,
                                      cache_path=args.cache_path)

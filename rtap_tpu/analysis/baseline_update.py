@@ -1,0 +1,153 @@
+"""``--update-baseline``: mechanical baseline maintenance, zero new whys.
+
+The baseline (``analysis_baseline.json``) is justification storage —
+every entry carries a reviewed ``why``. Its maintenance chores are
+mechanical, though, and doing them by hand invites exactly the errors
+the file exists to prevent:
+
+* a symbol MOVED (file rename, class rename) leaves a stale entry plus
+  a new finding — the why is still valid, only the key changed;
+* a fixed finding leaves a stale entry that should be deleted;
+* a genuinely new finding must NOT be baselined mechanically — a
+  why-less entry is a gate failure by design, and this tool refuses to
+  mint one.
+
+:func:`update_baseline` runs a full cold analysis and rewrites the
+file: stale entries whose ``(rule, symbol)`` reappears under exactly
+one new path (or whose ``(rule, path)`` reappears under exactly one
+new symbol) are RE-KEYED in place, keeping their why verbatim;
+remaining stale entries are dropped; remaining unmatched findings are
+reported and left failing (write a why by hand — inline suppression or
+baseline entry — or fix the code). Ambiguous moves (two candidates)
+are left alone rather than guessed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from rtap_tpu.analysis.core import (
+    BASELINE_NAME,
+    Baseline,
+    Finding,
+    run_analysis,
+)
+
+__all__ = ["update_baseline"]
+
+
+def _symbol_tail(symbol: str) -> str | None:
+    """The rename-stable part of a symbol: ``f:except Exception`` ->
+    ``except Exception``, ``Racy.n`` -> ``n``; None when the symbol has
+    no separator (nothing survives a rename, so nothing to match on)."""
+    for sep in (":", "."):
+        if sep in symbol:
+            return symbol.split(sep, 1)[1]
+    return None
+
+
+def _rekey(stale: list[dict], findings: list[Finding],
+           existing_paths: set[str]) -> tuple[
+        list[tuple[dict, Finding]], list[dict], list[Finding]]:
+    """Match stale entries to new findings, conservatively:
+
+    * round 1 — file move: identical (rule, symbol) under a new path,
+      and ONLY when the entry's old path no longer exists in the tree
+      (if the old file is still there, the same-named finding
+      elsewhere is more likely a new, unrelated site than a move);
+    * round 2 — container rename: same (rule, path), same symbol TAIL
+      (``f:except Exception`` → ``g:except Exception``), unique on
+      both sides.
+
+    Every surviving ambiguity is refused, not guessed — and re-keys
+    are printed by the CLI and land in the committed baseline's diff,
+    so a reviewer sees exactly which why moved where.
+    -> (moves, leftover_stale, leftover_findings)."""
+    moves: list[tuple[dict, Finding]] = []
+    stale = list(stale)
+    findings = list(findings)
+
+    def match_round(keyer, eligible):
+        nonlocal stale
+        by_key: dict[tuple, list[Finding]] = {}
+        for f in findings:
+            k = keyer(f.rule, f.path, f.symbol)
+            if k is not None:
+                by_key.setdefault(k, []).append(f)
+        still_stale = []
+        for e in stale:
+            k = keyer(e["rule"], e["path"], e["symbol"]) \
+                if eligible(e) else None
+            cands = by_key.get(k, []) if k is not None else []
+            if len(cands) == 1 and cands[0] in findings:
+                moves.append((e, cands[0]))
+                findings.remove(cands[0])
+            else:
+                still_stale.append(e)
+        stale = still_stale
+
+    match_round(lambda rule, path, symbol: (rule, symbol),
+                eligible=lambda e: e["path"] not in existing_paths)
+    match_round(lambda rule, path, symbol:
+                (rule, path, _symbol_tail(symbol))
+                if _symbol_tail(symbol) is not None else None,
+                eligible=lambda e: True)
+    return moves, stale, findings
+
+
+def update_baseline(root: str, baseline_path: str | None = None) -> dict:
+    """Rewrite the baseline against a fresh cold run. Returns a summary
+    dict: ``rekeyed`` [(old_key, new_key)], ``dropped`` [keys],
+    ``unmatched`` [keys] (new findings this tool REFUSED to baseline),
+    ``format_errors`` (why-less/malformed entries, left untouched for a
+    human), and ``wrote`` (whether the file changed)."""
+    baseline_path = baseline_path or os.path.join(root, BASELINE_NAME)
+    baseline = Baseline.load(baseline_path)
+    from rtap_tpu.analysis.core import AnalysisContext, discover_files
+
+    files = discover_files(root)
+    ctx = AnalysisContext(root=root, files=files)
+    report = run_analysis(root, baseline=baseline, ctx=ctx)
+
+    moves, leftover_stale, leftover_findings = _rekey(
+        report.stale_baseline, report.findings,
+        existing_paths={f.path for f in files})
+
+    entries = list(baseline.entries)
+    key_of = {id(e): (e.get("rule"), e.get("path"), e.get("symbol"))
+              for e in entries}
+    rekeyed, dropped = [], []
+    drop_ids = set()
+    for e, f in moves:
+        old = key_of[id(e)]
+        e["path"], e["symbol"] = f.path, f.symbol
+        rekeyed.append((old, f.key()))
+    for e in leftover_stale:
+        drop_ids.add(id(e))
+        dropped.append(key_of[id(e)])
+    new_entries = [e for e in entries if id(e) not in drop_ids]
+
+    wrote = bool(rekeyed or dropped)
+    if wrote:
+        try:
+            with open(baseline_path, encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            data = {}
+        if not isinstance(data, dict):
+            data = {}
+        data["entries"] = new_entries
+        tmp = f"{baseline_path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, baseline_path)
+
+    return {
+        "rekeyed": rekeyed,
+        "dropped": dropped,
+        "unmatched": [f.key() for f in leftover_findings],
+        "format_errors": list(baseline.format_errors),
+        "wrote": wrote,
+    }

@@ -58,16 +58,19 @@ _SUPPRESS_RE = re.compile(r"#\s*rtap:\s*allow\[([A-Za-z0-9_,\s-]+)\]")
 #: default baseline filename at the analysis root
 BASELINE_NAME = "analysis_baseline.json"
 
-#: the --json artifact's schema version (ISSUE 13). Bump on any shape
-#: change to the artifact dict — soaks/hw_session archive these lines
-#: across months and the reader must be able to dispatch on shape.
-SCHEMA_VERSION = 2
+#: the --json artifact's schema version. Bump on any shape change to
+#: the artifact dict — soaks/hw_session archive these lines across
+#: months and the reader must be able to dispatch on shape. v3
+#: (ISSUE 14): cache gains the "warm" mode (pass-partitioned partial
+#: reuse) and per_pass covers the device-kernel pass family.
+SCHEMA_VERSION = 3
 
 #: default findings-cache filename at the analysis root (gitignored)
 CACHE_NAME = ".rtap_lint_cache.json"
 
 #: bump to orphan every existing cache when the cache format changes
-_CACHE_FORMAT = 1
+#: (2: ISSUE 14 — per-file pass partition section added)
+_CACHE_FORMAT = 2
 
 #: gate-critical rules that neither inline suppressions nor the baseline
 #: may silence — the print gate is plumbing other gates stand on, and a
@@ -114,25 +117,33 @@ class SourceFile:
         except SyntaxError as e:
             self.tree = None
             self.parse_error = f"{type(e).__name__}: {e}"
-        # line -> set of rule ids suppressed there (comments live outside
-        # the AST: tokenize finds them, including trailing ones)
-        self.suppressions: dict[int, set[str]] = {}
-        if self.parse_error is None:
-            try:
-                for tok in tokenize.generate_tokens(
-                        io.StringIO(text).readline):
-                    if tok.type != tokenize.COMMENT:
-                        continue
-                    m = _SUPPRESS_RE.search(tok.string)
-                    if m is None:
-                        continue
-                    rules = {r.strip() for r in m.group(1).split(",")
-                             if r.strip()}
-                    self.suppressions.setdefault(
-                        tok.start[0], set()).update(rules)
-            except tokenize.TokenError:
-                pass  # ast accepted it; worst case this file's
-                # suppression comments are not honored (fails loud)
+        self._suppressions: dict[int, set[str]] | None = None
+
+    @property
+    def suppressions(self) -> dict[int, set[str]]:
+        """line -> rule ids suppressed there. Comments live outside the
+        AST, so tokenize finds them (including trailing ones) — LAZILY:
+        only files that actually have findings pay the tokenize pass
+        (~half the parse cost fleet-wide, and most files have none)."""
+        if self._suppressions is None:
+            self._suppressions = {}
+            if self.parse_error is None and "rtap:" in self.text:
+                try:
+                    for tok in tokenize.generate_tokens(
+                            io.StringIO(self.text).readline):
+                        if tok.type != tokenize.COMMENT:
+                            continue
+                        m = _SUPPRESS_RE.search(tok.string)
+                        if m is None:
+                            continue
+                        rules = {r.strip() for r in m.group(1).split(",")
+                                 if r.strip()}
+                        self._suppressions.setdefault(
+                            tok.start[0], set()).update(rules)
+                except tokenize.TokenError:
+                    pass  # ast accepted it; worst case this file's
+                    # suppression comments are not honored (fails loud)
+        return self._suppressions
 
     def suppressed(self, rule: str, line: int) -> bool:
         """A finding is suppressed by a comment on its line or on the
@@ -152,6 +163,10 @@ class AnalysisContext:
     #: README + docs/**.md concatenated (flag↔docs pass); lazily loaded,
     #: overridable by tests
     docs_text: str | None = None
+    #: tests/parity/**.py concatenated (twin-parity pass — deleting a
+    #: parity test must re-fail the gate, so the parity tree is an
+    #: analyzer INPUT and rides the cache key like the docs text)
+    parity_text: str | None = None
 
     def files_under(self, *prefixes: str) -> list[SourceFile]:
         return [f for f in self.files
@@ -170,6 +185,13 @@ class AnalysisContext:
         if self.docs_text is None:
             self.docs_text = _docs_text(self.root)
         return self.docs_text
+
+    def parity(self) -> str:
+        # same single-loader discipline as docs(): the twin-parity pass
+        # must see exactly the text the cache key hashed
+        if self.parity_text is None:
+            self.parity_text = _parity_text(self.root)
+        return self.parity_text
 
 
 class Baseline:
@@ -273,8 +295,9 @@ class Report:
     elapsed_s: float = 0.0
     files_scanned: int = 0
     #: "cold" (full run, cache written), "hit" (replayed from the
-    #: content-hash cache), "off" (cache not engaged: fixtures, --rules
-    #: subsets, --no-cache)
+    #: content-hash cache), "warm" (per-file passes reused for the
+    #: unchanged files, whole-program passes re-run — ISSUE 14), "off"
+    #: (cache not engaged: fixtures, --rules subsets, --no-cache)
     cache_mode: str = "off"
 
     @property
@@ -301,16 +324,23 @@ class Report:
 
 
 # --------------------------------------------------------------- cache --
-# The per-file content-hash findings cache (ISSUE 13). Whole-program
-# passes (lock-order, cross-share) make per-file findings REUSE unsound
-# — one edited file can add or remove a deadlock edge whose finding
-# anchors in another file — so the cache replays the full classified
-# report if and only if EVERY input is byte-identical: the per-file
-# content hashes (any edit, add, or delete misses), the docs text
-# (flag-docs input), the baseline file, and the analyzer's own sources.
-# A hit skips all parsing and every pass: incremental runs are
-# sub-second while a cold run stays bit-identical (both pinned by
-# tests/unit/test_static_checks.py).
+# The findings cache, pass-PARTITIONED since ISSUE 14. Whole-program
+# passes (lock-order, cross-share, twin-parity, donation,
+# wire-contract) make per-file findings reuse unsound for THEM — one
+# edited file can add or remove an edge whose finding anchors in
+# another file — so they stay all-or-nothing. Per-file passes
+# (PARTITION = "file": races, purity, excepts, determinism, lifecycle,
+# trace-safety, static-hash, dtype-domain) produce findings that
+# depend only on one file's bytes, so the cache additionally stores
+# their raw findings PER FILE and replays them for every unchanged
+# file while only the edited files re-run — the "warm" mode that keeps
+# incremental runs ~2 s with the full pass family live. The exact-hit
+# fast path is unchanged: when EVERY input is byte-identical (file
+# hashes, docs text, parity-test text, baseline, analyzer sources) the
+# classified report replays with no parsing at all. Classification
+# (suppressions/baseline) is always re-derived from raw findings — a
+# baseline edit must never be served a stale verdict. All three modes
+# are finding-identical by test (tests/unit/test_static_checks.py).
 
 def _sha(text: str) -> str:
     return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:20]
@@ -345,7 +375,22 @@ def _docs_text(root: str) -> str:
     return "\n".join(chunks)
 
 
-def _cache_key(texts: list[tuple[str, str]], docs: str,
+def _parity_text(root: str) -> str:
+    """tests/parity/**.py concatenated — the twin-parity pass's
+    test-coverage evidence (and a cache-key input for the same reason
+    the docs text is)."""
+    chunks = []
+    pdir = os.path.join(root, "tests", "parity")
+    if os.path.isdir(pdir):
+        for fn in sorted(os.listdir(pdir)):
+            if fn.endswith(".py"):
+                with open(os.path.join(pdir, fn),
+                          encoding="utf-8") as fh:
+                    chunks.append(fh.read())
+    return "\n".join(chunks)
+
+
+def _cache_key(texts: list[tuple[str, str]], docs: str, parity: str,
                baseline_path: str) -> dict:
     try:
         with open(baseline_path, encoding="utf-8") as fh:
@@ -357,6 +402,7 @@ def _cache_key(texts: list[tuple[str, str]], docs: str,
         "analyzer": _analyzer_fingerprint(),
         "files": {p: _sha(t) for p, t in texts},
         "docs": _sha(docs),
+        "parity": _sha(parity),
         "baseline": baseline_hash,
     }
 
@@ -388,16 +434,26 @@ def _report_from_cache(data: dict, elapsed_s: float) -> Report:
 
 def run_analysis_cached(root: str, baseline_path: str | None = None,
                         cache_path: str | None = None) -> Report:
-    """The CLI's full-run entry point: replay the findings cache when
-    every content hash matches, otherwise run cold and rewrite it.
+    """The CLI's full-run entry point. Three speeds:
+
+    * **hit** — every input byte-identical: replay the classified
+      report, no parsing at all;
+    * **warm** — same analyzer, some files changed: per-file passes
+      re-run only on the changed files (cached raw findings replayed
+      for the rest), whole-program passes re-run in full;
+    * **cold** — no usable cache (format/analyzer change, first run).
+
     ``--rules`` subsets and fixture contexts never come through here —
     the cache only ever holds full-tree reports."""
+    from rtap_tpu.analysis import PASSES
+
     t0 = time.perf_counter()
     baseline_path = baseline_path or os.path.join(root, BASELINE_NAME)
     cache_path = cache_path or os.path.join(root, CACHE_NAME)
     texts = discover_texts(root)
     docs = _docs_text(root)
-    key = _cache_key(texts, docs, baseline_path)
+    parity = _parity_text(root)
+    key = _cache_key(texts, docs, parity, baseline_path)
     try:
         with open(cache_path, encoding="utf-8") as fh:
             cached = json.load(fh)
@@ -406,16 +462,61 @@ def run_analysis_cached(root: str, baseline_path: str | None = None,
     if isinstance(cached, dict) and cached.get("key") == key:
         return _report_from_cache(
             cached["report"], time.perf_counter() - t0)
+
+    # ---- partial (warm) reuse: unchanged files keep their per-file-
+    # pass raw findings; only edited files pay the per-file passes
+    reuse: dict[str, dict] = {}
+    if isinstance(cached, dict) and isinstance(cached.get("key"), dict) \
+            and cached["key"].get("format") == _CACHE_FORMAT \
+            and cached["key"].get("analyzer") == key["analyzer"] \
+            and isinstance(cached.get("perfile"), dict):
+        old_hashes = cached["key"].get("files", {})
+        for p, h in key["files"].items():
+            if old_hashes.get(p) == h and p in cached["perfile"]:
+                reuse[p] = cached["perfile"][p]
+
     files = [SourceFile(p, t) for p, t in texts]
-    ctx = AnalysisContext(root=root, files=files, docs_text=docs)
-    report = run_analysis(root, baseline=Baseline.load(baseline_path),
-                          ctx=ctx)
-    report.cache_mode = "cold"
+    ctx = AnalysisContext(root=root, files=files, docs_text=docs,
+                          parity_text=parity)
+    baseline = Baseline.load(baseline_path)
+    file_passes = [m for m in PASSES
+                   if getattr(m, "PARTITION", "program") == "file"]
+    program_passes = [m for m in PASSES if m not in file_passes]
+
+    raw: list[Finding] = []
+    per_pass: dict[str, int] = {m.PASS_NAME: 0 for m in PASSES}
+    pass_of = {rid: m.PASS_NAME for m in file_passes for rid in m.RULES}
+    perfile: dict[str, dict] = {}
+    changed = [f for f in files if f.path not in reuse]
+    sub = AnalysisContext(root=root, files=changed, docs_text=docs,
+                          parity_text=parity)
+    fresh_raw, fresh_counts = _run_passes(sub, file_passes)
+    for p, n in fresh_counts.items():
+        per_pass[p] += n
+    for f in changed:
+        perfile[f.path] = {}
+    for fi in fresh_raw:
+        perfile.setdefault(fi.path, {}).setdefault(
+            pass_of.get(fi.rule, fi.rule), []).append(fi.to_dict())
+        raw.append(fi)
+    for path, bucket in reuse.items():
+        perfile[path] = bucket
+        for pname, dicts in bucket.items():
+            per_pass[pname] = per_pass.get(pname, 0) + len(dicts)
+            raw.extend(Finding(**d) for d in dicts)
+    prog_raw, prog_counts = _run_passes(ctx, program_passes)
+    per_pass.update(prog_counts)
+    raw.extend(prog_raw)
+
+    report = _classify(raw, ctx, baseline, rules=None,
+                       per_pass=per_pass)
+    report.elapsed_s = time.perf_counter() - t0
+    report.cache_mode = "warm" if reuse else "cold"
     tmp = f"{cache_path}.tmp-{os.getpid()}"
     try:
         with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump({"key": key, "report": _report_to_cache(report)},
-                      fh)
+            json.dump({"key": key, "report": _report_to_cache(report),
+                       "perfile": perfile}, fh)
         os.replace(tmp, cache_path)
     except OSError:
         # an unwritable cache (read-only checkout) costs the NEXT run
@@ -425,6 +526,59 @@ def run_analysis_cached(root: str, baseline_path: str | None = None,
         except OSError:
             pass
     return report
+
+
+def _run_passes(ctx: AnalysisContext, passes) -> tuple[list[Finding],
+                                                       dict[str, int]]:
+    raw: list[Finding] = []
+    per_pass: dict[str, int] = {}
+    for mod in passes:
+        found = mod.run(ctx)
+        per_pass[mod.PASS_NAME] = len(found)
+        raw.extend(found)
+    return raw, per_pass
+
+
+def _classify(raw: list[Finding], ctx: AnalysisContext,
+              baseline: Baseline, rules: set[str] | None,
+              per_pass: dict[str, int]) -> Report:
+    """Suppression/baseline classification over raw findings (always
+    re-derived — cached raw findings must never carry a stale
+    verdict). Parse errors are appended here: a file that does not
+    parse is a finding too (the analyzer must degrade loudly, not
+    crash or silently skip)."""
+    raw = list(raw)
+    for f in ctx.files:
+        if f.parse_error is not None:
+            raw.append(Finding(
+                rule="parse-error", path=f.path, line=1,
+                symbol="module", message=f.parse_error))
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    baselined: list[Finding] = []
+    for fi in raw:
+        if rules is not None and fi.rule not in rules:
+            continue
+        sf = ctx.file(fi.path)
+        if fi.rule in NON_SUPPRESSIBLE:
+            findings.append(fi)
+        elif sf is not None and sf.suppressed(fi.rule, fi.line):
+            suppressed.append(fi)
+        elif baseline.matches(fi):
+            baselined.append(fi)
+        else:
+            findings.append(fi)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.rule))
+    baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+    # staleness is only judgeable on a FULL run: a --rules subset never
+    # consults the baseline for the unselected rules, and reporting
+    # their (valid) entries as stale would advise deleting them
+    return Report(
+        findings=findings, suppressed=suppressed, baselined=baselined,
+        stale_baseline=baseline.stale_entries() if rules is None else [],
+        baseline_errors=list(baseline.format_errors),
+        per_pass=per_pass, files_scanned=len(ctx.files))
 
 
 def run_analysis(root: str, files: list[SourceFile] | None = None,
@@ -443,46 +597,10 @@ def run_analysis(root: str, files: list[SourceFile] | None = None,
         ctx = AnalysisContext(root=root, files=files)
     if baseline is None:
         baseline = Baseline.load(os.path.join(root, BASELINE_NAME))
-
-    raw: list[Finding] = []
-    per_pass: dict[str, int] = {}
-    for mod in PASSES:
-        found = mod.run(ctx)
-        per_pass[mod.PASS_NAME] = len(found)
-        raw.extend(found)
-    # a file that does not parse is a finding too (the analyzer must
-    # degrade loudly, not crash or silently skip)
-    for f in ctx.files:
-        if f.parse_error is not None:
-            raw.append(Finding(
-                rule="parse-error", path=f.path, line=1,
-                symbol="module", message=f.parse_error))
-
-    findings: list[Finding] = []
-    suppressed: list[Finding] = []
-    baselined: list[Finding] = []
-    for fi in raw:
-        if rules is not None and fi.rule not in rules:
-            continue
-        sf = ctx.file(fi.path)
-        if fi.rule in NON_SUPPRESSIBLE:
-            findings.append(fi)
-        elif sf is not None and sf.suppressed(fi.rule, fi.line):
-            suppressed.append(fi)
-        elif baseline.matches(fi):
-            baselined.append(fi)
-        else:
-            findings.append(fi)
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    # staleness is only judgeable on a FULL run: a --rules subset never
-    # consults the baseline for the unselected rules, and reporting
-    # their (valid) entries as stale would advise deleting them
-    return Report(
-        findings=findings, suppressed=suppressed, baselined=baselined,
-        stale_baseline=baseline.stale_entries() if rules is None else [],
-        baseline_errors=list(baseline.format_errors),
-        per_pass=per_pass, elapsed_s=time.perf_counter() - t0,
-        files_scanned=len(ctx.files))
+    raw, per_pass = _run_passes(ctx, PASSES)
+    report = _classify(raw, ctx, baseline, rules, per_pass)
+    report.elapsed_s = time.perf_counter() - t0
+    return report
 
 
 def render_human(report: Report) -> str:

@@ -53,6 +53,8 @@ from rtap_tpu.analysis.races import (
 )
 
 PASS_NAME = "cross-share"
+#: cross-file inputs -> all-or-nothing in the findings cache
+PARTITION = "program"
 RULES = {
     "cross-share": "object shared between a thread-running class and "
                    "another consumer has an attribute mutated in place "

@@ -40,6 +40,9 @@ from rtap_tpu.analysis.core import AnalysisContext, Finding
 from rtap_tpu.analysis.program import _functions, dotted as _dotted
 
 PASS_NAME = "replay-determinism"
+#: findings depend only on one file's bytes -> the warm
+#: cache may replay them per file (core.py partition contract)
+PARTITION = "file"
 RULES = {
     "replay-determinism": "iteration-order-dependent output in a "
                           "serialization/hashing path (unsorted set or "

@@ -1,0 +1,188 @@
+"""Donation discipline: a donated buffer is dead after the dispatch.
+
+Rule ``donate-read`` — ``donate_argnums`` is the memory lever that
+makes 100k-stream state updates in-place (ops/step.py: "the TM pools
+dominate HBM and the update must happen in place"), and it carries the
+nastiest failure mode in the stack: reading the donated binding after
+the call returns garbage ON TPU while working perfectly on CPU —
+exactly the class tier-1 (CPU-only) can never catch, which is why it
+must be a static gate.
+
+The pass takes the jit-wrapper registry from the kernel model
+(analysis/kernels.py — every ``@partial(jax.jit, donate_argnums=...)``
+in the surface) and, for every function in the program, walks its
+statements in source order: a call to a donating wrapper marks the
+argument bound to a donated position (a bare name or a dotted
+``self.state``-style chain) as DEAD; any later read of that binding
+before it is rebound is a finding. The idiomatic call shape —
+``state, out = group_step(state, ...)`` — rebinds in the same
+statement and never fires.
+
+Scope: every file in the surface (call sites live in service/, bench,
+and scripts, not in ops/). Symbol: ``<qual>:<binding>@<wrapper>`` —
+line-insensitive. Known limit (documented, deliberate): the walk is
+straight-line per function; a loop that donates late in the body and
+reads early in the next iteration needs the runtime's donation error
+to catch it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.kernels import build_kernel_model, dotted, \
+    functions_in, stmt_expr_nodes
+
+PASS_NAME = "donation"
+PARTITION = "program"
+RULES = {
+    "donate-read": "read of a jit-donated buffer after the donating "
+                   "call (garbage on TPU, works on CPU — invisible to "
+                   "tier-1)",
+}
+
+
+
+
+def _donated_args(call: ast.Call, wrapper) -> list[str]:
+    """Bindings (bare or dotted names) the call donates."""
+    out = []
+    for i in wrapper.donate_argnums:
+        if i < len(call.args):
+            d = dotted(call.args[i])
+            if d is not None:
+                out.append(d)
+    donate_names = wrapper.donate_params
+    for kw in call.keywords:
+        if kw.arg in donate_names:
+            d = dotted(kw.value)
+            if d is not None:
+                out.append(d)
+    return out
+
+
+def _store_targets(st: ast.stmt) -> set[str]:
+    """Dotted names this statement (re)binds."""
+    out: set[str] = set()
+    targets = []
+    if isinstance(st, ast.Assign):
+        targets = st.targets
+    elif isinstance(st, (ast.AnnAssign, ast.AugAssign)):
+        targets = [st.target]
+    elif isinstance(st, ast.For):
+        targets = [st.target]
+    elif isinstance(st, ast.With):
+        targets = [i.optional_vars for i in st.items
+                   if i.optional_vars is not None]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                d = dotted(n)
+                if d is not None:
+                    out.add(d)
+    return out
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    model = build_kernel_model(ctx)
+    donors = [w for w in model.wrappers if w.donate_argnums]
+    if not donors:
+        return []
+    out: list[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        # factory-local wrappers (nested defs) only match call sites in
+        # their own file — their bare name proves nothing elsewhere.
+        # Same-named donors: the one defined in THIS file wins the name
+        # (its call sites are the local one's). The substring prefilter
+        # keeps the statement walk off the ~100 files that never name a
+        # donor at all (wall-budget discipline).
+        file_donors: dict[str, object] = {}
+        for w in donors:
+            if w.nested and w.path != sf.path:
+                continue
+            if w.name not in sf.text:
+                continue
+            if w.name not in file_donors or w.path == sf.path:
+                file_donors[w.name] = w
+        if not file_donors:
+            continue
+        for qual, fn in functions_in(sf.tree):
+            #: binding -> (wrapper name, donation line)
+            dead: dict[str, tuple[str, int]] = {}
+            _walk_body(fn.body, dead, out, qual, sf, file_donors)
+    return out
+
+
+def _step_statement(st, dead, out, qual, sf, file_donors) -> None:
+    """One statement's OWN expressions (headers only for compounds):
+    reads are judged BEFORE this statement's donations are recorded,
+    so the idiomatic `state, out = f(state, ...)` never fires — while
+    a read (or re-donation) on any later line does."""
+    rebound = _store_targets(st)
+    for node in stmt_expr_nodes(st, skip_lambda=True):
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        if not isinstance(node.ctx, ast.Load):
+            continue
+        d = dotted(node)
+        if d is None or d not in dead:
+            continue
+        wname, wline = dead[d]
+        out.append(Finding(
+            rule="donate-read", path=sf.path,
+            line=node.lineno,
+            symbol=f"{qual}:{d}@{wname}",
+            message=f"`{d}` was donated to {wname}() "
+                    f"(line {wline}) and read afterwards "
+                    "— donated buffers are garbage on TPU "
+                    "after dispatch; rebind the result "
+                    "(`x, out = f(x, ...)`) or copy "
+                    "before the call"))
+        del dead[d]  # one finding per donation site
+    for d in rebound:
+        dead.pop(d, None)
+    for call in _calls_in(st, file_donors):
+        w = file_donors[dotted(call.func).rsplit(".", 1)[-1]]
+        for d in _donated_args(call, w):
+            if d not in rebound:
+                dead[d] = (w.name, call.lineno)
+
+
+def _walk_body(body, dead, out, qual, sf, file_donors) -> None:
+    """Source-order statement walk with MUST-analysis over `if`: each
+    branch runs with its own copy of the dead set and only bindings
+    dead on EVERY branch survive the join — a donation in the if-body
+    must not poison the mutually exclusive else (or the code after the
+    If, where only one branch ran). Loops/try bodies stay sequential
+    (the documented straight-line approximation)."""
+    for st in body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        _step_statement(st, dead, out, qual, sf, file_donors)
+        if isinstance(st, ast.If):
+            d_else = dict(dead)
+            _walk_body(st.body, dead, out, qual, sf, file_donors)
+            _walk_body(st.orelse, d_else, out, qual, sf, file_donors)
+            for k in list(dead):
+                if k not in d_else:
+                    del dead[k]
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            _walk_body(getattr(st, attr, []), dead, out, qual, sf,
+                       file_donors)
+        for h in getattr(st, "handlers", []):
+            _walk_body(h.body, dead, out, qual, sf, file_donors)
+
+
+def _calls_in(st: ast.stmt, donors: dict) -> list[ast.Call]:
+    out = []
+    for node in stmt_expr_nodes(st, skip_lambda=True):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d is not None and d.rsplit(".", 1)[-1] in donors:
+                out.append(node)
+    return out

@@ -34,6 +34,9 @@ import ast
 from rtap_tpu.analysis.core import AnalysisContext, Finding
 
 PASS_NAME = "excepts"
+#: findings depend only on one file's bytes -> the warm
+#: cache may replay them per file (core.py partition contract)
+PARTITION = "file"
 RULES = {
     "except-silent": "except handler in the serve stack whose body is "
                      "a bare pass (no re-raise, log, instrument bump, "

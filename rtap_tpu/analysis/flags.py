@@ -23,6 +23,8 @@ import re
 from rtap_tpu.analysis.core import AnalysisContext, Finding
 
 PASS_NAME = "flags"
+#: cross-file inputs -> all-or-nothing in the findings cache
+PARTITION = "program"
 RULES = {
     "flag-docs": "serve argparse flag absent from README.md and "
                  "docs/*.md",

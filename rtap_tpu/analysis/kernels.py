@@ -1,0 +1,384 @@
+"""The device-kernel model the v3 passes share (ISSUE 14).
+
+The v2 program model (``program.py``) understands classes, locks, and
+threads — the HOST side. The paper's bit-exactness story, though, rests
+on DEVICE-side contracts no test fully covers: every ``ops/`` kernel has
+a byte-identical oracle twin, donated buffers die at dispatch, static
+jit arguments stay hashable, and the quantized permanence domains never
+mix without a widening cast. This module builds the one model those
+passes share, once per run, memoized on the context:
+
+* **kernel discovery**: every top-level function in ``rtap_tpu/ops/``
+  with a *traced* body (``jnp``/``lax``/``pl`` usage that is a call or a
+  non-dtype attribute — ``jnp.int8`` alone is a dtype table, not a
+  trace) — public ones form the twin-parity surface;
+* **jit-wrapper extraction**: ``@jax.jit`` / ``@partial(jax.jit, ...)``
+  decorators anywhere in the analysis surface, with their
+  ``static_argnames``/``static_argnums``/``donate_argnums`` and the
+  donated *param names* resolved against the signature — the
+  donation-discipline and static-hash passes' ground truth;
+* **twin registry**: each public ops kernel resolved to its oracle twin
+  by name pairing — exact name, ``<name>_np``/``<name>_host`` host-twin
+  suffixes, a stripped ``_device`` suffix — against the oracle scope
+  (``rtap_tpu/models/`` + ``rtap_tpu/utils/hashing.py``) and same-file
+  host twins, or by an explicit annotation::
+
+      # rtap: twin[TMOracle] — megakernel twin of the default TM path
+
+  on the ``def`` (or its decorator) line. Name-paired function twins
+  must agree on positional arity (the "compatible signature" check);
+  an annotated pairing is the reviewed assertion and only has to
+  resolve.
+
+Everything is pure AST — no jax import, same discipline as the rest of
+the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from rtap_tpu.analysis.core import AnalysisContext, SourceFile
+
+__all__ = [
+    "Kernel",
+    "KernelModel",
+    "Wrapper",
+    "build_kernel_model",
+    "dotted",
+    "is_traced",
+    "own_body_nodes",
+    "stmt_expr_nodes",
+    "twin_annotation",
+]
+
+#: the twin-annotation grammar (docs/ANALYSIS.md): target is an oracle
+#: symbol (function, class, or Class.method) or a same-file host twin
+_TWIN_RE = re.compile(r"#\s*rtap:\s*twin\[([A-Za-z_][\w.]*)\]")
+
+#: files searched for oracle twins, by prefix (the host/semantic side
+#: of every device kernel lives here)
+ORACLE_SCOPE = ("rtap_tpu/models/", "rtap_tpu/utils/hashing.py")
+
+#: jnp/lax attributes that are dtype/constant tables, not traced compute
+#: — a function whose only jnp usage is ``jnp.int8`` selects a dtype,
+#: it does not trace
+_DTYPE_ATTRS = frozenset({
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bfloat16", "bool_",
+    "ndarray", "dtype", "nan", "inf", "pi", "newaxis",
+})
+
+#: names whose calls/attributes mean "this body traces"
+_TRACE_ROOTS = ("jnp", "lax", "pl", "pltpu")
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class Wrapper:
+    """One jit-wrapped function: the dispatch boundary the donation and
+    static-hash passes reason about."""
+
+    name: str
+    path: str
+    line: int
+    node: ast.FunctionDef
+    params: list[str] = field(default_factory=list)     # positional
+    kwonly: list[str] = field(default_factory=list)
+    static_argnames: set[str] = field(default_factory=set)
+    static_argnums: set[int] = field(default_factory=set)
+    donate_argnums: set[int] = field(default_factory=set)
+    #: defined inside another function (a factory-local wrapper like
+    #: _sharded_chunk_fn's `run`): its NAME is meaningless outside the
+    #: defining file, so donation call-site matching stays local
+    nested: bool = False
+
+    @property
+    def donate_params(self) -> set[str]:
+        return {self.params[i] for i in self.donate_argnums
+                if 0 <= i < len(self.params)}
+
+
+@dataclass
+class Kernel:
+    """One top-level traced function in ops/ (public ones are the
+    twin-parity surface)."""
+
+    name: str
+    path: str
+    line: int
+    node: ast.FunctionDef
+    arity: int                  # positional params (the signature check)
+    public: bool
+    twin_decl: str | None = None   # rtap: twin[...] target, if any
+
+
+@dataclass
+class KernelModel:
+    kernels: list[Kernel] = field(default_factory=list)
+    #: EVERY jit wrapper in the surface, in deterministic discovery
+    #: order — a list, not a by-name dict, so a same-named wrapper in
+    #: another file (the nested-factory `run` idiom) is still checked
+    #: by static-hash and visible to donation in its own file
+    wrappers: list[Wrapper] = field(default_factory=list)
+    #: oracle scope symbols: name -> positional arity for functions,
+    #: None for classes (a class twin has no single arity)
+    oracle: dict[str, int | None] = field(default_factory=dict)
+    #: per-ops-file function name sets (same-file host-twin lookup)
+    ops_functions: dict[str, dict[str, int]] = field(default_factory=dict)
+
+    def resolve_twin(self, k: Kernel) -> tuple[str, str, int | None] | None:
+        """-> (twin symbol, how, twin positional arity | None) or None.
+        ``how`` is 'annotation', 'name', 'suffix', or 'host'. The arity
+        is looked up where the twin actually RESOLVED (oracle scope vs
+        same ops file), so the signature check compares the right pair;
+        it is None for class twins."""
+        if k.twin_decl is not None:
+            t = k.twin_decl
+            # the FULL dotted target must be registered (classes and
+            # their methods both are) — accepting a bare class prefix
+            # would let a typoed/deleted method name keep passing
+            if t in self.oracle:
+                return t, "annotation", self.oracle.get(t)
+            if t in self.ops_functions.get(k.path, {}):
+                return t, "annotation", self.ops_functions[k.path][t]
+            return None
+        if k.name in self.oracle:
+            return k.name, "name", self.oracle[k.name]
+        if k.name.endswith("_device") and k.name[:-7] in self.oracle:
+            return k.name[:-7], "suffix", self.oracle[k.name[:-7]]
+        here = self.ops_functions.get(k.path, {})
+        for suffix in ("_host", "_np"):
+            if k.name + suffix in here:
+                return k.name + suffix, "host", here[k.name + suffix]
+            if k.name + suffix in self.oracle:
+                return (k.name + suffix, "suffix",
+                        self.oracle[k.name + suffix])
+        if k.name.endswith("_device") and k.name[:-7] in here:
+            return k.name[:-7], "host", here[k.name[:-7]]
+        return None
+
+
+def is_traced(fn: ast.FunctionDef) -> bool:
+    """A body traces when it CALLS into jnp/lax/pl or touches a
+    non-dtype attribute of them (``jnp.int8`` alone is a dtype pick)."""
+    for node in own_body_nodes(fn):
+        if isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d is None:
+                continue
+            root = d.split(".", 1)[0]
+            if root in _TRACE_ROOTS and d.split(".")[-1] \
+                    not in _DTYPE_ATTRS:
+                return True
+        elif isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d and d.split(".", 1)[0] in _TRACE_ROOTS:
+                return True
+    return False
+
+
+def own_body_nodes(fn: ast.FunctionDef):
+    """Every node of a function's body exactly once, excluding nested
+    function/class defs (those get their own qualnames from
+    :func:`functions_in`). THE shared walker — the v3 passes import it
+    rather than growing per-module copies that would drift."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def stmt_expr_nodes(st: ast.stmt, skip_lambda: bool = False):
+    """Expression nodes of ONE statement (headers only for compounds —
+    sub-statements are the statement walkers' business). With
+    ``skip_lambda`` a lambda body is opaque: its params are a fresh
+    scope (the donation pass's view)."""
+    stack = []
+    for _name, val in ast.iter_fields(st):
+        vals = val if isinstance(val, list) else [val]
+        for v in vals:
+            if isinstance(v, ast.expr):
+                stack.append(v)
+    while stack:
+        node = stack.pop()
+        if skip_lambda and isinstance(node, ast.Lambda):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def functions_in(tree: ast.AST):
+    """(qualname, FunctionDef) for every function/method, outer-first."""
+    out = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                out.append((q, child))
+                walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def twin_annotation(sf: SourceFile, fn: ast.FunctionDef) -> str | None:
+    """The ``# rtap: twin[...]`` target on the def line, a decorator
+    line, or the contiguous comment block directly above them (the
+    annotation is usually a 2-line reviewed note)."""
+    first = min([fn.lineno] + [d.lineno for d in fn.decorator_list])
+    for ln in range(first, fn.lineno + 1):
+        if ln - 1 < len(sf.lines):
+            m = _TWIN_RE.search(sf.lines[ln - 1])
+            if m:
+                return m.group(1)
+    ln = first - 1
+    while ln >= 1 and sf.lines[ln - 1].lstrip().startswith("#"):
+        m = _TWIN_RE.search(sf.lines[ln - 1])
+        if m:
+            return m.group(1)
+        ln -= 1
+    return None
+
+
+# ------------------------------------------------- jit decorator parsing --
+
+def _const_strs(node: ast.AST) -> set[str]:
+    out = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.add(e.value)
+    return out
+
+
+def _const_ints(node: ast.AST) -> set[int]:
+    out = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+    return out
+
+
+def jit_decorator_info(fn: ast.FunctionDef) -> dict | None:
+    """None when fn carries no jax.jit decorator; else the extracted
+    static/donate spec. Handles ``@jax.jit``, ``@jit``, and the
+    ``@partial(jax.jit, ...)`` / ``@functools.partial(jax.jit, ...)``
+    forms (any partial alias — the repo uses ``_functools`` too)."""
+    for dec in fn.decorator_list:
+        d = dotted(dec)
+        if d in ("jax.jit", "jit"):
+            return {"static_argnames": set(), "static_argnums": set(),
+                    "donate_argnums": set()}
+        if isinstance(dec, ast.Call):
+            dfn = dotted(dec.func)
+            leaf = dfn.rsplit(".", 1)[-1] if dfn else None
+            if dfn in ("jax.jit", "jit"):
+                kws = dec.keywords
+            elif leaf == "partial" and dec.args \
+                    and dotted(dec.args[0]) in ("jax.jit", "jit"):
+                kws = dec.keywords
+            else:
+                continue
+            info = {"static_argnames": set(), "static_argnums": set(),
+                    "donate_argnums": set()}
+            for kw in kws:
+                if kw.arg == "static_argnames":
+                    info["static_argnames"] = _const_strs(kw.value)
+                elif kw.arg == "static_argnums":
+                    info["static_argnums"] = _const_ints(kw.value)
+                elif kw.arg == "donate_argnums":
+                    info["donate_argnums"] = _const_ints(kw.value)
+            return info
+    return None
+
+
+def build_kernel_model(ctx: AnalysisContext) -> KernelModel:
+    """Build (or return the memoized) kernel model for this context."""
+    cached = getattr(ctx, "_kernel_model", None)
+    if cached is not None:
+        return cached
+    model = KernelModel()
+
+    # ---- oracle scope symbols ---------------------------------------
+    for sf in ctx.files:
+        if sf.tree is None or not any(
+                sf.path.startswith(p) for p in ORACLE_SCOPE):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                model.oracle.setdefault(
+                    node.name, len(node.args.args))
+            elif isinstance(node, ast.ClassDef):
+                model.oracle.setdefault(node.name, None)
+                for m in node.body:
+                    if isinstance(m, ast.FunctionDef):
+                        model.oracle.setdefault(
+                            f"{node.name}.{m.name}", None)
+
+    # ---- ops kernels + per-file function tables ---------------------
+    for sf in ctx.files_under("rtap_tpu/ops/"):
+        if sf.tree is None:
+            continue
+        table = model.ops_functions.setdefault(sf.path, {})
+        for node in sf.tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            table[node.name] = len(node.args.args)
+            # a kernel either traces itself or is a jit entry point
+            # whose body is pure kernel composition (fused_step calls
+            # sp_step/tm_step and never names jnp directly)
+            if is_traced(node) or jit_decorator_info(node) is not None:
+                model.kernels.append(Kernel(
+                    name=node.name, path=sf.path, line=node.lineno,
+                    node=node, arity=len(node.args.args),
+                    public=not node.name.startswith("_"),
+                    twin_decl=twin_annotation(sf, node)))
+
+    # ---- jit wrappers across the whole surface ----------------------
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        for qual, fn in functions_in(sf.tree):
+            info = jit_decorator_info(fn)
+            if info is None:
+                continue
+            w = Wrapper(
+                name=fn.name, path=sf.path, line=fn.lineno, node=fn,
+                params=[a.arg for a in fn.args.args],
+                kwonly=[a.arg for a in fn.args.kwonlyargs],
+                static_argnames=info["static_argnames"],
+                static_argnums=info["static_argnums"],
+                donate_argnums=info["donate_argnums"],
+                nested="." in qual)
+            model.wrappers.append(w)
+
+    ctx._kernel_model = model
+    return model

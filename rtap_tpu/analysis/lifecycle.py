@@ -43,6 +43,9 @@ from rtap_tpu.analysis.program import (
 )
 
 PASS_NAME = "resource-lifecycle"
+#: findings depend only on one file's bytes -> the warm
+#: cache may replay them per file (core.py partition contract)
+PARTITION = "file"
 RULES = {
     "resource-lifecycle": "class-owned thread/socket/shm/file with no "
                           "reachable release (join-with-timeout/close/"

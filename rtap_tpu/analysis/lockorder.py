@@ -50,6 +50,8 @@ from rtap_tpu.analysis.program import (
 )
 
 PASS_NAME = "lock-order"
+#: cross-file inputs -> all-or-nothing in the findings cache
+PARTITION = "program"
 RULES = {
     "lock-order": "cycle in the global lock-acquisition graph (or a "
                   "non-reentrant lock re-acquired on a path that "

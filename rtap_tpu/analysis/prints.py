@@ -31,6 +31,8 @@ import ast
 from rtap_tpu.analysis.core import AnalysisContext, Finding
 
 PASS_NAME = "prints"
+#: cross-file inputs -> all-or-nothing in the findings cache
+PARTITION = "program"
 RULES = {
     "print-strict": "print() in the serve stack (telemetry goes through "
                     "rtap_tpu.obs or logging)",

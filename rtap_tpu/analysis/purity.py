@@ -39,6 +39,9 @@ import ast
 from rtap_tpu.analysis.core import AnalysisContext, Finding
 
 PASS_NAME = "purity"
+#: findings depend only on one file's bytes -> the warm
+#: cache may replay them per file (core.py partition contract)
+PARTITION = "file"
 RULES = {
     "purity-nondet": "host nondeterminism (time/random/datetime.now) in "
                      "device-kernel or tick-path code",

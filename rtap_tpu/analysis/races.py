@@ -41,6 +41,9 @@ from dataclasses import dataclass, field
 from rtap_tpu.analysis.core import AnalysisContext, Finding
 
 PASS_NAME = "races"
+#: findings depend only on one file's bytes -> the warm
+#: cache may replay them per file (core.py partition contract)
+PARTITION = "file"
 RULES = {
     "race": "self.* attribute mutated from both a spawned thread and "
             "main-side methods without a common lock guard on every "

@@ -1,0 +1,154 @@
+"""Static-argument hygiene: hashable statics, no jit churn in loops.
+
+Two rules over the jit wrappers the kernel model discovers
+(analysis/kernels.py):
+
+``static-hash`` — a ``static_argnames``/``static_argnums`` entry must
+name a real parameter and must be a hashable, frozen type. Statics are
+dict keys in jax's compile cache: an unhashable static (list/dict/set/
+ndarray) is a TypeError at the first call, a *mutable-but-hashable*
+one is worse — a silently stale compile. The check is on the
+declared annotation (``cfg: ModelConfig`` — a frozen dataclass — is
+the idiom; ``cfg: dict`` is the finding) plus dangling names/indices.
+
+``jit-churn`` — ``jax.jit(...)`` (or ``partial(jax.jit, ...)``)
+evaluated inside a ``for``/``while`` body, or jit over a ``lambda``,
+builds a FRESH wrapper per iteration whose cache is thrown away —
+recompile churn. The AOT warm-up's cold-compile counter is the runtime
+dual (service/aot.py); this is the static gate: the fix is hoisting
+the wrapper to module scope or an ``lru_cache``-keyed factory (the
+``_sharded_chunk_fn`` idiom, ops/step.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.kernels import build_kernel_model, dotted, \
+    functions_in
+
+PASS_NAME = "static-hash"
+PARTITION = "file"
+RULES = {
+    "static-hash": "jit static arg that is unhashable/mutable by "
+                   "annotation, or names no parameter",
+    "jit-churn": "jax.jit constructed inside a loop (or over a "
+                 "lambda) — a fresh compile cache per iteration",
+}
+
+#: annotations that cannot (or must not) be jit statics
+_UNHASHABLE = frozenset({
+    "list", "dict", "set", "bytearray", "List", "Dict", "Set",
+    "np.ndarray", "numpy.ndarray", "jnp.ndarray", "jax.Array",
+})
+
+
+def _annotation_names(ann: ast.AST) -> list[str]:
+    out = []
+    for node in ast.walk(ann):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            d = dotted(node)
+            if d:
+                out.append(d)
+        elif isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            out.append(node.value)
+    return out
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    d = dotted(node.func)
+    if d in ("jax.jit", "jit"):
+        return True
+    leaf = d.rsplit(".", 1)[-1] if d else None
+    return leaf == "partial" and bool(node.args) \
+        and dotted(node.args[0]) in ("jax.jit", "jit")
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    model = build_kernel_model(ctx)
+    out: list[Finding] = []
+
+    # ---- static args must be declared params with frozen types ------
+    for w in model.wrappers:
+        params = w.params + w.kwonly
+        by_name = {a.arg: a for a in
+                   w.node.args.args + w.node.args.kwonlyargs}
+        for name in sorted(w.static_argnames):
+            if name not in params:
+                out.append(Finding(
+                    rule="static-hash", path=w.path, line=w.line,
+                    symbol=f"{w.name}:static:{name}",
+                    message=f"static_argnames names '{name}' but "
+                            f"{w.name}() has no such parameter — a "
+                            "rename left the static spec behind "
+                            "(jax raises only when it is USED)"))
+                continue
+            ann = by_name[name].annotation
+            if ann is not None and any(
+                    a in _UNHASHABLE or a.split("[")[0] in _UNHASHABLE
+                    for a in _annotation_names(ann)):
+                out.append(Finding(
+                    rule="static-hash", path=w.path, line=w.line,
+                    symbol=f"{w.name}:static:{name}",
+                    message=f"static arg '{name}' is annotated with "
+                            "an unhashable/mutable type — statics are "
+                            "compile-cache keys; use a frozen "
+                            "dataclass or tuple"))
+        for i in sorted(w.static_argnums | w.donate_argnums):
+            if not (0 <= i < len(w.params)):
+                which = "static_argnums" if i in w.static_argnums \
+                    else "donate_argnums"
+                out.append(Finding(
+                    rule="static-hash", path=w.path, line=w.line,
+                    symbol=f"{w.name}:argnum:{i}",
+                    message=f"{which} index {i} is out of range for "
+                            f"{w.name}()'s {len(w.params)} positional "
+                            "params — a signature edit left the spec "
+                            "behind"))
+
+    # ---- jit churn: jit built in loops / over lambdas ---------------
+    for sf in ctx.files:
+        # textual prefilter: the walk below visits every node of every
+        # function — skip the many files that never say "jit" at all
+        if sf.tree is None or "jit" not in sf.text:
+            continue
+        for qual, fn in functions_in(sf.tree):
+            loop_depth_nodes = []
+
+            def walk(node, in_loop):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef)):
+                        continue
+                    child_in_loop = in_loop or isinstance(
+                        node, (ast.For, ast.While)) and child in (
+                            getattr(node, "body", []))
+                    if isinstance(child, ast.Call) \
+                            and _is_jit_call(child):
+                        if child_in_loop:
+                            loop_depth_nodes.append((child, "loop"))
+                        elif any(isinstance(a, ast.Lambda)
+                                 for a in child.args):
+                            loop_depth_nodes.append((child, "lambda"))
+                    walk(child, child_in_loop)
+
+            walk(fn, False)
+            for call, kind in loop_depth_nodes:
+                if kind == "loop":
+                    msg = ("jax.jit evaluated inside a loop — a fresh "
+                           "wrapper (and compile cache) per iteration; "
+                           "hoist it to module scope or key it through "
+                           "an lru_cache factory (the _sharded_chunk_fn "
+                           "idiom)")
+                else:
+                    msg = ("jax.jit over a lambda — the wrapper cannot "
+                           "be cache-shared across call sites; def a "
+                           "named function")
+                out.append(Finding(
+                    rule="jit-churn", path=sf.path, line=call.lineno,
+                    symbol=f"{qual}:jit-{kind}",
+                    message=msg))
+    return out

@@ -1,0 +1,86 @@
+"""Twin parity: every public device kernel has an oracle twin AND a
+parity test.
+
+Rule ``twin-parity`` — the static gate under the repo's core claim
+("every kernel has a numpy oracle twin and bit-exact parity tests",
+ops/__init__.py). Three ways a kernel fails it:
+
+* **untwinned** — no oracle twin resolves (by name pairing against
+  ``rtap_tpu/models/`` + ``rtap_tpu/utils/hashing.py``, by the
+  ``_np``/``_host``/``_device`` suffix conventions, or by an explicit
+  ``# rtap: twin[Target]`` annotation — see analysis/kernels.py);
+* **signature** — a *name-paired* function twin disagrees on positional
+  arity (an annotated pairing is the reviewed assertion and only has to
+  resolve — state-dict vs explicit-tensor calling conventions are why
+  annotations exist);
+* **untested** — the kernel's name appears in no ``tests/parity/`` file.
+  This is what makes deleting a parity test a GATE failure instead of a
+  silent coverage hole: the parity tree is an analyzer input (it rides
+  the findings-cache key exactly like the docs text).
+
+Scope: public top-level traced functions in ``rtap_tpu/ops/`` (traced =
+calls into jnp/lax/pl — a dtype helper that only names ``jnp.int16``
+is not a kernel). Symbols are ``<kernel>:untwinned`` /
+``<kernel>:signature`` / ``<kernel>:untested`` — line-insensitive, so
+baselining survives edits.
+"""
+
+from __future__ import annotations
+
+import re
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.kernels import build_kernel_model
+
+PASS_NAME = "twin-parity"
+PARTITION = "program"
+RULES = {
+    "twin-parity": "public ops/ kernel with no resolvable oracle twin, "
+                   "an arity-incompatible name-paired twin, or no "
+                   "tests/parity/ coverage",
+}
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    model = build_kernel_model(ctx)
+    if not model.kernels:
+        return []
+    parity = ctx.parity()
+    out: list[Finding] = []
+    for k in model.kernels:
+        if not k.public:
+            continue
+        resolved = model.resolve_twin(k)
+        if resolved is None:
+            how = "annotation target does not resolve" \
+                if k.twin_decl is not None else "no twin resolves"
+            out.append(Finding(
+                rule="twin-parity", path=k.path, line=k.line,
+                symbol=f"{k.name}:untwinned",
+                message=f"{how} for public kernel {k.name} — pair it "
+                        "with its oracle (same name, _np/_host suffix) "
+                        "or declare `# rtap: twin[Target]` on the def "
+                        "(docs/ANALYSIS.md); an untwinned kernel has "
+                        "no bit-exactness story"))
+        else:
+            twin, via, arity = resolved
+            if via in ("name", "suffix", "host"):
+                if arity is not None and arity != k.arity:
+                    out.append(Finding(
+                        rule="twin-parity", path=k.path, line=k.line,
+                        symbol=f"{k.name}:signature",
+                        message=f"kernel {k.name} takes {k.arity} "
+                                f"positional args but its name-paired "
+                                f"twin {twin} takes {arity} — align "
+                                "the signatures or declare the "
+                                "reviewed pairing with "
+                                f"`# rtap: twin[{twin}]`"))
+        if not re.search(rf"\b{re.escape(k.name)}\b", parity):
+            out.append(Finding(
+                rule="twin-parity", path=k.path, line=k.line,
+                symbol=f"{k.name}:untested",
+                message=f"public kernel {k.name} appears in no "
+                        "tests/parity/ file — bit-exactness is only a "
+                        "claim until a parity test exercises it "
+                        "(removing that test re-fails this gate)"))
+    return out

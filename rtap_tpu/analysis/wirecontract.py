@@ -1,0 +1,361 @@
+"""Wire contract: struct formats and type codes never drift from docs.
+
+Rule ``wire-contract`` — the flag-docs gate's binary dual (ISSUE 14).
+The RB1 ingest frame (ingest/protocol.py ↔ docs/INGEST.md) and the RJ
+journal record (resilience/journal.py ↔ docs/RESILIENCE.md) are
+FROZEN framings: producers, soak feeders, and recovery code on other
+machines parse them from the operator docs. A struct format string
+edited without its doc row (or vice versa) is a silent cross-version
+corruption; this pass cross-checks them statically.
+
+What is extracted from every ``rtap_tpu/ingest/`` / ``rtap_tpu/
+resilience/`` file (pure AST + the assignment's trailing comment,
+which names the fields — the same comment-as-contract idiom as
+suppressions):
+
+* ``NAME = struct.Struct("<fmt>")  # field, names`` — per-field
+  offsets/sizes computed from the format chars;
+* ``*MAGIC = b"..."`` constants;
+* type-code groups: a tuple ``_TYPES``/``_KINDS`` of Name constants
+  (``KIND_DATA``...), each resolved to its int value.
+
+Checks (symbols are line-insensitive; docs text = README + docs/*.md):
+
+* format strings must be explicit little-endian (``<``) — wire layout
+  may never depend on host alignment;
+* the comment must name exactly as many fields as the format has;
+* magics are unique AND prefix-free across the framings (a magic that
+  prefixes another breaks byte-wise resync);
+* type codes are unique within their group, and each code's doc token
+  (``DATA``, ``TICK``) must co-occur with its numeric value on some
+  doc line (``1=DATA``, ``TICK (1)``);
+* a *header* struct (format opens with the magic's ``Ns``) must match
+  its doc layout: a markdown ``| offset | size | field |`` table whose
+  magic row names the magic (per-field offset+size equality, every
+  field documented), or an inline ``b"RJ" | type u8 | len u32`` line
+  (width-sequence equality). Neither present = undocumented framing;
+* any other comment-named field mentioned in docs as ``<name> <width>``
+  (``tick i64``) must agree on width.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.kernels import dotted
+
+PASS_NAME = "wire-contract"
+PARTITION = "program"
+RULES = {
+    "wire-contract": "struct format / type code / magic drifted from "
+                     "the documented wire layout (docs/INGEST.md, "
+                     "docs/RESILIENCE.md)",
+}
+
+_SCOPES = ("rtap_tpu/ingest/", "rtap_tpu/resilience/")
+
+#: struct format char -> byte width ('s' handled via its repeat count)
+_CHAR_SIZES = {"x": 1, "c": 1, "b": 1, "B": 1, "?": 1, "h": 2, "H": 2,
+               "e": 2, "i": 4, "I": 4, "l": 4, "L": 4, "f": 4,
+               "q": 8, "Q": 8, "d": 8}
+
+#: doc width tokens -> byte width
+_TOKEN_SIZES = {"u8": 1, "i8": 1, "u16": 2, "i16": 2, "u32": 4,
+                "i32": 4, "f32": 4, "u64": 8, "i64": 8, "f64": 8}
+
+_TOKEN_RE = re.compile(r"\b(u8|i8|u16|i16|u32|i32|f32|u64|i64|f64)\b")
+
+
+def parse_format(fmt: str) -> list[tuple[str, int]] | None:
+    """[(chars, size)] per field, or None on an unparseable format."""
+    body = fmt[1:] if fmt[:1] in "<>=!@" else fmt
+    out: list[tuple[str, int]] = []
+    i = 0
+    while i < len(body):
+        j = i
+        while j < len(body) and body[j].isdigit():
+            j += 1
+        count = int(body[i:j]) if j > i else 1
+        if j >= len(body):
+            return None
+        ch = body[j]
+        if ch == "s":
+            out.append((f"{count}s", count))
+        elif ch in _CHAR_SIZES:
+            out.extend((ch, _CHAR_SIZES[ch]) for _ in range(count))
+        else:
+            return None
+        i = j + 1
+    return out
+
+
+def _field_names(sf, line: int) -> list[str]:
+    """Comma-separated field names from the assignment line's trailing
+    comment plus directly-following comment-only lines."""
+    chunks: list[str] = []
+    ln = sf.lines[line - 1] if line - 1 < len(sf.lines) else ""
+    if "#" in ln:
+        chunks.append(ln.split("#", 1)[1])
+    nxt = line
+    # a continuation is only consumed while the list is visibly OPEN
+    # (accumulated text ends with a comma — the protocol.py idiom);
+    # otherwise the next comment is unrelated prose, and swallowing it
+    # would corrupt the field map into a spurious red gate. `#:` lines
+    # document the NEXT binding and never continue the list.
+    while nxt < len(sf.lines) and " ".join(chunks).rstrip().endswith(","):
+        stripped = sf.lines[nxt].lstrip()
+        if not stripped.startswith("#") or stripped.startswith("#:"):
+            break
+        chunks.append(stripped[1:])
+        nxt += 1
+    text = " ".join(chunks)
+    return [t.strip() for t in text.split(",") if t.strip()]
+
+
+def _tables(docs: str) -> list[dict]:
+    """Markdown | offset | size | field | tables -> list of
+    {'rows': {field: (offset, size)}, 'text': full table text}."""
+    out = []
+    lines = docs.splitlines()
+    i = 0
+    while i < len(lines):
+        cells = [c.strip().lower() for c in lines[i].split("|")]
+        if "offset" in cells and "size" in cells and "field" in cells:
+            cols = {name: cells.index(name)
+                    for name in ("offset", "size", "field")}
+            rows: dict[str, tuple[int, int]] = {}
+            text = [lines[i]]
+            j = i + 1
+            while j < len(lines) and lines[j].lstrip().startswith("|"):
+                text.append(lines[j])
+                row = [c.strip() for c in lines[j].split("|")]
+                if len(row) > max(cols.values()):
+                    off, size = row[cols["offset"]], row[cols["size"]]
+                    field = row[cols["field"]].strip("`* ")
+                    if off.isdigit() and size.isdigit() and field:
+                        rows[field] = (int(off), int(size))
+                j += 1
+            out.append({"rows": rows, "text": "\n".join(text)})
+            i = j
+        else:
+            i += 1
+    return out
+
+
+def _magic_doc_line(docs: str, magic: str) -> list[int] | None:
+    """Width sequence from an inline ``b"RJ" | type u8 | len u32``
+    style doc line, or None when no such line exists."""
+    for line in docs.splitlines():
+        if magic in line and "|" in line:
+            widths = [_TOKEN_SIZES[t] for t in _TOKEN_RE.findall(line)]
+            if widths:
+                return widths
+    return None
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    docs = ctx.docs()
+    out: list[Finding] = []
+    magics: dict[str, tuple[str, str, int]] = {}  # ascii -> (path, name, line)
+
+    for sf in ctx.files_under(*_SCOPES):
+        if sf.tree is None:
+            continue
+        structs: dict[str, tuple[str, int]] = {}   # name -> (fmt, line)
+        consts: dict[str, int] = {}
+        groups: dict[str, tuple[list[str], int]] = {}
+        file_magics: list[tuple[str, str, int]] = []
+        for node in sf.tree.body:
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            name = node.targets[0].id
+            v = node.value
+            if isinstance(v, ast.Call) \
+                    and dotted(v.func) in ("struct.Struct", "Struct") \
+                    and v.args and isinstance(v.args[0], ast.Constant) \
+                    and isinstance(v.args[0].value, str):
+                structs[name] = (v.args[0].value, node.lineno)
+            elif isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                    and not isinstance(v.value, bool):
+                consts[name] = v.value
+            elif isinstance(v, ast.Constant) \
+                    and isinstance(v.value, bytes) \
+                    and name.strip("_").endswith("MAGIC"):
+                file_magics.append(
+                    (v.value.decode("ascii", "replace"), name,
+                     node.lineno))
+            elif isinstance(v, ast.Tuple) \
+                    and name.strip("_").upper().endswith(
+                        ("KINDS", "TYPES")) \
+                    and v.elts and all(isinstance(e, ast.Name)
+                                       for e in v.elts):
+                groups[name] = ([e.id for e in v.elts], node.lineno)
+
+        # ---- magic uniqueness / prefix-freedom across framings ------
+        for magic, name, line in file_magics:
+            for seen, (spath, sname, _sline) in magics.items():
+                if magic == seen or magic.startswith(seen) \
+                        or seen.startswith(magic):
+                    out.append(Finding(
+                        rule="wire-contract", path=sf.path, line=line,
+                        symbol=f"magic:{magic}",
+                        message=f"magic {magic!r} ({name}) collides "
+                                f"with {sname} {seen!r} ({spath}) — "
+                                "framings must be unique and "
+                                "prefix-free or byte-wise resync "
+                                "misparses one as the other"))
+            magics.setdefault(magic, (sf.path, name, line))
+
+        # ---- type-code groups ---------------------------------------
+        for gname, (members, line) in groups.items():
+            values = {m: consts.get(m) for m in members}
+            by_val: dict[int, str] = {}
+            for m, val in values.items():
+                if val is None:
+                    continue
+                if val in by_val:
+                    out.append(Finding(
+                        rule="wire-contract", path=sf.path, line=line,
+                        symbol=f"code:{m}",
+                        message=f"type code {m}={val} duplicates "
+                                f"{by_val[val]} in {gname} — the "
+                                "walker cannot dispatch on an "
+                                "ambiguous code"))
+                    continue
+                by_val[val] = m
+                token = m.rsplit("_", 1)[-1]
+                documented = any(
+                    token in ln and re.search(rf"\b{val}\b", ln)
+                    for ln in docs.splitlines())
+                if not documented:
+                    out.append(Finding(
+                        rule="wire-contract", path=sf.path, line=line,
+                        symbol=f"code:{m}",
+                        message=f"type code {m}={val} is not "
+                                "documented (no doc line pairs "
+                                f"'{token}' with {val}) — the wire "
+                                "docs are the cross-version parser "
+                                "contract"))
+
+        # ---- struct formats vs docs ---------------------------------
+        for sname, (fmt, line) in structs.items():
+            fields = parse_format(fmt)
+            if fields is None:
+                out.append(Finding(
+                    rule="wire-contract", path=sf.path, line=line,
+                    symbol=f"fmt:{sname}",
+                    message=f"unparseable struct format {fmt!r}"))
+                continue
+            if fmt[:1] != "<":
+                out.append(Finding(
+                    rule="wire-contract", path=sf.path, line=line,
+                    symbol=f"fmt:{sname}:endian",
+                    message=f"struct format {fmt!r} is not explicit "
+                            "little-endian ('<') — wire layout must "
+                            "not depend on host alignment"))
+            names = _field_names(sf, line)
+            if names and len(names) != len(fields):
+                out.append(Finding(
+                    rule="wire-contract", path=sf.path, line=line,
+                    symbol=f"fmt:{sname}:names",
+                    message=f"{sname} comment names {len(names)} "
+                            f"fields but format {fmt!r} has "
+                            f"{len(fields)} — the comment IS the "
+                            "field map; keep it exact"))
+                names = []
+            if not names:
+                continue
+            # header struct: its comment NAMES the magic field and the
+            # format opens with that magic's Ns (first-field length
+            # alone would misclassify an unrelated `<2sI` trailer as
+            # the framing header and fail it against the wrong table)
+            magic_here = next(
+                (m for m, n, _l in file_magics
+                 if names[0].lower() == "magic"
+                 and fields[0][0] == f"{len(m)}s"), None)
+            if magic_here is not None:
+                out.extend(_check_header(
+                    sf, sname, line, fields, names, magic_here, docs))
+            else:
+                out.extend(_check_inline_widths(
+                    sf, sname, line, fields, names, docs))
+    return out
+
+
+def _offsets(fields: list[tuple[str, int]]) -> list[int]:
+    offs, total = [], 0
+    for _ch, size in fields:
+        offs.append(total)
+        total += size
+    return offs
+
+
+def _check_header(sf, sname, line, fields, names, magic, docs):
+    out: list[Finding] = []
+    offs = _offsets(fields)
+    table = next((t for t in _tables(docs)
+                  if magic in t["text"]), None)
+    if table is not None:
+        for fname, (ch, size), off in zip(names, fields, offs):
+            doc = table["rows"].get(fname)
+            if doc is None:
+                out.append(Finding(
+                    rule="wire-contract", path=sf.path, line=line,
+                    symbol=f"{sname}.{fname}:undocumented",
+                    message=f"header field {fname} has no row in the "
+                            f"{magic} layout table — document offset "
+                            f"{off}, size {size}"))
+            elif doc != (off, size):
+                out.append(Finding(
+                    rule="wire-contract", path=sf.path, line=line,
+                    symbol=f"{sname}.{fname}",
+                    message=f"header field {fname} is offset {off} "
+                            f"size {size} in {sname} ({fields!r}) but "
+                            f"the {magic} doc table says offset "
+                            f"{doc[0]} size {doc[1]} — struct and doc "
+                            "drifted; fix whichever is wrong and bump "
+                            "the magic if the wire layout changed"))
+        return out
+    widths = _magic_doc_line(docs, magic)
+    if widths is None:
+        out.append(Finding(
+            rule="wire-contract", path=sf.path, line=line,
+            symbol=f"{sname}:undocumented",
+            message=f"framing {sname} (magic {magic!r}) has neither a "
+                    "doc layout table nor an inline width line — the "
+                    "wire docs are the cross-version parser contract"))
+        return out
+    struct_widths = [size for _ch, size in fields[1:]]
+    for i, w in enumerate(widths):
+        if i < len(struct_widths) and w != struct_widths[i]:
+            out.append(Finding(
+                rule="wire-contract", path=sf.path, line=line,
+                symbol=f"{sname}.{names[i + 1]}",
+                message=f"field {names[i + 1]} is {struct_widths[i]} "
+                        f"bytes in {sname} but the {magic} doc line "
+                        f"says {w} — struct and doc drifted"))
+    return out
+
+
+def _check_inline_widths(sf, sname, line, fields, names, docs):
+    """Non-header structs: any field documented as `<name> <width>`
+    must agree."""
+    out: list[Finding] = []
+    for fname, (_ch, size) in zip(names, fields):
+        if not re.fullmatch(r"\w+", fname):
+            continue
+        m = re.search(rf"\b{fname}\s+"
+                      r"(u8|i8|u16|i16|u32|i32|f32|u64|i64|f64)\b",
+                      docs)
+        if m and _TOKEN_SIZES[m.group(1)] != size:
+            out.append(Finding(
+                rule="wire-contract", path=sf.path, line=line,
+                symbol=f"{sname}.{fname}",
+                message=f"field {fname} is {size} bytes in {sname} "
+                        f"but documented as {m.group(1)} — struct and "
+                        "doc drifted"))
+    return out

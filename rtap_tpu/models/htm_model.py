@@ -40,7 +40,7 @@ def oracle_record_step(
 
     The single source of the CPU per-record composition, shared by
     HTMModel.run and the service layer's CPU stream groups; the device twin
-    is ops/step.step_impl. With a `classifier` (SDRClassifierOracle), also
+    is ops/step._step_impl. With a `classifier` (SDRClassifierOracle), also
     decodes the predicted next value: returns (raw, prediction, prob).
     """
     bind = ~state["enc_bound"] & np.isfinite(values)
@@ -54,7 +54,7 @@ def oracle_record_step(
                         state["enc_resolution"], enc_prev)
     if enc_prev is not None:
         # advance the delta predecessor AFTER encoding (device twin:
-        # ops/step.step_impl); NaN gaps keep the pre-gap baseline
+        # ops/step._step_impl); NaN gaps keep the pre-gap baseline
         state["enc_prev"] = np.where(
             np.isfinite(values), values, enc_prev).astype(np.float32)
     # TM active cells at t-1: TMOracle rebinds (not mutates) prev_active, so

@@ -47,7 +47,16 @@ def sp_inhibit(overlap: np.ndarray, boost: np.ndarray, cfg: SPConfig) -> np.ndar
         # host/device winner parity overwhelmingly likely but not guaranteed:
         # a 1-ulp exp() difference can still flip q on an exact .5 boundary.
         # The NAB preset runs boost_strength=0, where parity is exact.
-        q = np.round((overlap * boost).astype(np.float32) * 256.0).astype(np.int64)
+        # same f32 clamp as the device kernel, BEFORE the int cast: i64
+        # cannot wrap here, but the DEVICE computes this score in i32
+        # and clamps q (in f32 — an overflowing f32→i32 convert is
+        # backend-defined) to keep q*C + tiebreak < 2^31; the min(·,
+        # 2^24) keeps qmax f32-exact for C < 128 (see ops/sp_tpu.py).
+        # The oracle mirrors the exact expression so the twins stay
+        # bit-identical even under pathological boost (ISSUE 14).
+        qmax = np.float32(min((2**31 - C) // C, 2**24))
+        qf = np.round((overlap * boost).astype(np.float32) * 256.0)
+        q = np.clip(qf, np.float32(0.0), qmax).astype(np.int64)
         score = q * C + (C - 1 - np.arange(C))
     else:
         score = overlap.astype(np.int64) * C + (C - 1 - np.arange(C))

@@ -35,6 +35,8 @@ def classifier_bucket_device(
     return jnp.clip(b + n_buckets // 2, 0, n_buckets - 1).astype(jnp.int32)
 
 
+# rtap: twin[SDRClassifierOracle] — the oracle classifier is stateful
+# (oracle/classifier.py .compute); parity in test_twin_registry.py
 def classifier_step(
     state: dict,
     pattern_prev: jnp.ndarray,  # bool [C, K] — active cells at t-1

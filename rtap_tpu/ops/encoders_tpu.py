@@ -74,6 +74,7 @@ def _composite_indices(
     return jnp.concatenate(parts)
 
 
+# rtap: twin[encode_record] — the host oracle encoder (oracle/encoders.py)
 def encode_device(
     cfg: ModelConfig,
     values: jnp.ndarray,  # [F] f32
@@ -166,6 +167,8 @@ def encode_device(
     return sdr
 
 
+# rtap: twin[oracle_record_step] — the oracle performs the first-finite
+# bind inline (models/htm_model.py, the np.where on enc_offset)
 def bind_offsets(
     values: jnp.ndarray, enc_offset: jnp.ndarray, enc_bound: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:

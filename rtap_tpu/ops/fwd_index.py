@@ -55,6 +55,8 @@ def pos_dtype(fanout_cap: int):
     return jnp.int8 if fanout_cap <= 127 else jnp.int16
 
 
+# rtap: twin[TMOracle] — the oracle walks presyn adjacency directly; the
+# index is DERIVED state whose only contract is count parity with it
 def build_fwd_index(presyn: jnp.ndarray, n_cells: int, fanout_cap: int):
     """Derive (fwd_slots [N, F], fwd_pos [pool], overflow i32) from a presyn
     pool (any shape; flattened row-major — slot id = flat index).
@@ -90,6 +92,7 @@ def build_fwd_index(presyn: jnp.ndarray, n_cells: int, fanout_cap: int):
     return fwd_slots, fwd_pos, overflow
 
 
+# rtap: twin[TMOracle] — counts must equal the oracle's adjacency walk
 def dendrite_counts(
     fwd_slots: jnp.ndarray,  # i32 [N, F]
     syn_perm_flat: jnp.ndarray,  # [pool] storage dtype
@@ -147,6 +150,8 @@ def dendrite_counts(
     return connc.reshape(-1)[:n_seg], pot.reshape(-1)[:n_seg]
 
 
+# rtap: twin[TMOracle] — incremental maintenance; rebuild-vs-incremental
+# equivalence pinned in tests/parity/test_fwd_index.py
 def apply_removals(
     fwd_slots: jnp.ndarray,
     fwd_pos: jnp.ndarray,
@@ -171,6 +176,7 @@ def apply_removals(
     return fwd_slots, fwd_pos
 
 
+# rtap: twin[TMOracle] — incremental maintenance (see apply_removals)
 def apply_appends(
     fwd_slots: jnp.ndarray,
     fwd_pos: jnp.ndarray,

@@ -227,6 +227,8 @@ def _guard_shapes(C, K, S, M, W, interpret):
         )
 
 
+# rtap: twin[TMOracle] — megakernel twin of the default TM learning path;
+# bit-parity in interpreter mode: tests/parity/test_pallas_tm.py
 def tm_learn_pallas(
     cfg,
     dom,

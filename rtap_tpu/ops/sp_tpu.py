@@ -22,6 +22,8 @@ from rtap_tpu.config import SPConfig
 from rtap_tpu.models.perm import sp_domain
 
 
+# rtap: twin[sp_overlap] — explicit-tensor calling convention vs the
+# oracle's state-dict one; same math, parity in test_twin_registry.py
 def sp_overlap(perm: jnp.ndarray, potential: jnp.ndarray, sdr: jnp.ndarray, cfg: SPConfig) -> jnp.ndarray:
     """Overlap per column = |connected potential synapses ∩ active inputs|.
     0/1 f32 matmul -> MXU; exact integer counts."""
@@ -41,7 +43,23 @@ def sp_inhibit(overlap: jnp.ndarray, boost: jnp.ndarray, cfg: SPConfig) -> jnp.n
     C = overlap.shape[0]
     col_rev = (C - 1 - jnp.arange(C, dtype=jnp.int32))
     if cfg.boost_strength > 0.0:
-        q = jnp.round(overlap.astype(jnp.float32) * boost * 256.0).astype(jnp.int32)
+        # q*C + col_rev must stay < 2^31: the device computes the score
+        # in i32 while the host oracle widens to i64, so an unclamped q
+        # (pathological boost × overlap > ~8M/C) would WRAP here and
+        # invert winners on TPU only. Both twins clamp IN F32, BEFORE
+        # the int cast — an out-of-range f32→i32 convert is backend-
+        # defined, so clamping after it would rest on exactly the
+        # nonportability this guards against. The extra min(·, 2^24)
+        # keeps qmax f32-EXACT for every C: for C < 128 the raw bound
+        # exceeds 2^24 and float32() would round it UP (C=64 →
+        # 33554431 → 2^25), re-opening the wrap; capped at 2^24 the
+        # compare and casts are exact and q*C ≤ 2^24·C < 2^31
+        # whenever the raw bound was the larger one. Twins stay
+        # bit-identical in every regime (the ISSUE 14 dtype-domain
+        # gate's i32-wrap rule pins this shape).
+        qmax = jnp.float32(min((2**31 - C) // C, 2**24))
+        qf = jnp.round(overlap.astype(jnp.float32) * boost * 256.0)
+        q = jnp.clip(qf, 0.0, qmax).astype(jnp.int32)
         score = q * C + col_rev
     else:
         score = overlap * C + col_rev
@@ -97,6 +115,7 @@ def sp_learn(
     }
 
 
+# rtap: twin[sp_compute] — the oracle names the full SP step sp_compute
 @partial(jax.jit, static_argnames=("cfg", "learn"))
 def sp_step(state: dict, sdr: jnp.ndarray, cfg: SPConfig, learn: bool = True):
     """One SP step -> (new_state, bool[C] active columns). Pure."""

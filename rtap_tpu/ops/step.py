@@ -34,7 +34,7 @@ from rtap_tpu.ops.sp_tpu import sp_step
 from rtap_tpu.ops.tm_tpu import tm_step
 
 
-def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
+def _step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
               inv: dict | None = None):
     """One fused record step -> (new_state, out). Pure/traceable.
 
@@ -69,12 +69,14 @@ def step_impl(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Model
     return state, raw
 
 
+# rtap: twin[oracle_record_step] — the oracle chains bind/encode/SP/TM
+# per record (models/htm_model.py); parity: tests/parity/test_e2e_parity.py
 @partial(jax.jit, static_argnames=("cfg", "learn"))
 def fused_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True):
-    """Single-stream fused step (see :func:`step_impl`)."""
+    """Single-stream fused step (see :func:`_step_impl`)."""
     from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
 
-    state, out = step_impl(to_kernel_layout(state), values, ts_unix, cfg, learn)
+    state, out = _step_impl(to_kernel_layout(state), values, ts_unix, cfg, learn)
     return from_kernel_layout(state, cfg.tm), out
 
 
@@ -103,7 +105,7 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
 
     def step_all(lrn):
         return lambda ss: jax.vmap(
-            lambda s1, vv, tt: step_impl(s1, vv, tt, cfg, lrn, inv)
+            lambda s1, vv, tt: _step_impl(s1, vv, tt, cfg, lrn, inv)
         )(ss, values, ts_unix)
 
     if not (learn and cfg.cadence_active):
@@ -120,6 +122,7 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
     return s, (out, health_reduce(s, raw, values, cfg))
 
 
+# rtap: twin[oracle_record_step] — vmapped form of the same oracle chain
 @partial(jax.jit, static_argnames=("cfg", "learn", "health"), donate_argnums=(0,))
 def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True,
                health: bool = False):
@@ -164,6 +167,7 @@ def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mod
     return from_kernel_layout(state, cfg.tm), out
 
 
+# rtap: twin[oracle_record_step] — time-scanned form of the oracle chain
 @partial(jax.jit, static_argnames=("cfg", "learn", "health"), donate_argnums=(0,))
 def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True,
                health: bool = False):
@@ -257,7 +261,7 @@ def _set_row_jit(state: dict, fresh: dict, slot: jnp.ndarray) -> dict:
         lambda s, f: s.at[slot].set(f.astype(s.dtype)), state, fresh)
 
 
-def set_state_row(state: dict, fresh: dict, slot: int) -> dict:
+def set_state_row(state: dict, fresh: dict, slot: int) -> dict:  # rtap: allow[twin-parity] — host twin is a one-line numpy row assignment; claim/release semantics pinned by tests/unit/test_dynamic_streams.py and the registry tests
     """Overwrite ONE stream's row of grouped [G, ...] state with a fresh
     single-stream state (dynamic slot claim — registry.claim_slot). The
     slot index is a traced argument so claiming different slots reuses one

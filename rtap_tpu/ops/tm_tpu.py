@@ -261,7 +261,7 @@ def _reduce_matrix(ks: int, m: int):
     return r
 
 
-def tm_invariants(cfg: TMConfig) -> dict | None:
+def tm_invariants(cfg: TMConfig) -> dict | None:  # rtap: allow[twin-parity] — trace-time constant builder (reduction matrix), not a semantic kernel; exercised through every tm_step parity run
     """Tick-invariant device operands of :func:`tm_step`, built ONCE so a
     caller scanning over ticks (ops/step.py:_scan_chunk) can hoist them
     out of the scan body explicitly — they stay HBM-resident across the
@@ -477,6 +477,7 @@ def _gather_rows_i32(x: jnp.ndarray, oh_b: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(oh_b[:, :, None], x[None, :, :], 0).sum(1)
 
 
+# rtap: twin[TMOracle] — the oracle TM is stateful (TMOracle.compute)
 @partial(jax.jit, static_argnames=("cfg", "learn"))
 def tm_step(state: dict, active_cols: jnp.ndarray, cfg: TMConfig, learn: bool = True,
             inv: dict | None = None):

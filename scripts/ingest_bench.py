@@ -107,6 +107,7 @@ def make_frames(n_records: int, slot_map: dict, ids: list[str],
         sl = slice(off, min(off + frame_rows, n_records))
         frames.append(data_frame(codes[sl], values[sl],
                                  1_700_000_000 + off,
+                                 # rtap: allow[dtype-domain] — RB1 ts_delta wire field is u16 by layout, not a permanence grid
                                  deltas=(idx[sl] - off).astype(np.uint16)))
     return frames
 

@@ -110,7 +110,7 @@ def main() -> None:
     bench("pool_pass_flat", scanned(pass2), (pool2, perm2))
 
     # same pass in u16 storage with f32 compute (the quantized domain cost)
-    perm2_u16 = (perm2 * 65535).astype(jnp.uint16)
+    perm2_u16 = (perm2 * 65535).astype(jnp.uint16)  # rtap: domain[u16]
 
     def pass2_u16(carry):
         p, w16 = carry
@@ -119,7 +119,7 @@ def main() -> None:
         w = jnp.where(act, jnp.minimum(w + 0.01, 1.0), w)
         dead = (p >= 0) & (w <= 0.0)
         p = jnp.where(dead, -1, p)
-        return (p, (w * 65535).astype(jnp.uint16))
+        return (p, (w * 65535).astype(jnp.uint16))  # rtap: domain[u16]
 
     bench("pool_pass_flat_u16", scanned(pass2_u16), (pool2, perm2_u16))
 
@@ -130,6 +130,7 @@ def main() -> None:
 
     segpot = jnp.asarray(rng.integers(0, M, (G, C, K * S)), jnp.int32)
     bench("argmax_KS", scanned(
+        # rtap: allow[dtype-domain] — ×0 keeps the op in the graph, value dropped
         lambda x: x + jnp.argmax(x, axis=-1)[..., None].astype(jnp.int32) * 0), segpot)
 
     lperm = jnp.asarray(rng.random((G, L, M)), jnp.float32)

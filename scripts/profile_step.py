@@ -91,7 +91,10 @@ def region_analysis(cfg, G: int, T: int) -> dict:
         init_state(cfg, seed=0))
     vals = jnp.zeros((T, G, cfg.n_fields), jnp.float32)
     ts = jnp.zeros((T, G), jnp.int32)
-    fn = jax.jit(lambda s, v, t: chunk_step(s, v, t, cfg, learn=True))
+    def _chunk_learn(s, v, t):
+        return chunk_step(s, v, t, cfg, learn=True)
+
+    fn = jax.jit(_chunk_learn)
     compiled = fn.lower(state, vals, ts).compile()
 
     txt = compiled.as_text()

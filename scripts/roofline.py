@@ -86,8 +86,10 @@ def cost_of(cfg, G: int, T: int) -> dict:
     vals = jnp.zeros((T, G, 1), jnp.float32)
     ts = jnp.zeros((T, G), jnp.int32)
 
-    fn = jax.jit(lambda s, v, t: chunk_step(s, v, t, cfg, learn=True),
-                 donate_argnums=(0,))
+    def _chunk_learn(s, v, t):
+        return chunk_step(s, v, t, cfg, learn=True)
+
+    fn = jax.jit(_chunk_learn, donate_argnums=(0,))
     compiled = fn.lower(state, vals, ts).compile()
     ca = compiled.cost_analysis()
     if isinstance(ca, (list, tuple)):  # older jax returns [dict]

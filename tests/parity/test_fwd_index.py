@@ -170,3 +170,64 @@ def test_fanout_overflow_counts(forward_dendrite):
     for i in range(300):
         m.run(1_700_000_000 + 300 * i, float(vals[i, 0]))
     assert int(jax.device_get(m._runner.state)["fwd_of"]) > 0
+
+
+@pytest.mark.quick
+def test_incremental_maintenance_matches_rebuild_and_numpy():
+    """apply_removals + apply_appends over a random mutation batch must
+    leave an index that is membership-identical to a canonical rebuild,
+    and dendrite_counts over either index (both impls) must match a
+    direct numpy adjacency count — the ISSUE 14 twin-registry contract
+    for the incremental-maintenance kernels."""
+    import jax.numpy as jnp
+
+    from rtap_tpu.ops.fwd_index import apply_appends, apply_removals, dendrite_counts
+
+    rng = np.random.Generator(np.random.Philox(key=(9, 41)))
+    N, F, pool, M = 64, 32, 512, 8
+    presyn0 = np.where(rng.random(pool) < 0.5,
+                       rng.integers(0, N, pool), -1).astype(np.int32)
+    slots, pos, of = build_fwd_index(presyn0, N, F)
+    assert int(of) == 0
+
+    E = 48
+    mut = rng.choice(pool, E, replace=False).astype(np.int32)
+    new = rng.integers(-1, N, E).astype(np.int32)
+    presyn1 = presyn0.copy()
+    presyn1[mut] = new
+    changed = presyn1[mut] != presyn0[mut]
+    rem = changed & (presyn0[mut] >= 0)
+    add = changed & (presyn1[mut] >= 0)
+
+    s2, p2 = apply_removals(slots, pos, jnp.asarray(mut),
+                            jnp.asarray(presyn0[mut]), jnp.asarray(rem))
+    s2, p2, dropped = apply_appends(s2, p2, jnp.asarray(mut),
+                                    jnp.asarray(presyn1[mut]),
+                                    jnp.asarray(add))
+    assert int(dropped) == 0
+
+    rs, _rp, rof = build_fwd_index(presyn1, N, F)
+    assert int(rof) == 0
+    s2_np, rs_np = np.asarray(s2), np.asarray(rs)
+    for n in range(N):
+        got = set(s2_np[n][s2_np[n] >= 0].tolist())
+        want = set(rs_np[n][rs_np[n] >= 0].tolist())
+        assert got == want, f"cell {n} row membership diverged"
+
+    perm = rng.random(pool).astype(np.float32)
+    act = rng.choice(N, 10, replace=False).astype(np.int32)
+    act_ids = jnp.asarray(np.concatenate([act, [N, N]]).astype(np.int32))
+    n_seg = pool // M
+    seg_of = np.arange(pool) // M
+    active = np.isin(presyn1, act)
+    want_pot = np.bincount(seg_of[active], minlength=n_seg).astype(np.int32)
+    want_conn = np.bincount(seg_of[active & (perm >= 0.5)],
+                            minlength=n_seg).astype(np.int32)
+    for index in (s2, rs):
+        for impl in ("scatter", "matmul"):
+            conn, pot = dendrite_counts(index, jnp.asarray(perm), act_ids,
+                                        0.5, n_seg, M, impl)
+            np.testing.assert_array_equal(np.asarray(pot), want_pot,
+                                          err_msg=f"pot {impl}")
+            np.testing.assert_array_equal(np.asarray(conn), want_conn,
+                                          err_msg=f"conn {impl}")

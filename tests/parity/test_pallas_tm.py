@@ -221,3 +221,29 @@ def test_megakernel_guards_reject_oversized_shapes(pallas_scatter):
     active = jnp.zeros(cfg.sp.columns, bool)
     with pytest.raises(ValueError, match="INTERPRETER|winner-list|VMEM"):
         tm_step(st, active, cfg.tm, learn=True)
+
+
+@pytest.mark.quick
+def test_pallas_mode_actually_dispatches_tm_learn_pallas(pallas_scatter, monkeypatch):
+    """The twin-registry pin for tm_learn_pallas: RTAP_TM_SCATTER=pallas
+    must route the learning pass through the megakernel entry point —
+    if the mode switch silently fell back to the workspace path, every
+    'pallas parity' test above would be vacuously green."""
+    import rtap_tpu.ops.pallas_tm as pallas_tm
+
+    calls = []
+    real = pallas_tm.tm_learn_pallas
+
+    def counting(*args, **kw):
+        calls.append(1)
+        return real(*args, **kw)
+
+    monkeypatch.setattr(pallas_tm, "tm_learn_pallas", counting)
+    C = 32
+    cfg = TMConfig(
+        cells_per_column=4, activation_threshold=2, min_threshold=1,
+        max_segments_per_cell=2, max_synapses_per_segment=8,
+        new_synapse_count=4, learn_cap=16, col_cap=4,
+    )
+    _run_tm_parity(C, cfg, [np.arange(4), np.arange(4)])
+    assert calls, "pallas scatter mode never reached tm_learn_pallas"
