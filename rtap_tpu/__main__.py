@@ -56,7 +56,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     from rtap_tpu.config import nab_preset
     from rtap_tpu.service.loop import live_loop
     from rtap_tpu.service.registry import StreamGroupRegistry
+    from rtap_tpu.service.shardpath import shard_scoped_path
     from rtap_tpu.service.sources import HttpPollSource, TcpJsonlSource
+
+    # Shard-scope every operator resource path up front (ISSUE 15, the
+    # shard-resource gate): one serve process = one mesh shard, and its
+    # journal dir, checkpoint claims, lease file, and alert sink (plus
+    # the .corr/.epoch sidecars derived from it downstream) must be
+    # distinct per shard. Today's single-shard serve is shard 0 —
+    # shard_scoped_path returns every path byte-identical — and
+    # ROADMAP-1's mesh launcher lands its shard index here.
+    serve_shard = 0
+    for _attr in ("journal_dir", "checkpoint_dir", "lease_file", "alerts"):
+        if getattr(args, _attr, None):
+            setattr(args, _attr,
+                    shard_scoped_path(getattr(args, _attr), serve_shard))
 
     if args.streams.startswith("@"):
         # @file form: one stream id per line — a 16k-stream fleet's comma

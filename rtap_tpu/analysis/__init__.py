@@ -1,5 +1,5 @@
 """rtap-lint: AST-based invariant analysis for the serve stack AND the
-device-kernel surface (ISSUEs 12 + 13 + 14).
+device-kernel surface (ISSUEs 12 + 13 + 14 + 15).
 
 The repo's correctness story rests on contracts no test fully covers —
 bit-exact device/oracle twins, exactly-once alert delivery, and a lock
@@ -11,7 +11,10 @@ whole-program passes over the shared model in
 host/device boundary with a kernel model
 (``rtap_tpu/analysis/kernels.py``: jit-wrapper discovery with
 static/donate extraction, the ops/ ↔ oracle/ twin registry) feeding
-six device passes:
+six device passes; v4 (ISSUE 15) adds the mesh-readiness family over a
+mesh model (``rtap_tpu/analysis/meshmodel.py``: mesh entry points,
+host boundaries, partition-rule tables, the shard-resource registry) —
+the machine-checked work inventory for ROADMAP-1's pod-scale sharding:
 
 ==================  ====================================================
 pass (module)       rules
@@ -58,6 +61,22 @@ dtypedomain         ``dtype-domain`` (declared u8|u16|i32-key domains:
                     multiplies, or undeclared quantized casts)
 wirecontract        ``wire-contract`` (RB1/RJ struct formats, magics,
                     and type codes cross-checked against the wire docs)
+partition           ``partition-contract`` (every state leaf declares
+                    shard-streams|replicated|host-only; coverage exact;
+                    consumers and checkpoint/journal wiring agree)
+devicescope         ``device-scope`` (devices()[0] reads, device
+                    fetches outside declared host boundaries, flat-
+                    stream-id arithmetic bypassing SlotAddress)
+collectives         ``collective-discipline`` (psum/all_gather/
+                    ppermute/shard_map banned outside declared mesh
+                    entry points — sharded_chunk_step stays
+                    collective-free by gate)
+shardresource       ``shard-resource`` (journal/checkpoint/lease/
+                    sidecar paths derive from service/shardpath.py,
+                    never bare concat)
+scalingmath         ``scaling-math`` (SCALING.md bytes/stream +
+                    streams/chip cross-checked against a static
+                    derivation from the config dataclasses)
 ==================  ====================================================
 
 CLI: ``python -m rtap_tpu.analysis`` (human report, exit 0 iff zero
@@ -76,17 +95,22 @@ syntax and the triage runbook: docs/ANALYSIS.md.
 from __future__ import annotations
 
 from rtap_tpu.analysis import (
+    collectives,
     crossshare,
     determinism,
+    devicescope,
     donation,
     dtypedomain,
     excepts,
     flags,
     lifecycle,
     lockorder,
+    partition,
     prints,
     purity,
     races,
+    scalingmath,
+    shardresource,
     statichash,
     tracesafety,
     twinparity,
@@ -112,7 +136,9 @@ from rtap_tpu.analysis.core import (  # noqa: F401
 PASSES = (prints, excepts, flags, purity, races,
           determinism, lifecycle, lockorder, crossshare,
           tracesafety, statichash, dtypedomain,
-          twinparity, donation, wirecontract)
+          twinparity, donation, wirecontract,
+          devicescope, collectives, shardresource,
+          partition, scalingmath)
 
 #: rule id -> description, across every pass (the CLI's --list-passes)
 ALL_RULES = {rid: desc for mod in PASSES for rid, desc in mod.RULES.items()}

@@ -1,0 +1,90 @@
+"""Collective discipline: the chunk step stays collective-free, forever.
+
+Rule ``collective-discipline`` (ISSUE 15) — the entire ROADMAP-1
+scale-out story rests on one measured property: ``sharded_chunk_step``
+is collective-free (SCALING.md — per-stream state never couples across
+the mesh, so XLA inserts zero cross-chip communication and scale-out is
+linear by construction). That property is currently true by
+inspection; this pass makes it a permanent gate: ``psum`` /
+``all_gather`` / ``ppermute`` / ``shard_map`` and friends are BANNED
+everywhere except declared mesh entry points — the functions that own
+placement (``rtap_tpu/parallel/`` wholesale, any function calling the
+parallel placement API, or an explicit ``# rtap: mesh-entry — why``).
+
+A collective inside a chunk-scan body would not just be slow: it would
+change the program's numerics per mesh shape and break the bit-exact
+single-device ≡ sharded contract the parity tree pins. Finding symbol:
+``<qual>:collective:<name>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.kernels import dotted
+from rtap_tpu.analysis.meshmodel import build_mesh_model, scopes_of
+
+PASS_NAME = "collective-discipline"
+PARTITION = "file"
+RULES = {
+    "collective-discipline": "cross-device collectives (psum/"
+                             "all_gather/ppermute/shard_map/...) "
+                             "outside declared mesh entry points — "
+                             "pins sharded_chunk_step's collective-"
+                             "free property",
+}
+
+#: the jax cross-device vocabulary (lax collectives + the spmd wrappers)
+_COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "pbroadcast",
+    "all_gather", "all_to_all", "ppermute", "pshuffle", "pswapaxes",
+    "axis_index", "shard_map", "pmap", "xmap", "pdot",
+})
+
+#: call roots that make a bare-looking collective name credible
+_ROOTS = ("jax", "lax", "jnp", "pl", "shard_map")
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    model = build_mesh_model(ctx)
+    out: list[Finding] = []
+    for sf in ctx.files:
+        if sf.tree is None or sf.path.startswith("rtap_tpu/parallel/"):
+            continue   # the mesh module is the blessed home
+        if not any(name in sf.text for name in _COLLECTIVES):
+            continue   # text prefilter: collectives are rare by design
+        for qual, nodes in scopes_of(sf):
+            if model.is_entry(sf.path, qual):
+                continue
+            for node in nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None:
+                    continue
+                leaf = d.rsplit(".", 1)[-1]
+                if leaf not in _COLLECTIVES:
+                    continue
+                root = d.split(".", 1)[0]
+                if "." in d and root not in _ROOTS:
+                    continue   # someone else's method named psum
+                in_ops = sf.path.startswith("rtap_tpu/ops/")
+                out.append(Finding(
+                    rule="collective-discipline", path=sf.path,
+                    line=node.lineno,
+                    symbol=f"{qual}:collective:{leaf}",
+                    message=(f"collective {leaf}() "
+                             + ("inside the kernel surface — the chunk "
+                                "step's collective-free property is a "
+                                "measured scale-out contract (SCALING."
+                                "md); per-stream state must never "
+                                "couple across the mesh"
+                                if in_ops else
+                                "outside a declared mesh entry point — "
+                                "placement and cross-shard reduction "
+                                "belong to rtap_tpu/parallel/ or a "
+                                "`# rtap: mesh-entry` function")
+                             + "; if this site must own placement, "
+                               "declare it `# rtap: mesh-entry — why`")))
+    return out

@@ -62,15 +62,19 @@ BASELINE_NAME = "analysis_baseline.json"
 #: the artifact dict — soaks/hw_session archive these lines across
 #: months and the reader must be able to dispatch on shape. v3
 #: (ISSUE 14): cache gains the "warm" mode (pass-partitioned partial
-#: reuse) and per_pass covers the device-kernel pass family.
-SCHEMA_VERSION = 3
+#: reuse) and per_pass covers the device-kernel pass family. v4
+#: (ISSUE 15): the mesh-readiness pass family lands (partition-contract,
+#: device-scope, collective-discipline, shard-resource, scaling-math)
+#: and SCALING.md joins the analyzer inputs.
+SCHEMA_VERSION = 4
 
 #: default findings-cache filename at the analysis root (gitignored)
 CACHE_NAME = ".rtap_lint_cache.json"
 
 #: bump to orphan every existing cache when the cache format changes
-#: (2: ISSUE 14 — per-file pass partition section added)
-_CACHE_FORMAT = 2
+#: (2: ISSUE 14 — per-file pass partition section added; 3: ISSUE 15 —
+#: SCALING.md hash joins the key)
+_CACHE_FORMAT = 3
 
 #: gate-critical rules that neither inline suppressions nor the baseline
 #: may silence — the print gate is plumbing other gates stand on, and a
@@ -167,6 +171,11 @@ class AnalysisContext:
     #: parity test must re-fail the gate, so the parity tree is an
     #: analyzer INPUT and rides the cache key like the docs text)
     parity_text: str | None = None
+    #: SCALING.md at the repo root (scaling-math pass, ISSUE 15: the
+    #: quoted bytes/stream numbers are cross-checked against a static
+    #: derivation from the config dataclasses — editing the doc must
+    #: re-run the pass, so it is an analyzer INPUT like the docs text)
+    scaling_text: str | None = None
 
     def files_under(self, *prefixes: str) -> list[SourceFile]:
         return [f for f in self.files
@@ -192,6 +201,12 @@ class AnalysisContext:
         if self.parity_text is None:
             self.parity_text = _parity_text(self.root)
         return self.parity_text
+
+    def scaling(self) -> str:
+        # same single-loader discipline again (scaling-math pass)
+        if self.scaling_text is None:
+            self.scaling_text = _scaling_text(self.root)
+        return self.scaling_text
 
 
 class Baseline:
@@ -390,8 +405,19 @@ def _parity_text(root: str) -> str:
     return "\n".join(chunks)
 
 
+def _scaling_text(root: str) -> str:
+    """SCALING.md at the repo root — the scaling-math pass's
+    cross-check subject (and a cache-key input for the same reason the
+    docs text is; it lives at the root, outside _docs_text's walk)."""
+    p = os.path.join(root, "SCALING.md")
+    if os.path.isfile(p):
+        with open(p, encoding="utf-8") as fh:
+            return fh.read()
+    return ""
+
+
 def _cache_key(texts: list[tuple[str, str]], docs: str, parity: str,
-               baseline_path: str) -> dict:
+               scaling: str, baseline_path: str) -> dict:
     try:
         with open(baseline_path, encoding="utf-8") as fh:
             baseline_hash = _sha(fh.read())
@@ -403,6 +429,7 @@ def _cache_key(texts: list[tuple[str, str]], docs: str, parity: str,
         "files": {p: _sha(t) for p, t in texts},
         "docs": _sha(docs),
         "parity": _sha(parity),
+        "scaling": _sha(scaling),
         "baseline": baseline_hash,
     }
 
@@ -453,7 +480,8 @@ def run_analysis_cached(root: str, baseline_path: str | None = None,
     texts = discover_texts(root)
     docs = _docs_text(root)
     parity = _parity_text(root)
-    key = _cache_key(texts, docs, parity, baseline_path)
+    scaling = _scaling_text(root)
+    key = _cache_key(texts, docs, parity, scaling, baseline_path)
     try:
         with open(cache_path, encoding="utf-8") as fh:
             cached = json.load(fh)
@@ -477,7 +505,7 @@ def run_analysis_cached(root: str, baseline_path: str | None = None,
 
     files = [SourceFile(p, t) for p, t in texts]
     ctx = AnalysisContext(root=root, files=files, docs_text=docs,
-                          parity_text=parity)
+                          parity_text=parity, scaling_text=scaling)
     baseline = Baseline.load(baseline_path)
     file_passes = [m for m in PASSES
                    if getattr(m, "PARTITION", "program") == "file"]
@@ -489,7 +517,7 @@ def run_analysis_cached(root: str, baseline_path: str | None = None,
     perfile: dict[str, dict] = {}
     changed = [f for f in files if f.path not in reuse]
     sub = AnalysisContext(root=root, files=changed, docs_text=docs,
-                          parity_text=parity)
+                          parity_text=parity, scaling_text=scaling)
     fresh_raw, fresh_counts = _run_passes(sub, file_passes)
     for p, n in fresh_counts.items():
         per_pass[p] += n

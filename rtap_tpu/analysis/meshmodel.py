@@ -1,0 +1,459 @@
+"""The mesh model the v4 (mesh-readiness) passes share (ISSUE 15).
+
+ROADMAP-1 (pod-scale sharded serving: 1M streams on a v5e-8 mesh) is
+blocked not by the kernels — ``sharded_chunk_step`` is collective-free
+and bit-exact under the mesh — but by the serve stack's implicit
+single-device assumptions: ``jax.local_devices()[0]`` reads, blanket
+``device_get`` fetches, journal/lease/alert paths with no shard
+qualifier. SDR theory (PAPERS.md 1503.07469) makes stream-axis sharding
+embarrassingly parallel — per-stream state never couples across the
+mesh — so every cross-shard data or resource flow is a bug-in-waiting,
+and all of them are statically visible. This module builds the one
+model the four mesh passes share, once per run, memoized on the
+context:
+
+* **mesh entry points** — functions whose own body calls the
+  ``rtap_tpu/parallel`` placement API (``make_stream_mesh`` /
+  ``stream_sharding`` / ``put_sharded`` / ``shard_state`` /
+  ``broadcast_group_state`` / ``init_distributed``), every function in
+  ``rtap_tpu/parallel/`` itself, plus explicit declarations::
+
+      # rtap: mesh-entry — registry builds the group mesh here
+
+  Entry points are where collectives and device placement legitimately
+  live; everywhere else they are findings.
+
+* **host boundaries** — functions declared as the place where sharded
+  device values legitimately materialize on host::
+
+      # rtap: host-boundary — checkpoint save fetches the full tree
+
+  (on the ``def``/decorator line or the contiguous comment block above,
+  the ``twin[...]`` placement grammar). Mesh entry points are host
+  boundaries too — they own placement in both directions.
+
+* **partition tables + state-tree constructors** — the declared
+  partition rule for every state leaf built in ``rtap_tpu/models/``.
+  Rules (docs/ANALYSIS.md)::
+
+      # rtap: partition[presyn=shard-streams, scores=host-only]  (module)
+      "boost": np.ones(C, np.float32),  # rtap: partition[shard-streams]
+
+  Valid rules: ``shard-streams`` (leading G axis splits over the
+  mesh), ``replicated`` (every shard holds the full leaf), and
+  ``host-only`` (never device-resident; per-shard process state).
+  Constructors are discovered structurally: any models/ function whose
+  body builds dict literals of numpy/jnp arrays under string keys (the
+  state.py/likelihood.py idiom) — so a brand-new state tree can never
+  dodge the contract by not opting in.
+
+* **shard resources** — filesystem-path-producing sites in the serve
+  stack (``TickJournal``/``Lease``/``AlertWriter`` construction, alert
+  sidecar suffixes, checkpoint group-claim components): the
+  shard-resource pass's ground truth for the "one shard-qualified
+  helper" rule (service/shardpath.py).
+
+Everything is pure AST — no jax import, same discipline as the rest of
+the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding, SourceFile
+from rtap_tpu.analysis.kernels import dotted, functions_in, own_body_nodes
+
+__all__ = [
+    "MESH_APIS",
+    "MODULE_QUAL",
+    "MeshModel",
+    "PARTITION_RULES",
+    "ResourceSite",
+    "StateConstructor",
+    "build_mesh_model",
+    "fn_marker",
+    "functions_of",
+    "module_level_nodes",
+    "scopes_of",
+]
+
+
+def functions_of(sf: SourceFile) -> list:
+    """``functions_in(sf.tree)``, memoized on the SourceFile — the v4
+    passes each iterate every function of every scoped file, and four
+    independent full-tree walks per file blew the warm-run budget."""
+    cached = getattr(sf, "_functions", None)
+    if cached is None:
+        cached = functions_in(sf.tree) if sf.tree is not None else []
+        sf._functions = cached
+    return cached
+
+
+#: the synthetic qualname for import-time code — module body and class
+#: bodies outside any def. The mesh passes must see it too: a
+#: module-level ``devices()[0]`` pick or ``path + ".corr"`` mint runs
+#: at import and is MORE dangerous than the same line in a function
+MODULE_QUAL = "(module)"
+
+
+def module_level_nodes(sf: SourceFile):
+    """Every AST node that executes at import time: the module body and
+    class bodies, excluding function defs (those get their own
+    qualnames from :func:`functions_of`)."""
+    stack = list(sf.tree.body) if sf.tree is not None else []
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def scopes_of(sf: SourceFile):
+    """(qualname, node iterable) for every scope a mesh pass must scan:
+    the import-time scope first, then each function body."""
+    yield MODULE_QUAL, module_level_nodes(sf)
+    for qual, fn in functions_of(sf):
+        yield qual, own_body_nodes(fn)
+
+
+#: the parallel-placement API: calling any of these makes the caller a
+#: mesh entry point (it is MAKING a placement decision)
+MESH_APIS = frozenset({
+    "make_stream_mesh", "stream_sharding", "put_sharded", "shard_state",
+    "broadcast_group_state", "init_distributed",
+})
+
+#: valid partition rules (docs/ANALYSIS.md)
+PARTITION_RULES = ("shard-streams", "replicated", "host-only")
+
+#: alert sidecar suffixes — the names a second shard would clobber if
+#: minted by bare concat (service/shardpath.py owns them now)
+RESOURCE_SUFFIXES = (".corr", ".epoch")
+
+_MESH_ENTRY_RE = re.compile(r"#\s*rtap:\s*mesh-entry\b")
+_HOST_BOUNDARY_RE = re.compile(r"#\s*rtap:\s*host-boundary\b")
+_PARTITION_MODULE_RE = re.compile(
+    r"#\s*rtap:\s*partition\[([A-Za-z_][\w]*\s*=\s*[\w-]+"
+    r"(?:\s*,\s*[A-Za-z_][\w]*\s*=\s*[\w-]+)*)\]")
+_PARTITION_TRAILING_RE = re.compile(r"#\s*rtap:\s*partition\[([\w-]+)\]")
+
+
+def fn_marker(sf: SourceFile, fn: ast.FunctionDef, marker: re.Pattern) -> bool:
+    """True when `marker` appears on the ``def`` line, a decorator
+    line, or the contiguous comment block directly above them — the
+    same placement grammar as ``# rtap: twin[...]`` (kernels.py)."""
+    first = min([fn.lineno] + [d.lineno for d in fn.decorator_list])
+    for ln in range(first, fn.lineno + 1):
+        if ln - 1 < len(sf.lines) and marker.search(sf.lines[ln - 1]):
+            return True
+    ln = first - 1
+    while ln >= 1 and sf.lines[ln - 1].lstrip().startswith("#"):
+        if marker.search(sf.lines[ln - 1]):
+            return True
+        ln -= 1
+    return False
+
+
+@dataclass
+class StateConstructor:
+    """One discovered state-tree-building function in models/."""
+
+    qual: str
+    path: str
+    line: int
+    #: (leaf name, line of the dict key) in source order
+    leaves: list[tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class ResourceSite:
+    """One filesystem-resource construction site in the serve stack."""
+
+    kind: str        # "TickJournal" | "Lease" | "AlertWriter" | "mint"
+    path: str
+    line: int
+    qual: str
+    #: for constructor sites: the path argument node; for mints: the
+    #: offending expression
+    node: ast.AST | None = None
+    detail: str = ""
+
+
+@dataclass
+class MeshModel:
+    #: (path, qualname) of every mesh entry point
+    entry_points: set[tuple[str, str]] = field(default_factory=set)
+    #: (path, qualname) of every declared host boundary (entry points
+    #: are host boundaries too — see module docstring)
+    host_boundaries: set[tuple[str, str]] = field(default_factory=set)
+    #: models/ partition tables: path -> {leaf name -> (rule, line)}
+    partition_tables: dict[str, dict[str, tuple[str, int]]] = \
+        field(default_factory=dict)
+    #: models/ trailing annotations: path -> {line -> rule}
+    partition_trailing: dict[str, dict[int, str]] = field(default_factory=dict)
+    #: malformed partition annotations (unknown rule tokens)
+    partition_errors: list[Finding] = field(default_factory=list)
+    #: discovered state-tree constructors in models/
+    constructors: list[StateConstructor] = field(default_factory=list)
+    #: merged leaf -> rule across every models/ file (consumer checks);
+    #: None when the leaf has no (valid) declaration yet
+    leaf_rules: dict[str, str | None] = field(default_factory=dict)
+    #: serve-stack resource construction sites (shard-resource pass)
+    resources: list[ResourceSite] = field(default_factory=list)
+
+    def is_entry(self, path: str, qual: str) -> bool:
+        if path.startswith("rtap_tpu/parallel/"):
+            return True
+        return _self_or_outer(self.entry_points, path, qual)
+
+    def is_host_boundary(self, path: str, qual: str) -> bool:
+        return self.is_entry(path, qual) or _self_or_outer(
+            self.host_boundaries, path, qual)
+
+    def rule_of(self, leaf: str) -> str | None:
+        return self.leaf_rules.get(leaf)
+
+
+def _self_or_outer(table: set, path: str, qual: str) -> bool:
+    """A nested function inherits its enclosing function's declaration
+    (the annotation sits on the outer ``def``; locals are its body)."""
+    parts = qual.split(".")
+    for i in range(len(parts), 0, -1):
+        if (path, ".".join(parts[:i])) in table:
+            return True
+    return False
+
+
+# ------------------------------------------------------- partition tables --
+
+def partition_annotations(sf: SourceFile) -> tuple[dict[str, tuple[str, int]],
+                                                   dict[int, str],
+                                                   list[Finding]]:
+    """(module-wide leaf->(rule, line), line->rule trailing form, syntax
+    findings) — the dtype-domain table grammar, reused for partitions."""
+    table: dict[str, tuple[str, int]] = {}
+    trailing: dict[int, str] = {}
+    bad: list[Finding] = []
+    for i, line in enumerate(sf.lines, start=1):
+        m = _PARTITION_MODULE_RE.search(line)
+        if m:
+            for pair in m.group(1).split(","):
+                name, rule = (s.strip() for s in pair.split("="))
+                if rule not in PARTITION_RULES:
+                    bad.append(Finding(
+                        rule="partition-contract", path=sf.path, line=i,
+                        symbol=f"partition-syntax:{name}",
+                        message=f"unknown partition rule '{rule}' — "
+                                f"valid: {', '.join(PARTITION_RULES)}"))
+                else:
+                    table[name] = (rule, i)
+            continue
+        m = _PARTITION_TRAILING_RE.search(line)
+        if m:
+            rule = m.group(1)
+            if rule not in PARTITION_RULES:
+                bad.append(Finding(
+                    rule="partition-contract", path=sf.path, line=i,
+                    symbol="partition-syntax:trailing",
+                    message=f"unknown partition rule '{rule}' — valid: "
+                            f"{', '.join(PARTITION_RULES)}"))
+            else:
+                trailing[i] = rule
+    return table, trailing, bad
+
+
+def _np_rooted_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            d = dotted(sub.func)
+            if d and d.split(".", 1)[0] in ("np", "numpy", "jnp"):
+                return True
+    return False
+
+
+def _constructor_leaves(fn: ast.FunctionDef) -> list[tuple[str, int]]:
+    """String keys of every array-building dict literal in `fn` (the
+    state-tree idiom: string keys over np/jnp constructor values).
+    Returns [] when the function does not look like a constructor
+    (fewer than 3 such keys across all its dicts)."""
+    leaves: list[tuple[str, int]] = []
+    for node in own_body_nodes(fn):
+        if not isinstance(node, ast.Dict):
+            continue
+        if not any(v is not None and _np_rooted_call(v)
+                   for v in node.values):
+            continue
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                leaves.append((k.value, k.lineno))
+    return leaves if len(leaves) >= 3 else []
+
+
+# ----------------------------------------------------- resource registry --
+
+#: serve-stack classes whose first argument is a filesystem path a
+#: second shard would clobber (the shard-resource constructor registry)
+_RESOURCE_CLASSES = ("TickJournal", "Lease", "AlertWriter")
+
+#: files the resource registry scans (the serve stack's path-producing
+#: surface; ops/models build no files)
+RESOURCE_SCOPE = ("rtap_tpu/service/", "rtap_tpu/resilience/",
+                  "rtap_tpu/correlate/", "rtap_tpu/obs/",
+                  "rtap_tpu/__main__.py")
+
+
+def _is_group_claim_fstring(node: ast.JoinedStr) -> bool:
+    """f"group{gi:04d}" — the checkpoint group-claim component. The
+    zero-padded spec is what distinguishes an on-disk claim name from
+    the many diagnostic f-strings that merely SAY "group" (trace track
+    names, chaos messages, stats keys)."""
+    has_claim_spec = any(
+        isinstance(v, ast.FormattedValue)
+        and isinstance(v.format_spec, ast.JoinedStr)
+        and any(isinstance(s, ast.Constant) and "04d" in str(s.value)
+                for s in v.format_spec.values)
+        for v in node.values)
+    return has_claim_spec and any(
+        isinstance(v, ast.Constant) and isinstance(v.value, str)
+        and v.value.endswith("group") for v in node.values)
+
+
+def _mint_detail(node: ast.AST) -> str | None:
+    """Non-None when `node` mints a shard-scoped resource path by bare
+    string construction — the exact thing service/shardpath.py exists
+    to own. Returns the human label of what was minted."""
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        for side in (node.left, node.right):
+            if isinstance(side, ast.Constant) and isinstance(side.value, str):
+                if side.value in RESOURCE_SUFFIXES:
+                    return f"sidecar suffix {side.value!r}"
+    if isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                for suf in RESOURCE_SUFFIXES:
+                    if suf in v.value:
+                        return f"sidecar suffix {suf!r}"
+        if _is_group_claim_fstring(node):
+            return "checkpoint group-claim component"
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1] if d else None
+        if leaf in ("join", "with_name", "with_suffix"):
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str) \
+                        and (a.value in RESOURCE_SUFFIXES
+                             or a.value.startswith("group")):
+                    return f"resource component {a.value!r}"
+                if isinstance(a, ast.JoinedStr) \
+                        and _is_group_claim_fstring(a):
+                    return "checkpoint group-claim component"
+    return None
+
+
+def build_mesh_model(ctx: AnalysisContext) -> MeshModel:
+    """Build (or return the memoized) mesh model for this context."""
+    cached = getattr(ctx, "_mesh_model", None)
+    if cached is not None:
+        return cached
+    model = MeshModel()
+
+    for sf in ctx.files:
+        if sf.tree is None:
+            continue
+        in_models = sf.path.startswith("rtap_tpu/models/")
+        if in_models:
+            table, trailing, bad = partition_annotations(sf)
+            if table:
+                model.partition_tables[sf.path] = table
+            if trailing:
+                model.partition_trailing[sf.path] = trailing
+            model.partition_errors.extend(bad)
+        # text prefilters: most files never mention the placement API or
+        # the annotations, and a full body walk per function across the
+        # whole surface is what blows the warm-run budget
+        may_entry_ann = "mesh-entry" in sf.text
+        may_hb_ann = "host-boundary" in sf.text
+        may_call_api = any(api in sf.text for api in MESH_APIS)
+        if not (may_entry_ann or may_hb_ann or may_call_api or in_models):
+            continue
+        for qual, fn in functions_of(sf):
+            # ---- entry points / host boundaries ---------------------
+            if may_entry_ann and fn_marker(sf, fn, _MESH_ENTRY_RE):
+                model.entry_points.add((sf.path, qual))
+            elif may_call_api:
+                for node in own_body_nodes(fn):
+                    if isinstance(node, ast.Call):
+                        d = dotted(node.func)
+                        if d and d.rsplit(".", 1)[-1] in MESH_APIS:
+                            model.entry_points.add((sf.path, qual))
+                            break
+            if may_hb_ann and fn_marker(sf, fn, _HOST_BOUNDARY_RE):
+                model.host_boundaries.add((sf.path, qual))
+            # ---- state-tree constructors ----------------------------
+            if in_models:
+                leaves = _constructor_leaves(fn)
+                if leaves:
+                    model.constructors.append(StateConstructor(
+                        qual=qual, path=sf.path, line=fn.lineno,
+                        leaves=leaves))
+
+    # merged leaf -> rule view for the consumer checks: trailing form
+    # wins over the module table (it sits on the leaf itself). Two
+    # files declaring DIFFERENT rules for one leaf name is a finding,
+    # not a first-wins tiebreak — the consumer checks would otherwise
+    # validate against whichever file enumerates first
+    origin: dict[str, tuple[str, str]] = {}   # leaf -> (rule, path)
+    for c in model.constructors:
+        table = model.partition_tables.get(c.path, {})
+        trailing = model.partition_trailing.get(c.path, {})
+        for name, line in c.leaves:
+            rule = trailing.get(line) or table.get(name, (None, 0))[0]
+            prev = origin.get(name)
+            if rule is not None and prev is not None \
+                    and prev[0] is not None and prev[0] != rule:
+                model.partition_errors.append(Finding(
+                    rule="partition-contract", path=c.path, line=line,
+                    symbol=f"partition-conflict:{name}",
+                    message=f"leaf {name!r} declares rule '{rule}' here "
+                            f"but '{prev[0]}' in {prev[1]} — one leaf "
+                            "name, one placement; rename the leaf or "
+                            "reconcile the rules"))
+            if prev is None or prev[0] is None:
+                origin[name] = (rule, c.path)
+            model.leaf_rules[name] = origin[name][0]
+
+    # ---- shard-resource registry ------------------------------------
+    for sf in ctx.files_under(*RESOURCE_SCOPE):
+        if sf.tree is None:
+            continue
+        t = sf.text
+        if not (any(s in t for s in RESOURCE_SUFFIXES)
+                or ("group" in t and "04d" in t)
+                or any(c in t for c in _RESOURCE_CLASSES)):
+            continue   # nothing resource-shaped to register
+        for qual, nodes in scopes_of(sf):
+            for node in nodes:
+                if isinstance(node, ast.Call):
+                    d = dotted(node.func)
+                    leaf = d.rsplit(".", 1)[-1] if d else None
+                    if leaf in _RESOURCE_CLASSES and node.args:
+                        model.resources.append(ResourceSite(
+                            kind=leaf, path=sf.path, line=node.lineno,
+                            qual=qual, node=node.args[0]))
+                detail = _mint_detail(node)
+                if detail is not None and not any(
+                        r.kind == "mint" and r.path == sf.path
+                        and r.line == node.lineno
+                        for r in model.resources):
+                    # one finding per line: an os.path.join over an
+                    # f"group{gi:04d}" literal is ONE mint, not two
+                    model.resources.append(ResourceSite(
+                        kind="mint", path=sf.path, line=node.lineno,
+                        qual=qual, node=node, detail=detail))
+
+    ctx._mesh_model = model
+    return model

@@ -1,0 +1,136 @@
+"""Shard-scoped resources: two shards must never clobber one file.
+
+Rule ``shard-resource`` (ISSUE 15) — the journal dir, the checkpoint
+group claims, the lease file, and the alert/corr sidecars are all
+per-serve-process state. Run two shard processes of ROADMAP-1's mesh
+against the same operator paths and every one of them becomes a silent
+split-brain: interleaved journal segments, a lease two leaders both
+think they hold, a correlator sidecar floor ping-ponging between two
+folds. The fix discipline is ONE shard-qualified helper —
+``service/shardpath.py`` (``shard_scoped_path`` / ``group_checkpoint_
+path`` / ``alert_sidecar_path``; shard 0 is byte-identical to the
+pre-mesh paths) — and this pass makes bypassing it a finding:
+
+* ``<qual>:mint`` — a resource path minted by bare string construction
+  (``path + ".corr"``, ``f"group{gi:04d}"`` joins, sidecar suffixes in
+  f-strings) anywhere outside shardpath.py: only the helper may spell
+  these suffixes, so a new call site cannot forget the shard;
+* ``<qual>:inline-path:<Class>`` — a ``TickJournal``/``Lease``/
+  ``AlertWriter`` constructed over an inline path expression instead
+  of a helper-bound name (the concat hazard at the construction site
+  itself);
+* ``serve-wiring:<flag>`` — the serve CLI (rtap_tpu/__main__.py) wires
+  an operator resource flag (``--journal-dir``/``--checkpoint-dir``/
+  ``--lease-file``/``--alerts``) without routing it through
+  ``shard_scoped_path`` (the zero-cost rebind that makes every
+  downstream path shard-correct the day the shard index is nonzero).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from rtap_tpu.analysis.core import AnalysisContext, Finding
+from rtap_tpu.analysis.kernels import dotted, own_body_nodes
+from rtap_tpu.analysis.meshmodel import build_mesh_model, functions_of
+
+PASS_NAME = "shard-resource"
+PARTITION = "file"
+RULES = {
+    "shard-resource": "shard-scoped resource paths (journal dir, "
+                      "checkpoint claims, lease file, alert sidecars) "
+                      "minted outside service/shardpath.py or wired "
+                      "past it",
+}
+
+#: the one helper module allowed to spell resource suffixes
+HELPER_PATH = "rtap_tpu/service/shardpath.py"
+
+#: the helpers a constructor-site path expression may call directly
+HELPER_FNS = frozenset({"shard_scoped_path", "group_checkpoint_path",
+                        "alert_sidecar_path"})
+
+#: serve flags whose values are shard-scoped resources (attr names on
+#: the parsed argparse namespace)
+SERVE_RESOURCE_FLAGS = ("journal_dir", "checkpoint_dir", "lease_file",
+                        "alerts")
+
+_MAIN_PATH = "rtap_tpu/__main__.py"
+
+
+def _scoped_expr(node: ast.AST) -> bool:
+    """True when a constructor's path argument is an opaque binding
+    (responsibility chained to the caller) or a direct helper call —
+    never an inline concat/f-string/join minted at the site."""
+    if isinstance(node, (ast.Name, ast.Attribute, ast.Subscript,
+                         ast.Constant)):
+        return True
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1] if d else None
+        if leaf in HELPER_FNS:
+            return True
+        # Path(x) / str(x) wrappers around an opaque binding stay opaque
+        if leaf in ("Path", "str") and len(node.args) == 1:
+            return _scoped_expr(node.args[0])
+    return False
+
+
+def run(ctx: AnalysisContext) -> list[Finding]:
+    model = build_mesh_model(ctx)
+    out: list[Finding] = []
+    for site in model.resources:
+        if site.path == HELPER_PATH:
+            continue   # the helper owns the suffixes by design
+        if site.kind == "mint":
+            out.append(Finding(
+                rule="shard-resource", path=site.path, line=site.line,
+                symbol=f"{site.qual}:mint",
+                message=f"resource path minted by bare string "
+                        f"construction ({site.detail}) — only "
+                        "service/shardpath.py may spell shard-scoped "
+                        "suffixes/claims; route through "
+                        "shard_scoped_path/group_checkpoint_path/"
+                        "alert_sidecar_path so a second shard can "
+                        "never clobber this file"))
+        elif site.node is not None and not _scoped_expr(site.node):
+            out.append(Finding(
+                rule="shard-resource", path=site.path, line=site.line,
+                symbol=f"{site.qual}:inline-path:{site.kind}",
+                message=f"{site.kind} constructed over an inline path "
+                        "expression — bind the path through a "
+                        "service/shardpath helper (or an opaque "
+                        "parameter the caller scoped) first"))
+
+    # ---- serve CLI wiring: every resource flag passes the helper -----
+    main = ctx.file(_MAIN_PATH)
+    if main is not None and main.tree is not None:
+        used = {f for f in SERVE_RESOURCE_FLAGS
+                if f"args.{f}" in main.text}
+        covered: set[str] = set()
+        for qual, fn in functions_of(main):
+            calls_helper = any(
+                isinstance(n, ast.Call)
+                and (dotted(n.func) or "").rsplit(".", 1)[-1]
+                == "shard_scoped_path"
+                for n in own_body_nodes(fn))
+            if not calls_helper:
+                continue
+            for n in own_body_nodes(fn):
+                if isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str) \
+                        and n.value in SERVE_RESOURCE_FLAGS:
+                    covered.add(n.value)
+                elif isinstance(n, ast.Attribute) \
+                        and n.attr in SERVE_RESOURCE_FLAGS:
+                    covered.add(n.attr)
+        for flag in sorted(used - covered):
+            out.append(Finding(
+                rule="shard-resource", path=_MAIN_PATH, line=1,
+                symbol=f"serve-wiring:{flag}",
+                message=f"serve wires args.{flag} without routing it "
+                        "through shard_scoped_path — the operator path "
+                        "reaches a shard-scoped resource un-scoped "
+                        "(shard 0 is byte-identical, so the rebind is "
+                        "free today and correct on the mesh)"))
+    return out

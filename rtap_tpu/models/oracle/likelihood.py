@@ -80,16 +80,19 @@ class AnomalyLikelihood:
         self.have_distribution = self.records >= self.cfg.probationary_period
 
     # serialization seam, mirroring BatchAnomalyLikelihood.state_dict — the
-    # single source of truth for what this state machine persists
+    # single source of truth for what this state machine persists.
+    # Partition rules (ISSUE 15): likelihood state is host-side post-
+    # processing — it never lives in HBM, and under the mesh each shard
+    # PROCESS owns the moments of exactly its own streams (host-only).
     def state_dict(self) -> dict:
         return {
-            "records": np.asarray(self.records, np.int64),
-            "have_distribution": np.asarray(int(self.have_distribution), np.int64),
-            "scalars": np.array(
+            "records": np.asarray(self.records, np.int64),  # rtap: partition[host-only]
+            "have_distribution": np.asarray(int(self.have_distribution), np.int64),  # rtap: partition[host-only]
+            "scalars": np.array(  # rtap: partition[host-only]
                 [self.mean, self.std, self._s0, self._s1, self._s2], np.float64
             ),
-            "scores": np.asarray(self.scores, np.float64),
-            "recent": np.asarray(self.recent, np.float64),
+            "scores": np.asarray(self.scores, np.float64),  # rtap: partition[host-only]
+            "recent": np.asarray(self.recent, np.float64),  # rtap: partition[host-only]
         }
 
     def load_state_dict(self, d: dict) -> None:

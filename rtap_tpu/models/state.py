@@ -66,9 +66,9 @@ def fwd_index_arrays(cfg: ModelConfig) -> dict[str, np.ndarray]:
     F = tm.fanout_cap
     pool = cfg.sp.columns * tm.cells_per_column * tm.max_segments_per_cell * tm.max_synapses_per_segment
     return {
-        "fwd_slots": np.full((cfg.num_cells, F), -1, np.int32),
-        "fwd_pos": np.full(pool, -1, np.int8 if F <= 127 else np.int16),
-        "fwd_of": np.int32(0),
+        "fwd_slots": np.full((cfg.num_cells, F), -1, np.int32),  # rtap: partition[shard-streams]
+        "fwd_pos": np.full(pool, -1, np.int8 if F <= 127 else np.int16),  # rtap: partition[shard-streams]
+        "fwd_of": np.int32(0),  # rtap: partition[shard-streams]
     }
 
 
@@ -96,47 +96,53 @@ def init_state(
         0.0,
     ).astype(np.float32)
 
+    # Partition rules (ISSUE 15, rtap-lint partition-contract): every
+    # leaf below is per-stream state whose group form carries a leading
+    # G axis — shard-streams, the SDR-independence property ROADMAP-1's
+    # mesh stands on. A future leaf that is NOT per-stream must declare
+    # replicated/host-only or the analyzer refuses it.
     return {
         # SP
-        "potential": potential,
-        "perm": sp_domain(cfg.sp).quantize_init(perm),
-        "boost": np.ones(C, np.float32),
-        "overlap_duty": np.zeros(C, np.float32),
-        "active_duty": np.zeros(C, np.float32),
-        "sp_iter": np.int32(0),
+        "potential": potential,  # rtap: partition[shard-streams]
+        "perm": sp_domain(cfg.sp).quantize_init(perm),  # rtap: partition[shard-streams]
+        "boost": np.ones(C, np.float32),  # rtap: partition[shard-streams]
+        "overlap_duty": np.zeros(C, np.float32),  # rtap: partition[shard-streams]
+        "active_duty": np.zeros(C, np.float32),  # rtap: partition[shard-streams]
+        "sp_iter": np.int32(0),  # rtap: partition[shard-streams]
         # TM
-        "presyn": np.full((C, K, S, M), -1, presyn_dtype(cfg)),
-        "syn_perm": np.zeros((C, K, S, M), tm_domain(cfg.tm).dtype),
-        "seg_last": np.full((C, K, S), -1, np.int32),
-        "active_seg": np.zeros((C, K, S), bool),
-        "matching_seg": np.zeros((C, K, S), bool),
-        "seg_pot": np.zeros((C, K, S), np.int16),
-        "prev_active": np.zeros((C, K), bool),
-        "prev_winner": np.zeros((C, K), bool),
-        "tm_iter": np.int32(0),
-        "tm_overflow": np.int32(0),  # device-kernel capacity overflow counter
+        "presyn": np.full((C, K, S, M), -1, presyn_dtype(cfg)),  # rtap: partition[shard-streams]
+        "syn_perm": np.zeros((C, K, S, M), tm_domain(cfg.tm).dtype),  # rtap: partition[shard-streams]
+        "seg_last": np.full((C, K, S), -1, np.int32),  # rtap: partition[shard-streams]
+        "active_seg": np.zeros((C, K, S), bool),  # rtap: partition[shard-streams]
+        "matching_seg": np.zeros((C, K, S), bool),  # rtap: partition[shard-streams]
+        "seg_pot": np.zeros((C, K, S), np.int16),  # rtap: partition[shard-streams]
+        "prev_active": np.zeros((C, K), bool),  # rtap: partition[shard-streams]
+        "prev_winner": np.zeros((C, K), bool),  # rtap: partition[shard-streams]
+        "tm_iter": np.int32(0),  # rtap: partition[shard-streams]
+        # device-kernel capacity overflow counter
+        "tm_overflow": np.int32(0),  # rtap: partition[shard-streams]
 
         # encoder (offset binds per field at the first *finite* value seen;
         # resolutions are per field — uniform configs repeat the family
         # default bit-for-bit, composite fields carry their FieldSpec's)
-        "enc_offset": np.zeros(cfg.n_fields, np.float32),
-        "enc_bound": np.zeros(cfg.n_fields, bool),
-        "enc_resolution": np.asarray(cfg.field_resolutions(), np.float32),
+        "enc_offset": np.zeros(cfg.n_fields, np.float32),  # rtap: partition[shard-streams]
+        "enc_bound": np.zeros(cfg.n_fields, bool),  # rtap: partition[shard-streams]
+        "enc_resolution": np.asarray(cfg.field_resolutions(), np.float32),  # rtap: partition[shard-streams]
         # delta-encoder predecessor (composite family only): last FINITE
         # value per field, NaN = no predecessor yet (the first sample of
         # a delta field encodes as missing — NuPIC DeltaEncoder). Absent
         # for every non-delta config, so pre-ISSUE-9 state trees (and
         # their checkpoints) are byte-identical.
-        **({"enc_prev": np.full(cfg.n_fields, np.nan, np.float32)}
+        **({"enc_prev": np.full(cfg.n_fields, np.nan, np.float32)}  # rtap: partition[shard-streams]
            if cfg.composite is not None and cfg.composite.has_delta else {}),
         # forward synapse index (derived; present only in forward dendrite mode)
         **(fwd_index_arrays(cfg) if include_fwd else {}),
         # SDR classifier (SURVEY.md C10), present only when enabled
         **(
             {
-                "cls_w": np.zeros((C * K, cfg.classifier.buckets), np.float32),
-                "cls_val": np.zeros(cfg.classifier.buckets, np.float32),
-                "cls_cnt": np.zeros(cfg.classifier.buckets, np.int32),
+                "cls_w": np.zeros((C * K, cfg.classifier.buckets), np.float32),  # rtap: partition[shard-streams]
+                "cls_val": np.zeros(cfg.classifier.buckets, np.float32),  # rtap: partition[shard-streams]
+                "cls_cnt": np.zeros(cfg.classifier.buckets, np.int32),  # rtap: partition[shard-streams]
             }
             if cfg.classifier.enabled
             else {}

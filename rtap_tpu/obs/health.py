@@ -423,7 +423,9 @@ def bump_run_epoch(beside_path: str | None,
     """
     epoch = 0
     if beside_path:
-        path = beside_path + ".epoch"
+        from rtap_tpu.service.shardpath import alert_sidecar_path
+
+        path = alert_sidecar_path(beside_path, "epoch")
         try:
             with open(path) as f:
                 epoch = int(json.load(f).get("epoch", 0))

@@ -747,10 +747,11 @@ class StandbyFollower:
         if not self.checkpoint_dir or not os.path.isdir(self.checkpoint_dir):
             return False
         from rtap_tpu.service.checkpoint import load_group, validate_resume
+        from rtap_tpu.service.shardpath import group_checkpoint_path
 
         loaded = False
         for gi, grp in enumerate(self.groups):
-            ck_path = os.path.join(self.checkpoint_dir, f"group{gi:04d}")
+            ck_path = group_checkpoint_path(self.checkpoint_dir, gi)
             if not os.path.isdir(ck_path):
                 continue
             for attempt in range(attempts):

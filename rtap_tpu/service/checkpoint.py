@@ -24,6 +24,9 @@ from rtap_tpu.obs import get_registry
 from rtap_tpu.service.registry import StreamGroup
 
 
+# rtap: host-boundary — checkpoint save OWNS the device->host
+# materialization: it must fetch the full (possibly mesh-sharded) tree
+# to write a topology-independent checkpoint, with the pipeline drained
 def save_group(grp: StreamGroup, path: str | Path,
                alerts_offset: int | None = None,
                journal_tick: int | None = None) -> None:

@@ -151,7 +151,9 @@ def replay_streams(
         if checkpoint_dir is not None:
             import os
 
-            ck_path = os.path.join(checkpoint_dir, f"group{gi:04d}")
+            from rtap_tpu.service.shardpath import group_checkpoint_path
+
+            ck_path = group_checkpoint_path(checkpoint_dir, gi)
             if os.path.isdir(ck_path):
                 from rtap_tpu.service.checkpoint import load_group, validate_resume
 
@@ -601,9 +603,10 @@ def live_loop(
         import os
 
         from rtap_tpu.service.checkpoint import load_group, validate_resume
+        from rtap_tpu.service.shardpath import group_checkpoint_path
 
         for gi, grp in enumerate(groups):
-            ck_path = os.path.join(checkpoint_dir, f"group{gi:04d}")
+            ck_path = group_checkpoint_path(checkpoint_dir, gi)
             if not os.path.isdir(ck_path):
                 continue
             resumed = load_group(ck_path, mesh=grp.mesh)
@@ -780,7 +783,10 @@ def live_loop(
             # cursor past that window's earlier members, and a re-fold
             # missing them would hash a divergent incident_id.
             if correlator.sidecar_path is None:
-                correlator.sidecar_path = alert_path + ".corr"
+                from rtap_tpu.service.shardpath import alert_sidecar_path
+
+                correlator.sidecar_path = alert_sidecar_path(
+                    alert_path, "corr")
             known = [off for off in (
                 getattr(g, "resume_alerts_offset", None) for g in groups)
                 if off is not None]
@@ -1484,12 +1490,15 @@ def live_loop(
                         load_group,
                         validate_resume,
                     )
+                    from rtap_tpu.service.shardpath import (
+                        group_checkpoint_path,
+                    )
 
                     _align_boundaries()
                     restored_any = False
                     for gi in due:
-                        ck_path = os.path.join(checkpoint_dir,
-                                               f"group{gi:04d}")
+                        ck_path = group_checkpoint_path(
+                            checkpoint_dir, gi)
                         old = groups[gi]
                         try:
                             if not os.path.isdir(ck_path):
@@ -2049,9 +2058,8 @@ def _save_all(groups, checkpoint_dir: str, skip=(), chaos=None, tick: int = 0,
     save_group's temp-sibling atomicity guarantees the previous
     checkpoint is still intact after any failure. Returns
     (saved, failed) counts."""
-    import os
-
     from rtap_tpu.service.checkpoint import save_group
+    from rtap_tpu.service.shardpath import group_checkpoint_path
 
     saved = failed = 0
     for gi, grp in enumerate(groups):
@@ -2060,7 +2068,7 @@ def _save_all(groups, checkpoint_dir: str, skip=(), chaos=None, tick: int = 0,
         try:
             if chaos is not None:
                 chaos.on_checkpoint_save(gi, tick)
-            save_group(grp, os.path.join(checkpoint_dir, f"group{gi:04d}"),
+            save_group(grp, group_checkpoint_path(checkpoint_dir, gi),
                        alerts_offset=alerts_offset,
                        journal_tick=journal_tick)
             saved += 1
@@ -2071,6 +2079,9 @@ def _save_all(groups, checkpoint_dir: str, skip=(), chaos=None, tick: int = 0,
     return saved, failed
 
 
+# rtap: host-boundary — end-of-run stats fetch of two scalar-per-stream
+# counters; runs once per serve exit, never on the hot path, and a mesh
+# gather of [G] i32 leaves is bytes, not state
 def _overflow_total(groups) -> int | None:
     """Sum the per-stream kernel overflow counters (tm_overflow + fwd_of)
     across device groups; None for CPU-oracle groups (the oracle has no
@@ -2094,7 +2105,13 @@ def _occupancy() -> dict:
     (CPU test backend). Only consulted when jax is ALREADY in use: a pure
     CPU-oracle run must not initialize the TPU backend as a stats side
     effect (backend init can hang on a wedged tunnel, and would claim the
-    exclusive chip out from under a concurrent device run)."""
+    exclusive chip out from under a concurrent device run).
+
+    Sums over EVERY local device (the ISSUE 15 device-scope pass caught
+    the old ``local_devices()[0]`` read): a sharded fleet's state lives
+    spread across the mesh, and reporting one chip's slice as "the" HBM
+    figure under-reports by the shard count. Single-device hosts are
+    numerically unchanged."""
     import sys
 
     if "jax" not in sys.modules:
@@ -2102,12 +2119,16 @@ def _occupancy() -> dict:
     try:
         import jax
 
-        stats = jax.local_devices()[0].memory_stats() or {}
+        per_device = [d.memory_stats() or {} for d in jax.local_devices()]
         out = {}
-        if "bytes_in_use" in stats:
-            out["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
-        if "peak_bytes_in_use" in stats:
-            out["hbm_peak_bytes_in_use"] = int(stats["peak_bytes_in_use"])
+        in_use = [s["bytes_in_use"] for s in per_device
+                  if "bytes_in_use" in s]
+        if in_use:
+            out["hbm_bytes_in_use"] = int(sum(in_use))
+        peak = [s["peak_bytes_in_use"] for s in per_device
+                if "peak_bytes_in_use" in s]
+        if peak:
+            out["hbm_peak_bytes_in_use"] = int(sum(peak))
         return out
     except Exception:
         return {}

@@ -1,0 +1,65 @@
+"""Shard-qualified resource paths — the ONE place that spells them.
+
+ROADMAP-1's pod-scale serving runs one serve process per mesh shard,
+and every filesystem resource the serve stack owns — the journal dir,
+the per-group checkpoint claims, the lease file, the alert sink's
+``.corr``/``.epoch`` sidecars — must be distinct per shard or two
+shards silently clobber one file (interleaved journal segments, a lease
+two leaders both think they hold, a correlator floor ping-ponging
+between two folds). The rtap-lint ``shard-resource`` pass (ISSUE 15)
+enforces that these names are minted HERE and nowhere else: a call site
+cannot forget the shard because it never spells the suffix.
+
+Shard 0 is byte-identical to the pre-mesh paths (pinned by
+tests/unit/test_shardpath.py), so every existing artifact, soak ledger,
+and operator runbook keeps working unchanged; nonzero shards qualify
+the base name itself (``journal.shard001/``, ``lease.json.shard001``),
+which works uniformly for files and directories.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["shard_scoped_path", "group_checkpoint_path",
+           "alert_sidecar_path"]
+
+#: sidecar kinds the alert sink owns (correlator resume floor, run
+#: epoch); the helper rejects unknown kinds so a typo cannot mint an
+#: orphan file the resume paths never read
+SIDECAR_KINDS = ("corr", "epoch")
+
+
+def shard_scoped_path(base: str, shard: int) -> str:
+    """Qualify an operator-provided resource path with the mesh shard.
+
+    Shard 0 returns `base` unchanged — today's single-shard serve keeps
+    byte-identical artifacts. Nonzero shards suffix the base itself
+    (``<base>.shard<NNN>``), uniform for files and directories; 3
+    digits covers the 256-shard ingest-protocol ceiling (MAX_SHARDS).
+    A trailing separator on a dir flag (``runs/journal/``) is stripped
+    before suffixing — otherwise shard 1's dir would nest INSIDE shard
+    0's as a hidden ``.shard001`` entry instead of being a sibling.
+    """
+    if not 0 <= int(shard) <= 999:
+        raise ValueError(f"shard must be in [0, 999]; got {shard!r}")
+    if shard == 0:
+        return base
+    return f"{base.rstrip('/' + os.sep)}.shard{int(shard):03d}"
+
+
+def group_checkpoint_path(checkpoint_dir: str, gi: int) -> str:
+    """The per-group checkpoint claim directory inside an (already
+    shard-scoped) checkpoint dir — ``<dir>/group<NNNN>``, the name
+    save_group/load_group and every resume scan agree on."""
+    return os.path.join(checkpoint_dir, f"group{int(gi):04d}")
+
+
+def alert_sidecar_path(alert_path: str, kind: str) -> str:
+    """A sidecar beside an (already shard-scoped) alert sink:
+    ``<alerts>.corr`` (correlator resume floor) or ``<alerts>.epoch``
+    (run-epoch continuity). The shard rides the base path."""
+    if kind not in SIDECAR_KINDS:
+        raise ValueError(
+            f"unknown sidecar kind {kind!r}; valid: {SIDECAR_KINDS}")
+    return f"{alert_path}.{kind}"

@@ -1,0 +1,568 @@
+"""rtap-lint v4 (ISSUE 15): mesh-readiness pass fixtures.
+
+Same discipline as test_analysis.py / test_analysis_device.py — every
+new pass gets a positive (deliberately-bad snippet fails), a negative
+(idiomatic-good snippet passes), and a suppressed fixture, all over
+in-memory SourceFiles with synthetic paths. The armed-gate subprocess
+canaries live in test_static_checks.py; this file proves the library
+semantics fast. The tests/scale sweep at the bottom runs the mesh
+passes over the REAL mesh test files — the code that exercises the
+sharded path must itself analyze clean.
+"""
+
+import os
+
+import pytest
+
+from rtap_tpu.analysis import run_analysis
+from rtap_tpu.analysis.core import AnalysisContext, Baseline, SourceFile
+
+pytestmark = pytest.mark.quick
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def lint(path, code, rules=None, docs="", parity="", scaling="",
+         extra=(), baseline=None):
+    files = [SourceFile(path, code)]
+    files += [SourceFile(p, c) for p, c in extra]
+    ctx = AnalysisContext(root="/__fixture__", files=files,
+                          docs_text=docs, parity_text=parity,
+                          scaling_text=scaling)
+    return run_analysis("/__fixture__", baseline=baseline or Baseline([]),
+                        rules=set(rules) if rules is not None else None,
+                        ctx=ctx)
+
+
+def syms(report):
+    return sorted(f.symbol for f in report.findings)
+
+
+# ------------------------------------------------- partition-contract --
+_TREE = ("rtap_tpu/models/_fx_state.py",
+         "import numpy as np\n\n\n"
+         "def init_fx(n):\n"
+         "    return {\n"
+         "        'alpha': np.zeros(n),  # rtap: partition[shard-streams]\n"
+         "        'beta': np.zeros(n),  # rtap: partition[shard-streams]\n"
+         "        'gamma': np.zeros(n),  # rtap: partition[host-only]\n"
+         "    }\n")
+
+
+def test_partition_unruled_and_trailing_form():
+    bad = ("import numpy as np\n\n\n"
+           "def init_fx(n):\n"
+           "    return {\n"
+           "        'alpha': np.zeros(n),  # rtap: partition[shard-streams]\n"
+           "        'beta': np.zeros(n),\n"
+           "        'gamma': np.zeros(n),\n"
+           "    }\n")
+    r = lint("rtap_tpu/models/_fx_state.py", bad, ["partition-contract"])
+    assert syms(r) == ["init_fx:unruled:beta", "init_fx:unruled:gamma"]
+    r2 = lint(*_TREE, rules=["partition-contract"])
+    assert r2.findings == [] and r2.ok
+
+
+def test_partition_module_table_and_stale_entry():
+    tabled = ("# rtap: partition[alpha=shard-streams, beta=replicated,"
+              " ghost=host-only]\n"
+              "import numpy as np\n\n\n"
+              "def init_fx(n):\n"
+              "    return {\n"
+              "        'alpha': np.zeros(n),\n"
+              "        'beta': np.zeros(n),\n"
+              "        'gamma': np.zeros(n),  # rtap: partition[host-only]\n"
+              "    }\n")
+    r = lint("rtap_tpu/models/_fx_state.py", tabled,
+             ["partition-contract"])
+    # coverage is exact BOTH directions: gamma rides its trailing rule,
+    # ghost's table entry names no constructed leaf
+    assert syms(r) == ["partition-table:stale:ghost"]
+
+
+def test_partition_bad_rule_token_and_suppression():
+    bad = ("import numpy as np\n\n\n"
+           "def init_fx(n):\n"
+           "    return {\n"
+           "        'alpha': np.zeros(n),  # rtap: partition[sharded]\n"
+           "        'beta': np.zeros(n),\n"
+           "        'gamma': np.zeros(n),\n"
+           "    }\n")
+    r = lint("rtap_tpu/models/_fx_state.py", bad, ["partition-contract"])
+    assert "partition-syntax:trailing" in syms(r)
+    supp = bad.replace(
+        "'beta': np.zeros(n),",
+        "'beta': np.zeros(n),  # rtap: allow[partition-contract] — fx")
+    r2 = lint("rtap_tpu/models/_fx_state.py", supp,
+              ["partition-contract"])
+    assert not any("beta" in s for s in syms(r2))
+    assert any("beta" in f.symbol for f in r2.suppressed)
+
+
+def test_partition_small_dicts_are_not_constructors():
+    """A two-key helper dict in models/ is not a state tree — the
+    structural discovery needs >= 3 array leaves, so dtype maps and
+    option dicts don't drag the contract onto non-state code."""
+    ok = ("import numpy as np\n\n\n"
+          "def helper(n):\n"
+          "    return {'a': np.zeros(n), 'b': np.ones(n)}\n")
+    r = lint("rtap_tpu/models/_fx_state.py", ok, ["partition-contract"])
+    assert r.findings == []
+
+
+def test_partition_consumer_unknown_leaf():
+    consumer = ("def fold(grp):\n"
+                "    x = grp.state['alpha']\n"
+                "    y = grp.state['ghost_leaf']\n"
+                "    return x, y\n")
+    r = lint("rtap_tpu/service/_fx_consumer.py", consumer,
+             ["partition-contract"], extra=(_TREE,))
+    assert syms(r) == ["fold:unknown-leaf:ghost_leaf"]
+    # non-state receivers are not judged (meta dicts, option tables)
+    meta = ("def read(meta):\n"
+            "    return meta['ghost_leaf']\n")
+    r2 = lint("rtap_tpu/service/_fx_consumer.py", meta,
+              ["partition-contract"], extra=(_TREE,))
+    assert r2.findings == []
+
+
+def test_partition_wiring_gates():
+    """shard-streams leaves demand a shard-aware checkpoint restore and
+    DispatchTable-routed journal materialization — deleting either
+    reference re-fails the gate."""
+    naked_ck = ("rtap_tpu/service/checkpoint.py",
+                "def load_group(path):\n    return path\n")
+    r = lint(*_TREE, rules=["partition-contract"], extra=(naked_ck,))
+    assert "restore:not-shard-aware" in syms(r)
+    aware_ck = ("rtap_tpu/service/checkpoint.py",
+                "def load_group(path, mesh=None):\n"
+                "    from rtap_tpu.parallel.sharding import shard_state\n"
+                "    return shard_state\n")
+    r2 = lint(*_TREE, rules=["partition-contract"], extra=(aware_ck,))
+    assert r2.findings == []
+    naked_loop = ("rtap_tpu/service/loop.py",
+                  "def live_loop():\n    pass\n")
+    r3 = lint(*_TREE, rules=["partition-contract"], extra=(naked_loop,))
+    assert "journal-frame:not-dispatch-routed" in syms(r3)
+
+
+# ------------------------------------------------------ device-scope --
+def test_device_scope_device0_and_suppression():
+    bad = ("def probe():\n"
+           "    import jax\n\n"
+           "    return jax.local_devices()[0].memory_stats()\n")
+    r = lint("rtap_tpu/obs/_fx_ds.py", bad, ["device-scope"])
+    assert syms(r) == ["probe:device0"]
+    supp = bad.replace(
+        "return jax.local_devices()[0].memory_stats()",
+        "return jax.local_devices()[0].memory_stats()"
+        "  # rtap: allow[device-scope] — fx")
+    r2 = lint("rtap_tpu/obs/_fx_ds.py", supp, ["device-scope"])
+    assert r2.findings == [] and len(r2.suppressed) == 1
+    # iterating the device list is the idiomatic-good form
+    ok = ("def probe():\n"
+          "    import jax\n\n"
+          "    return [d.memory_stats() for d in jax.local_devices()]\n")
+    r3 = lint("rtap_tpu/obs/_fx_ds.py", ok, ["device-scope"])
+    assert r3.findings == []
+
+
+def test_device_scope_fetch_and_host_boundary():
+    bad = ("import jax\nimport numpy as np\n\n\n"
+           "def snapshot(grp):\n"
+           "    return jax.device_get(grp.state)\n\n\n"
+           "def peek(st):\n"
+           "    return np.asarray(st['tm_overflow'])\n")
+    r = lint("rtap_tpu/service/_fx_ds.py", bad, ["device-scope"])
+    assert syms(r) == ["peek:fetch:st", "snapshot:fetch:device_get"]
+    # the host-boundary declaration legalizes the materialization
+    ann = bad.replace("def snapshot(grp):",
+                      "# rtap: host-boundary — fx owns the fetch\n"
+                      "def snapshot(grp):")
+    ann = ann.replace("def peek(st):",
+                      "# rtap: host-boundary — fx stats read\n"
+                      "def peek(st):")
+    r2 = lint("rtap_tpu/service/_fx_ds.py", ann, ["device-scope"])
+    assert r2.findings == []
+    # host-data asarray (no state root) was never a finding
+    ok = ("import numpy as np\n\n\n"
+          "def parse(rows):\n"
+          "    return np.asarray(rows, np.float32)\n")
+    r3 = lint("rtap_tpu/service/_fx_ds.py", ok, ["device-scope"])
+    assert r3.findings == []
+
+
+def test_device_scope_mesh_entry_is_boundary():
+    """A function that calls the parallel placement API owns placement
+    in both directions — its fetches are legal without annotation."""
+    ok = ("import jax\n\n"
+          "from rtap_tpu.parallel.sharding import put_sharded\n\n\n"
+          "def reshard(grp, mesh):\n"
+          "    host = jax.device_get(grp.state)\n"
+          "    return {k: put_sharded(v, mesh) for k, v in host.items()}\n")
+    r = lint("rtap_tpu/service/_fx_ds.py", ok, ["device-scope"])
+    assert r.findings == []
+
+
+def test_device_scope_flat_id_arithmetic():
+    bad = ("def route(sid, group_size):\n"
+           "    return sid // group_size\n")
+    r = lint("rtap_tpu/service/_fx_ds.py", bad, ["device-scope"])
+    assert syms(r) == ["route:flat-id:sid"]
+    # the addressing owners are exempt — the conversion LIVES there
+    r2 = lint("rtap_tpu/service/registry.py", bad, ["device-scope"])
+    assert r2.findings == []
+    shift = ("SLOT_BITS = 12\n\n\n"
+             "def unpack(code):\n"
+             "    return code >> SLOT_BITS\n")
+    r3 = lint("rtap_tpu/ingest/_fx_ds.py", shift, ["device-scope"])
+    assert syms(r3) == ["unpack:flat-id:SLOT_BITS"]
+    r4 = lint("rtap_tpu/ingest/protocol.py", shift, ["device-scope"])
+    assert r4.findings == []
+
+
+# --------------------------------------------- collective-discipline --
+def test_collective_in_scan_body():
+    bad = ("import jax\nimport jax.numpy as jnp\n\n\n"
+           "def chunk(state, values):\n"
+           "    def body(s, v):\n"
+           "        return s, jax.lax.psum(v, axis_name='streams')\n"
+           "    return jax.lax.scan(body, state, values)\n")
+    r = lint("rtap_tpu/ops/_fx_cd.py", bad, ["collective-discipline"])
+    assert syms(r) == ["chunk.body:collective:psum"]
+    assert "collective-free" in r.findings[0].message
+
+
+def test_collective_entry_points_are_legal():
+    # explicit declaration
+    ann = ("import jax\n\n\n"
+           "# rtap: mesh-entry — fx reduction owner\n"
+           "def fleet_total(x):\n"
+           "    return jax.lax.psum(x, axis_name='streams')\n")
+    r = lint("rtap_tpu/service/_fx_cd.py", ann, ["collective-discipline"])
+    assert r.findings == []
+    # discovered: the function makes placement decisions itself
+    disc = ("import jax\n\n"
+            "from rtap_tpu.parallel.sharding import make_stream_mesh\n\n\n"
+            "def fleet_total(x):\n"
+            "    mesh = make_stream_mesh(8)\n"
+            "    return jax.lax.psum(x, axis_name='streams')\n")
+    r2 = lint("rtap_tpu/service/_fx_cd.py", disc,
+              ["collective-discipline"])
+    assert r2.findings == []
+    # rtap_tpu/parallel/ is the blessed home wholesale
+    bare = ("import jax\n\n\n"
+            "def helper(x):\n"
+            "    return jax.lax.psum(x, axis_name='streams')\n")
+    r3 = lint("rtap_tpu/parallel/_fx_cd.py", bare,
+              ["collective-discipline"])
+    assert r3.findings == []
+
+
+def test_collective_foreign_method_and_suppression():
+    # someone else's method named psum is not a jax collective
+    ok = ("def fold(accumulator, x):\n"
+          "    return accumulator.psum(x)\n")
+    r = lint("rtap_tpu/obs/_fx_cd.py", ok, ["collective-discipline"])
+    assert r.findings == []
+    supp = ("import jax\n\n\n"
+            "def fleet_total(x):\n"
+            "    # rtap: allow[collective-discipline] — fx\n"
+            "    return jax.lax.psum(x, axis_name='streams')\n")
+    r2 = lint("rtap_tpu/service/_fx_cd.py", supp,
+              ["collective-discipline"])
+    assert r2.findings == [] and len(r2.suppressed) == 1
+
+
+# --------------------------------------------------- shard-resource --
+def test_shard_resource_sidecar_mint():
+    bad = ("def sidecar_for(alert_path):\n"
+           "    return alert_path + '.corr'\n")
+    r = lint("rtap_tpu/service/_fx_sr.py", bad, ["shard-resource"])
+    assert syms(r) == ["sidecar_for:mint"]
+    # the helper module itself owns the suffixes
+    r2 = lint("rtap_tpu/service/shardpath.py", bad, ["shard-resource"])
+    assert r2.findings == []
+    supp = bad.replace("return alert_path + '.corr'",
+                       "return alert_path + '.corr'"
+                       "  # rtap: allow[shard-resource] — fx")
+    r3 = lint("rtap_tpu/service/_fx_sr.py", supp, ["shard-resource"])
+    assert r3.findings == [] and len(r3.suppressed) == 1
+
+
+def test_shard_resource_group_claim_mint():
+    bad = ("import os\n\n\n"
+           "def claim(ck_dir, gi):\n"
+           "    return os.path.join(ck_dir, f'group{gi:04d}')\n")
+    r = lint("rtap_tpu/resilience/_fx_sr.py", bad, ["shard-resource"])
+    assert syms(r) == ["claim:mint"]
+    # a diagnostic f-string that merely SAYS group is not a claim
+    ok = ("def label(gi):\n"
+          "    return f'group{gi} quarantined'\n")
+    r2 = lint("rtap_tpu/resilience/_fx_sr.py", ok, ["shard-resource"])
+    assert r2.findings == []
+
+
+def test_shard_resource_inline_constructor_path():
+    bad = ("from rtap_tpu.resilience.journal import TickJournal\n\n\n"
+           "def boot(base):\n"
+           "    return TickJournal(base + '/journal')\n")
+    r = lint("rtap_tpu/resilience/_fx_sr.py", bad, ["shard-resource"])
+    assert syms(r) == ["boot:inline-path:TickJournal"]
+    ok = ("from rtap_tpu.resilience.journal import TickJournal\n\n\n"
+          "def boot(journal_dir):\n"
+          "    return TickJournal(journal_dir)\n")
+    r2 = lint("rtap_tpu/resilience/_fx_sr.py", ok, ["shard-resource"])
+    assert r2.findings == []
+
+
+def test_shard_resource_serve_wiring():
+    unwired = ("rtap_tpu/__main__.py",
+               "def _cmd_serve(args):\n"
+               "    journal = open(args.journal_dir)\n"
+               "    return journal\n")
+    r = lint(*unwired, rules=["shard-resource"])
+    assert syms(r) == ["serve-wiring:journal_dir"]
+    wired = ("rtap_tpu/__main__.py",
+             "from rtap_tpu.service.shardpath import shard_scoped_path\n\n\n"
+             "def _cmd_serve(args):\n"
+             "    for attr in ('journal_dir',):\n"
+             "        setattr(args, attr,\n"
+             "                shard_scoped_path(getattr(args, attr), 0))\n"
+             "    journal = open(args.journal_dir)\n"
+             "    return journal\n")
+    r2 = lint(*wired, rules=["shard-resource"])
+    assert r2.findings == []
+
+
+# ----------------------------------------------------- scaling-math --
+_FX_CONFIG = ("rtap_tpu/config.py", """
+def cluster_preset(perm_bits=16):
+    return ModelConfig(
+        rdse=RDSEConfig(size=8, active_bits=3, resolution=0.5),
+        date=DateConfig(time_of_day_width=0, time_of_day_size=0,
+                        weekend_width=0),
+        sp=SPConfig(columns=4, perm_bits=perm_bits),
+        tm=TMConfig(cells_per_column=2, max_segments_per_cell=2,
+                    max_synapses_per_segment=3, perm_bits=perm_bits),
+    )
+""")
+_FX_PERM = ("rtap_tpu/models/perm.py",
+            "import numpy as np\n\n"
+            "_DTYPES = {0: np.float32, 8: np.uint8, 16: np.uint16}\n")
+_FX_LAW = ("scripts/scaling_law.py",
+           "HBM_BYTES = 1000000\nWORKSPACE_RESERVE = 0\n")
+
+# derived for the fixture geometry (C=4, K=2, S=2, M=3, n_in=8):
+# u16 501 B, f32 661 B, u8 421 B; fits at 1 MB HBM: 1996/1512/2375
+_FX_SCALING_OK = """
+| perm domain | bytes/stream | max streams/chip (fx) |
+|---|---|---|
+| f32 | 661 | 1,512 |
+| u16 quanta | 501 | 1,996 |
+| u8 quanta | 421 | 2,375 |
+
+Largest tensors (u16 domain): `presyn` 96 B, `syn_perm` 96 B, `perm` 64 B, `potential` 32 B.
+"""
+
+
+def _scaling_lint(scaling, extra=None):
+    files = [_FX_CONFIG, _FX_PERM, _FX_LAW] if extra is None else extra
+    return lint(files[0][0], files[0][1], ["scaling-math"],
+                scaling=scaling, extra=tuple(files[1:]))
+
+
+def test_scaling_math_green_and_stale_bytes():
+    r = _scaling_lint(_FX_SCALING_OK)
+    assert r.findings == [] and r.ok
+    stale = _FX_SCALING_OK.replace("| u16 quanta | 501 |",
+                                   "| u16 quanta | 502 |")
+    r2 = _scaling_lint(stale)
+    assert syms(r2) == ["bytes:u16"]
+    assert "502" in r2.findings[0].message
+    assert "501" in r2.findings[0].message
+
+
+def test_scaling_math_stale_fit_and_tensor():
+    stale_fit = _FX_SCALING_OK.replace("| 501 | 1,996 |",
+                                       "| 501 | 2,000 |")
+    r = _scaling_lint(stale_fit)
+    assert syms(r) == ["fit:u16"]
+    stale_tensor = _FX_SCALING_OK.replace("`presyn` 96 B",
+                                          "`presyn` 97 B")
+    r2 = _scaling_lint(stale_tensor)
+    assert syms(r2) == ["tensor:presyn"]
+    renamed = _FX_SCALING_OK.replace("`potential` 32 B",
+                                     "`ghost_pool` 32 B")
+    r3 = _scaling_lint(renamed)
+    assert syms(r3) == ["tensor:ghost_pool"]
+
+
+def test_scaling_math_underivable_and_absent():
+    # a quoted table with no derivable config is itself a finding —
+    # the memory twin must never go silently blind
+    r = lint("rtap_tpu/obs/_fx_other.py", "x = 1\n", ["scaling-math"],
+             scaling=_FX_SCALING_OK)
+    assert syms(r) == ["derive:inputs"]
+    # no analytic table in the doc -> nothing to check
+    r2 = _scaling_lint("# SCALING\n\nprose only\n")
+    assert r2.findings == []
+
+
+def test_scaling_math_real_tree_agrees():
+    """The committed SCALING.md figures agree with the real config —
+    run the pass over the actual repo files (the live twin check, in
+    process). Guards against the fixture diverging from reality."""
+    names = ("rtap_tpu/config.py", "rtap_tpu/models/perm.py",
+             "scripts/scaling_law.py")
+    files = []
+    for rel in names:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            files.append(SourceFile(rel, fh.read()))
+    with open(os.path.join(REPO, "SCALING.md"), encoding="utf-8") as fh:
+        scaling = fh.read()
+    ctx = AnalysisContext(root=REPO, files=files, docs_text="",
+                          parity_text="", scaling_text=scaling)
+    r = run_analysis(REPO, baseline=Baseline([]),
+                     rules={"scaling-math"}, ctx=ctx)
+    assert r.findings == [], syms(r)
+
+
+# ------------------------------------ baseline matrix for the new keys --
+def test_new_rules_baseline_match_whyless_stale():
+    bad = ("def probe():\n"
+           "    import jax\n\n"
+           "    return jax.local_devices()[0].memory_stats()\n")
+    entry = {"rule": "device-scope", "path": "rtap_tpu/obs/_fx_b.py",
+             "symbol": "probe:device0", "why": "fixture inventory entry"}
+    r = lint("rtap_tpu/obs/_fx_b.py", bad, ["device-scope"],
+             baseline=Baseline([entry]))
+    assert r.findings == [] and len(r.baselined) == 1
+    # why-less entries are a gate failure by design
+    r2 = lint("rtap_tpu/obs/_fx_b.py", bad, ["device-scope"],
+              baseline=Baseline([{**entry, "why": ""}]))
+    assert r2.baseline_errors and not r2.ok
+    # stale entries report on a full run (rules=None)
+    clean = "def probe():\n    return 0\n"
+    r3 = lint("rtap_tpu/obs/_fx_b.py", clean,
+              baseline=Baseline([entry]))
+    assert r3.stale_baseline == [entry]
+
+
+def test_update_baseline_rekeys_new_finding_kinds(tmp_path):
+    """--update-baseline's mechanical re-key covers the v4 rules: a
+    moved symbol (function rename) keeps its why, stale entries drop,
+    new findings are refused (never minted why-less)."""
+    import json
+
+    from rtap_tpu.analysis.baseline_update import update_baseline
+
+    root = tmp_path / "repo"
+    (root / "rtap_tpu" / "obs").mkdir(parents=True)
+    (root / "rtap_tpu" / "obs" / "_fx_u.py").write_text(
+        "def probe_renamed():\n"
+        "    import jax\n\n"
+        "    return jax.local_devices()[0].memory_stats()\n")
+    baseline = root / "analysis_baseline.json"
+    baseline.write_text(json.dumps({"entries": [
+        {"rule": "device-scope", "path": "rtap_tpu/obs/_fx_u.py",
+         "symbol": "probe:device0", "why": "kept why"},
+        {"rule": "shard-resource", "path": "rtap_tpu/obs/_gone.py",
+         "symbol": "gone:mint", "why": "stale"},
+    ]}))
+    summary = update_baseline(str(root), baseline_path=str(baseline))
+    data = json.loads(baseline.read_text())
+    assert [tuple(k) for k in (e[1] for e in summary["rekeyed"])] == [
+        ("device-scope", "rtap_tpu/obs/_fx_u.py",
+         "probe_renamed:device0")]
+    assert summary["dropped"] == [
+        ("shard-resource", "rtap_tpu/obs/_gone.py", "gone:mint")]
+    whys = {e["symbol"]: e["why"] for e in data["entries"]}
+    assert whys == {"probe_renamed:device0": "kept why"}
+
+
+# ------------------------------- review-pass fixes, regression-pinned --
+def test_module_level_violations_are_visible():
+    """Review finding: the mesh passes scanned only function bodies, so
+    import-time violations passed the gate. Module scope (and class
+    bodies) must be first-class — a module-level devices()[0] pick or
+    sidecar mint runs at import and is worse, not exempt."""
+    dev = ("import jax\n\n"
+           "DEV = jax.local_devices()[0]\n")
+    r = lint("rtap_tpu/service/_fx_ml.py", dev, ["device-scope"])
+    assert syms(r) == ["(module):device0"]
+    mint = ("ALERTS = '/tmp/a.jsonl'\n"
+            "SIDECAR = ALERTS + '.corr'\n")
+    r2 = lint("rtap_tpu/service/_fx_ml.py", mint, ["shard-resource"])
+    assert syms(r2) == ["(module):mint"]
+    coll = ("import jax\n\n"
+            "_Z = jax.lax.psum(0, axis_name='streams')\n")
+    r3 = lint("rtap_tpu/obs/_fx_ml.py", coll, ["collective-discipline"])
+    assert syms(r3) == ["(module):collective:psum"]
+    # class bodies execute at import too
+    cls = ("import jax\n\n\n"
+           "class Pinned:\n"
+           "    DEV = jax.devices()[0]\n")
+    r4 = lint("rtap_tpu/service/_fx_ml.py", cls, ["device-scope"])
+    assert syms(r4) == ["(module):device0"]
+
+
+def test_device0_legal_inside_mesh_entry():
+    """Review finding: docs say mesh entry points own 'device picks',
+    so a declared entry indexing the device list (by shard index) must
+    not go red — the annotation legalizes exactly that."""
+    ok = ("import jax\n\n\n"
+          "# rtap: mesh-entry — fx launcher picks its shard's device\n"
+          "def launch(shard):\n"
+          "    return jax.devices()[shard]\n")
+    r = lint("rtap_tpu/service/_fx_me.py", ok, ["device-scope"])
+    assert r.findings == []
+
+
+def test_partition_conflicting_rules_across_files():
+    """Review finding: two models/ files declaring DIFFERENT rules for
+    one leaf name silently resolved first-wins. It must be a finding."""
+    other = ("rtap_tpu/models/_fx_other.py",
+             "import numpy as np\n\n\n"
+             "def init_other(n):\n"
+             "    return {\n"
+             "        'gamma': np.zeros(n),  # rtap: partition[shard-streams]\n"
+             "        'delta': np.zeros(n),  # rtap: partition[shard-streams]\n"
+             "        'eps': np.zeros(n),  # rtap: partition[shard-streams]\n"
+             "    }\n")
+    # _TREE declares gamma=host-only; the second file says shard-streams
+    r = lint(*_TREE, rules=["partition-contract"], extra=(other,))
+    assert "partition-conflict:gamma" in syms(r)
+    # same rule in both files is NOT a conflict
+    agree = (other[0], other[1].replace(
+        "'gamma': np.zeros(n),  # rtap: partition[shard-streams]",
+        "'gamma': np.zeros(n),  # rtap: partition[host-only]"))
+    r2 = lint(*_TREE, rules=["partition-contract"], extra=(agree,))
+    assert r2.findings == []
+
+
+# ---------------------------------------------- tests/scale mesh sweep --
+def test_scale_tree_analyzes_clean_under_mesh_rules():
+    """The mesh test files themselves (tests/scale/) must satisfy the
+    mesh-readiness rules when held to serve-stack scope: their fetches
+    happen inside functions that own placement (they call the parallel
+    API), and no collective leaks outside those functions. The code
+    that PROVES the sharded path cannot itself model the anti-pattern."""
+    import glob
+
+    scale_files = sorted(glob.glob(os.path.join(REPO, "tests", "scale",
+                                                "*.py")))
+    assert scale_files, "tests/scale moved — update the sweep"
+    files = []
+    for full in scale_files:
+        name = os.path.basename(full)
+        with open(full, encoding="utf-8") as fh:
+            files.append(SourceFile(f"rtap_tpu/service/_scale_{name}",
+                                    fh.read()))
+    ctx = AnalysisContext(root="/__fixture__", files=files,
+                          docs_text="", parity_text="", scaling_text="")
+    r = run_analysis("/__fixture__", baseline=Baseline([]),
+                     rules={"device-scope", "collective-discipline"},
+                     ctx=ctx)
+    assert r.findings == [], syms(r)

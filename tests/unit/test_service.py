@@ -276,3 +276,32 @@ def test_partial_multigroup_resume_still_works(tmp_path):
     assert np.isnan(res.raw[:, 0]).all()      # completed group: prior run's
     assert np.isfinite(res.raw[:, 1]).all()   # interrupted group: rescored
     assert res.throughput["resumed_from"] == {"group0": 64}
+
+
+@pytest.mark.quick
+def test_occupancy_sums_over_every_local_device(monkeypatch):
+    """Regression for the ISSUE 15 device-scope finding: _occupancy read
+    local_devices()[0] only, under-reporting HBM by the shard count on a
+    multi-device host. It must SUM bytes over the local device list (and
+    stay numerically identical on single-device hosts)."""
+    import jax
+
+    from rtap_tpu.service.loop import _occupancy
+
+    class _Dev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    devs = [_Dev({"bytes_in_use": 100, "peak_bytes_in_use": 150}),
+            _Dev({"bytes_in_use": 40, "peak_bytes_in_use": 60}),
+            _Dev(None)]  # a backend exposing no stats must not poison
+    monkeypatch.setattr(jax, "local_devices", lambda: devs)
+    out = _occupancy()
+    assert out == {"hbm_bytes_in_use": 140, "hbm_peak_bytes_in_use": 210}
+    # single-device: identical to the old [0] read
+    monkeypatch.setattr(jax, "local_devices", lambda: devs[:1])
+    assert _occupancy() == {"hbm_bytes_in_use": 100,
+                            "hbm_peak_bytes_in_use": 150}

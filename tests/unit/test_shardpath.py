@@ -1,0 +1,73 @@
+"""service/shardpath.py (ISSUE 15): shard-qualified resource paths.
+
+The load-bearing contract is shard 0 ≡ the pre-mesh paths, BYTE-
+identical: every committed soak artifact, operator runbook, and resume
+path keys on the exact strings the serve stack wrote before the helper
+existed. These tests pin the equivalences against the literal old
+spellings (the ones the shard-resource pass now bans at call sites) and
+the nonzero-shard separation property the helper exists for.
+"""
+
+import os
+
+import pytest
+
+from rtap_tpu.service.shardpath import (
+    alert_sidecar_path,
+    group_checkpoint_path,
+    shard_scoped_path,
+)
+
+pytestmark = pytest.mark.quick
+
+
+def test_shard_zero_is_byte_identical_to_pre_mesh_paths():
+    # the literal pre-ISSUE-15 spellings, pinned:
+    assert shard_scoped_path("/data/journal", 0) == "/data/journal"
+    assert shard_scoped_path("alerts.jsonl", 0) == "alerts.jsonl"
+    for gi in (0, 7, 123, 9999):
+        assert group_checkpoint_path("/ck", gi) \
+            == os.path.join("/ck", f"group{gi:04d}")
+    assert alert_sidecar_path("/tmp/a.jsonl", "corr") == "/tmp/a.jsonl.corr"
+    assert alert_sidecar_path("/tmp/a.jsonl", "epoch") \
+        == "/tmp/a.jsonl.epoch"
+
+
+def test_nonzero_shards_never_collide():
+    base = "/data/journal"
+    paths = {shard_scoped_path(base, s) for s in range(256)}
+    assert len(paths) == 256
+    assert shard_scoped_path(base, 1) == "/data/journal.shard001"
+    assert shard_scoped_path(base, 255) == "/data/journal.shard255"
+    # a trailing separator on a dir flag must yield a SIBLING, never a
+    # hidden entry nested inside shard 0's directory (review finding)
+    assert shard_scoped_path("runs/journal/", 1) == "runs/journal.shard001"
+    assert shard_scoped_path("runs/journal/", 0) == "runs/journal/"
+    # sidecars derive from the scoped base, so they separate too
+    a0 = alert_sidecar_path(shard_scoped_path("a.jsonl", 0), "corr")
+    a1 = alert_sidecar_path(shard_scoped_path("a.jsonl", 1), "corr")
+    assert a0 == "a.jsonl.corr" and a1 == "a.jsonl.shard001.corr"
+
+
+def test_helper_rejects_garbage():
+    with pytest.raises(ValueError):
+        shard_scoped_path("x", -1)
+    with pytest.raises(ValueError):
+        shard_scoped_path("x", 1000)
+    with pytest.raises(ValueError):
+        alert_sidecar_path("x", "lock")   # unknown sidecar kind
+
+
+def test_serve_stack_routes_through_helper():
+    """The call sites this PR rewired produce exactly the helper's
+    output (spot checks at the import level — the shard-resource pass
+    plus the armed canaries own the no-regression story)."""
+    from rtap_tpu.service import loop as loop_mod
+
+    src = open(loop_mod.__file__, encoding="utf-8").read()
+    assert 'f"group{gi:04d}"' not in src
+    assert '+ ".corr"' not in src
+    from rtap_tpu.obs import health as health_mod
+
+    hsrc = open(health_mod.__file__, encoding="utf-8").read()
+    assert '+ ".epoch"' not in hsrc
