@@ -285,11 +285,18 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # rounding pads; make the elastic intent explicit by default
     reserve = args.reserve if args.reserve is not None \
         else (gsize if args.auto_register else 0)
+    # predictive horizon (ISSUE 16): a non-zero k makes every group carry
+    # the pred_* ring leaves and the fused reducer from tick 0 — the
+    # horizon is structural (it sizes device state), so it is fixed at
+    # registry construction, not toggled later
+    predict_k = (args.predict_horizon if args.predict_horizon is not None
+                 else 8) if args.predict else 0
     grp = StreamGroupRegistry(cfg, group_size=gsize,
                               backend=args.backend, threshold=args.threshold,
                               debounce=args.debounce,
                               stagger_learn=args.stagger_learn,
-                              health=args.health)
+                              health=args.health,
+                              predict=predict_k)
     for sid in ids:
         grp.add_stream(sid)
     grp.finalize(reserve=reserve)
@@ -454,6 +461,34 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               f"(drift tvd>={args.health_drift_threshold} after "
               f"{args.health_drift_min_ticks} ticks, pool occupancy>="
               f"{args.health_occupancy_threshold})", file=sys.stderr)
+    # predictive horizon (rtap_tpu/predict/, ISSUE 16): the groups above
+    # were built with predict=k, so every chunk already carries the fused
+    # predictive-divergence leaf; the tracker folds it into precursor
+    # events with a predicted lead time, and with --topology the fuser
+    # collapses precursors into one predicted_incident with a predicted
+    # blast radius (the correlator's TopologyMap is reused — one parse,
+    # one owner)
+    predictor = None
+    if args.predict:
+        from rtap_tpu.predict import BlastFuser, PredictTracker
+
+        try:
+            predictor = PredictTracker(
+                horizon=predict_k,
+                threshold=args.predict_threshold
+                if args.predict_threshold is not None else 0.35,
+                min_ticks=args.predict_min_ticks
+                if args.predict_min_ticks is not None else 12,
+                blast=BlastFuser(correlator.topology, seed_streams=ids)
+                if correlator is not None else None)
+        except ValueError as e:
+            print(f"serve: bad --predict parameters: {e}", file=sys.stderr)
+            return 2
+        print("serve: predictive horizon armed "
+              f"(k={predict_k} ticks, miss ewma>={predictor.threshold} "
+              f"for {predictor.min_ticks} ticks"
+              + (", blast fusion on" if predictor.blast is not None
+                 else "") + ")", file=sys.stderr)
     # restart continuity (ISSUE 6 satellite): the run epoch persists
     # beside the incident stream and the gauge survives into every
     # snapshot, so a supervised child's counter resets are attributable
@@ -476,6 +511,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             port=args.obs_port, trace=trace,
             flight=flight, health=health,
             correlator=correlator, latency=latency, slo=slo_tracker,
+            predict=predictor,
             healthz_stale_after_s=max(30.0, 10 * args.cadence)).start()
         ohost, oport = obs_server.address
         print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
@@ -525,7 +561,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                               resume_suppression=resume_sup,
                               correlator=correlator,
                               latency=latency,
-                              slo=slo_tracker)
+                              slo=slo_tracker,
+                              predictor=predictor)
         except BaseException as e:  # noqa: BLE001 — dump, then re-raise
             # crash black-box: an exception escaping serve dumps a
             # postmortem bundle BEFORE the traceback, so a dead soak
@@ -1075,6 +1112,35 @@ def main(argv: list[str] | None = None) -> int:
                    help="scored ticks a group must fold before the drift "
                         "detector may fire (the slow EWMA baseline needs "
                         "weight before a distance to it means anything)")
+    p.add_argument("--predict", action="store_true",
+                   help="predictive horizon (docs/PREDICT.md): a fused "
+                        "on-device reducer scores the TM's own "
+                        "predictions against the input that actually "
+                        "arrives k ticks later (~13 B/stream/tick, pure "
+                        "reads — scores and state are bit-identical) and "
+                        "a PredictTracker turns sustained predictive "
+                        "divergence into precursor events with a "
+                        "predicted lead time, BEFORE the anomaly score "
+                        "crosses the alert threshold; with --topology, "
+                        "precursors fuse into a single "
+                        "predicted_incident with a predicted blast "
+                        "radius at the FIRST node (GET /predict with "
+                        "--obs-port)")
+    p.add_argument("--predict-horizon", type=int, default=None,
+                   help="prediction lead k in ticks: each tick's "
+                        "predicted-active columns are scored against the "
+                        "input k ticks later, so precursors carry a "
+                        "~k-tick predicted lead (default 8, with "
+                        "--predict)")
+    p.add_argument("--predict-threshold", type=float, default=None,
+                   help="predictive-miss EWMA level at/above which a "
+                        "stream counts as diverging (default 0.35, with "
+                        "--predict)")
+    p.add_argument("--predict-min-ticks", type=int, default=None,
+                   help="consecutive diverging scored ticks before a "
+                        "precursor fires — edge-triggered hysteresis, "
+                        "one event per excursion (default 12, with "
+                        "--predict)")
     p.add_argument("--topology", default=None,
                    help="arm topology-aware incident correlation "
                         "(rtap_tpu/correlate/, docs/WORKLOADS.md): a JSON "
@@ -1287,6 +1353,24 @@ def main(argv: list[str] | None = None) -> int:
         print("serve: --correlate-min-streams must be >= 2 (one stream "
               "is a per-stream alert, not an incident)", file=sys.stderr)
         return 2
+    if (getattr(args, "predict_horizon", None) is not None
+            or getattr(args, "predict_threshold", None) is not None
+            or getattr(args, "predict_min_ticks", None) is not None) \
+            and not getattr(args, "predict", False):
+        print("serve: --predict-horizon/--predict-threshold/"
+              "--predict-min-ticks are predictive-horizon knobs; add "
+              "--predict", file=sys.stderr)
+        return 2
+    if getattr(args, "predict_horizon", None) is not None \
+            and args.predict_horizon < 1:
+        print("serve: --predict-horizon must be >= 1 (the reducer scores "
+              "each tick's prediction against the input that many ticks "
+              "later)", file=sys.stderr)
+        return 2
+    if getattr(args, "predict_min_ticks", None) is not None \
+            and args.predict_min_ticks < 1:
+        print("serve: --predict-min-ticks must be >= 1", file=sys.stderr)
+        return 2
     if getattr(args, "slo", None) and not getattr(args, "latency", False):
         print("serve: --slo declares an objective over the latency "
               "tracker's measurements; add --latency", file=sys.stderr)
@@ -1393,6 +1477,16 @@ def main(argv: list[str] | None = None) -> int:
               "post-failover splice could not stay byte-identical to "
               "the leader's stream (attribution under replication is "
               "future work)", file=sys.stderr)
+        return 2
+    if (getattr(args, "standby", False)
+            or getattr(args, "replicate_to", None)) \
+            and getattr(args, "predict", False):
+        print("serve: --predict under replication is unsupported — the "
+              "standby buffers would-be alert lines WITHOUT the "
+              "tracker's hysteresis state, so a post-failover precursor "
+              "stream could not stay identical to the leader's "
+              "(predictive horizon under replication is future work)",
+              file=sys.stderr)
         return 2
     if getattr(args, "replicate_listen", None) is not None \
             and not getattr(args, "standby", False):

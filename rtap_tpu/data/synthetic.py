@@ -390,6 +390,10 @@ class TopologyWorkload:
     burst_dur: int
     #: the topology spec dict ({"services": ...}) matching the stream ids
     spec: dict
+    #: origin node carrying the slow-drift precursor ramp (None: no ramp)
+    precursor_node: str | None = None
+    #: tick the origin node's ramp begins (its onset - precursor_ticks)
+    precursor_start: int | None = None
 
 
 def generate_topology_workload(
@@ -402,6 +406,8 @@ def generate_topology_workload(
     cascade_lag: int = 2,
     burst_dur: int = 8,
     burst_magnitude: float = 12.0,
+    precursor_ramp: float = 0.0,
+    precursor_ticks: int = 0,
 ) -> TopologyWorkload:
     """Seeded cascading-fault workload (ISSUE 9 acceptance): per-node
     per-metric base signals (ids ``{svc}-{i:02d}.{metric}``, the
@@ -410,17 +416,41 @@ def generate_topology_workload(
     ``cascade_lag * j`` ticks after the first) across ALL its metrics,
     the blast-radius shape exactly one cluster-level incident must
     cover. All other services stay fault-free (the false-positive
-    control)."""
+    control).
+
+    ``precursor_ramp`` > 0 (with ``precursor_ticks`` > 0) prepends a
+    slow linear drift to the ORIGIN node only — every metric climbs from
+    0 to ``precursor_ramp * sigma`` over the ``precursor_ticks`` ticks
+    ending at that node's burst onset (ISSUE 16's cascade scenario: the
+    predictive horizon must page on the origin's drift BEFORE the second
+    node's step fault lands). The ramp is applied post-draw like the
+    burst itself, so enabling it never perturbs the RNG draw order: all
+    other streams — and every stream of a ramp-free call — stay
+    byte-identical to previous releases."""
     cfg = cfg or SyntheticStreamConfig(length=400, n_anomalies=0,
                                       noise_phi=0.9, noise_scale=0.3)
     if cfg.n_anomalies:
         raise ValueError(
             "generate_topology_workload owns its fault injection; pass a "
             "cfg with n_anomalies=0")
+    if precursor_ramp < 0 or precursor_ticks < 0:
+        raise ValueError("precursor_ramp/precursor_ticks must be >= 0")
+    if (precursor_ramp > 0) != (precursor_ticks > 0):
+        raise ValueError(
+            "precursor_ramp and precursor_ticks arm the drift together: "
+            "set both > 0 (or neither)")
     rng = _rng_for(seed, "topology-workload")
     svc_names = [f"svc{chr(ord('a') + i)}" for i in range(n_services)]
     burst_service = svc_names[int(rng.integers(n_services))]
     onset0 = int(cfg.length * burst_at_frac)
+    if onset0 - precursor_ticks < 0:
+        # same loud-failure discipline as the cascade-fit check below: a
+        # truncated ramp would silently hand the eval a steeper (easier)
+        # drift than the caller asked for
+        raise ValueError(
+            f"precursor ramp does not fit: onset {onset0} needs "
+            f"{precursor_ticks} ramp ticks before it (lower "
+            f"precursor_ticks or raise burst_at_frac/length)")
     last_onset = onset0 + cascade_lag * (nodes_per_service - 1)
     if last_onset + 2 > cfg.length - 1:
         # the last cascaded node must still get a real burst (>= 2 ticks
@@ -452,6 +482,14 @@ def generate_topology_workload(
                     e = min(onset + burst_dur, cfg.length - 1)
                     sig = s.values.astype(np.float64)
                     sig[onset:e] += burst_magnitude * sigma
+                    if precursor_ticks and j == 0:
+                        # origin-node slow drift: 0 -> ramp*sigma over the
+                        # ticks ending at onset (endpoint excluded — the
+                        # step itself is the fault, the ramp its precursor)
+                        r0 = onset - precursor_ticks
+                        sig[r0:onset] += precursor_ramp * sigma * \
+                            np.linspace(0.0, 1.0, precursor_ticks,
+                                        endpoint=False)
                     lo_c, hi_c = METRIC_PROFILES.get(
                         m, METRIC_PROFILES["cpu"])[3]
                     if lo_c is not None:
@@ -470,7 +508,10 @@ def generate_topology_workload(
     return TopologyWorkload(
         streams=streams, burst_service=burst_service,
         burst_nodes=burst_nodes, burst_onsets=burst_onsets,
-        burst_dur=burst_dur, spec=spec)
+        burst_dur=burst_dur, spec=spec,
+        precursor_node=burst_nodes[0] if precursor_ticks else None,
+        precursor_start=(burst_onsets[burst_nodes[0]] - precursor_ticks)
+        if precursor_ticks else None)
 
 
 @dataclass

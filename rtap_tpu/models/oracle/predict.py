@@ -1,0 +1,170 @@
+"""Host twin of the fused predictive-horizon reducer (ISSUE 16).
+
+The TM's active segments are a one-step forward model the fused step
+computes and discards every tick. The predict reducer
+(ops/predict_tpu.py:predict_update) turns that state into a LEAD-TIME
+signal: the predicted-active column set captured at tick ``t - k`` is
+compared against the actual active columns at tick ``t`` — a stream in
+a learned stable regime keeps high overlap across the horizon, while a
+slow pre-fault drift erodes it ticks before the anomaly score spikes
+(the precursor the host tracker in rtap_tpu/predict/ pages on).
+
+This module is the oracle side of the pair, in numpy on PUBLIC-layout
+state — :func:`predict_update_host` is the bit-twin the rtap-lint v3
+``twin-parity`` pass resolves for the device kernel, and
+:func:`predict_from_states` is the CPU-oracle backend's adapter (stacks
+per-stream state dicts, folds the twin, scatters the updated predictor
+leaves back). Everything schema-shaped lives here so the device module
+imports it, never the reverse (models/ must not import ops/).
+
+Predictor state (models/state.py, present only when a horizon is set):
+
+    pred_ring      bool [k, C]  predicted-active column sets of the last
+                                k ticks (slot ``t % k``)
+    pred_miss_ewma f32  []      divergence trajectory: EWMA of the
+                                predicted->actual miss rate (NaN until
+                                the first scored tick — init-on-first)
+    pred_tick0     i32  []      tick the stream's predictor state was
+                                (re)initialized — claimed slots stay
+                                unscored for a full horizon instead of
+                                scoring against a zeroed ring
+
+Update semantics, per tick t (post-step, group layout):
+
+- ``act``  = this tick's active columns (post-step ``prev_active``);
+- ``pred`` = columns with any active segment (the TM's prediction for
+  t+1); written to ring slot ``t % k`` AFTER the slot is read;
+- the slot's prior content is the set captured at ``t - k``; overlap =
+  |old & act| / max(|act|, 1), miss = 1 - overlap;
+- a stream scores iff it is live (finite input) AND ``t >= pred_tick0
+  + k`` (the ring holds a real horizon-old prediction for it);
+- the EWMA folds ``miss`` with :data:`PRED_ALPHA` on scored ticks only
+  (first scored tick adopts ``miss`` outright).
+
+All arithmetic is f32 with a power-of-two alpha so the device and host
+twins agree bit for bit (tests/parity/test_predict_parity.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+
+__all__ = [
+    "PREDICT_KEYS",
+    "PRED_ALPHA",
+    "predict_horizon_of",
+    "predict_nbytes",
+    "predict_update_host",
+    "predict_from_states",
+]
+
+#: divergence-EWMA step — a power of two, so the fold is bit-exact
+#: across the device and numpy twins (no fused-multiply reassociation)
+PRED_ALPHA = np.float32(0.125)
+
+#: the leaf's key set, in a fixed order (schema contract for the host
+#: tracker, the /predict route, and the parity tests). Unlike the
+#: health leaf these are PER-STREAM vectors: the tracker needs each
+#: stream's own divergence trajectory to page with a stable stream id.
+PREDICT_KEYS = (
+    "overlap",        # f32 [G] predicted(t-k) -> actual(t) column overlap
+    #                           (NaN on unscored streams)
+    "miss_ewma",      # f32 [G] post-update divergence EWMA (NaN until a
+    #                           stream's first scored tick)
+    "pred_col_frac",  # f32 [G] predicted-active column fraction (of C)
+    "scored",         # bool [G] live AND past the per-stream horizon
+)
+
+
+def predict_horizon_of(state: dict) -> int:
+    """Horizon k carried by a state tree (0 when the predictor leaves are
+    absent — flags-off trees are byte-identical to pre-predict HEAD)."""
+    ring = state.get("pred_ring")
+    if ring is None:
+        return 0
+    # single-stream [k, C] or group [G, k, C]
+    return int(np.shape(ring)[-2])
+
+
+def predict_nbytes(group_size: int) -> int:
+    """Bytes per (group, tick) predict leaf: three f32 vectors plus one
+    bool mask per stream — 13 B/stream, riding the chunk output beside
+    the [T, G] scores (never a separate device->host fetch)."""
+    return group_size * (3 * 4 + 1)
+
+
+def predict_update_host(state: dict, values: np.ndarray,
+                        cfg: ModelConfig) -> tuple[dict, dict]:
+    """Numpy twin of ``predict_update`` on PUBLIC-layout group state
+    ([G, ...] leaves) -> (state', leaf). Only the predictor-owned leaves
+    (``pred_ring``, ``pred_miss_ewma``) change; every model leaf passes
+    through untouched — the flags-off bit-exactness contract is
+    structural, not behavioral."""
+    tm = cfg.tm
+    C, K, S = cfg.sp.columns, tm.cells_per_column, tm.max_segments_per_cell
+    ring = np.asarray(state["pred_ring"])
+    G, k = ring.shape[0], ring.shape[1]
+    values = np.asarray(values, np.float32)
+    if values.ndim == 1:
+        values = values[:, None]
+
+    liv = np.isfinite(values).any(-1)  # [G]
+    # tm_iter counts COMPLETED steps (lockstep scalar); the tick just
+    # scored is t = tm_iter - 1
+    t = np.int32(np.asarray(state["tm_iter"]).reshape(-1)[0]) - np.int32(1)
+    slot = int(t % k)
+
+    act = np.asarray(state["prev_active"]).reshape(G, C, K).any(-1)  # [G, C]
+    aseg = np.asarray(state["active_seg"]).reshape(G, C, K, S)
+    pred_new = aseg.any(-1).any(-1)  # [G, C] columns predicted for t+1
+
+    old = ring[:, slot, :]  # the set captured at tick t - k
+    act_n = act.sum(-1).astype(np.float32)
+    ov_n = (old & act).sum(-1).astype(np.float32)
+    overlap = ov_n / np.maximum(act_n, np.float32(1.0))
+    miss = np.float32(1.0) - overlap
+
+    tick0 = np.asarray(state["pred_tick0"], np.int32).reshape(G)
+    scored = liv & (t >= tick0 + np.int32(k))
+
+    ewma = np.asarray(state["pred_miss_ewma"], np.float32).reshape(G)
+    folded = np.where(np.isnan(ewma), miss,
+                      ewma + PRED_ALPHA * (miss - ewma)).astype(np.float32)
+    new_ewma = np.where(scored, folded, ewma).astype(np.float32)
+
+    new_ring = ring.copy()
+    new_ring[:, slot, :] = pred_new
+
+    nan_overlap = np.where(scored, overlap,
+                           np.float32(np.nan)).astype(np.float32)
+    col_frac = (pred_new.sum(-1).astype(np.float32) / np.float32(C))
+    leaf = {
+        "overlap": nan_overlap,  # rtap: partition[shard-streams]
+        "miss_ewma": new_ewma,  # rtap: partition[shard-streams]
+        "pred_col_frac": col_frac,  # rtap: partition[shard-streams]
+        "scored": scored,  # rtap: partition[shard-streams]
+    }
+    state = dict(state)
+    state["pred_ring"] = new_ring
+    state["pred_miss_ewma"] = new_ewma
+    return state, leaf
+
+
+def predict_from_states(states: list[dict], values: np.ndarray,
+                        cfg: ModelConfig) -> dict:
+    """CPU-oracle backend adapter: stack the per-stream oracle dicts into
+    a [G, ...] view, fold the host twin, and scatter the updated
+    predictor leaves back into each stream's dict (the oracle owns its
+    state in place). Only the leaves the reducer reads are stacked."""
+    grouped = {
+        key: np.stack([np.asarray(s[key]) for s in states])
+        for key in ("prev_active", "active_seg", "tm_iter",
+                    "pred_ring", "pred_miss_ewma", "pred_tick0")
+    }
+    grouped, leaf = predict_update_host(grouped, values, cfg)
+    for g, s in enumerate(states):
+        s["pred_ring"] = grouped["pred_ring"][g]
+        s["pred_miss_ewma"] = np.float32(grouped["pred_miss_ewma"][g])
+    return leaf

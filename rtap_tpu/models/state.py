@@ -73,12 +73,19 @@ def fwd_index_arrays(cfg: ModelConfig) -> dict[str, np.ndarray]:
 
 
 def init_state(
-    cfg: ModelConfig, seed: int = 0, include_fwd: bool | None = None
+    cfg: ModelConfig, seed: int = 0, include_fwd: bool | None = None,
+    predict_horizon: int = 0,
 ) -> dict[str, np.ndarray]:
     """Build the full per-stream state dict (see module docstring for layout).
 
     `include_fwd` adds the forward-index arrays (None = yes iff the kernel's
-    dendrite mode is "forward", so callers stay mode-agnostic)."""
+    dendrite mode is "forward", so callers stay mode-agnostic).
+
+    `predict_horizon` > 0 adds the predictive-horizon leaves (ISSUE 16,
+    ops/predict_tpu.py): a k-deep ring of predicted-active column sets, the
+    divergence EWMA, and the per-stream warm-up epoch. 0 (the default) omits
+    them entirely, so predict-less state trees — and their checkpoints — stay
+    byte-identical to pre-predict builds (the flags-off bit-exactness pin)."""
     if include_fwd is None:
         from rtap_tpu.ops.tm_tpu import dendrite_mode
 
@@ -135,6 +142,17 @@ def init_state(
         # their checkpoints) are byte-identical.
         **({"enc_prev": np.full(cfg.n_fields, np.nan, np.float32)}  # rtap: partition[shard-streams]
            if cfg.composite is not None and cfg.composite.has_delta else {}),
+        # predictive-horizon leaves (ISSUE 16, ops/predict_tpu.py): present
+        # only when a horizon is armed — serve --predict off keeps the tree
+        # byte-identical to HEAD. pred_ring slot t%k holds the predicted-
+        # active column set captured at tick t; pred_miss_ewma is NaN until
+        # the stream's first scored tick; pred_tick0 is the (re)init tick —
+        # claimed slots stay unscored for a full horizon (registry sets it).
+        **({
+            "pred_ring": np.zeros((predict_horizon, cfg.sp.columns), bool),  # rtap: partition[shard-streams]
+            "pred_miss_ewma": np.float32(np.nan),  # rtap: partition[shard-streams]
+            "pred_tick0": np.int32(0),  # rtap: partition[shard-streams]
+        } if predict_horizon else {}),
         # forward synapse index (derived; present only in forward dendrite mode)
         **(fwd_index_arrays(cfg) if include_fwd else {}),
         # SDR classifier (SURVEY.md C10), present only when enabled

@@ -239,6 +239,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = (json.dumps(ht.snapshot()) + "\n").encode()
             ctype = "application/json"
+        elif path == "/predict":
+            # per-stream divergence trajectories, alarmed streams, and
+            # open predicted-blast windows (ISSUE 16, rtap_tpu/predict/):
+            # the PredictTracker's point-in-time snapshot — diagnostic
+            # read, same contract as /health
+            pt = getattr(self.server, "predict", None)
+            if pt is None:
+                self.send_error(404, "predictive horizon not enabled "
+                                     "(serve --predict)")
+                return
+            body = (json.dumps(pt.snapshot()) + "\n").encode()
+            ctype = "application/json"
         elif path == "/incidents":
             # cluster-level incident records + open correlation windows
             # (ISSUE 9, rtap_tpu/correlate/): the correlator's point-in-
@@ -355,7 +367,10 @@ class ExpositionServer:
     snapshot). With a ``latency`` tracker (obs/latency.py),
     ``/latency`` serves the stage waterfalls + windowed quantiles, and
     with an ``slo`` tracker (obs/slo.py), ``/slo`` serves the declared
-    SLOs' live burn rates and verdict. ``/healthz`` is always routed:
+    SLOs' live burn rates and verdict, and with a ``predict`` tracker
+    (rtap_tpu/predict/), ``/predict`` serves the divergence
+    trajectories, alarmed streams, and open predicted-blast windows.
+    ``/healthz`` is always routed:
     a liveness probe returning 200 while the loop ticked within
     ``healthz_stale_after_s`` seconds, 503 otherwise
     (docs/TELEMETRY.md documents the contract).
@@ -364,7 +379,7 @@ class ExpositionServer:
     def __init__(self, registry: TelemetryRegistry | None = None,
                  host: str = "127.0.0.1", port: int = 0,
                  trace=None, flight=None, health=None, correlator=None,
-                 latency=None, slo=None,
+                 latency=None, slo=None, predict=None,
                  healthz_stale_after_s: float = 30.0):
         self.registry = registry or get_registry()
         self._server = _Server((host, port), _Handler)
@@ -375,6 +390,7 @@ class ExpositionServer:
         self._server.correlator = correlator
         self._server.latency = latency
         self._server.slo = slo
+        self._server.predict = predict
         self._server.healthz_stale_after_s = float(healthz_stale_after_s)
         self.address = self._server.server_address  # (host, bound port)
         self._thread = threading.Thread(

@@ -66,7 +66,8 @@ class FlightRecorder:
                  n_events: int = 512, max_event_bytes: int = 1024,
                  miss_burst: int = 5, min_dump_gap_ticks: int = 120,
                  max_bundles: int = 16, info: dict | None = None,
-                 health_provider=None, latency_provider=None):
+                 health_provider=None, latency_provider=None,
+                 predict_provider=None):
         if n_ticks < 1:
             raise ValueError(f"n_ticks must be >= 1; got {n_ticks}")
         if miss_burst < 1:
@@ -90,6 +91,11 @@ class FlightRecorder:
         # quantiles land in every bundle's summary, so an slo_burn (or
         # any other) postmortem names the stage that ate the budget
         self.latency_provider = latency_provider
+        # optional predictive-horizon scorecard source (rtap_tpu/predict/
+        # ISSUE 16): same contract — the divergence trajectories and
+        # open blast windows land in every bundle's summary, so a
+        # precursor postmortem shows what the predictor saw
+        self.predict_provider = predict_provider
         # tick rings (preallocated; the scored ring is sized on first use
         # because the group count is the loop's to know)
         self._tick = np.full(self.n_ticks, -1, np.int64)
@@ -280,6 +286,11 @@ class FlightRecorder:
                 out["latency"] = self.latency_provider()
             except Exception:  # noqa: BLE001 — must not kill a dump
                 out["latency"] = None
+        if self.predict_provider is not None:
+            try:
+                out["predict"] = self.predict_provider()
+            except Exception:  # noqa: BLE001 — must not kill a dump
+                out["predict"] = None
         return out
 
     def dump(self, reason: str, tick: int | None = None) -> str | None:

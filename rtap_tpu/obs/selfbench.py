@@ -16,11 +16,11 @@ import time
 from rtap_tpu.obs.metrics import TelemetryRegistry
 
 __all__ = ["measure", "measure_trace", "measure_journal", "measure_health",
-           "measure_correlate", "measure_latency", "GATE_MEASURES",
-           "GATE_BUDGET_FRAC",
+           "measure_correlate", "measure_latency", "measure_predict",
+           "GATE_MEASURES", "GATE_BUDGET_FRAC",
            "OPS_PER_TICK", "TRACE_SPANS_PER_TICK",
            "HEALTH_FOLDS_PER_TICK", "CORRELATE_ALERTS_PER_TICK",
-           "LATENCY_OBSERVES_PER_TICK"]
+           "LATENCY_OBSERVES_PER_TICK", "PREDICT_FOLDS_PER_TICK"]
 
 #: instrument operations a serve tick costs at the production shape (six
 #: phase observes + tick latency observe + ticks/scored/alert counters +
@@ -46,6 +46,11 @@ CORRELATE_ALERTS_PER_TICK = 32
 #: for (ISSUE 11): the same 32-stream alert-storm ceiling as the
 #: correlator, on top of the per-tick record_tick + SLO evaluation
 LATENCY_OBSERVES_PER_TICK = 32
+
+#: PredictTracker.fold calls a serve tick costs at the production
+#: multi-group shape (ISSUE 16): one per collected chunk per group, 16
+#: groups — the same shape as the health folds they ride beside
+PREDICT_FOLDS_PER_TICK = 16
 
 
 def _time_op(fn, n: int) -> float:
@@ -338,6 +343,58 @@ def measure_latency(n: int = 20_000, cadence_s: float = 1.0,
     }
 
 
+def measure_predict(n: int = 2000, cadence_s: float = 1.0,
+                    n_groups: int = PREDICT_FOLDS_PER_TICK,
+                    n_streams: int = 1024) -> dict:
+    """Predictive-horizon host-path cost (ISSUE 16), same protocol as
+    :func:`measure`: per-fold nanoseconds of ``PredictTracker.fold`` on
+    a private tracker fed realistic per-(group, tick) leaves at the
+    production group width, projected to a tick at the multi-group
+    shape (one fold per group per tick at 16 groups, beside the health
+    folds). The DEVICE-side reducer cost is a property of the compiled
+    step and is measured on silicon by the ``r15_predict`` hw-session
+    step; the host fold is what the loop thread pays, and ISSUE 16
+    gates it <= 1% of the tick budget alongside every other obs
+    instrument (``bench.py --obs-bench``)."""
+    import numpy as np
+
+    from rtap_tpu.models.oracle.predict import predict_nbytes
+    from rtap_tpu.predict import PredictTracker
+
+    pt = PredictTracker(horizon=8, registry=TelemetryRegistry(),
+                        threshold=0.35, min_ticks=12)
+    rng = np.random.default_rng(0)
+    miss = rng.random(n_streams).astype(np.float32) * 0.3
+    leaves = {
+        "overlap": (1.0 - miss)[None, :],
+        "miss_ewma": miss[None, :],
+        "pred_col_frac": np.full((1, n_streams), 0.04, np.float32),
+        "scored": np.ones((1, n_streams), bool),
+    }
+    ids = [f"node{i:05d}.cpu" for i in range(n_streams)]
+    gi = [0]
+
+    def _fold():
+        gi[0] = (gi[0] + 1) % n_groups
+        pt.fold(gi[0], leaves, tick=gi[0], ids=ids)
+
+    _fold()  # warm the group slot + instrument shards out of the timing
+    fold_s = _time_op(_fold, n)
+    snap_s = _time_op(pt.snapshot, max(1, n // 20))
+    per_tick_s = n_groups * fold_s
+    return {
+        "predict_fold_us": round(fold_s * 1e6, 2),
+        "predict_snapshot_us": round(snap_s * 1e6, 2),
+        "folds_per_tick": n_groups,
+        "n_groups": n_groups,
+        "n_streams": n_streams,
+        "leaf_bytes_per_group_tick": predict_nbytes(n_streams),
+        "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
+        "per_tick_overhead_frac": per_tick_s / cadence_s,
+        "cadence_s": cadence_s,
+    }
+
+
 #: THE obs-bench gate registry (ISSUE 11 satellite): every self-
 #: benchmarked instrument surface, each gated <= ``budget_frac`` of the
 #: tick budget by ``bench.py --obs-bench`` and the tier-1 overhead
@@ -351,6 +408,7 @@ GATE_MEASURES: tuple = (
     ("obs_health_overhead", measure_health),
     ("obs_correlate_overhead", measure_correlate),
     ("obs_latency_overhead", measure_latency),
+    ("obs_predict_overhead", measure_predict),
 )
 
 #: the shared acceptance bar: each surface's projected per-tick cost

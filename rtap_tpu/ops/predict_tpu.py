@@ -1,0 +1,113 @@
+"""Fused on-device predictive-horizon reducer (ISSUE 16 tentpole).
+
+The pipeline detects anomalies 1-2 s AFTER onset (ROADMAP item 6); the
+paper's title promises *prediction*. The TM already computes a forward
+model every tick — its active segments name the columns it expects next
+— and throws it away. :func:`predict_update` runs INSIDE the fused step
+program (ops/step.py ``_tick``, behind the static ``predict`` flag,
+beside ``health``): it keeps a k-deep ring of predicted-active column
+sets in predictor-owned state leaves and reduces the horizon-old
+prediction against the tick's actual active columns into a compact
+per-stream leaf — overlap, a divergence EWMA (the trajectory the host
+tracker in rtap_tpu/predict/ pages on), and predicted sparsity.
+
+Properties the tests pin (the PR 6 health discipline):
+
+- **Model state untouched.** The reducer reads the post-step TM state
+  and writes ONLY the predictor-owned leaves (``pred_ring``,
+  ``pred_miss_ewma``), which exist only when a horizon is configured —
+  with ``--predict`` off the state tree, scores, and alert stream are
+  byte-identical to a predict-less build
+  (tests/integration/test_predict_serve.py).
+- **No extra device<->host fetch.** The [G] leaf rides the existing
+  chunk output beside the scores.
+- **Bit-exact twin.** The numpy oracle twin lives in
+  models/oracle/predict.py (``predict_update_host``) — same schema,
+  same f32 arithmetic, power-of-two EWMA alpha;
+  tests/parity/test_predict_parity.py pins device == oracle.
+
+Semantics (full derivation in the twin module's docstring): at tick t
+the ring slot ``t % k`` is read (the prediction captured at ``t - k``)
+then overwritten with this tick's prediction; overlap vs the actual
+active columns scores only streams that are live AND past their
+per-stream warm-up (``t >= pred_tick0 + k`` — a claimed slot's zeroed
+ring must not fake a divergence).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+from rtap_tpu.models.oracle.predict import (
+    PRED_ALPHA,
+    PREDICT_KEYS,
+    predict_horizon_of,
+    predict_nbytes,
+)
+
+__all__ = [
+    "PREDICT_KEYS",
+    "PRED_ALPHA",
+    "predict_horizon_of",
+    "predict_nbytes",
+    "predict_update",
+]
+
+
+# rtap: twin[predict_update_host] — numpy oracle twin on public-layout
+# state (models/oracle/predict.py); parity: tests/parity/test_predict_parity.py
+def predict_update(state: dict, values, cfg: ModelConfig) -> tuple[dict, dict]:
+    """Fold one tick into the predictor state -> (state', leaf [G]).
+
+    `state` is the kernel-layout POST-STEP group state (flat or aos —
+    the reads reshape like the health reducer, layout-invariant),
+    `values` the [G, n_fields] polled inputs (live-stream mask source).
+    Traced inside the fused step program: shape-static, the only writes
+    are the predictor-owned ring + EWMA leaves (donation-safe in-place
+    updates). See :data:`PREDICT_KEYS` for the leaf schema.
+    """
+    import jax.numpy as jnp
+
+    tm = cfg.tm
+    C, K, S = cfg.sp.columns, tm.cells_per_column, tm.max_segments_per_cell
+    ring = state["pred_ring"]
+    G, k = ring.shape[0], ring.shape[1]
+
+    liv = jnp.isfinite(values).any(-1)  # [G] streams with data this tick
+    # tm_iter counts COMPLETED steps (lockstep scalar); the tick just
+    # scored is t = tm_iter - 1
+    t = state["tm_iter"].reshape(-1)[0].astype(jnp.int32) - jnp.int32(1)
+    slot = jnp.mod(t, jnp.int32(k))
+
+    act = state["prev_active"].reshape(G, C, K).any(-1)  # [G, C] this tick
+    aseg = state["active_seg"].reshape(G, C, K, S)
+    pred_new = aseg.any(-1).any(-1)  # [G, C] columns predicted for t+1
+
+    old = jnp.take(ring, slot, axis=1)  # the set captured at tick t - k
+    act_n = act.sum(-1).astype(jnp.float32)
+    ov_n = (old & act).sum(-1).astype(jnp.float32)
+    overlap = ov_n / jnp.maximum(act_n, jnp.float32(1.0))
+    miss = jnp.float32(1.0) - overlap
+
+    tick0 = state["pred_tick0"].reshape(G).astype(jnp.int32)
+    scored = liv & (t >= tick0 + jnp.int32(k))
+
+    ewma = state["pred_miss_ewma"].reshape(G).astype(jnp.float32)
+    folded = jnp.where(jnp.isnan(ewma), miss,
+                       ewma + PRED_ALPHA * (miss - ewma))
+    new_ewma = jnp.where(scored, folded, ewma)
+
+    state = dict(state)
+    state["pred_ring"] = ring.at[:, slot, :].set(pred_new)
+    state["pred_miss_ewma"] = new_ewma.reshape(
+        np.shape(state["pred_miss_ewma"]))
+
+    leaf = {
+        "overlap": jnp.where(scored, overlap, jnp.float32(np.nan)),
+        "miss_ewma": new_ewma,
+        "pred_col_frac": (pred_new.sum(-1).astype(jnp.float32)
+                          / jnp.float32(C)),
+        "scored": scored,
+    }
+    return state, leaf

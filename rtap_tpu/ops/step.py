@@ -81,7 +81,7 @@ def fused_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
 
 
 def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
-          inv: dict | None = None, health: bool = False):
+          inv: dict | None = None, health: bool = False, predict: bool = False):
     """One group tick on KERNEL-layout state, honoring cfg.learn_every.
 
     With a learning cadence (cfg.learn_every > 1 and learn=True) the
@@ -101,6 +101,14 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
     produced — the model state and scores are bit-identical either way
     (tests/integration/test_health_serve.py pins it), and the leaf adds
     ~200 bytes to the chunk output instead of a device->host state fetch.
+
+    `predict=True` (static, ISSUE 16) additionally folds the predictive-
+    horizon reducer (ops/predict_tpu.py) — it updates ONLY the
+    predictor-owned ring/EWMA leaves and wraps the per-stream leaf
+    OUTERMOST: (state, (inner, predict_leaf)) where `inner` is whatever
+    the health flag produced, so existing unpack sites are untouched.
+    Requires the predictor leaves in the state tree (the registry builds
+    them via init_state(predict_horizon=...)).
     """
 
     def step_all(lrn):
@@ -114,35 +122,42 @@ def _tick(s: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, 
         tick = s["tm_iter"].reshape(-1)[0]  # completed steps so far (lockstep)
         s, out = jax.lax.cond(
             cfg.learns_on(tick), step_all(True), step_all(False), s)
-    if not health:
-        return s, out
-    from rtap_tpu.ops.health_tpu import health_reduce
+    if predict:
+        from rtap_tpu.ops.predict_tpu import predict_update
 
-    raw = out[0] if cfg.classifier.enabled else out
-    return s, (out, health_reduce(s, raw, values, cfg))
+        s, pleaf = predict_update(s, values, cfg)
+    if health:
+        from rtap_tpu.ops.health_tpu import health_reduce
+
+        raw = out[0] if cfg.classifier.enabled else out
+        out = (out, health_reduce(s, raw, values, cfg))
+    if predict:
+        out = (out, pleaf)
+    return s, out
 
 
 # rtap: twin[oracle_record_step] — vmapped form of the same oracle chain
-@partial(jax.jit, static_argnames=("cfg", "learn", "health"), donate_argnums=(0,))
+@partial(jax.jit, static_argnames=("cfg", "learn", "health", "predict"), donate_argnums=(0,))
 def group_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True,
-               health: bool = False):
+               health: bool = False, predict: bool = False):
     """Stream-group fused step: every state leaf carries a leading G axis;
     `values` is [G, n_fields] f32, `ts_unix` [G] i32 -> (state, raw [G] f32).
 
     State buffers are donated: at 100k streams the TM pools dominate HBM and
     the update must happen in place (SURVEY.md §7 hard part 4).
-    With `health=True` the out leaf becomes (out, health_leaf) — see
-    :func:`_tick` / ops/health_tpu.py.
+    With `health=True` the out leaf becomes (out, health_leaf); with
+    `predict=True` the predictive-horizon leaf wraps outermost — see
+    :func:`_tick` / ops/health_tpu.py / ops/predict_tpu.py.
     """
     from rtap_tpu.ops.tm_tpu import from_kernel_layout, to_kernel_layout
 
     state, out = _tick(to_kernel_layout(state), values, ts_unix, cfg, learn,
-                       health=health)
+                       health=health, predict=predict)
     return from_kernel_layout(state, cfg.tm), out
 
 
 def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool,
-                health: bool = False):
+                health: bool = False, predict: bool = False):
     """Shared hot-loop body: scan the vmapped fused step over the time axis.
     Used identically by the single-device and shard_map entry points, so the
     two can never diverge semantically.
@@ -161,16 +176,17 @@ def _scan_chunk(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mod
 
     def body(s, inp):
         v, t = inp
-        return _tick(s, v, t, cfg, learn, inv, health=health)
+        return _tick(s, v, t, cfg, learn, inv, health=health,
+                     predict=predict)
 
     state, out = jax.lax.scan(body, to_kernel_layout(state), (values, ts_unix))
     return from_kernel_layout(state, cfg.tm), out
 
 
 # rtap: twin[oracle_record_step] — time-scanned form of the oracle chain
-@partial(jax.jit, static_argnames=("cfg", "learn", "health"), donate_argnums=(0,))
+@partial(jax.jit, static_argnames=("cfg", "learn", "health", "predict"), donate_argnums=(0,))
 def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: ModelConfig, learn: bool = True,
-               health: bool = False):
+               health: bool = False, predict: bool = False):
     """Multi-tick stream-group step: scan :func:`group_step`'s body over a
     leading time axis so T ticks cost ONE device dispatch.
 
@@ -181,9 +197,12 @@ def chunk_step(state: dict, values: jnp.ndarray, ts_unix: jnp.ndarray, cfg: Mode
     :func:`group_step` per tick instead. With `health=True` (static) the
     out leaf becomes (out, health_leaf) and every health-leaf array gains
     the leading T axis — one ~200 B record per tick, scanned alongside the
-    scores (ops/health_tpu.py).
+    scores (ops/health_tpu.py). With `predict=True` the predictive-horizon
+    leaf rides the same way, wrapped outermost ([T, G] per-stream vectors
+    beside the scores — ops/predict_tpu.py).
     """
-    return _scan_chunk(state, values, ts_unix, cfg, learn, health=health)
+    return _scan_chunk(state, values, ts_unix, cfg, learn, health=health,
+                       predict=predict)
 
 
 @_functools.lru_cache(maxsize=None)

@@ -1,0 +1,23 @@
+"""Host-side predictive-horizon tracking (ISSUE 16).
+
+The fused step's on-device predict reducer (ops/predict_tpu.py) hands
+the loop one compact per-stream leaf per (group, tick): horizon-old
+predicted-column overlap vs the tick's actual input, the divergence
+EWMA, predicted sparsity. This package turns those into LEAD TIME:
+
+- :class:`~rtap_tpu.predict.horizon.PredictTracker` folds the leaves
+  into per-stream divergence trajectories and emits edge-triggered
+  ``precursor`` event lines (stable alert ids, flight-recorder dumps)
+  BEFORE the anomaly score spikes;
+- :class:`~rtap_tpu.predict.blast.BlastFuser` fuses precursors with the
+  correlate/ topology so a cascading fault pages ONCE, at the first
+  node, with the predicted blast radius — not once per stream as the
+  fault rolls downstream.
+
+See docs/PREDICT.md for the operator surface.
+"""
+
+from rtap_tpu.predict.blast import BlastFuser
+from rtap_tpu.predict.horizon import PredictTracker
+
+__all__ = ["PredictTracker", "BlastFuser"]

@@ -461,6 +461,26 @@ def scan_alert_ids(path: str, offset: int = 0) -> set[str]:
     return ids
 
 
+def scan_event_ids(path: str, offset: int = 0,
+                   events: tuple = ("precursor", "predicted_incident"),
+                   ) -> set[str]:
+    """Stable EVENT-line alert_ids already on disk at/after `offset` —
+    the resume suppression set for id-carrying structured events (the
+    predictive ``precursor`` / ``predicted_incident`` lines, whose ids
+    are pure functions of (stream, tick) so a journal replay reproduces
+    them bit-for-bit). Same walker, same cursor discipline as
+    :func:`scan_alert_ids`; alert records and other event kinds are
+    skipped."""
+    ids: set[str] = set()
+    for kind, d in iter_alert_records(path, offset):
+        if kind != "event" or d.get("event") not in events:
+            continue
+        aid = d.get("alert_id")
+        if aid:
+            ids.add(aid)
+    return ids
+
+
 @dataclass
 class ThroughputCounter:
     """Counts scored metrics against wall clock -> metrics/sec/chip."""

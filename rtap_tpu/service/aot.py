@@ -104,24 +104,36 @@ def prewarm(groups, micro_chunk: int, learn: bool, degradation=None,
                  for g in device_groups if g.cfg == cfg)
         for cfg in by_cfg
     }
+    # the predict reducer is a static flag too (ISSUE 16) AND sizes extra
+    # state leaves: warm with the horizon the groups will dispatch, and
+    # pass the flag EXPLICITLY — jit keys on how statics are passed, so a
+    # defaulted kwarg here would compile a program the loop never reuses
+    predict_by_cfg = {
+        cfg: max((int(getattr(g, "predict", 0))
+                  for g in device_groups if g.cfg == cfg), default=0)
+        for cfg in by_cfg
+    }
     for cfg, mls in by_cfg.items():
         G = next(g.G for g in device_groups if g.cfg == cfg)
+        pk = predict_by_cfg[cfg]
         # one scratch state per config, threaded through every program
         # (chunk_step donates its state argument, so each call consumes
         # the previous call's output buffers — no HBM accumulation)
-        scratch = replicate_state_device(init_state(cfg, seed), G)
+        scratch = replicate_state_device(
+            init_state(cfg, seed, predict_horizon=pk), G)
         for m, lf in sorted(mls):
             vals = jnp.full((m, G, cfg.n_fields), jnp.nan, jnp.float32)
             ts = jnp.zeros((m, G), jnp.int32)
             scratch, _ = chunk_step(scratch, vals, ts, cfg, learn=lf,
-                                    health=health_by_cfg[cfg])
+                                    health=health_by_cfg[cfg],
+                                    predict=bool(pk))
             counter.inc()
             warmed.add((m, cfg, lf))
         if include_claim:
             # the first-claim/realignment program (registry.claim_slot ->
             # set_state_row): the slot index is traced, so ONE execution
             # covers every future claim
-            fresh = init_state(cfg, seed)
+            fresh = init_state(cfg, seed, predict_horizon=pk)
             scratch = set_state_row(
                 scratch, {k: fresh[k] for k in scratch}, 0)
             counter.inc()

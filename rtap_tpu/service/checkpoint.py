@@ -89,6 +89,7 @@ def save_group(grp: StreamGroup, path: str | Path,
         "ticks": grp.ticks,
         "threshold": grp.threshold,
         "debounce": grp.debounce,
+        "predict": int(getattr(grp, "predict", 0)),
         "n_live": getattr(grp, "n_live", grp.G),
         "sharded": grp.mesh is not None,
         "config": grp.cfg.to_dict(),
@@ -201,6 +202,7 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
     grp = StreamGroup(
         cfg, meta["stream_ids"], backend=meta["backend"], threshold=meta["threshold"],
         mesh=mesh, debounce=int(meta.get("debounce", 1)),
+        predict=int(meta.get("predict", 0)),
     )
     with ocp.PyTreeCheckpointer() as ckptr:
         tree = ckptr.restore(path / "state")
@@ -321,6 +323,11 @@ def validate_resume(resumed: StreamGroup, ck_path, grp: StreamGroup,
             ("config", resumed.cfg, grp.cfg),
             ("threshold", resumed.threshold, grp.threshold),
             ("debounce", resumed.debounce, grp.debounce),
+            # the predictor leaves live INSIDE the state tree: resuming
+            # across a horizon change would need a structural migration,
+            # not a silent blend
+            ("predict", getattr(resumed, "predict", 0),
+             getattr(grp, "predict", 0)),
         )
         if a != b
     ]

@@ -330,6 +330,7 @@ def live_loop(
     correlator=None,
     latency=None,
     slo=None,
+    predictor=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -550,6 +551,23 @@ def live_loop(
     dump, and the run's verdict lands in ``stats["slo"]``
     (docs/SLO.md). Requires `latency` (it is the measurement source).
 
+    `predictor` (a predict.PredictTracker, serve --predict; ISSUE 16):
+    when the groups were built with ``predict=k``, every collected
+    chunk carries the fused on-device predictive-horizon leaf
+    (ops/predict_tpu.py — horizon-old predicted-column overlap vs the
+    tick's actual input, per-stream divergence EWMA, predicted
+    sparsity; pure reads, bit-exact-neutral) and the tracker folds it
+    into per-stream divergence trajectories. Edge-triggered
+    ``precursor`` events (stable alert ids, predicted lead time) ride
+    the alert stream and request flight-recorder dumps; with an
+    attached BlastFuser (serve --predict + --topology) the first
+    precursor in a topology cluster pages ONE ``predicted_incident``
+    with the predicted blast radius. On resume the event ids already
+    on disk are re-armed for suppression (service/alerts.
+    scan_event_ids) so a journal replay never pages twice. Scorecards
+    serve at ``GET /predict`` and land in ``stats["predict"]``. None =
+    leaves (if any) are simply not folded.
+
     Service restarts (SURVEY.md §5 checkpoint/resume, C16): with
     `checkpoint_dir` + `checkpoint_every=k`, every group's full resume
     state is saved atomically every k ticks (the in-flight pipeline is
@@ -691,6 +709,11 @@ def live_loop(
             slots = g.live_slots()
             maps.append((slots, [g.stream_ids[i] for i in slots], off))
             off += len(slots)
+        if predictor is not None and predictor.blast is not None:
+            # claimed streams join their cluster's predicted blast
+            # radius as soon as they route (idempotent set union)
+            predictor.blast.observe_streams(
+                sid for _slots, ids, _off in maps for sid in ids)
         return maps, off
 
     routing, n_expected = _build_routing()
@@ -837,6 +860,17 @@ def live_loop(
             health.flight = flight
         if flight is not None and flight.health_provider is None:
             flight.health_provider = health.snapshot
+    if predictor is not None:
+        # same wiring contract as the health tracker: precursor /
+        # predicted_incident events ride the alert stream, request
+        # postmortem dumps, and every bundle's summary embeds the
+        # latest divergence scorecards
+        if predictor.sink is None:
+            predictor.sink = writer.emit_event
+        if predictor.flight is None:
+            predictor.flight = flight
+        if flight is not None and flight.predict_provider is None:
+            flight.predict_provider = predictor.snapshot
     if slo is not None:
         # SLO guardrail wiring (ISSUE 11, obs/slo.py): burn events ride
         # the alert stream, a fast burn dumps a postmortem, and the
@@ -1051,6 +1085,22 @@ def live_loop(
                 # scorecard (one call per collected chunk per group; the
                 # tracker's own cost is gated by bench.py --obs-bench)
                 health.fold(gi, groups[gi].last_health, tick=cur_tick)
+            if predictor is not None \
+                    and groups[gi].last_predict is not None:
+                # fold the chunk's fused predict leaves into the per-
+                # stream divergence trajectories; slot -> id mapping
+                # rides the same routing snapshot the emission used, so
+                # precursor events page with live stream ids. The fold
+                # keys on the GROUP tick (the counter checkpoints
+                # carry, = the chunk's last row), NOT the loop-local
+                # cur_tick: precursor ids must reproduce across a
+                # restart + journal replay for resume suppression
+                id_by_slot = [None] * groups[gi].G
+                for s, sid in zip(slots, ids):
+                    id_by_slot[s] = sid
+                predictor.fold(gi, groups[gi].last_predict,
+                               tick=groups[gi].ticks - 1,
+                               ids=id_by_slot)
         obs_scored.inc(scored)
         if journal is not None and pairs:
             # alert-delivery cursor: alerts through this tick have been
@@ -1158,6 +1208,17 @@ def live_loop(
                     if off is not None]
                 writer.arm_suppression(scan_alert_ids(
                     alert_path, min(known_offs) if known_offs else 0))
+                if predictor is not None:
+                    # precursor/predicted_incident ids are pure
+                    # functions of (stream, group tick), so the replay
+                    # below reproduces them — arm the tracker's own
+                    # suppression so the replayed folds re-latch state
+                    # without paging twice
+                    from rtap_tpu.service.alerts import scan_event_ids
+
+                    predictor.arm_suppression(scan_event_ids(
+                        alert_path,
+                        min(known_offs) if known_offs else 0))
             obs_jr = obs.counter(
                 "rtap_obs_journal_replayed_ticks_total",
                 "journaled ticks replayed through the scoring path on "
@@ -1244,6 +1305,20 @@ def live_loop(
                         # park the flight recorder's per-reason dump
                         # throttle thousands of ticks in the future
                         health.fold(gi, grp.last_health, tick=0)
+                    if predictor is not None \
+                            and grp.last_predict is not None:
+                        # predictor folds key on the GROUP tick — the
+                        # counter the checkpoints carry — so a replayed
+                        # fold reproduces the pre-crash precursor ids
+                        # exactly and the suppression set armed above
+                        # catches them (unlike health, whose fold tick
+                        # is only dump-throttle metadata)
+                        id_by_slot = [None] * grp.G
+                        for s, sid in zip(slots, g_ids):
+                            id_by_slot[s] = sid
+                        predictor.fold(gi, grp.last_predict,
+                                       tick=grp.ticks - 1,
+                                       ids=id_by_slot)
                     n = len(slots)
                     writer.emit_batch(
                         g_ids, np.full(n, int(jts)), jvals[off:off + n],
@@ -2010,6 +2085,10 @@ def live_loop(
     if health is not None:
         # the model-health artifact: scorecard rollup + incident counts
         extra["health"] = health.stats()
+    if predictor is not None:
+        # the predictive-horizon artifact: divergence rollup, precursor/
+        # predicted_incident counts, replay-suppression accounting
+        extra["predict"] = predictor.stats()
     if correlator is not None:
         # the correlation artifact: incidents emitted, windows expired,
         # resume re-fold summary (docs/WORKLOADS.md incident schema)

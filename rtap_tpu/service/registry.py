@@ -57,6 +57,16 @@ class StreamGroup:
     health on or off — the leaf is pure reads. Unsupported under a mesh
     (the aggregate would need a cross-shard collective, and
     sharded_chunk_step is collective-free by contract).
+
+    ``predict=k`` > 0 (ISSUE 16) arms the predictive-horizon reducer
+    (ops/predict_tpu.py) at horizon k: the state tree gains the
+    predictor-owned ring/EWMA leaves and every dispatched step returns
+    the per-stream divergence leaf, stashed in ``self.last_predict``
+    exactly like health for the host PredictTracker (rtap_tpu/predict/)
+    to fold. Model state and scores stay bit-identical with predict on
+    or off (the model leaves are pure reads; the predictor leaves exist
+    only when armed). Unsupported under a mesh for the same contract
+    reason as health.
     """
 
     def __init__(
@@ -69,6 +79,7 @@ class StreamGroup:
         mesh=None,
         debounce: int = 1,
         health: bool = False,
+        predict: int = 0,
     ):
         if debounce < 1:
             raise ValueError(f"debounce must be >= 1, got {debounce}")
@@ -77,6 +88,13 @@ class StreamGroup:
                 "health reducers are unsupported on meshed groups: the "
                 "per-group aggregate would need a cross-shard collective "
                 "(sharded_chunk_step is collective-free by contract)")
+        if predict < 0:
+            raise ValueError(f"predict horizon must be >= 0, got {predict}")
+        if predict and mesh is not None:
+            raise ValueError(
+                "the predictive-horizon reducer is unsupported on meshed "
+                "groups (sharded_chunk_step is collective-free by "
+                "contract, like health)")
         self.cfg = cfg
         self.stream_ids = list(stream_ids)
         self.G = len(self.stream_ids)
@@ -92,9 +110,12 @@ class StreamGroup:
         self._alert_run = np.zeros(self.G, np.int64)  # consecutive hit count
         self.mesh = mesh
         self.health = bool(health)
+        self.predict = int(predict)  # horizon k; 0 = predictor off
         # latest per-tick health leaves [T, ...] (health=True only);
         # kept in sync by collect_chunk and tick like last_predictions
         self.last_health: dict | None = None
+        # latest per-tick predictive-horizon leaves [T, G] (predict > 0)
+        self.last_predict: dict | None = None
         self.likelihood = BatchAnomalyLikelihood(cfg.likelihood, self.G)
         self.ticks = 0
         # alert-id timeline epoch: 0 for a group's original timeline;
@@ -122,12 +143,16 @@ class StreamGroup:
                 # host staging (208 s at the G=24k HBM frontier)
                 from rtap_tpu.ops.step import replicate_state_device
 
-                self.state = replicate_state_device(init_state(cfg, seed), self.G)
+                self.state = replicate_state_device(
+                    init_state(cfg, seed, predict_horizon=self.predict),
+                    self.G)
         else:
             from rtap_tpu.models.oracle.temporal_memory import TMOracle
             from rtap_tpu.models.state import init_state
 
-            self._states = [init_state(cfg, seed) for _ in range(self.G)]
+            self._states = [
+                init_state(cfg, seed, predict_horizon=self.predict)
+                for _ in range(self.G)]
             self._tms = [TMOracle(s, cfg.tm) for s in self._states]
             self._classifiers = None
             if cfg.classifier.enabled:
@@ -201,7 +226,13 @@ class StreamGroup:
     def _reset_slot_state(self, slot: int) -> None:
         from rtap_tpu.models.state import init_state
 
-        fresh = init_state(self.cfg, self.seed)
+        fresh = init_state(self.cfg, self.seed, predict_horizon=self.predict)
+        if self.predict:
+            # the claimed slot's predictor warm-up restarts NOW: its ring
+            # is zeroed, and scoring a real tick against a zeroed ring
+            # would fake a full-divergence precursor (ops/predict_tpu.py
+            # gates scoring on tick >= pred_tick0 + horizon)
+            fresh["pred_tick0"] = np.int32(self.ticks)
         if self.backend == "tpu":
             from rtap_tpu.ops.step import set_state_row
 
@@ -283,7 +314,12 @@ class StreamGroup:
                 self.state, out = group_step(
                     self.state, self._put(values), self._put(ts.astype(np.int32)), self.cfg,
                     learn=learn, health=self.health,
+                    predict=bool(self.predict),
                 )
+                if self.predict:  # wraps outermost (ops/step.py _tick)
+                    out, pleaf = out
+                    self.last_predict = {
+                        k: np.asarray(v)[None, ...] for k, v in pleaf.items()}
                 if self.health:
                     out, health = out
                     self.last_health = {
@@ -298,6 +334,13 @@ class StreamGroup:
                     k: np.asarray(v)[None, ...] for k, v in
                     health_from_states(self._states, raw, values,
                                        self.cfg).items()}
+            if self.predict:
+                from rtap_tpu.models.oracle.predict import predict_from_states
+
+                self.last_predict = {
+                    k: np.asarray(v)[None, ...] for k, v in
+                    predict_from_states(self._states, values,
+                                        self.cfg).items()}
         self.last_predictions = None if pred is None else pred[None, :]
         self.ticks += 1
         lik, loglik = self.likelihood.update(raw)
@@ -353,17 +396,23 @@ class StreamGroup:
                 self.state, out = chunk_step(
                     self.state, self._put(values, axis=1), self._put(ts.astype(np.int32), axis=1),
                     self.cfg, learn=learn, health=self.health,
+                    predict=bool(self.predict),
                 )
             health = None
+            predict = None
+            if self.predict and self.mesh is None:
+                # predict wraps outermost (ops/step.py _tick)
+                out, predict = out
             if self.health and self.mesh is None:
                 out, health = out
             # seq advances only on successful dispatch: a raise above must
             # leave the pipeline collectable, not permanently desynced
             self._seq += 1
-            return {"out": out, "health": health, "T": T, "seq": self._seq,
-                    "device": True}
+            return {"out": out, "health": health, "predict": predict,
+                    "T": T, "seq": self._seq, "device": True}
         outs = []
         hticks = []
+        pticks = []
         for i in range(T):
             o = self._raw_cpu(values[i], np.asarray(ts[i]), learn)
             outs.append(o)
@@ -374,13 +423,21 @@ class StreamGroup:
 
                 hticks.append(health_from_states(
                     self._states, o[0], values[i], self.cfg))
+            if self.predict:
+                from rtap_tpu.models.oracle.predict import predict_from_states
+
+                pticks.append(predict_from_states(
+                    self._states, values[i], self.cfg))
         raw = np.stack([o[0] for o in outs])
         pred = np.stack([o[1] for o in outs]) if self.cfg.classifier.enabled else None
         health = {k: np.stack([h[k] for h in hticks]) for k in hticks[0]} \
             if hticks else None
+        predict = {k: np.stack([p[k] for p in pticks]) for k in pticks[0]} \
+            if pticks else None
         self._seq += 1
-        return {"raw": raw, "pred": pred, "health": health, "T": T,
-                "seq": self._seq, "device": False}
+        return {"raw": raw, "pred": pred, "health": health,
+                "predict": predict, "T": T, "seq": self._seq,
+                "device": False}
 
     def collect_chunk(self, handle: dict) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Block on a dispatched chunk -> (raw [T,G], log_likelihood [T,G],
@@ -401,6 +458,10 @@ class StreamGroup:
             # extra device round trip (the leaf is ~200 B/tick)
             self.last_health = {
                 k: np.asarray(v) for k, v in handle["health"].items()}
+        if handle.get("predict") is not None:
+            # same boundary; 13 B/stream/tick (predict_nbytes)
+            self.last_predict = {
+                k: np.asarray(v) for k, v in handle["predict"].items()}
         self._collected = handle["seq"]
         T = handle["T"]
         self.last_predictions = pred
@@ -466,9 +527,11 @@ class StreamGroupRegistry:
         debounce: int = 1,
         stagger_learn: bool = False,
         health: bool = False,
+        predict: int = 0,
     ):
         self.cfg = cfg
         self.health = bool(health)
+        self.predict = int(predict)
         # Stagger the learning-cadence phase across groups (group i learns
         # on ticks where (it - i % learn_every) % learn_every == 0): with
         # every group at phase 0 the whole fleet learns on the SAME ticks,
@@ -546,7 +609,7 @@ class StreamGroupRegistry:
             self._group_cfg(len(self.groups)), padded,
             seed=self.seed + len(self.groups),
             backend=self.backend, threshold=self.threshold, mesh=self.mesh,
-            debounce=self.debounce, health=self.health,
+            debounce=self.debounce, health=self.health, predict=self.predict,
         )
         for i, sid in enumerate(ids):
             self._slots[sid] = _Slot(grp, i)
@@ -594,7 +657,7 @@ class StreamGroupRegistry:
             [f"{PAD_PREFIX}{i}" for i in range(self.group_size)],
             seed=self.seed + len(self.groups), backend=self.backend,
             threshold=self.threshold, mesh=self.mesh, debounce=self.debounce,
-            health=self.health,
+            health=self.health, predict=self.predict,
         )
         self.groups.append(grp)
 

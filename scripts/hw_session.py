@@ -779,6 +779,21 @@ STEPS: list[tuple[str, list[str]] | tuple[str, list[str], float]] = [
                      "--startup-timeout", "900",
                      "--out", "reports/live_soak_latency_r13.json"],
      2400.0),
+    # ---------------- round 15 (ISSUE 16: predictive horizon) ---------
+    # The title claim on silicon: the committed cpu cascade gate
+    # (reports/predict_r15.json — precursor ramp at the origin node,
+    # lagged step faults downstream, win = page BEFORE the second
+    # node's onset with the blast radius covered and zero false
+    # precursors) re-measured with the predict reducer fused into the
+    # compiled step on real HBM. Same seed/shape as the cpu artifact so
+    # the two reports diff leaf-for-leaf; the eval exits 5 on any gate
+    # failure, so a red step here is a real regression, not noise.
+    # Budget covers compile + 400 ticks + the eval fold.
+    ("r15_predict", [sys.executable, "scripts/predict_eval.py",
+                     "--seed", "0", "--ticks", "400",
+                     "--backend", "tpu",
+                     "--out", "reports/predict_hw_r15.json"],
+     1800.0),
 ]
 
 

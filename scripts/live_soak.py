@@ -296,6 +296,15 @@ def main() -> int:
                          "sparsity/score aggregates + scorecards; the "
                          "fleet gauges land in the obs snapshot this "
                          "soak reads back")
+    ap.add_argument("--predict", action="store_true",
+                    help="arm the serve child's predictive horizon "
+                         "(serve --predict): fused predict reducer + "
+                         "precursor paging; the predict fleet gauges "
+                         "land in the obs snapshot this soak reads back "
+                         "(docs/PREDICT.md)")
+    ap.add_argument("--predict-horizon", type=int, default=None,
+                    help="passed through to serve: score the forward "
+                         "model k ticks ahead (implies --predict)")
     ap.add_argument("--threshold", type=float, default=None,
                     help="passed through to serve: alert threshold "
                          "(lower it to densify alert traffic when the "
@@ -390,6 +399,10 @@ def main() -> int:
         cmd += ["--freeze"]
     if args.health:
         cmd += ["--health"]
+    if args.predict or args.predict_horizon is not None:
+        cmd += ["--predict"]
+    if args.predict_horizon is not None:
+        cmd += ["--predict-horizon", str(args.predict_horizon)]
     if args.threshold is not None:
         cmd += ["--threshold", str(args.threshold)]
     if args.latency or args.slo:

@@ -1,0 +1,197 @@
+"""Predictive-horizon cascade eval: page BEFORE the second node falls over.
+
+ISSUE 16 acceptance gate. A seeded two-service cluster takes ONE
+cascading fault whose origin node first degrades slowly — a linear
+drift climbing over ``--precursor-ticks`` ticks before the origin's
+step fault, with downstream nodes stepping ``--cascade-lag`` ticks
+apart (data/synthetic.generate_topology_workload with a precursor
+ramp). The full predict stack flies in-process: groups carry the fused
+predictive-divergence reducer (``predict=k``), a PredictTracker turns
+sustained divergence into ``precursor`` events, and a BlastFuser over
+the declared topology collapses them into one ``predicted_incident``
+at the FIRST node with the predicted blast radius.
+
+The run FAILS (exit 5) unless eval/fault_eval.score_lead_time says
+
+- ``win``: the first page lands strictly BEFORE the second node's fault
+  onset (the cascade was still preventable when the operator was paged),
+- the predicted blast radius covers every faulted cascade node, and
+- zero false precursors fired on the healthy control service.
+
+The committed artifact is reports/predict_r15.json (hw_session step
+``r15_predict`` re-measures it on silicon; this script is cpu-safe).
+
+Usage: python scripts/predict_eval.py [--ticks 400] [--seed 0]
+       [--horizon 8] [--threshold 0.35] [--min-ticks 12]
+       [--out reports/predict_r15.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+VERIFY_FAILED_EXIT = 5
+
+#: short probation (workload_soak's discipline) so a few-hundred-tick
+#: run has a mature window long before the ramp begins
+EVAL_LEARNING_PERIOD = 60
+EVAL_ESTIMATION = 30
+
+
+def log(msg: str) -> None:
+    print(f"[predict] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ticks", type=int, default=400)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--services", type=int, default=2)
+    ap.add_argument("--nodes-per-service", type=int, default=3)
+    ap.add_argument("--burst-at-frac", type=float, default=0.75)
+    ap.add_argument("--cascade-lag", type=int, default=8)
+    ap.add_argument("--burst-dur", type=int, default=12)
+    ap.add_argument("--precursor-ramp", type=float, default=8.0,
+                    help="origin-node drift magnitude in noise sigmas "
+                         "at the tick before its step fault")
+    ap.add_argument("--precursor-ticks", type=int, default=80,
+                    help="length of the origin node's pre-fault drift")
+    ap.add_argument("--horizon", type=int, default=8)
+    ap.add_argument("--threshold", type=float, default=0.35)
+    ap.add_argument("--min-ticks", type=int, default=12)
+    ap.add_argument("--backend", default="tpu")
+    ap.add_argument("--out",
+                    default=os.path.join(REPO, "reports", "predict_r15.json"))
+    args = ap.parse_args()
+
+    maybe_force_cpu()
+
+    import dataclasses
+
+    import numpy as np
+
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.correlate import TopologyMap
+    from rtap_tpu.data.synthetic import (
+        SyntheticStreamConfig,
+        generate_topology_workload,
+    )
+    from rtap_tpu.eval.fault_eval import score_lead_time
+    from rtap_tpu.predict import BlastFuser, PredictTracker
+    from rtap_tpu.service.loop import live_loop
+    from rtap_tpu.service.registry import StreamGroupRegistry
+
+    scfg = SyntheticStreamConfig(length=args.ticks, n_anomalies=0,
+                                 noise_phi=0.9, noise_scale=0.3)
+    wl = generate_topology_workload(
+        n_services=args.services,
+        nodes_per_service=args.nodes_per_service,
+        cfg=scfg, seed=args.seed, burst_at_frac=args.burst_at_frac,
+        cascade_lag=args.cascade_lag, burst_dur=args.burst_dur,
+        precursor_ramp=args.precursor_ramp,
+        precursor_ticks=args.precursor_ticks)
+    log(f"cascade: origin {wl.precursor_node} ramps from tick "
+        f"{wl.precursor_start}; onsets {wl.burst_onsets}")
+
+    ids = [s.stream_id for s in wl.streams]
+    values = np.stack([s.values for s in wl.streams], axis=1)  # [T, N]
+    ts = wl.streams[0].timestamps
+    base = cluster_preset()
+    cfg = dataclasses.replace(base, likelihood=dataclasses.replace(
+        base.likelihood, learning_period=EVAL_LEARNING_PERIOD,
+        estimation_samples=EVAL_ESTIMATION))
+    reg = StreamGroupRegistry(cfg, group_size=len(ids),
+                              backend=args.backend, threshold=0.0,
+                              debounce=1, predict=args.horizon)
+    for sid in ids:
+        reg.add_stream(sid)
+    reg.finalize()
+
+    events: list[dict] = []
+    predictor = PredictTracker(
+        horizon=args.horizon, threshold=args.threshold,
+        min_ticks=args.min_ticks, sink=events.append,
+        blast=BlastFuser(TopologyMap.from_spec(wl.spec),
+                         seed_streams=ids))
+
+    def feed(k: int):
+        return values[k], int(ts[k])
+
+    t0 = time.perf_counter()
+    stats = live_loop(feed, reg, n_ticks=args.ticks, cadence_s=0.0,
+                      predictor=predictor)
+    elapsed = time.perf_counter() - t0
+    score = score_lead_time(events, wl.burst_onsets, wl.burst_nodes)
+
+    failures: list[str] = []
+    if not score["paged"]:
+        failures.append("no precursor/predicted_incident fired on the "
+                        "cascade service")
+    elif score["lead_ticks_vs_second"] is None \
+            or score["lead_ticks_vs_second"] <= 0:
+        failures.append(
+            f"paged at tick {score['page_tick']}, AFTER the second "
+            f"node's onset {score['second_onset']} — no lead")
+    if not score["blast_covered"]:
+        failures.append(
+            "predicted blast radius does not cover the faulted nodes: "
+            f"{score['predicted_incident']} vs {wl.burst_nodes}")
+    if score["false_precursors"]:
+        failures.append(f"{score['false_precursors']} false precursor(s) "
+                        "on the healthy control service")
+
+    result = {
+        "verified": not failures,
+        "failures": failures,
+        "scenario": {
+            "ticks": args.ticks, "seed": args.seed,
+            "services": args.services,
+            "nodes_per_service": args.nodes_per_service,
+            "cascade_lag": args.cascade_lag,
+            "burst_dur": args.burst_dur,
+            "precursor_ramp": args.precursor_ramp,
+            "precursor_ticks": args.precursor_ticks,
+            "precursor_node": wl.precursor_node,
+            "precursor_start": wl.precursor_start,
+            "burst_onsets": wl.burst_onsets,
+            "n_streams": len(ids),
+        },
+        "predictor": {
+            "horizon_ticks": args.horizon,
+            "threshold": args.threshold,
+            "min_ticks": args.min_ticks,
+        },
+        "score": score,
+        "predict_stats": stats.get("predict"),
+        "backend": args.backend,
+        "native_active": bool(stats.get("native_active")),
+        "elapsed_s": round(elapsed, 3),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log(f"report written to {args.out}")
+    print(json.dumps(score, indent=2))
+    if failures:
+        for msg in failures:
+            log(f"FAIL: {msg}")
+        return VERIFY_FAILED_EXIT
+    log(f"VERIFIED: paged {score['lead_ticks_vs_second']} ticks before "
+        f"the second node's onset (origin lead "
+        f"{score['lead_ticks_vs_origin']}), blast radius "
+        f"{score['predicted_incident']['blast_radius']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

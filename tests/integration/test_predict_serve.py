@@ -1,0 +1,287 @@
+"""Predictive horizon through the real serve stack (ISSUE 16).
+
+Acceptance tests: (1) serving with the predict reducer enabled is
+BIT-EXACT against serving without it — final model state (minus the
+predictor's own leaves) and the alert stream are byte-identical (the
+reducer is a pure read); (2) GET /predict serves the fleet rollup +
+scorecard schema (404 without a tracker); (3) a learned-calm ->
+unpredictable-drift scenario pages a ``precursor`` onto the alert
+stream and the flight recorder's bundle embeds the scorecard; (4) the
+operator CLI surface (`serve --predict`) end to end, including the
+usage-error sweep; (5) a journal-replay resume re-derives the same
+precursor alert_id and SUPPRESSES it — exactly-once paging.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import scaled_cluster_preset
+from rtap_tpu.obs import ExpositionServer, FlightRecorder, validate_bundle
+from rtap_tpu.predict import PredictTracker
+from rtap_tpu.service.loop import live_loop
+from rtap_tpu.service.registry import StreamGroupRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CFG = scaled_cluster_preset(32)
+N_STREAMS = 6
+GROUP_SIZE = 3
+N_TICKS = 10
+HORIZON = 2
+
+
+def _registry(predict: int, backend: str = "tpu"):
+    reg = StreamGroupRegistry(CFG, group_size=GROUP_SIZE, backend=backend,
+                              threshold=0.0, debounce=1, predict=predict)
+    for i in range(N_STREAMS):
+        reg.add_stream(f"s{i}")
+    reg.finalize()
+    return reg
+
+
+def _feed(k):
+    rng = np.random.Generator(np.random.Philox(key=(83, k)))
+    return (30 + 5 * rng.random(N_STREAMS)).astype(np.float32), \
+        1_700_000_000 + k
+
+
+def _drift_feed(k, n=N_STREAMS, calm_until=24):
+    """Learnable constant, then an unpredictable jump walk: the TM's
+    one-step prediction goes stale and the miss EWMA climbs."""
+    if k < calm_until:
+        return np.full(n, 30.0, np.float32), 1_700_000_000 + k
+    rng = np.random.Generator(np.random.Philox(key=(97, k)))
+    return (10 + 80 * rng.random(n)).astype(np.float32), 1_700_000_000 + k
+
+
+def _alert_lines(path):
+    with open(path) as f:
+        return [ln for ln in f.read().splitlines()
+                if ln and not ln.startswith('{"event"')]
+
+
+def _event_lines(path, kind):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f.read().splitlines()
+                if ln.startswith('{"event"')
+                and json.loads(ln).get("event") == kind]
+
+
+@pytest.mark.quick
+def test_predict_on_vs_off_bit_exact_state_and_alert_stream(tmp_path):
+    """The neutrality bar: the reducer is a pure read — model state and
+    the alert stream are provably unchanged with predict on."""
+    finals = {}
+    for k_on in (0, HORIZON):
+        reg = _registry(predict=k_on)
+        alerts = tmp_path / f"alerts_{k_on}.jsonl"
+        pt = PredictTracker(horizon=HORIZON) if k_on else None
+        stats = live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.005,
+                          alert_path=str(alerts), micro_chunk=2,
+                          predictor=pt)
+        assert stats["ticks"] == N_TICKS
+        finals[k_on] = [
+            {k: np.asarray(v) for k, v in g.state.items()}
+            for g in reg.groups
+        ]
+        if k_on:
+            assert stats["predict"]["groups"] == len(reg.groups)
+            assert stats["predict"]["ticks_folded"] == \
+                N_TICKS * len(reg.groups)
+    for g_off, g_on in zip(finals[0], finals[HORIZON]):
+        # predict=k adds ONLY the pred_* leaves
+        extra = sorted(set(g_on) - set(g_off))
+        assert extra == ["pred_miss_ewma", "pred_ring", "pred_tick0"]
+        for k in g_off:
+            np.testing.assert_array_equal(g_off[k], g_on[k], err_msg=k)
+    lines_off = _alert_lines(tmp_path / "alerts_0.jsonl")
+    lines_on = _alert_lines(tmp_path / f"alerts_{HORIZON}.jsonl")
+    assert lines_off and lines_off == lines_on
+
+
+@pytest.mark.quick
+def test_predict_route_serves_fleet_rollup_and_scorecards():
+    reg = _registry(predict=HORIZON)
+    pt = PredictTracker(horizon=HORIZON)
+    live_loop(_feed, reg, n_ticks=N_TICKS, cadence_s=0.005, predictor=pt)
+    with ExpositionServer(predict=pt) as srv:
+        host, port = srv.address
+        body = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/predict", timeout=10).read())
+    fleet = body["fleet"]
+    assert fleet["groups"] == len(reg.groups)
+    assert fleet["ticks_folded"] == N_TICKS * len(reg.groups)
+    assert fleet["horizon_ticks"] == HORIZON
+    assert fleet["verdict"] in ("ok", "precursor")
+    for g in body["groups"]:
+        assert g["streams_scored"] >= 1  # past the tiny horizon by now
+        assert g["miss_ewma"]["max"] is not None
+        assert 0.0 <= g["miss_ewma"]["max"] <= 1.0
+        assert g["verdict"]
+
+
+@pytest.mark.quick
+def test_predict_route_404_without_tracker():
+    with ExpositionServer() as srv:
+        host, port = srv.address
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"http://{host}:{port}/predict",
+                                   timeout=10)
+        assert e.value.code == 404
+
+
+@pytest.mark.quick
+def test_drift_pages_precursor_and_bundle_embeds_scorecard(tmp_path):
+    """The paging path end to end: sustained predictive divergence emits
+    a precursor onto the alert stream and the flight recorder dumps a
+    bundle whose summary embeds the predict snapshot."""
+    reg = _registry(predict=HORIZON)
+    pm = tmp_path / "pm"
+    fl = FlightRecorder(n_ticks=64, out_dir=str(pm))
+    alerts = tmp_path / "alerts.jsonl"
+    pt = PredictTracker(horizon=HORIZON, threshold=0.3, min_ticks=3,
+                        warmup_ticks=4)
+    stats = live_loop(_drift_feed, reg, n_ticks=60, cadence_s=0.002,
+                      alert_path=str(alerts), flight=fl, predictor=pt)
+    assert stats["predict"]["events"].get("precursor", 0) >= 1
+    pre = _event_lines(alerts, "precursor")
+    assert pre, "no precursor on the alert stream"
+    ev = pre[0]
+    assert ev["alert_id"] == f"precursor:{ev['stream']}:{ev['tick']}"
+    assert ev["predicted_lead_ticks"] == HORIZON
+    assert ev["miss_ewma"] >= 0.3
+    assert ev["threshold"] == 0.3 and ev["horizon_ticks"] == HORIZON
+    bundles = [d for d in pm.iterdir() if "precursor" in d.name]
+    assert bundles, list(pm.iterdir())
+    v = validate_bundle(str(bundles[0]))
+    assert v["ok"], v
+    summary = json.loads((bundles[0] / "summary.json").read_text())
+    assert summary["reason"] == "precursor"
+    assert summary["predict"]["fleet"]["streams_alarmed"] >= 1
+
+
+@pytest.mark.quick
+def test_journal_replay_suppresses_precursor_exactly_once(tmp_path):
+    """Resume continuity: a journaled run that paged a precursor is
+    replayed from scratch — the fold re-derives the SAME alert_id on the
+    group-tick clock and the suppression set swallows it."""
+    from rtap_tpu.resilience import TickJournal
+
+    jdir = str(tmp_path / "journal")
+    alerts = str(tmp_path / "alerts.jsonl")
+
+    def mkpt():
+        return PredictTracker(horizon=HORIZON, threshold=0.3, min_ticks=3,
+                              warmup_ticks=4)
+
+    reg = _registry(predict=HORIZON, backend="cpu")
+    j = TickJournal(jdir)
+    live_loop(_drift_feed, reg, n_ticks=40, cadence_s=0.0,
+              alert_path=alerts, journal=j, predictor=mkpt())
+    j.close()
+    first = _event_lines(alerts, "precursor")
+    assert first, "run 1 paged no precursor"
+
+    # resume: no checkpoint — the whole journal replays through a fresh
+    # registry and tracker; every precursor is re-derived and suppressed
+    j2 = TickJournal(jdir)
+    reg2 = _registry(predict=HORIZON, backend="cpu")
+    pt2 = mkpt()
+    stats = live_loop(_drift_feed, reg2, n_ticks=0, cadence_s=0.0,
+                      alert_path=alerts, journal=j2, predictor=pt2)
+    j2.close()
+    assert stats["journal"]["replayed_ticks"] == 40
+    assert pt2.events_suppressed >= len(first)
+    after = _event_lines(alerts, "precursor")
+    assert [e["alert_id"] for e in after] == \
+        [e["alert_id"] for e in first]  # exactly-once
+    # the tracker still latched the alarm state it replayed through
+    assert pt2.stats()["streams_alarmed"] >= 1
+
+
+@pytest.mark.quick
+def test_predict_variant_is_aot_prewarmed():
+    """The predict flag is a STATIC of the compiled step — and jit keys
+    on how statics are passed. The AOT warm-up must dispatch the exact
+    predict variant the loop will (explicit flag + predictor-sized
+    scratch state) or every program recompiles inside a scored tick."""
+    from rtap_tpu.ops.step import chunk_step
+    from rtap_tpu.service.aot import prewarm
+
+    reg = _registry(predict=HORIZON)
+    pre = prewarm(reg.groups, 2, learn=True)
+    assert pre
+    cache_at_tick0 = chunk_step._cache_size()
+    stats = live_loop(_feed, reg, n_ticks=6, cadence_s=0.0, micro_chunk=2,
+                      aot_warmup=True, predictor=PredictTracker(HORIZON))
+    assert stats["cold_compiles_after_warmup"] == 0
+    assert chunk_step._cache_size() == cache_at_tick0, (
+        "a predict-armed dispatch compiled a program the warm-up missed"
+    )
+
+
+@pytest.mark.quick
+def test_serve_cli_predict_end_to_end(tmp_path):
+    """`serve --predict` through the operator command: armed stderr,
+    stats carry the predict block, and the snapshot carries the fold
+    histogram + fleet gauges."""
+    alerts = tmp_path / "alerts.jsonl"
+    snap_path = tmp_path / "obs.jsonl"
+    p = subprocess.run(
+        [sys.executable, "-m", "rtap_tpu", "serve",
+         "--streams", "a,b", "--group-size", "2",
+         "--ticks", "4", "--cadence", "0.05", "--backend", "cpu",
+         "--alerts", str(alerts), "--predict", "--predict-horizon", "2",
+         "--obs-snapshot", str(snap_path)],
+        cwd=REPO, env={**os.environ, "RTAP_FORCE_CPU": "1"},
+        capture_output=True, text=True, timeout=600)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert "predictive horizon armed (k=2 ticks" in p.stderr
+    stats = json.loads(p.stdout.strip().splitlines()[-1])
+    assert stats["predict"]["groups"] == 1
+    assert stats["predict"]["ticks_folded"] == 4
+    assert stats["predict"]["horizon_ticks"] == 2
+    from rtap_tpu.obs import read_last_snapshot, summarize_snapshot
+
+    s = summarize_snapshot(read_last_snapshot(str(snap_path)))
+    assert s["rtap_obs_predict_fold_seconds"]["count"] >= 4
+    assert "rtap_obs_predict_streams_alarmed" in s
+
+
+@pytest.mark.quick
+def test_serve_cli_predict_usage_errors():
+    """The flag-gate sweep: every invalid combination is a usage error
+    (exit 2) BEFORE any backend or listener comes up."""
+    cases = [
+        (["--streams", "a", "--predict-horizon", "4"],
+         "add --predict"),
+        (["--streams", "a", "--predict-threshold", "0.5"],
+         "add --predict"),
+        (["--streams", "a", "--predict-min-ticks", "6"],
+         "add --predict"),
+        (["--streams", "a", "--predict", "--predict-horizon", "0"],
+         "--predict-horizon must be >= 1"),
+        (["--streams", "a", "--predict", "--predict-min-ticks", "0"],
+         "--predict-min-ticks must be >= 1"),
+        (["--streams", "a", "--predict", "--predict-threshold", "1.5"],
+         "bad --predict parameters"),
+        (["--streams", "a", "--predict", "--replicate-to", "h:1",
+          "--journal-dir", "j", "--lease-file", "l",
+          "--checkpoint-dir", "c"],
+         "--predict under replication is unsupported"),
+    ]
+    for extra, needle in cases:
+        p = subprocess.run(
+            [sys.executable, "-m", "rtap_tpu", "serve", *extra],
+            cwd=REPO, env={**os.environ, "RTAP_FORCE_CPU": "1"},
+            capture_output=True, text=True, timeout=600)
+        assert p.returncode == 2, (extra, p.returncode, p.stderr[-500:])
+        assert needle in p.stderr, (extra, p.stderr[-500:])

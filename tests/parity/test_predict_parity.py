@@ -1,0 +1,186 @@
+"""Predictive-horizon reducer parity: predict_update ≡ its numpy twin.
+
+ISSUE 16 acceptance. ``predict_update`` (ops/predict_tpu.py) runs
+INSIDE the fused device step when a group is built with ``predict=k``;
+``predict_update_host`` (models/oracle/predict.py) is its numpy twin on
+the public [G, ...] layout. The pair must be BIT-EXACT — the leaf's
+EWMA uses a power-of-two alpha so float32 folding is associative-free —
+across every served branch: the vmapped group path (tick and chunk),
+both backends, and the quantized u8/u16 permanence domains. The twin
+registry (rtap-lint v3) resolves the ``# rtap: twin[...]`` annotation
+against this file.
+"""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.config import cluster_preset, scaled_cluster_preset
+from rtap_tpu.models.oracle.predict import (
+    PREDICT_KEYS,
+    predict_from_states,
+    predict_horizon_of,
+    predict_nbytes,
+    predict_update_host,
+)
+from rtap_tpu.service.registry import StreamGroup
+
+CFG = scaled_cluster_preset(32)
+G, K_HORIZON = 4, 3
+
+
+def _feed(T, G, key=(7, 1)):
+    rng = np.random.Generator(np.random.Philox(key=key))
+    vals = (30 + 5 * rng.random((T, G))).astype(np.float32)
+    ts = np.tile(1_700_000_000 + np.arange(T)[:, None],
+                 (1, G)).astype(np.int64)
+    return vals, ts
+
+
+def _group(cfg=CFG, backend="tpu", predict=K_HORIZON):
+    return StreamGroup(cfg, [f"s{i}" for i in range(G)], backend=backend,
+                      predict=predict)
+
+
+# ------------------------------------------------ device ≡ twin, vmapped --
+def test_predict_update_matches_host_twin_vmapped_chunk():
+    """predict_update inside the fused chunk (the vmapped group path)
+    vs the numpy twin replayed over the SAME pre-step state: every leaf
+    bit-exact, every tick of the chunk."""
+    T = 10
+    vals, ts = _feed(T, G)
+    grp = _group()
+    # replay the twin tick by tick against the public state snapshots
+    twin_leaves = []
+    host = {k: np.array(v) for k, v in grp.state.items()}
+    for t in range(T):
+        # the twin consumes the PRE-step TM state like the device kernel
+        # (prev_active/active_seg are the step's own outputs, already in
+        # the post-step state it reads) — run the real step, then fold
+        r, _ll, _al = grp.run_chunk(vals[t:t + 1], ts[t:t + 1])
+        host = {k: np.array(v) for k, v in grp.state.items()}
+        # rewind the twin's OWN pred leaves: the device already folded
+        # this tick, so hand the twin the previous ring/ewma
+        host["pred_ring"] = twin_ring if t else np.zeros_like(
+            np.asarray(grp.state["pred_ring"]))
+        host["pred_miss_ewma"] = twin_ewma if t else np.full(
+            (G,), np.nan, np.float32)
+        out_state, leaf = predict_update_host(host, vals[t][:, None], CFG)
+        twin_ring = out_state["pred_ring"]
+        twin_ewma = out_state["pred_miss_ewma"]
+        twin_leaves.append(leaf)
+    assert grp.last_predict is not None
+    for k in PREDICT_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(grp.last_predict[k][-1]),
+            np.asarray(twin_leaves[-1][k]), err_msg=k)
+    # the state rings themselves converged bit-exactly
+    np.testing.assert_array_equal(
+        np.asarray(grp.state["pred_ring"]), twin_ring)
+    np.testing.assert_array_equal(
+        np.asarray(grp.state["pred_miss_ewma"]).astype(np.float32),
+        twin_ewma.astype(np.float32))
+
+
+@pytest.mark.parametrize("micro", [1, 4])
+def test_predict_tick_and_chunk_branches_agree(micro):
+    """The per-tick dispatch branch and the scanned chunk branch fold
+    the same leaves (per-branch parity): one group stepped tick by tick
+    vs one fed the same T rows in chunks."""
+    T = 8
+    vals, ts = _feed(T, G, key=(7, 2))
+    a, b = _group(), _group()
+    last_a = None
+    for t in range(T):
+        a.tick(vals[t], int(ts[t, 0]))
+        last_a = {k: np.asarray(v) for k, v in a.last_predict.items()}
+    for t0 in range(0, T, micro):
+        b.run_chunk(vals[t0:t0 + micro], ts[t0:t0 + micro])
+    for k in PREDICT_KEYS:
+        np.testing.assert_array_equal(
+            last_a[k][-1], np.asarray(b.last_predict[k][-1]), err_msg=k)
+    for k in ("pred_ring", "pred_miss_ewma", "pred_tick0"):
+        np.testing.assert_array_equal(
+            np.asarray(a.state[k]), np.asarray(b.state[k]), err_msg=k)
+
+
+def test_predict_cpu_backend_matches_tpu():
+    """The CPU backend's twin-driven fold (predict_from_states) and the
+    device reducer produce identical leaves on identical input."""
+    T = 8
+    vals, ts = _feed(T, G, key=(7, 3))
+    dev, host = _group(backend="tpu"), _group(backend="cpu")
+    for t in range(T):
+        dev.run_chunk(vals[t:t + 1], ts[t:t + 1])
+        host.run_chunk(vals[t:t + 1], ts[t:t + 1])
+    for k in PREDICT_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(dev.last_predict[k][-1]),
+            np.asarray(host.last_predict[k][-1]), err_msg=k)
+
+
+# ------------------------------------------------- quantized perm domains --
+@pytest.mark.parametrize("perm_bits", [0, 8, 16])
+def test_predict_parity_quantized_perm_domains(perm_bits):
+    """f32/u8/u16 permanence domains change the TM's internal dtype but
+    not the reducer contract: device leaves still match the twin
+    bit-exactly (the reducer reads activity masks, never permanences —
+    this pins that it STAYS that way)."""
+    cfg = scaled_cluster_preset(32, perm_bits=perm_bits)
+    T = 6
+    vals, ts = _feed(T, G, key=(7, perm_bits))
+    dev, host = _group(cfg=cfg), _group(cfg=cfg, backend="cpu")
+    for t in range(T):
+        dev.run_chunk(vals[t:t + 1], ts[t:t + 1])
+        host.run_chunk(vals[t:t + 1], ts[t:t + 1])
+    for k in PREDICT_KEYS:
+        np.testing.assert_array_equal(
+            np.asarray(dev.last_predict[k][-1]),
+            np.asarray(host.last_predict[k][-1]), err_msg=k)
+
+
+# --------------------------------------------------------- leaf contract --
+def test_predict_leaf_schema_and_nbytes():
+    grp = _group()
+    vals, ts = _feed(2, G, key=(7, 9))
+    grp.run_chunk(vals, ts)
+    leaf = grp.last_predict
+    assert sorted(leaf) == sorted(PREDICT_KEYS)
+    assert np.asarray(leaf["overlap"]).dtype == np.float32
+    assert np.asarray(leaf["miss_ewma"]).dtype == np.float32
+    assert np.asarray(leaf["pred_col_frac"]).dtype == np.float32
+    assert np.asarray(leaf["scored"]).dtype == np.bool_
+    assert predict_nbytes(G) == G * 13
+    assert predict_horizon_of(grp.state) == K_HORIZON
+
+
+def test_predict_off_leaves_absent_and_state_identical():
+    """predict=0 (the default): no pred_* leaves, no predict output, and
+    the model state is bit-identical to a predict=k run's non-pred
+    leaves — the reducer is a pure read."""
+    T = 6
+    vals, ts = _feed(T, G, key=(7, 4))
+    off, on = _group(predict=0), _group(predict=K_HORIZON)
+    for t in range(T):
+        off.run_chunk(vals[t:t + 1], ts[t:t + 1])
+        on.run_chunk(vals[t:t + 1], ts[t:t + 1])
+    assert off.last_predict is None
+    assert "pred_ring" not in off.state
+    for k in off.state:
+        np.testing.assert_array_equal(
+            np.asarray(off.state[k]), np.asarray(on.state[k]), err_msg=k)
+
+
+def test_predict_from_states_matches_group_fold():
+    """The single-model stacking helper (the CPU service path) agrees
+    with one big vmapped group on the same inputs."""
+    from rtap_tpu.models.state import init_state
+
+    cfg = cluster_preset()
+    states = [init_state(cfg, seed=i, predict_horizon=K_HORIZON)
+              for i in range(2)]
+    vals = np.asarray([31.0, 44.0], np.float32)[:, None]
+    leaf = predict_from_states(states, vals, cfg)
+    assert sorted(leaf) == sorted(PREDICT_KEYS)
+    assert leaf["scored"].shape == (2,)
+    # tick 0: nothing can be scored yet (warm-up covers the zeroed ring)
+    assert not leaf["scored"].any()

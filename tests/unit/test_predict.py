@@ -1,0 +1,282 @@
+"""PredictTracker / BlastFuser / lead-time scorer unit matrix (ISSUE 16).
+
+The device↔twin reducer parity lives in
+tests/parity/test_predict_parity.py; this suite covers the HOST side:
+warm-up gating, edge-triggered hysteresis and re-arm, pad-slot
+discipline, suppression replay, blast-radius fusion over a declared
+topology, the cascade workload's precursor ramp, and
+eval/fault_eval.score_lead_time's win condition.
+"""
+
+import numpy as np
+import pytest
+
+from rtap_tpu.correlate import TopologyMap
+from rtap_tpu.data.synthetic import (
+    SyntheticStreamConfig,
+    generate_topology_workload,
+)
+from rtap_tpu.eval.fault_eval import score_lead_time
+from rtap_tpu.predict import BlastFuser, PredictTracker
+
+SPEC = {"services": {"web": ["web-00", "web-01"], "db": ["db-00"]}}
+
+
+def _leaves(ewma, scored=None, overlap=None, col_frac=None):
+    """One fold's [T, G] leaf dict from a [T, G] (or [G]) ewma array."""
+    e = np.atleast_2d(np.asarray(ewma, np.float32))
+    s = np.isfinite(e) if scored is None \
+        else np.atleast_2d(np.asarray(scored, bool))
+    ov = np.where(s, np.float32(1.0) - e, np.nan).astype(np.float32) \
+        if overlap is None else np.atleast_2d(np.asarray(overlap, np.float32))
+    cf = np.full_like(e, 0.05) if col_frac is None \
+        else np.atleast_2d(np.asarray(col_frac, np.float32))
+    return {"miss_ewma": e, "scored": s, "overlap": ov,
+            "pred_col_frac": cf}
+
+
+def _tracker(**kw):
+    kw.setdefault("horizon", 4)
+    kw.setdefault("threshold", 0.5)
+    kw.setdefault("min_ticks", 3)
+    kw.setdefault("warmup_ticks", 5)
+    events = []
+    t = PredictTracker(sink=events.append, **kw)
+    return t, events
+
+
+# ------------------------------------------------------------- tracker --
+def test_precursor_fires_once_after_warmup_and_min_ticks():
+    t, events = _tracker()
+    tick = 0
+    # 4 cool scored ticks (warm-up samples), then hot forever
+    for _ in range(4):
+        t.fold(0, _leaves([0.1, 0.1]), tick=tick, ids=["a", "b"])
+        tick += 1
+    for _ in range(10):
+        t.fold(0, _leaves([0.9, 0.1]), tick=tick, ids=["a", "b"])
+        tick += 1
+    pre = [e for e in events if e["event"] == "precursor"]
+    assert len(pre) == 1
+    ev = pre[0]
+    # warm-up needs 5 samples; run needs 3 consecutive hot: whichever
+    # binds later — hot ticks start at tick 4, warmup satisfied at 4,
+    # run of 3 completes on tick 6
+    assert ev["stream"] == "a" and ev["tick"] == 6
+    assert ev["alert_id"] == "precursor:a:6"
+    assert ev["predicted_lead_ticks"] == 4
+    assert ev["miss_ewma"] == pytest.approx(0.9)
+    # latched: no refire while hot
+    assert t.stats()["streams_alarmed"] == 1
+
+
+def test_warmup_blocks_early_hot_streams():
+    t, events = _tracker(warmup_ticks=8)
+    for k in range(6):
+        t.fold(0, _leaves([0.9]), tick=k, ids=["a"])
+    assert not events  # only 6 samples < 8, despite run >= min_ticks
+
+
+def test_rearm_below_half_threshold_then_refire():
+    t, events = _tracker(warmup_ticks=0)
+    tick = 0
+    for _ in range(3):
+        t.fold(0, _leaves([0.9]), tick=tick, ids=["a"]); tick += 1
+    assert len(events) == 1
+    # cooling to 0.3 (>= rearm 0.25) keeps the latch
+    t.fold(0, _leaves([0.3]), tick=tick, ids=["a"]); tick += 1
+    # below rearm_frac * threshold re-arms
+    t.fold(0, _leaves([0.2]), tick=tick, ids=["a"]); tick += 1
+    for _ in range(3):
+        t.fold(0, _leaves([0.9]), tick=tick, ids=["a"]); tick += 1
+    assert len(events) == 2
+    assert events[1]["tick"] > events[0]["tick"]
+
+
+def test_unscored_ticks_hold_run_scored_cool_resets():
+    t, events = _tracker(warmup_ticks=0)
+    t.fold(0, _leaves([0.9]), tick=0, ids=["a"])
+    t.fold(0, _leaves([0.9]), tick=1, ids=["a"])
+    # outage tick: unscored (NaN) must HOLD the run, not reset it
+    t.fold(0, _leaves([np.nan], scored=[False]), tick=2, ids=["a"])
+    t.fold(0, _leaves([0.9]), tick=3, ids=["a"])
+    assert len(events) == 1 and events[0]["tick"] == 3
+    # a SCORED cool tick resets the run
+    t2, ev2 = _tracker(warmup_ticks=0)
+    t2.fold(0, _leaves([0.9]), tick=0, ids=["a"])
+    t2.fold(0, _leaves([0.9]), tick=1, ids=["a"])
+    t2.fold(0, _leaves([0.1]), tick=2, ids=["a"])
+    t2.fold(0, _leaves([0.9]), tick=3, ids=["a"])
+    assert not ev2
+
+
+def test_pad_slots_never_page():
+    t, events = _tracker(warmup_ticks=0)
+    for k in range(5):
+        t.fold(0, _leaves([0.9, 0.9]), tick=k, ids=["a", "__pad1"])
+    assert [e["stream"] for e in events] == ["a"]
+
+
+def test_multi_row_chunk_fold_ticks_back_from_last():
+    """A [T, G] chunk folds row i at tick - (T - 1 - i): the precursor's
+    tick (and alert_id) is exact even inside a chunk."""
+    t, events = _tracker(warmup_ticks=0)
+    e = np.stack([np.full(1, 0.9, np.float32)] * 3)  # [3, 1] all hot
+    t.fold(0, _leaves(e), tick=12, ids=["a"])
+    assert events and events[0]["tick"] == 12  # rows 10, 11, 12
+    assert events[0]["alert_id"] == "precursor:a:12"
+
+
+def test_suppression_swallows_replayed_ids_but_latches_state():
+    t, events = _tracker(warmup_ticks=0)
+    t.arm_suppression({"precursor:a:2"})
+    for k in range(3):
+        t.fold(0, _leaves([0.9]), tick=k, ids=["a"])
+    assert not events
+    assert t.events_suppressed == 1
+    assert t.stats()["streams_alarmed"] == 1  # latched — no double fire
+    for k in range(3, 6):
+        t.fold(0, _leaves([0.9]), tick=k, ids=["a"])
+    assert not events
+
+
+def test_snapshot_and_scorecard_schema():
+    t, _ = _tracker()
+    t.fold(0, _leaves([0.2, np.nan], scored=[True, False]),
+           tick=0, ids=["a", "b"])
+    snap = t.snapshot()
+    assert snap["fleet"]["groups"] == 1
+    assert snap["fleet"]["horizon_ticks"] == 4
+    g = snap["groups"][0]
+    assert g["streams_scored"] == 1
+    assert g["miss_ewma"]["max"] == pytest.approx(0.2)
+    assert g["verdict"] == "ok"
+    assert "blast" not in snap  # no fuser attached
+    stats = t.stats()
+    assert stats["ticks_folded"] == 1 and stats["verdict"] == "ok"
+
+
+def test_tracker_parameter_validation():
+    for kw in ({"horizon": 0}, {"threshold": 0.0}, {"threshold": 1.5},
+               {"min_ticks": 0}, {"warmup_ticks": -1},
+               {"rearm_frac": 1.5}):
+        with pytest.raises(ValueError):
+            PredictTracker(**{"horizon": 4, **kw})
+
+
+# ---------------------------------------------------------------- blast --
+def test_blast_first_precursor_opens_window_and_predicts_radius():
+    b = BlastFuser(TopologyMap.from_spec(SPEC))
+    inc = b.precursor("web-00.cpu", 100, {"alert_id": "precursor:web-00.cpu:100"})
+    assert inc is not None
+    assert inc["event"] == "predicted_incident"
+    assert inc["first_node"] == "web-00"
+    # the whole declared service is the predicted radius
+    assert set(inc["blast_radius"]) >= {"web-00", "web-01"}
+    assert inc["alert_id"].startswith("predicted_incident:")
+    snap = b.snapshot()
+    assert snap["open"] and snap["open"][0]["incident_id"] == inc["alert_id"]
+    # later precursors in the open window attach silently
+    assert b.precursor("web-01.cpu", 110,
+                       {"alert_id": "precursor:web-01.cpu:110"}) is None
+
+
+def test_blast_window_expires_then_new_incident():
+    b = BlastFuser(TopologyMap.from_spec(SPEC), window_ticks=50)
+    a = b.precursor("web-00.cpu", 0, {"alert_id": "p:0"})
+    assert a is not None
+    assert b.precursor("web-00.cpu", 40, {"alert_id": "p:40"}) is None
+    c = b.precursor("web-00.cpu", 200, {"alert_id": "p:200"})
+    assert c is not None and c["alert_id"] != a["alert_id"]
+
+
+def test_blast_observe_streams_extends_radius():
+    b = BlastFuser(TopologyMap.from_spec(SPEC))
+    b.observe_streams(["web-00.cpu", "web-01.mem", "__pad3"])
+    inc = b.precursor("web-00.cpu", 5, {"alert_id": "p:5"})
+    assert {"web-00", "web-01"} <= set(inc["blast_radius"])
+    assert not any(n.startswith("__pad") for n in inc["blast_radius"])
+
+
+# --------------------------------------------------------- lead scoring --
+def _cascade_events():
+    return [
+        {"event": "precursor", "stream": "svca-00.cpu", "tick": 250},
+        {"event": "predicted_incident", "tick": 250,
+         "alert_id": "predicted_incident:svca:250", "first_node": "svca-00",
+         "blast_radius": ["svca-00", "svca-01", "svca-02"]},
+        {"event": "precursor", "stream": "svcb-01.cpu", "tick": 260},
+        {"event": "precursor", "stream": "svca-01.mem", "tick": 315},
+    ]
+
+
+def test_score_lead_time_win_and_false_precursors():
+    sc = score_lead_time(
+        _cascade_events(),
+        {"svca-00": 300, "svca-01": 308, "svca-02": 316},
+        ["svca-00", "svca-01", "svca-02"])
+    assert sc["win"] and sc["paged"] and sc["blast_covered"]
+    assert sc["page_tick"] == 250
+    assert sc["lead_ticks_vs_origin"] == 50
+    assert sc["lead_ticks_vs_second"] == 58
+    assert sc["false_precursors"] == 1  # the svcb one
+    assert sc["first_precursor_by_node"] == {"svca-00": 250, "svca-01": 315}
+    assert sc["predicted_incident"]["incident_id"] == \
+        "predicted_incident:svca:250"
+
+
+def test_score_lead_time_late_page_is_not_a_win():
+    events = [{"event": "precursor", "stream": "svca-00.cpu", "tick": 310}]
+    sc = score_lead_time(events, {"svca-00": 300, "svca-01": 308},
+                         ["svca-00", "svca-01"])
+    assert sc["paged"] and not sc["win"]
+    assert sc["lead_ticks_vs_second"] == -2
+    assert not sc["blast_covered"]  # no incident at all
+
+
+def test_score_lead_time_no_events():
+    sc = score_lead_time([], {"n0": 10, "n1": 20}, ["n0", "n1"])
+    assert not sc["paged"] and not sc["win"]
+    assert sc["page_tick"] is None
+
+
+# ------------------------------------------------------ cascade workload --
+def test_precursor_ramp_digest_stable_and_shape():
+    scfg = SyntheticStreamConfig(length=200, n_anomalies=0,
+                                 noise_phi=0.9, noise_scale=0.3)
+    base = generate_topology_workload(n_services=2, nodes_per_service=2,
+                                      cfg=scfg, seed=5)
+    ramp = generate_topology_workload(n_services=2, nodes_per_service=2,
+                                      cfg=scfg, seed=5,
+                                      precursor_ramp=6.0,
+                                      precursor_ticks=40)
+    assert ramp.precursor_node == ramp.burst_nodes[0]
+    onset = ramp.burst_onsets[ramp.precursor_node]
+    assert ramp.precursor_start == onset - 40
+    by_id = {s.stream_id: s for s in base.streams}
+    for s in ramp.streams:
+        b = by_id[s.stream_id]
+        if s.stream_id.startswith(ramp.precursor_node):
+            d = np.asarray(s.values, np.float64) - \
+                np.asarray(b.values, np.float64)
+            # zero outside the ramp span, monotone non-trivial inside
+            assert d[:ramp.precursor_start].max() == 0.0
+            assert (d[onset:] == 0.0).all()
+            inner = d[ramp.precursor_start:onset]
+            assert inner[0] == 0.0 and inner[-1] > 0.0
+        else:
+            # every other stream (incl. the ramp-free call) byte-stable
+            np.testing.assert_array_equal(s.values, b.values,
+                                          err_msg=s.stream_id)
+
+
+def test_precursor_ramp_validation():
+    scfg = SyntheticStreamConfig(length=200, n_anomalies=0)
+    with pytest.raises(ValueError, match="together"):
+        generate_topology_workload(cfg=scfg, precursor_ramp=1.0)
+    with pytest.raises(ValueError, match="does not fit"):
+        generate_topology_workload(cfg=scfg, precursor_ramp=1.0,
+                                   precursor_ticks=10_000)
+    with pytest.raises(ValueError, match=">= 0"):
+        generate_topology_workload(cfg=scfg, precursor_ramp=-1.0,
+                                   precursor_ticks=4)
