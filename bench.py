@@ -114,6 +114,43 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+_STATE_BYTES: int | None = None  # set by state_bytes_gate; rides the emitted line
+
+
+def state_bytes_gate() -> int:
+    """Honest bytes/stream of one cluster-preset stream (u16 domain, summed
+    over the REAL arrays) gated against the scaling-math static derivation —
+    the same derivation that checks SCALING.md's capacity table. Drift means
+    a layout change moved real bytes without moving the doc twin (or the
+    derivation learned a layout the code doesn't have): fail the bench
+    loudly instead of letting the capacity story rot (ISSUE 18). Runs on
+    CPU before any TPU attempt; the figure rides the emitted JSON line as
+    ``state_bytes_per_stream``."""
+    global _STATE_BYTES
+    import numpy as np
+
+    from rtap_tpu.analysis.scalingmath import derived_stream_bytes
+    from rtap_tpu.config import cluster_preset
+    from rtap_tpu.models.state import init_state
+
+    # fwd_* excluded on both sides: derived state, never checkpointed and
+    # not part of the scaling-math layout model
+    st = init_state(cluster_preset(perm_bits=16), include_fwd=False)
+    measured = sum(int(np.asarray(v).nbytes) for v in st.values())
+    derived = derived_stream_bytes(os.path.dirname(os.path.abspath(__file__)), 16)
+    log(json.dumps({"state_bytes_per_stream": measured,
+                    "scalingmath_derived": derived,
+                    "state_bytes_gate": "pass" if measured == derived else "FAIL"}))
+    if measured != derived:
+        log("bench: state-bytes drift — models/state.py and the scaling-math "
+            "derivation (rtap_tpu/analysis/scalingmath.py) disagree on the "
+            "cluster preset's per-stream bytes; reconcile them and rerun "
+            "scripts/scaling_law.py before benching")
+        sys.exit(1)
+    _STATE_BYTES = measured
+    return measured
+
+
 # ---------------------------------------------------------------- child ----
 
 
@@ -299,6 +336,8 @@ def emit(best: dict | None) -> int | None:
             extra.setdefault(field, best[field])
     if _BEST_FULL is not None:
         extra.setdefault("full_rate_value", round(_BEST_FULL["value"], 1))
+    if _STATE_BYTES is not None:
+        extra.setdefault("state_bytes_per_stream", _STATE_BYTES)
     print(
         json.dumps(
             {
@@ -387,6 +426,7 @@ def _finish(best: dict | None, tunnel_down: bool = False) -> None:
 def main() -> None:
     budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     per_attempt = float(os.environ.get("BENCH_ATTEMPT_BUDGET_S", "330"))
+    state_bytes_gate()  # layout-vs-derivation drift fails before any attempt
     t_start = time.monotonic()
     best: dict | None = None
     current_proc: list = [None]

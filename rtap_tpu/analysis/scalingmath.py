@@ -26,6 +26,7 @@ symbols ``bytes:<domain>``, ``fit:<domain>``, ``tensor:<name>``,
 from __future__ import annotations
 
 import ast
+import os
 import re
 
 from rtap_tpu.analysis.core import AnalysisContext, Finding
@@ -184,8 +185,19 @@ def derive_leaf_bytes(cfg_sf, perm_sf, bits: int) -> dict[str, int] | None:
     cells, segs, pool = C * K, C * K * S, C * K * S * M
     presyn_b = 2 if cells <= (1 << 15) - 1 else 4
     pb = perm_b[bits]
+    if bool(sp.get("sparse_pool", False)):
+        # member-index layout (ISSUE 18): members i16/i32 [C, P] + perm
+        # [C, P] replace the dense potential/perm plane; P mirrors
+        # ModelConfig.sp_members (pool_members pin wins, else the
+        # round-half-up potential fraction) and the index dtype mirrors
+        # models/state.py members_dtype
+        P = int(sp.get("pool_members", 0) or 0) or int(float(sp["potential_pct"]) * nin + 0.5)
+        members_b = 2 if nin <= (1 << 15) - 1 else 4
+        sp_leaves = {"members": C * P * members_b, "perm": C * P * pb}
+    else:
+        sp_leaves = {"potential": C * nin, "perm": C * nin * pb}
     return {
-        "potential": C * nin, "perm": C * nin * pb,
+        **sp_leaves,
         "boost": C * 4, "overlap_duty": C * 4, "active_duty": C * 4,
         "sp_iter": 4,
         "presyn": pool * presyn_b, "syn_perm": pool * pb,
@@ -195,6 +207,26 @@ def derive_leaf_bytes(cfg_sf, perm_sf, bits: int) -> dict[str, int] | None:
         "enc_offset": n_fields * 4, "enc_bound": n_fields,
         "enc_resolution": n_fields * 4,
     }
+
+
+def derived_stream_bytes(root: str, bits: int) -> int | None:
+    """Analyzer-derived bytes/stream of one cluster-preset stream, read
+    from the REAL repo files under `root` (None when underivable). This is
+    the same static derivation the SCALING.md gate runs; bench.py gates
+    its honest ``state_nbytes`` figure against it so a layout change that
+    moves real bytes without moving the doc twin fails loudly instead of
+    drifting (ISSUE 18 satellite 5)."""
+    from rtap_tpu.analysis.core import SourceFile
+
+    sfs = []
+    for rel in (_CONFIG, _PERM):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as fh:
+                sfs.append(SourceFile(rel, fh.read()))
+        except OSError:
+            return None
+    leaves = derive_leaf_bytes(sfs[0], sfs[1], bits)
+    return None if leaves is None else sum(leaves.values())
 
 
 def run(ctx: AnalysisContext) -> list[Finding]:

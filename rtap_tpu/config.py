@@ -215,6 +215,22 @@ class SPConfig:
     # (models/perm.py). Quantization is the per-stream HBM lever (SURVEY.md
     # §7 hard part 4): SP perm is the second-largest state tensor.
     perm_bits: int = 0
+    # Structurally sparse pool storage (ISSUE 18): True replaces the dense
+    # `potential` bool [C, n_in] mask + `perm` [C, n_in] plane with a
+    # member-index table `members` [C, P] (P potential inputs per column,
+    # -1 = empty slot) + `perm` [C, P] over the members only. Overlap and
+    # learning become gathers over the member table (ops/sp_tpu.py); bytes
+    # and the per-tick sweep shrink from C*n_in to C*P. SDR theory says
+    # sparsity, not pool width, carries capacity (PAPERS.md 1503.07469).
+    # False (default) keeps the dense layout — every pre-existing config,
+    # checkpoint, and golden is byte-identical.
+    sparse_pool: bool = False
+    # Members per column in the sparse layout: 0 derives
+    # P = round(potential_pct * input_size) (the structural twin of the
+    # dense mask's expected density); > 0 pins P explicitly — the
+    # dense->sparse checkpoint migration needs an exact P that covers the
+    # widest migrated column (models/migrate.py). Ignored when dense.
+    pool_members: int = 0
 
 
 @dataclass(frozen=True)
@@ -554,6 +570,18 @@ class ModelConfig:
                 f"learn_phase must be in [0, learn_every*learn_burst="
                 f"{cycle}); got {self.learn_phase}"
             )
+        if self.sp.pool_members < 0:
+            raise ValueError(
+                f"SPConfig.pool_members must be >= 0; got {self.sp.pool_members}"
+            )
+        if self.sp.sparse_pool:
+            p = self.sp_members
+            if not 1 <= p <= self.input_size:
+                raise ValueError(
+                    f"sparse SP pool needs 1 <= members <= input_size="
+                    f"{self.input_size}; potential_pct={self.sp.potential_pct} "
+                    f"/ pool_members={self.sp.pool_members} derive P={p}"
+                )
         if self.sp.columns * self.tm.cells_per_column >= 1 << 24:
             # The kernel round-trips presynaptic cell ids through f32 one-hot
             # matmuls; ids >= 2^24 would lose bits silently.
@@ -617,6 +645,20 @@ class ModelConfig:
     @property
     def num_cells(self) -> int:
         return self.sp.columns * self.tm.cells_per_column
+
+    @property
+    def sp_members(self) -> int:
+        """Members per column P of the sparse SP pool layout (0 for the
+        dense layout): an explicit ``pool_members`` wins (the migration
+        path pins it to the widest migrated column); otherwise P derives
+        from the dense mask's expected density, round-half-up — the same
+        arithmetic the scaling-math analyzer re-derives statically
+        (analysis/scalingmath.py), so the two can never disagree."""
+        if not self.sp.sparse_pool:
+            return 0
+        if self.sp.pool_members:
+            return self.sp.pool_members
+        return int(self.sp.potential_pct * self.input_size + 0.5)
 
     # ---- serialization (JSON round-trip for config files) ----
     def to_dict(self) -> dict[str, Any]:
@@ -770,11 +812,20 @@ def node_preset(n_metrics: int = 3, perm_bits: int = 16) -> ModelConfig:
     range and per-field offset binding — models/oracle/encoders.py). The SP
     learns cross-metric structure, so a fault visible in any one field (or a
     correlated node-level fault across all of them) perturbs the shared
-    column code. Built on the cluster_preset footprint: only the SP potential
-    /permanence matrices grow with input_size (+~100 KB/stream at 3 fields,
-    u16 domain), the TM pools — the dominant state — are unchanged.
+    column code. Built on the DENSE cluster geometry
+    (:func:`dense_cluster_preset` — the pre-ISSUE-18 cluster_preset), NOT
+    the sparse member-index preset: the ISSUE 18 quality evidence
+    (reports/sparse_quality.json) covers single-metric streams only, and
+    the fused multi-field bars in tests/integration/
+    test_multivariate_node.py measurably regress at the sparse P=0.5*n_in
+    width (learned-quiet p99 raw 0.10 -> 0.30; sweeping P recovers one bar
+    only at the cost of leaving the weakest single-field window response
+    at the alertability threshold). Sparse-migrating the multivariate
+    config needs its own occupancy/quality study — until then it keeps
+    the measured dense geometry, and only the SP pool tables grow with
+    input_size.
     """
-    base = cluster_preset(perm_bits=perm_bits)
+    base = dense_cluster_preset(perm_bits=perm_bits)
     return dataclasses.replace(base, n_fields=n_metrics)
 
 
@@ -852,21 +903,32 @@ def cluster_preset(perm_bits: int = 16) -> ModelConfig:
     with models/state.state_nbytes, which sums the actual arrays — a round-2
     comment here claimed ~112 KB/stream by counting only SP perms and
     misreading the TM pool product; the round-2 layout's real figure was
-    ~1015 KB/stream, dominated by the TM pools 256 cols x 8 cells x 4 seg x
-    12 syn = 98304 synapses x 8 B for (presyn i32, perm f32)). Current
-    measured state_nbytes totals — presyn narrows to int16 and seg_pot to
-    int16 automatically (num_cells = 2048 here), independent of perm_bits:
+    ~1015 KB/stream).
 
-    - perm_bits=0  (f32 perms):  826 KB/stream
-    - perm_bits=16 (u16 quanta): 564 KB/stream  (0.56x of round-2 layout)
-    - perm_bits=8  (u8 quanta):  433 KB/stream  (0.43x)
+    ISSUE 18 (structurally sparse synapse pools) re-lays the preset on the
+    memory frontier: the SP pool is the sparse member-index layout
+    (``sparse_pool``; P = 64 of 128 inputs per column — SDR capacity rides
+    sparsity, not pool width, PAPERS.md 1503.07469) and the TM segment pool
+    is right-sized from live occupancy evidence (obs/health occupancy
+    histograms + reports/sparse_quality.json: single-metric streams leave
+    most of the old 4-segment lanes empty) to 2 segments/cell with LRU
+    eviction unchanged. Current measured state_nbytes totals — presyn
+    narrows to int16 and seg_pot to int16 automatically (num_cells = 2048
+    here), independent of perm_bits:
 
+    - perm_bits=0  (f32 perms):  433,173 B/stream (was 826 KB dense)
+    - perm_bits=16 (u16 quanta): 302,101 B/stream (was 564,245 B: -46%)
+    - perm_bits=8  (u8 quanta):  236,565 B/stream (was 433,173 B)
+
+    The pre-ISSUE-18 dense geometry survives as :func:`dense_cluster_preset`
+    (checkpoint migration source, quality A/B baseline, frozen golden).
     SCALING.md records the measured HBM frontier per domain on hardware.
     """
     return ModelConfig(
         rdse=RDSEConfig(size=128, active_bits=11, resolution=0.5),
         date=DateConfig(time_of_day_width=0, time_of_day_size=0, weekend_width=0),
-        sp=SPConfig(columns=256, potential_pct=0.8, num_active_columns=10,
+        sp=SPConfig(columns=256, potential_pct=0.5, sparse_pool=True,
+                    num_active_columns=10,
                     syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002,
                     perm_bits=perm_bits),
         # activation_threshold/new_synapse_count ratio 5/10: a learned segment
@@ -879,8 +941,12 @@ def cluster_preset(perm_bits: int = 16) -> ModelConfig:
         # truncating learning bursts on the default synthetic workload
         # (tm_overflow_total=2 at magnitude 6; 48 clears it — kept at 64 for
         # headroom, the [learn_cap, M] workspace is tiny next to the pools)
+        # max_segments_per_cell 2 (was 4): the compact right-sizing half of
+        # ISSUE 18 — a knob-only change (no format change); the occupancy
+        # evidence and the F1 A/B vs the dense baseline are committed in
+        # reports/sparse_quality.json
         tm=TMConfig(cells_per_column=8, activation_threshold=5, min_threshold=4,
-                    max_segments_per_cell=4, max_synapses_per_segment=12,
+                    max_segments_per_cell=2, max_synapses_per_segment=12,
                     new_synapse_count=10, learn_cap=64, col_cap=10,
                     perm_bits=perm_bits),
         # probation 400: false-alert episodes cluster in ticks 150-400 with
@@ -889,6 +955,25 @@ def cluster_preset(perm_bits: int = 16) -> ModelConfig:
         # landed there.
         likelihood=LikelihoodConfig(mode="streaming", historic_window_size=512,
                                     learning_period=300, estimation_samples=100),
+    )
+
+
+def dense_cluster_preset(perm_bits: int = 16) -> ModelConfig:
+    """The pre-ISSUE-18 cluster preset: dense SP pool (potential mask at
+    pct 0.8) and 4-segment TM lanes — 564,245 B/stream at u16.
+
+    Kept verbatim because committed artifacts stand on it: the frozen
+    quantized golden (tests/golden), the dense-layout checkpoint fixture
+    the migration test restores (docs/MIGRATION.md), and the quality A/B
+    baseline the sparse preset is measured against
+    (reports/sparse_quality.json). New deployments should use
+    :func:`cluster_preset`; dense checkpoints upgrade via
+    ``load_group(..., sparsify=True)`` (service/checkpoint.py)."""
+    base = cluster_preset(perm_bits=perm_bits)
+    return dataclasses.replace(
+        base,
+        sp=dataclasses.replace(base.sp, potential_pct=0.8, sparse_pool=False),
+        tm=dataclasses.replace(base.tm, max_segments_per_cell=4),
     )
 
 

@@ -1,0 +1,81 @@
+"""Dense→sparse SP pool migration (ISSUE 18; docs/MIGRATION.md).
+
+A dense-layout checkpoint stores the SP pool as `potential` bool [C, n_in]
++ `perm` [C, n_in]. The sparse layout stores the same pool as a
+member-index table `members` [C, P] (+ `perm` [C, P]). The two are
+informationally identical whenever P covers the widest column's potential
+count — migration is a pure re-layout: every (column, input) synapse keeps
+its exact permanence, columns with fewer than P members pad with the -1
+empty-slot sentinel (permanence 0), and both kernels mask those slots out
+of every overlap/learning term. Forward scores after migration are
+therefore BIT-IDENTICAL to the dense run, forever: overlap is an
+order-independent integer count over the same synapse set, and the
+learning masks touch the same (column, input) pairs
+(tests/parity/test_sparse_sp.py pins this; the committed-checkpoint
+restore is tests/unit/test_checkpoint.py).
+
+Group state trees carry a leading G axis; everything here is shape-
+polymorphic over leading axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from rtap_tpu.config import ModelConfig
+
+
+def sparse_pool_width(potential: np.ndarray, multiple: int = 8) -> int:
+    """Smallest P (rounded up to `multiple` for lane alignment) that holds
+    the widest column of `potential` bool [..., C, n_in]."""
+    widest = int(np.asarray(potential).sum(-1).max()) if potential.size else 0
+    widest = max(widest, 1)
+    return -(-widest // multiple) * multiple
+
+
+def sparsify_sp_state(state: dict, pool_members: int | None = None) -> dict:
+    """Re-lay a dense state tree's SP pool as member-index sparse.
+
+    `state` holds `potential` bool [..., C, n_in] and `perm` [..., C, n_in]
+    (leading group axes allowed). Returns a new dict where those two become
+    `members` [..., C, P] (ascending input indices, -1 padding) and `perm`
+    [..., C, P]; every other leaf rides through unchanged. P defaults to
+    :func:`sparse_pool_width` of the mask; an explicit `pool_members` must
+    cover the widest column or the migration would silently DROP synapses —
+    refused loudly."""
+    potential = np.asarray(state["potential"])
+    perm = np.asarray(state["perm"])
+    n_in = potential.shape[-1]
+    widest = int(potential.sum(-1).max()) if potential.size else 0
+    P = sparse_pool_width(potential) if pool_members is None else int(pool_members)
+    if P < widest:
+        raise ValueError(
+            f"pool_members={P} cannot hold the widest migrated column "
+            f"({widest} potential synapses); a lossy migration would change "
+            "scores silently — raise pool_members or let it default"
+        )
+    # stable argsort of (not potential) lists each row's True positions
+    # first, in ascending input order, then the False positions — exactly
+    # the ascending member table with the pad tail in one vectorized shot
+    order = np.argsort(~potential, axis=-1, kind="stable")[..., :P]
+    valid = np.take_along_axis(potential, order, axis=-1)
+    members_dt = np.int16 if n_in <= (1 << 15) - 1 else np.int32
+    members = np.where(valid, order, -1).astype(members_dt)
+    sparse_perm = np.where(
+        valid, np.take_along_axis(perm, order, axis=-1), np.zeros((), perm.dtype)
+    ).astype(perm.dtype)
+    out = {k: v for k, v in state.items() if k != "potential"}
+    out["members"] = members
+    out["perm"] = sparse_perm
+    return out
+
+
+def sparsify_config(cfg: ModelConfig, pool_members: int) -> ModelConfig:
+    """The migrated state's config: same model, sparse pool layout with the
+    migration's exact P pinned via `pool_members` (the derived
+    potential_pct*input_size width only applies to fresh-init pools)."""
+    return dataclasses.replace(
+        cfg, sp=dataclasses.replace(cfg.sp, sparse_pool=True, pool_members=int(pool_members))
+    )

@@ -23,12 +23,23 @@ from rtap_tpu.models.perm import sp_domain
 
 def sp_overlap(state: dict, input_sdr: np.ndarray, cfg: SPConfig) -> np.ndarray:
     """Overlap per column: number of connected potential synapses whose
-    presynaptic input bit is active. Indexes the ~w active bits instead of
-    building the full [C, n_in] connected mask (O(C*w) vs O(C*n_in))."""
+    presynaptic input bit is active.
+
+    Dense layout: indexes the ~w active bits instead of building the full
+    [C, n_in] connected mask (O(C*w) vs O(C*n_in)). Sparse layout
+    (SPConfig.sparse_pool, ISSUE 18): gathers the SDR at the member-index
+    table [C, P] and counts connected hits (O(C*P)); empty slots
+    (members == -1) are masked out, clamp-gathered in-bounds exactly like
+    the device kernel. Exact integer counts either way."""
+    connected = sp_domain(cfg).threshold(cfg.syn_perm_connected)
+    if cfg.sparse_pool:
+        members = state["members"]
+        hit = input_sdr[np.maximum(members, 0)]
+        cols = (state["perm"] >= connected) & (members >= 0) & hit
+        return cols.sum(1, dtype=np.int64)
     idx = np.nonzero(input_sdr)[0]
     if len(idx) == 0:
         return np.zeros(state["perm"].shape[0], np.int64)
-    connected = sp_domain(cfg).threshold(cfg.syn_perm_connected)
     cols = (state["perm"][:, idx] >= connected) & state["potential"][:, idx]
     return cols.sum(1, dtype=np.int64)
 
@@ -78,9 +89,19 @@ def sp_learn(
     in place (the oracle is imperative; the TPU kernel is the functional twin).
     """
     dom = sp_domain(cfg)
-    potential = state["potential"]
-    inc_mask = active[:, None] & potential & input_sdr[None, :]
-    dec_mask = active[:, None] & potential & ~input_sdr[None, :]
+    if cfg.sparse_pool:
+        # sparse member-index pool: the valid mask (members >= 0) plays the
+        # dense potential mask's role, and the per-slot SDR bit comes from
+        # the member gather — same masks, same op order as the device twin
+        members = state["members"]
+        potential = members >= 0
+        hit = input_sdr[np.maximum(members, 0)]
+        inc_mask = active[:, None] & potential & hit
+        dec_mask = active[:, None] & potential & ~hit
+    else:
+        potential = state["potential"]
+        inc_mask = active[:, None] & potential & input_sdr[None, :]
+        dec_mask = active[:, None] & potential & ~input_sdr[None, :]
     # Arithmetic runs in the domain's compute dtype. f32 domain: np.float32
     # constants (a python float * bool-mask would promote to f64 and
     # double-round on the store, drifting 1 ulp from the device f32 chain —

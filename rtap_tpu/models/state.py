@@ -8,9 +8,18 @@ makes oracle-vs-TPU parity exact (SURVEY.md §4 item 2).
 
 Layout (single stream; stream groups add a leading G axis):
 
-SP state:
+SP state — two structurally different pool layouts (SPConfig.sparse_pool):
+
+  dense (default; NuPIC-shaped):
     potential   bool [C, n_in]   fixed potential pool mask
     perm        P_sp [C, n_in]   permanences (0 outside potential)
+  sparse (ISSUE 18; gather-addressed member-index pools):
+    members     i16/i32 [C, P]   presynaptic INPUT indices of each column's
+                                 P potential synapses, ascending; -1 = empty
+                                 slot (only dense->sparse migration pads —
+                                 models/migrate.py; i16 iff n_in fits)
+    perm        P_sp [C, P]      permanences per member slot (0 in empty)
+  shared:
     boost       f32  [C]         boost factors (1.0 when boost_strength == 0)
     overlap_duty f32 [C]         overlap duty cycles
     active_duty f32  [C]         activation duty cycles
@@ -57,6 +66,13 @@ def presyn_dtype(cfg: ModelConfig):
     return np.int16 if cfg.num_cells <= (1 << 15) - 1 else np.int32
 
 
+def members_dtype(cfg: ModelConfig):
+    """Sparse SP member-index dtype: int16 whenever every input index
+    (< input_size) fits, else int32 — same rule (and same -1 sentinel
+    need) as presyn_dtype."""
+    return np.int16 if cfg.input_size <= (1 << 15) - 1 else np.int32
+
+
 def fwd_index_arrays(cfg: ModelConfig) -> dict[str, np.ndarray]:
     """Fresh (all-empty) forward-index arrays for an empty synapse pool
     (RTAP_TM_DENDRITE=forward — ops/fwd_index.py): fwd_slots [N, F] i32,
@@ -94,14 +110,36 @@ def init_state(
     C, n_in = cfg.sp.columns, cfg.input_size
     K, S, M = cfg.tm.cells_per_column, cfg.tm.max_segments_per_cell, cfg.tm.max_synapses_per_segment
 
-    potential = rng.random((C, n_in)) < cfg.sp.potential_pct
-    # Permanences seeded around the connected threshold so ~half the potential
-    # pool starts connected (NuPIC's init strategy, SURVEY.md C3).
-    perm = np.where(
-        potential,
-        np.clip(cfg.sp.syn_perm_connected + (rng.random((C, n_in)) - 0.5) * 0.1, 0.0, 1.0),
-        0.0,
-    ).astype(np.float32)
+    if cfg.sp.sparse_pool:
+        # Sparse member-index pool (ISSUE 18): exactly P distinct input
+        # indices per column (a uniform P-subset via argsort of iid
+        # uniforms), stored ascending. Every init slot is valid; -1 padding
+        # only enters via dense->sparse migration (models/migrate.py).
+        P = cfg.sp_members
+        sel = np.argsort(rng.random((C, n_in)), axis=1, kind="stable")[:, :P]
+        # Permanences seeded around the connected threshold so ~half the
+        # pool starts connected (NuPIC's init strategy, SURVEY.md C3) —
+        # the same formula as the dense branch, over member slots only.
+        perm = np.clip(
+            cfg.sp.syn_perm_connected + (rng.random((C, P)) - 0.5) * 0.1, 0.0, 1.0
+        ).astype(np.float32)
+        sp_pool = {
+            "members": np.sort(sel, axis=1).astype(members_dtype(cfg)),  # rtap: partition[shard-streams]
+            "perm": sp_domain(cfg.sp).quantize_init(perm),  # rtap: partition[shard-streams]
+        }
+    else:
+        potential = rng.random((C, n_in)) < cfg.sp.potential_pct
+        # Permanences seeded around the connected threshold so ~half the potential
+        # pool starts connected (NuPIC's init strategy, SURVEY.md C3).
+        perm = np.where(
+            potential,
+            np.clip(cfg.sp.syn_perm_connected + (rng.random((C, n_in)) - 0.5) * 0.1, 0.0, 1.0),
+            0.0,
+        ).astype(np.float32)
+        sp_pool = {
+            "potential": np.asarray(potential),  # rtap: partition[shard-streams]
+            "perm": sp_domain(cfg.sp).quantize_init(perm),  # rtap: partition[shard-streams]
+        }
 
     # Partition rules (ISSUE 15, rtap-lint partition-contract): every
     # leaf below is per-stream state whose group form carries a leading
@@ -109,9 +147,8 @@ def init_state(
     # mesh stands on. A future leaf that is NOT per-stream must declare
     # replicated/host-only or the analyzer refuses it.
     return {
-        # SP
-        "potential": potential,  # rtap: partition[shard-streams]
-        "perm": sp_domain(cfg.sp).quantize_init(perm),  # rtap: partition[shard-streams]
+        # SP pool (dense potential/perm or sparse members/perm — above)
+        **sp_pool,
         "boost": np.ones(C, np.float32),  # rtap: partition[shard-streams]
         "overlap_duty": np.zeros(C, np.float32),  # rtap: partition[shard-streams]
         "active_duty": np.zeros(C, np.float32),  # rtap: partition[shard-streams]

@@ -1,11 +1,23 @@
 """Spatial Pooler — device kernel (functional twin of oracle/spatial_pooler.py).
 
 The reference's SP hot loop is SpatialPooler.cpp's sparse matvec + inhibition
-(SURVEY.md C3, §3.2). TPU-native layout: the connected-synapse mask is a dense
-bool [C, n_in]; overlap is a 0/1 matmul that XLA tiles onto the MXU (counts
-< 2^24, so f32 accumulation is exact); inhibition is `lax.top_k` over an
-integer score that encodes the low-index tie-break, making winner selection
-bit-identical to the oracle's argsort.
+(SURVEY.md C3, §3.2). Two TPU-native pool layouts (SPConfig.sparse_pool):
+
+* dense (default): the connected-synapse mask is a dense bool [C, n_in];
+  overlap is a 0/1 matmul that XLA tiles onto the MXU (counts < 2^24, so f32
+  accumulation is exact).
+* sparse (ISSUE 18): the pool is a member-index table [C, P] of input
+  indices (-1 = empty slot) + perm [C, P]; overlap gathers the SDR at the
+  member indices and reduces over the P lane — an O(C*P) VPU
+  gather-and-count instead of the O(C*n_in) matmul, and the learning pass
+  sweeps C*P instead of C*n_in permanence slots. On a memory-bound step the
+  byte traffic, not the flop count, is the cost (docs/KERNELS.md roofline
+  section), so shrinking the swept plane is both the HBM and the
+  throughput lever. Counts stay exact integers on both layouts.
+
+Inhibition is `lax.top_k` over an integer score that encodes the low-index
+tie-break, making winner selection bit-identical to the oracle's argsort on
+either layout.
 
 State dict keys/layout are shared with the oracle (models/state.py); this
 module never mutates — it returns the updated SP slice of the state dict.
@@ -22,13 +34,29 @@ from rtap_tpu.config import SPConfig
 from rtap_tpu.models.perm import sp_domain
 
 
+def _gather_sdr(pool: jnp.ndarray, sdr: jnp.ndarray) -> jnp.ndarray:
+    """SDR bits at each member slot: bool [C, P]. Empty slots (-1) gather
+    index 0 and are masked out by every caller via ``pool >= 0`` — the
+    clamp keeps the gather in-bounds so the backend never sees the
+    sentinel (out-of-bounds gather semantics are backend-defined)."""
+    return sdr[jnp.maximum(pool, 0).astype(jnp.int32)]
+
+
 # rtap: twin[sp_overlap] — explicit-tensor calling convention vs the
 # oracle's state-dict one; same math, parity in test_twin_registry.py
-def sp_overlap(perm: jnp.ndarray, potential: jnp.ndarray, sdr: jnp.ndarray, cfg: SPConfig) -> jnp.ndarray:
+def sp_overlap(perm: jnp.ndarray, pool: jnp.ndarray, sdr: jnp.ndarray, cfg: SPConfig) -> jnp.ndarray:
     """Overlap per column = |connected potential synapses ∩ active inputs|.
-    0/1 f32 matmul -> MXU; exact integer counts."""
+
+    `pool` is the layout-defining tensor: dense bool potential mask
+    [C, n_in], or the sparse member-index table [C, P]. Exact integer
+    counts either way (dense: 0/1 f32 matmul -> MXU; sparse: gather +
+    masked popcount on the VPU)."""
     thr = sp_domain(cfg).threshold(cfg.syn_perm_connected)
-    connected = ((perm >= thr) & potential).astype(jnp.float32)
+    if cfg.sparse_pool:
+        connected = (perm >= thr) & (pool >= 0)
+        hit = _gather_sdr(pool, sdr)
+        return jnp.sum((connected & hit).astype(jnp.int32), axis=1)
+    connected = ((perm >= thr) & pool).astype(jnp.float32)
     return jnp.dot(connected, sdr.astype(jnp.float32), precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
 
 
@@ -75,11 +103,22 @@ def sp_learn(
     Same op order as the oracle (hebbian -> clip -> duty -> boost -> bump ->
     clip); inc/dec masks are disjoint so the fused expression is bit-equal to
     the oracle's sequential += / -=. Quantized domains compute in int32
-    (bit-equal to the oracle's int32 by construction)."""
+    (bit-equal to the oracle's int32 by construction). Sparse layout: the
+    per-slot SDR bit comes from the member-index gather and the valid mask
+    (members >= 0) plays the dense potential mask's role in every term."""
     dom = sp_domain(cfg)
-    potential = state["potential"]
-    inc_mask = active[:, None] & potential & sdr[None, :]
-    dec_mask = active[:, None] & potential & ~sdr[None, :]
+    if cfg.sparse_pool:
+        pool = state["members"]
+        valid = pool >= 0
+        hit = _gather_sdr(pool, sdr)
+        inc_mask = active[:, None] & valid & hit
+        dec_mask = active[:, None] & valid & ~hit
+        bump_pool = valid
+    else:
+        pool = state["potential"]
+        inc_mask = active[:, None] & pool & sdr[None, :]
+        dec_mask = active[:, None] & pool & ~sdr[None, :]
+        bump_pool = pool
     perm = state["perm"].astype(dom.compute_dtype)
     perm = perm + dom.rate(cfg.syn_perm_active_inc) * inc_mask - dom.rate(cfg.syn_perm_inactive_dec) * dec_mask
     perm = jnp.clip(perm, dom.zero, dom.one)
@@ -101,7 +140,7 @@ def sp_learn(
     min_duty = cfg.min_pct_overlap_duty_cycle * overlap_duty.max()
     weak = overlap_duty < min_duty
     perm = jnp.clip(
-        perm + dom.rate(cfg.syn_perm_below_stimulus_inc) * (weak[:, None] & potential),
+        perm + dom.rate(cfg.syn_perm_below_stimulus_inc) * (weak[:, None] & bump_pool),
         dom.zero, dom.one,
     )
 
@@ -119,7 +158,8 @@ def sp_learn(
 @partial(jax.jit, static_argnames=("cfg", "learn"))
 def sp_step(state: dict, sdr: jnp.ndarray, cfg: SPConfig, learn: bool = True):
     """One SP step -> (new_state, bool[C] active columns). Pure."""
-    overlap = sp_overlap(state["perm"], state["potential"], sdr, cfg)
+    pool = state["members"] if cfg.sparse_pool else state["potential"]
+    overlap = sp_overlap(state["perm"], pool, sdr, cfg)
     active = sp_inhibit(overlap, state["boost"], cfg)
     if learn:
         state = sp_learn(state, sdr, overlap, active, cfg)

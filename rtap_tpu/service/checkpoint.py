@@ -178,13 +178,20 @@ def _recover_residue(path: Path) -> Path:
     return path
 
 
-def load_group(path: str | Path, mesh=None) -> StreamGroup:
+def load_group(path: str | Path, mesh=None, sparsify: bool = False) -> StreamGroup:
     """Rebuild a StreamGroup from `path`; scoring continues bit-identically.
 
     A group checkpointed while sharded over a mesh records that fact; pass
     `mesh` to re-shard on resume. Resuming a sharded checkpoint without a mesh
     downgrades to single-device and logs a warning (the state itself is
     topology-independent — only placement changes).
+
+    `sparsify` migrates a DENSE-layout SP pool checkpoint into the sparse
+    member-index layout on the way in (models/migrate.py): the resumed
+    group's config gains ``sparse_pool=True`` with the migration's exact
+    pool width pinned via ``pool_members``, and scoring continues
+    BIT-IDENTICALLY to the dense run (the re-layout is lossless — see
+    docs/MIGRATION.md). Already-sparse checkpoints are untouched.
     """
     import jax
     import orbax.checkpoint as ocp
@@ -199,13 +206,33 @@ def load_group(path: str | Path, mesh=None) -> StreamGroup:
             "checkpoint %s was saved sharded over a mesh; resuming single-device "
             "(pass mesh= to load_group to restore the sharded topology)", path
         )
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(path / "state")
+    if sparsify and not cfg.sp.sparse_pool:
+        from rtap_tpu.models.migrate import (
+            sparse_pool_width, sparsify_config, sparsify_sp_state)
+
+        n_slots = len(meta["stream_ids"])
+        if meta["backend"] == "tpu":
+            # batched tree [G, C, n_in]: one migration call, one shared P
+            model = {k: np.asarray(v) for k, v in tree["model"].items()}
+            P = sparse_pool_width(model["potential"])
+            tree["model"] = sparsify_sp_state(model, P)
+        else:
+            # per-stream dicts share the group's config, so the pool width
+            # is the max over all streams (narrower columns pad with -1)
+            P = max(
+                sparse_pool_width(np.asarray(tree["model"][f"s{g}"]["potential"]))
+                for g in range(n_slots))
+            for g in range(n_slots):
+                tree["model"][f"s{g}"] = sparsify_sp_state(
+                    {k: np.asarray(v) for k, v in tree["model"][f"s{g}"].items()}, P)
+        cfg = sparsify_config(cfg, P)
     grp = StreamGroup(
         cfg, meta["stream_ids"], backend=meta["backend"], threshold=meta["threshold"],
         mesh=mesh, debounce=int(meta.get("debounce", 1)),
         predict=int(meta.get("predict", 0)),
     )
-    with ocp.PyTreeCheckpointer() as ckptr:
-        tree = ckptr.restore(path / "state")
     if grp.backend == "tpu":
         from rtap_tpu.ops.tm_tpu import dendrite_mode
 

@@ -1,0 +1,94 @@
+"""Generate the committed dense-layout checkpoint fixture (ISSUE 18).
+
+tests/unit/test_checkpoint.py's migration test restores this checkpoint
+with ``load_group(..., sparsify=True)`` and asserts the migrated sparse
+group reproduces the DENSE continuation recorded here bit-for-bit. The
+fixture is committed so the test exercises a real cross-build restore — a
+checkpoint written by the dense layout, read by the sparse build — not a
+same-process round-trip.
+
+Run from the repo root (CPU-only; the group runs on the JAX backend so the
+checkpoint carries the batched [G, ...] tree and the restore path also
+exercises the fwd-index rebuild):
+
+    JAX_PLATFORMS=cpu python scripts/make_migration_fixture.py
+
+Outputs (committed):
+    tests/fixtures/migration/dense_ckpt/   orbax group checkpoint (dense SP pool)
+    tests/fixtures/migration/expected.npz  values + the dense run's scores
+"""
+
+from __future__ import annotations
+
+import pathlib
+import shutil
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from rtap_tpu.config import DateConfig, ModelConfig, RDSEConfig, SPConfig, TMConfig  # noqa: E402
+
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parent.parent / "tests" / "fixtures" / "migration"
+WARM_TICKS = 60   # ticks before the checkpoint is cut
+TAIL_TICKS = 40   # dense-continuation ticks recorded for the migration test
+G = 2
+
+
+def fixture_config() -> ModelConfig:
+    """Small dense-pool model (perm_bits=16) — the committed checkpoint's
+    geometry, kept tiny so the binary fixture stays a few tens of KB."""
+    return ModelConfig(
+        rdse=RDSEConfig(size=64, active_bits=7, resolution=0.5),
+        date=DateConfig(time_of_day_width=0, time_of_day_size=0, weekend_width=0),
+        sp=SPConfig(columns=64, potential_pct=0.8, num_active_columns=6,
+                    syn_perm_active_inc=0.01, syn_perm_inactive_dec=0.002,
+                    perm_bits=16),
+        tm=TMConfig(cells_per_column=4, activation_threshold=3, min_threshold=2,
+                    max_segments_per_cell=2, max_synapses_per_segment=8,
+                    new_synapse_count=6, learn_cap=32, col_cap=6, perm_bits=16),
+    )
+
+
+def fixture_values(n: int = WARM_TICKS + TAIL_TICKS) -> np.ndarray:
+    """Deterministic [n, G] stream values: phase-shifted sines + noise, one
+    spike in the recorded tail so the scores are not flat."""
+    rng = np.random.Generator(np.random.Philox(key=(77, 0xD15E)))
+    t = np.arange(n)[:, None]
+    phase = np.array([0.0, 1.3])[None, :]
+    v = 50 + 12 * np.sin(2 * np.pi * t / 24.0 + phase) + rng.normal(0, 1.5, (n, G))
+    v[WARM_TICKS + 12, 0] += 40.0
+    return v.astype(np.float32)
+
+
+def main() -> None:
+    from rtap_tpu.service.checkpoint import save_group
+    from rtap_tpu.service.registry import StreamGroup
+
+    cfg = fixture_config()
+    assert not cfg.sp.sparse_pool, "the fixture must be a DENSE-layout checkpoint"
+    vals = fixture_values()
+    grp = StreamGroup(cfg, [f"m{i}" for i in range(G)], backend="tpu")
+    for i in range(WARM_TICKS):
+        grp.tick(vals[i], 1_700_000_000 + i)
+
+    if FIXTURE_DIR.exists():
+        shutil.rmtree(FIXTURE_DIR)
+    FIXTURE_DIR.mkdir(parents=True)
+    save_group(grp, FIXTURE_DIR / "dense_ckpt")
+
+    raw, loglik = [], []
+    for i in range(WARM_TICKS, WARM_TICKS + TAIL_TICKS):
+        r = grp.tick(vals[i], 1_700_000_000 + i)
+        raw.append(np.asarray(r.raw))
+        loglik.append(np.asarray(r.log_likelihood))
+    np.savez(FIXTURE_DIR / "expected.npz",
+             vals=vals, raw=np.stack(raw), log_likelihood=np.stack(loglik),
+             warm_ticks=WARM_TICKS)
+    total = sum(p.stat().st_size for p in FIXTURE_DIR.rglob("*") if p.is_file())
+    print(f"fixture written to {FIXTURE_DIR} ({total:,} bytes)", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,37 @@ def analytic_rows():
     return rows
 
 
+def sparse_frontier_rows():
+    """Member-index pool ladder (u16 domain): bytes/stream and streams/chip
+    as the per-column pool width P moves, plus the legacy dense layout —
+    the r16 decision table for trading pool capacity against the memory
+    frontier. Analytic (state_nbytes on real arrays), so it regenerates on
+    every run. Row labels deliberately do NOT match the analyzer's checked
+    per-domain rows (those stay the single source of truth for the preset)."""
+    import dataclasses
+
+    from rtap_tpu.config import cluster_preset, dense_cluster_preset
+    from rtap_tpu.models.state import state_nbytes
+
+    base = cluster_preset(perm_bits=16)
+    preset_p = base.sp_members
+    rows = []
+    for P in (32, 48, 64, 96):
+        cfg = dataclasses.replace(
+            base, sp=dataclasses.replace(base.sp, pool_members=P))
+        per = state_nbytes(cfg)["total"]
+        label = f"sparse P={P}" + (" (preset)" if P == preset_p else "")
+        rows.append({"label": label, "bytes_per_stream": per,
+                     "max_streams_per_chip": int((HBM_BYTES - WORKSPACE_RESERVE) // per)})
+    dense = state_nbytes(dense_cluster_preset(perm_bits=16))["total"]
+    rows.append({"label": "dense legacy (potential_pct=0.8, S=4)",
+                 "bytes_per_stream": dense,
+                 "max_streams_per_chip": int((HBM_BYTES - WORKSPACE_RESERVE) // dense)})
+    for r in rows:
+        log({"frontier": r})
+    return rows
+
+
 def device_sweep(gs: list[int], chunk_ticks: int = 64, measure_chunks: int = 3):
     import jax
 
@@ -140,7 +171,7 @@ def _carry_section(old_generated: str, heading_prefix: str) -> list[str] | None:
     return block + [""]
 
 
-def write_scaling_md(analytic, sweep, sweep_backend, quality) -> None:
+def write_scaling_md(analytic, sweep, sweep_backend, quality, frontier=None) -> None:
     path = os.path.join(REPO, "SCALING.md")
     old = open(path).read() if os.path.exists(path) else ""
     if MANUAL_MARKER in old:
@@ -164,23 +195,47 @@ def write_scaling_md(analytic, sweep, sweep_backend, quality) -> None:
         dom = {0: "f32", 16: "u16 quanta", 8: "u8 quanta"}[r["perm_bits"]]
         lines.append(f"| {dom} | {r['bytes_per_stream']:,} | {r['max_streams_per_chip']:,} |")
     a16 = next(r for r in analytic if r["perm_bits"] == 16)
+    a8 = next(r for r in analytic if r["perm_bits"] == 8)
+    # Prose quotes the EXACT derived byte figures (a //1024 "KB" rounding
+    # here once drifted 10 KB from the table it sits next to — ISSUE 18
+    # satellite 1; the scaling-math analyzer checks the table rows, and the
+    # prose must cite the same numbers verbatim).
     lines += [
         "",
         f"Largest tensors (u16 domain): "
         + ", ".join(f"`{k}` {v:,} B" for k, v in a16["top_tensors"]) + ".",
         "",
-        "**The 100k-streams-on-ONE-chip north star is NOT achievable at the",
-        "current pool sizes** (needs ≤ ~155 KB/stream; u8 reaches "
-        f"{next(r for r in analytic if r['perm_bits'] == 8)['bytes_per_stream'] // 1024} KB). "
+        "**The 100k-streams-on-ONE-chip north star is NOT reached even at the",
+        "sparse cluster preset** (needs ≤ ~155 KB/stream; u8 reaches "
+        f"{a8['bytes_per_stream']:,} B/stream = {a8['max_streams_per_chip']:,} "
+        "streams/chip). "
         "It IS achievable on a v5e-8 pod: 100k streams / 8 chips x "
-        f"{a16['bytes_per_stream'] // 1024} KB ≈ "
+        f"{a16['bytes_per_stream']:,} B ≈ "
         f"{100_000 // 8 * a16['bytes_per_stream'] / 1024**3:.1f} GiB per chip "
         "(u16 domain), well inside HBM — the sharded path `sharded_chunk_step`",
         "is collective-free, so scale-out is linear by construction.",
-        "Single-chip beyond the frontier requires shrinking the TM pools",
+        "Single-chip beyond the frontier requires shrinking the pools further",
         "(quality trade measured in the fault eval) — not promised here.",
         "",
     ]
+    if frontier:
+        lines += [
+            "## Sparse frontier (member-index pool ladder, u16 domain)",
+            "",
+            "Pool width P is the per-column member count (`SPConfig.pool_members`;",
+            "0 derives P from `potential_pct`). The dense legacy row is",
+            "`dense_cluster_preset` — the pre-sparse geometry kept for the frozen",
+            "golden, checkpoint migration, and the quality A/B baseline.",
+            "",
+            "| layout | bytes/stream | streams/chip |",
+            "|---|---|---|",
+        ]
+        for r in frontier:
+            lines.append(f"| {r['label']} | {r['bytes_per_stream']:,} "
+                         f"| {r['max_streams_per_chip']:,} |")
+        lines.append("")
+    elif carried := _carry_section(old_generated, "## Sparse frontier"):
+        lines += carried
     if sweep:
         lines += [
             f"## Device G-sweep (backend: {sweep_backend}, chunked replay, "
@@ -248,16 +303,17 @@ def main() -> None:
     args = ap.parse_args()
 
     analytic = analytic_rows()
+    frontier = sparse_frontier_rows()
     sweep, backend = ([], "none")
     if not args.no_sweep and not FORCED_CPU:
         # persist the analytic tables BEFORE touching the backend: the init
         # watchdog hard-exits (os._exit) on a wedged tunnel, which would
         # otherwise lose this run's results entirely
-        write_scaling_md(analytic, sweep, backend, [])
+        write_scaling_md(analytic, sweep, backend, [], frontier)
         init_backend_or_die()
         sweep, backend = device_sweep([int(g) for g in args.gs.split(",")])
     quality = quality_rows() if args.quality else []
-    write_scaling_md(analytic, sweep, backend, quality)
+    write_scaling_md(analytic, sweep, backend, quality, frontier)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,157 @@
+"""Sparse-vs-dense quality A/B -> reports/sparse_quality.json (ISSUE 18).
+
+Two pieces of committed evidence for the member-index pool flip:
+
+1. Held-out detection quality A/B: the fault-injection eval (family=
+   "heldout" — the heavy-tailed/bursty/regime-switching world no preset was
+   tuned on) run on the shipping sparse ``cluster_preset`` and on
+   ``dense_cluster_preset`` (the pre-flip geometry: potential_pct=0.8 dense
+   pools, S=4 TM lanes). Acceptance (one-sided): f1_sparse >= f1_dense -
+   0.01 at each config's swept-best operating point.
+
+2. TM segment-occupancy evidence for the S=4 -> S=2 lane cut: replay
+   single-metric streams through the DENSE (S=4) config and histogram
+   segments-in-use per cell (``seg_last >= 0``). The knob change is honest
+   only if lanes 3-4 are essentially empty at convergence.
+
+Usage:
+    RTAP_FORCE_CPU=1 python scripts/sparse_quality.py [--streams 40]
+        [--length 1000] [--quick]
+
+Writes reports/sparse_quality.json and prints one JSON line per measurement
+to stderr as it goes (partial progress survives a kill).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+
+maybe_force_cpu()
+
+import numpy as np  # noqa: E402
+
+REPORT = os.path.join(REPO, "reports", "sparse_quality.json")
+
+
+def _window_mode(cfg):
+    return dataclasses.replace(
+        cfg, likelihood=dataclasses.replace(cfg.likelihood, mode="window"))
+
+
+def _progress(obj) -> None:
+    print(json.dumps(obj), file=sys.stderr, flush=True)
+
+
+def eval_config(label: str, cfg, n_streams: int, length: int, seed: int) -> dict:
+    """Held-out fault-eval for one config; returns the committed summary."""
+    from rtap_tpu.eval.fault_eval import run_fault_eval
+
+    t0 = time.perf_counter()
+    rep = run_fault_eval(n_streams=n_streams, length=length,
+                         cfg=_window_mode(cfg), backend="tpu",
+                         chunk_ticks=128, seed=seed, family="heldout")
+    out = {
+        "label": label,
+        "at_best": rep.at_best,
+        "best_threshold": rep.best_threshold,
+        "best_debounce": rep.best_debounce,
+        "at_default": rep.at_default,
+        "per_kind": rep.per_kind,
+        "wall_s": round(time.perf_counter() - t0, 1),
+    }
+    _progress({"eval": label, "f1": rep.at_best["f1"], "wall_s": out["wall_s"]})
+    return out
+
+
+def measure_occupancy(cfg, n_streams: int = 8, length: int = 900,
+                      seed: int = 23) -> dict:
+    """Replay single-metric streams on the dense S=4 config and histogram
+    segments-in-use per cell at the end of learning."""
+    from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_stream
+    from rtap_tpu.models.htm_model import HTMModel
+
+    metrics = ("cpu", "mem", "net", "disk_io", "latency_ms")
+    S = cfg.tm.max_segments_per_cell
+    counts = np.zeros(S + 1, np.int64)  # counts[k] = cells using exactly k segments
+    for i in range(n_streams):
+        s = generate_stream(
+            f"occ{i:03d}.{metrics[i % len(metrics)]}",
+            SyntheticStreamConfig(length=length, n_anomalies=1,
+                                  kinds=("level_shift",), anomaly_magnitude=6.0,
+                                  noise_phi=0.97, noise_scale=0.5,
+                                  inject_after_frac=0.6,
+                                  metric=metrics[i % len(metrics)]),
+            seed=seed + i,
+        )
+        m = HTMModel(cfg, seed=seed + i, backend="cpu")
+        for t in range(length):
+            m.run(int(s.timestamps[t]), float(s.values[t]))
+        used = (m.state["seg_last"] >= 0).sum(axis=-1).ravel()
+        counts += np.bincount(used, minlength=S + 1)
+    total = int(counts.sum())
+    frac = (counts / total).round(6).tolist()
+    over2 = float(counts[3:].sum() / total) if S >= 3 else 0.0
+    out = {
+        "config": "dense_cluster_preset (S=4)",
+        "n_streams": n_streams, "ticks": length,
+        "cells_total": total,
+        "cells_by_segments_used": counts.tolist(),
+        "frac_by_segments_used": frac,
+        "frac_cells_needing_gt2_segments": round(over2, 6),
+    }
+    _progress({"occupancy": out["frac_by_segments_used"],
+               "frac_gt2": out["frac_cells_needing_gt2_segments"]})
+    return out
+
+
+def main() -> None:
+    from rtap_tpu.config import cluster_preset, dense_cluster_preset
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--streams", type=int, default=40)
+    ap.add_argument("--length", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--quick", action="store_true",
+                    help="8-stream smoke run (not for the committed report; "
+                         "length stays >= probation + margin)")
+    args = ap.parse_args()
+    n, length = (8, args.length) if args.quick else (args.streams, args.length)
+
+    sparse = eval_config("cluster_preset (sparse P=64, S=2)",
+                         cluster_preset(), n, length, args.seed)
+    dense = eval_config("dense_cluster_preset (dense pct=0.8, S=4)",
+                        dense_cluster_preset(), n, length, args.seed)
+    delta = round(sparse["at_best"]["f1"] - dense["at_best"]["f1"], 4)
+    occ = measure_occupancy(dense_cluster_preset())
+
+    report = {
+        "issue": 18,
+        "family": "heldout",
+        "n_streams": n, "n_ticks": length, "seed": args.seed,
+        "sparse": sparse,
+        "dense_baseline": dense,
+        "f1_delta_sparse_minus_dense": delta,
+        # acceptance is one-sided: sparse may not be WORSE than dense by
+        # more than 0.01 (being better is fine)
+        "f1_no_worse_than_dense_minus_0.01": bool(delta >= -0.01 - 1e-9),
+        "tm_segment_occupancy": occ,
+    }
+    os.makedirs(os.path.dirname(REPORT), exist_ok=True)
+    with open(REPORT, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    _progress({"wrote": os.path.relpath(REPORT, REPO), "f1_delta": delta})
+
+
+if __name__ == "__main__":
+    main()

@@ -59,10 +59,48 @@ Q16_ROWS = 900
 
 
 def run_quant():
-    """Golden for the quantized (u16) cluster preset over a deterministic
-    synthetic stream — pins the fixed-point arithmetic itself (a change to
-    quantum conversion or integer update order shows up here even if
-    oracle/device parity still holds, since both would drift together)."""
+    """Golden for the quantized (u16) dense-pool cluster geometry over a
+    deterministic synthetic stream — pins the fixed-point arithmetic itself
+    (a change to quantum conversion or integer update order shows up here
+    even if oracle/device parity still holds, since both would drift
+    together). dense_cluster_preset IS the pre-ISSUE-18 cluster_preset
+    geometry, so the committed golden survives the sparse-pool flip
+    unchanged — the strongest no-regression proof for the dense path."""
+    import dataclasses
+
+    from rtap_tpu.config import dense_cluster_preset
+    from rtap_tpu.data.synthetic import SyntheticStreamConfig, generate_stream
+    from rtap_tpu.models import AnomalyDetector
+
+    base = dense_cluster_preset(perm_bits=16)
+    cfg = dataclasses.replace(
+        base, likelihood=dataclasses.replace(base.likelihood, mode="window")
+    )
+    s = generate_stream(
+        "golden.cpu",
+        SyntheticStreamConfig(length=Q16_ROWS, n_anomalies=1,
+                              kinds=("level_shift",), anomaly_magnitude=6.0,
+                              noise_phi=0.97, noise_scale=0.5,
+                              inject_after_frac=cfg.likelihood.safe_inject_frac(Q16_ROWS)),
+        seed=33,
+    )
+    det = AnomalyDetector(cfg, seed=0)
+    raw = np.zeros(Q16_ROWS)
+    loglik = np.zeros(Q16_ROWS)
+    for i in range(Q16_ROWS):
+        res = det.model.run(int(s.timestamps[i]), float(s.values[i]))
+        raw[i], loglik[i] = res.raw_score, res.log_likelihood
+    return raw, loglik
+
+
+GOLDEN_SPARSE_PATH = Path(__file__).parent / "golden_cluster_sparse.npz"
+
+
+def run_sparse():
+    """Golden for the SHIPPING cluster preset (sparse member-index pools,
+    u16 quanta, S=2 TM lanes — ISSUE 18) over the same deterministic stream
+    as run_quant: pins the gather-addressed overlap/learning arithmetic
+    against history the way the dense golden pins the matmul path."""
     import dataclasses
 
     from rtap_tpu.config import cluster_preset
@@ -100,3 +138,6 @@ if __name__ == "__main__":
     raw, loglik = run_quant()
     np.savez(GOLDEN_Q16_PATH, raw=raw, loglik=loglik)
     print(f"wrote {GOLDEN_Q16_PATH}: raw mean={raw.mean():.4f} loglik mean={loglik.mean():.4f}")
+    raw, loglik = run_sparse()
+    np.savez(GOLDEN_SPARSE_PATH, raw=raw, loglik=loglik)
+    print(f"wrote {GOLDEN_SPARSE_PATH}: raw mean={raw.mean():.4f} loglik mean={loglik.mean():.4f}")

@@ -16,13 +16,27 @@ def test_golden_config1(tmp_path):
 
 
 def test_golden_cluster_quantized():
-    """Frozen sequence for the u16 cluster preset: pins the fixed-point
-    permanence arithmetic against history (parity tests can't catch a drift
-    that moves oracle and device together)."""
+    """Frozen sequence for the u16 dense cluster geometry
+    (dense_cluster_preset = the pre-ISSUE-18 cluster_preset, so this golden
+    predates the sparse flip and proves the dense path untouched): pins the
+    fixed-point permanence arithmetic against history (parity tests can't
+    catch a drift that moves oracle and device together)."""
     from tests.golden.generate_golden import GOLDEN_Q16_PATH, run_quant
 
     assert GOLDEN_Q16_PATH.exists(), "run python tests/golden/generate_golden.py"
     golden = np.load(GOLDEN_Q16_PATH)
     raw, loglik = run_quant()
+    np.testing.assert_array_equal(raw, golden["raw"])
+    np.testing.assert_allclose(loglik, golden["loglik"], atol=1e-12)
+
+
+def test_golden_cluster_sparse():
+    """Frozen sequence for the shipping sparse cluster preset (member-index
+    pools, ISSUE 18): pins the gather-addressed arithmetic against history."""
+    from tests.golden.generate_golden import GOLDEN_SPARSE_PATH, run_sparse
+
+    assert GOLDEN_SPARSE_PATH.exists(), "run python tests/golden/generate_golden.py"
+    golden = np.load(GOLDEN_SPARSE_PATH)
+    raw, loglik = run_sparse()
     np.testing.assert_array_equal(raw, golden["raw"])
     np.testing.assert_allclose(loglik, golden["loglik"], atol=1e-12)

@@ -1,0 +1,158 @@
+"""Sparse member-index SP pool parity (ISSUE 18): oracle vs device twins
+over the gather-addressed layout, bit-exact across every permanence domain
+(f32 / u16 / u8), through the vmapped group-chunk path, and on the edge
+rows the layout introduces (all-empty and completely-full member tables).
+Also pins the migration invariant: a dense pool re-laid by
+models/migrate.sparsify_sp_state scores bit-identically to the dense
+original forever (same synapses, same permanences, order-independent
+integer overlap).
+
+Twin coverage: `sp_overlap` and `sp_compute` (oracle names) against
+ops/sp_tpu.py's `sp_overlap` / `sp_step` — the same pairs the dense parity
+file exercises, now on the sparse branch of each kernel.
+"""
+
+import copy
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rtap_tpu.config import ModelConfig, RDSEConfig, SPConfig, cluster_preset, dense_cluster_preset
+from rtap_tpu.models.migrate import sparse_pool_width, sparsify_config, sparsify_sp_state
+from rtap_tpu.models.oracle.spatial_pooler import sp_compute, sp_overlap
+from rtap_tpu.models.state import init_state, members_dtype
+from rtap_tpu.ops.sp_tpu import sp_step
+
+SP_KEYS = ("perm", "boost", "overlap_duty", "active_duty", "sp_iter", "members")
+
+
+def _sparse_cfg(perm_bits: int = 0, pool_members: int = 0) -> ModelConfig:
+    return ModelConfig(
+        rdse=RDSEConfig(size=64, active_bits=5, resolution=0.5),
+        sp=SPConfig(columns=128, num_active_columns=8, potential_pct=0.5,
+                    sparse_pool=True, pool_members=pool_members,
+                    perm_bits=perm_bits),
+    )
+
+
+def _device_state(state):
+    return {k: jnp.asarray(state[k]) for k in SP_KEYS}
+
+
+def _sdr(rng, n_in, frac=0.05):
+    sdr = np.zeros(n_in, bool)
+    sdr[rng.choice(n_in, size=max(1, int(frac * n_in)), replace=False)] = True
+    return sdr
+
+
+def _run_parity(cfg: ModelConfig, n_steps: int, learn: bool, host=None):
+    rng = np.random.default_rng(7)
+    host = init_state(cfg, seed=3) if host is None else host
+    dev = _device_state(copy.deepcopy(host))
+    for step in range(n_steps):
+        sdr = _sdr(rng, cfg.input_size)
+        host_active = sp_compute(host, sdr, cfg.sp, learn=learn)
+        dev, dev_active = sp_step(dev, jnp.asarray(sdr), cfg.sp, learn=learn)
+        np.testing.assert_array_equal(
+            host_active, np.asarray(dev_active), err_msg=f"step {step}")
+        np.testing.assert_array_equal(
+            host["perm"], np.asarray(dev["perm"]), err_msg=f"step {step}")
+        np.testing.assert_array_equal(host["overlap_duty"], np.asarray(dev["overlap_duty"]))
+        np.testing.assert_array_equal(host["active_duty"], np.asarray(dev["active_duty"]))
+    assert int(host["sp_iter"]) == int(dev["sp_iter"]) == (n_steps if learn else 0)
+    return host
+
+
+@pytest.mark.parametrize("perm_bits", [0, 16, 8])
+@pytest.mark.parametrize("learn", [True, False])
+def test_sparse_sp_parity_all_domains(perm_bits, learn):
+    """Gather-addressed overlap + learning bit-exact oracle-vs-device in
+    every permanence domain (f32 arithmetic and int32 quanta arithmetic)."""
+    _run_parity(_sparse_cfg(perm_bits), n_steps=100, learn=learn)
+
+
+def test_sparse_sp_parity_cluster_preset():
+    """The shipping geometry itself (C=256, P=64, u16)."""
+    cfg = cluster_preset()
+    assert cfg.sp.sparse_pool and cfg.sp_members == 64
+    _run_parity(cfg, n_steps=40, learn=True)
+
+
+@pytest.mark.parametrize("perm_bits", [0, 16])
+def test_sparse_vmapped_chunk_parity(perm_bits):
+    """The group path: sp_step vmapped over a stacked [G, ...] state (how
+    the fused chunk kernel consumes the pool) matches G independent oracle
+    streams bit-for-bit."""
+    cfg = _sparse_cfg(perm_bits)
+    G, n_steps = 4, 30
+    hosts = [init_state(cfg, seed=10 + g) for g in range(G)]
+    dev = {k: jnp.stack([jnp.asarray(h[k]) for h in hosts]) for k in SP_KEYS}
+    step = jax.vmap(lambda st, sdr: sp_step(st, sdr, cfg.sp, learn=True))
+    rng = np.random.default_rng(12)
+    for t in range(n_steps):
+        sdrs = np.stack([_sdr(rng, cfg.input_size) for _ in range(G)])
+        host_active = np.stack(
+            [sp_compute(hosts[g], sdrs[g], cfg.sp, learn=True) for g in range(G)])
+        dev, dev_active = step(dev, jnp.asarray(sdrs))
+        np.testing.assert_array_equal(host_active, np.asarray(dev_active), err_msg=f"t {t}")
+    for g in range(G):
+        np.testing.assert_array_equal(hosts[g]["perm"], np.asarray(dev["perm"][g]))
+        np.testing.assert_array_equal(hosts[g]["members"], np.asarray(dev["members"][g]))
+
+
+def test_empty_and_full_pool_edge_rows():
+    """Padding semantics: an all-empty member row (every slot -1, the
+    migration pad extreme) contributes overlap 0 and its permanences stay
+    exactly 0 through learning and the weak-column bump on BOTH backends;
+    a completely full row behaves like a dense column of the same members."""
+    cfg = _sparse_cfg(perm_bits=16)
+    host = init_state(cfg, seed=3)
+    P = cfg.sp_members
+    host["members"][0, :] = np.int16(-1)   # empty pool row
+    host["perm"][0, :] = 0
+    host["members"][1, :] = np.arange(P, dtype=members_dtype(cfg))  # full row
+    dev = _device_state(copy.deepcopy(host))
+    rng = np.random.default_rng(5)
+    for t in range(60):
+        sdr = _sdr(rng, cfg.input_size, frac=0.2)
+        ho = sp_overlap(host, sdr, cfg.sp)
+        assert ho[0] == 0, "empty pool row must never overlap"
+        host_active = sp_compute(host, sdr, cfg.sp, learn=True)
+        dev, dev_active = sp_step(dev, jnp.asarray(sdr), cfg.sp, learn=True)
+        np.testing.assert_array_equal(host_active, np.asarray(dev_active), err_msg=f"t {t}")
+        assert not host["perm"][0].any(), "empty slots must stay at permanence 0"
+    np.testing.assert_array_equal(host["perm"], np.asarray(dev["perm"]))
+    np.testing.assert_array_equal(host["members"], np.asarray(dev["members"]))
+
+
+@pytest.mark.parametrize("perm_bits", [0, 16, 8])
+def test_migrated_pool_scores_match_dense(perm_bits):
+    """models/migrate.py invariant: the re-laid pool is the SAME pool —
+    overlap, winners, and learned permanences track the dense original
+    bit-for-bit through learning (the committed-checkpoint restore in
+    tests/unit/test_checkpoint.py pins the end-to-end version)."""
+    base = dense_cluster_preset(perm_bits=perm_bits)
+    cfg = dataclasses.replace(
+        base, sp=dataclasses.replace(base.sp, columns=128))
+    dense = init_state(cfg, seed=5)
+    P = sparse_pool_width(dense["potential"])
+    scfg = sparsify_config(cfg, P)
+    sparse = sparsify_sp_state({k: np.copy(v) for k, v in dense.items()}, P)
+    rng = np.random.default_rng(11)
+    for t in range(50):
+        sdr = _sdr(rng, cfg.input_size, frac=0.08)
+        np.testing.assert_array_equal(
+            sp_overlap(dense, sdr, cfg.sp), sp_overlap(sparse, sdr, scfg.sp),
+            err_msg=f"t {t}")
+        a_d = sp_compute(dense, sdr, cfg.sp, learn=True)
+        a_s = sp_compute(sparse, sdr, scfg.sp, learn=True)
+        np.testing.assert_array_equal(a_d, a_s, err_msg=f"t {t}")
+    # learned permanences agree slot-for-slot on the member table
+    order = np.argsort(~dense["potential"], axis=-1, kind="stable")[:, :P]
+    valid = np.take_along_axis(dense["potential"], order, axis=-1)
+    np.testing.assert_array_equal(
+        np.where(valid, np.take_along_axis(dense["perm"], order, axis=-1), 0),
+        sparse["perm"])

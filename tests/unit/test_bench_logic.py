@@ -85,6 +85,39 @@ def test_store_lkg_guard_and_roundtrip(tmp_path, monkeypatch):
     assert fallback == {"value": 9.9, "G": 1, "T": 1, "modes": None, "full_rate_value": None} and extra["cached"] is True
 
 
+def test_state_bytes_gate_matches_derivation(tmp_path, monkeypatch, capsys):
+    """The honest per-stream figure (real arrays) and the scaling-math static
+    derivation must agree on the cluster preset — the gate that keeps
+    SCALING.md's capacity table and the actual layout from drifting apart
+    (ISSUE 18)."""
+    from rtap_tpu.analysis.scalingmath import derived_stream_bytes
+
+    b = load_bench(tmp_path, monkeypatch, None)
+    measured = b.state_bytes_gate()
+    assert measured == b._STATE_BYTES == derived_stream_bytes(".", 16)
+    line = json.loads(capsys.readouterr().err.strip().splitlines()[-1])
+    assert line["state_bytes_gate"] == "pass"
+    assert line["state_bytes_per_stream"] == measured
+    # the figure rides the emitted result line
+    assert b.emit({"value": 42.0}) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["state_bytes_per_stream"] == measured
+
+
+def test_state_bytes_gate_fails_on_drift(tmp_path, monkeypatch, capsys):
+    import pytest
+
+    import rtap_tpu.analysis.scalingmath as sm
+
+    b = load_bench(tmp_path, monkeypatch, None)
+    monkeypatch.setattr(sm, "derived_stream_bytes", lambda root, bits: 1)
+    with pytest.raises(SystemExit) as exc:
+        b.state_bytes_gate()
+    assert exc.value.code == 1
+    line = json.loads(capsys.readouterr().err.strip().splitlines()[-2])
+    assert line["state_bytes_gate"] == "FAIL"
+
+
 def test_oom_dominance_skip_logic():
     """The ladder-skip predicate: only configs dominating the observed OOM
     point in BOTH dims are skipped."""

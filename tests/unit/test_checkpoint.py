@@ -109,6 +109,69 @@ def test_checkpoint_roundtrip_with_classifier(backend, tmp_path):
         np.testing.assert_array_equal(r_ref.prediction, r_res.prediction, err_msg=f"tick {i}")
 
 
+class TestDenseToSparseMigration:
+    """ISSUE 18: a COMMITTED dense-layout checkpoint restores into the
+    sparse build (``load_group(..., sparsify=True)``) and continues
+    bit-identically to the dense run recorded at fixture-creation time
+    (scripts/make_migration_fixture.py). The re-layout is lossless: every
+    synapse keeps its exact permanence, so scores can never drift."""
+
+    FIXTURE = "tests/fixtures/migration"
+
+    def _fixture(self):
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parents[2] / self.FIXTURE
+        exp = np.load(root / "expected.npz")
+        return root / "dense_ckpt", exp
+
+    def test_committed_dense_checkpoint_restores_sparse_bit_identical(self):
+        ckpt, exp = self._fixture()
+        grp = load_group(ckpt, sparsify=True)
+        # the resumed group IS the sparse build: layout flipped, the
+        # migration's exact pool width pinned, dense mask gone
+        assert grp.cfg.sp.sparse_pool
+        assert grp.cfg.sp.pool_members == grp.cfg.sp_members > 0
+        assert "members" in grp.state and "potential" not in grp.state
+        warm = int(exp["warm_ticks"])
+        vals = exp["vals"]
+        for j in range(exp["raw"].shape[0]):
+            r = grp.tick(vals[warm + j], 1_700_000_000 + warm + j)
+            np.testing.assert_array_equal(r.raw, exp["raw"][j], err_msg=f"tick {j}")
+            np.testing.assert_array_equal(
+                r.log_likelihood, exp["log_likelihood"][j], err_msg=f"tick {j}")
+
+    def test_sparsify_rebuilds_fwd_index_from_migrated_state(self):
+        from functools import partial
+
+        import jax
+
+        from rtap_tpu.ops.fwd_index import build_fwd_index
+        from rtap_tpu.ops.tm_tpu import set_dendrite_mode
+
+        ckpt, _ = self._fixture()
+        set_dendrite_mode("forward")
+        try:
+            grp = load_group(ckpt, sparsify=True)
+            assert {"fwd_slots", "fwd_pos", "fwd_of"} <= set(grp.state)
+            slots, pos, of = jax.vmap(partial(
+                build_fwd_index, n_cells=grp.cfg.num_cells,
+                fanout_cap=grp.cfg.tm.fanout_cap,
+            ))(np.asarray(grp.state["presyn"]))
+            np.testing.assert_array_equal(np.asarray(grp.state["fwd_slots"]), slots)
+            np.testing.assert_array_equal(np.asarray(grp.state["fwd_pos"]), pos)
+        finally:
+            set_dendrite_mode(None)
+
+    def test_sparsify_noop_on_already_sparse_checkpoint(self, tmp_path):
+        cfg = cluster_preset()  # sparse layout since ISSUE 18
+        grp = StreamGroup(cfg, ["a", "b"], backend="tpu")
+        grp.tick(np.array([1.0, 2.0], np.float32), 1_700_000_000)
+        save_group(grp, tmp_path / "g")
+        back = load_group(tmp_path / "g", sparsify=True)
+        assert back.cfg == cfg  # untouched: no pool_members pin, same layout
+
+
 class TestSingleModelSaveLoad:
     """HTMModel.save/load (SURVEY.md C16 model.save surface): resume is
     bit-exact vs an uninterrupted run, across backends and domains."""

@@ -312,7 +312,7 @@ def test_dispatch_threads_validation():
 # synapse growth, or duty cycles). seg_pot is dynamic: it is the count of
 # potential synapses whose presynaptic cell fired at the PREVIOUS step —
 # frozen weights x evolving activity (models/state.py).
-FROZEN_KEYS = {"perm", "syn_perm", "presyn", "potential", "boost",
+FROZEN_KEYS = {"perm", "syn_perm", "presyn", "members", "boost",
                "active_duty", "overlap_duty", "seg_last", "tm_overflow",
                "sp_iter", "enc_bound", "enc_offset", "enc_resolution"}
 DYNAMIC_KEYS = {"active_seg", "matching_seg", "prev_active", "prev_winner",

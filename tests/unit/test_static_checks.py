@@ -563,8 +563,8 @@ def test_scaling_math_canary_bites_end_to_end():
     scaling = os.path.join(REPO, "SCALING.md")
     with open(scaling, encoding="utf-8") as f:
         original = f.read()
-    doctored, n = re.subn(r"\| u16 quanta \| 564,245 \|",
-                          "| u16 quanta | 564,246 |", original, count=1)
+    doctored, n = re.subn(r"\| u16 quanta \| 302,101 \|",
+                          "| u16 quanta | 302,102 |", original, count=1)
     assert n == 1, "SCALING.md analytic table row moved — update canary"
     with open(scaling, "w", encoding="utf-8") as f:
         f.write(doctored)
