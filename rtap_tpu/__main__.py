@@ -319,6 +319,25 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         prev[sig] = signal.signal(sig, _on_signal)
+    # fleet observability plane, member side (ISSUE 19, rtap_tpu/fleet/,
+    # docs/FLEET.md): started BEFORE the standby block so the aggregator
+    # watches the whole standby phase — the follow loop, the promotion
+    # role change, and the served remainder are one member timeline
+    fleet_pub = None
+    if args.fleet_join:
+        from rtap_tpu.fleet import FleetPublisher
+
+        fhost, _fsep, fport_s = args.fleet_join.rpartition(":")
+        frole = "standby" if args.standby else "leader"
+        fleet_pub = FleetPublisher(
+            (fhost or "127.0.0.1", int(fport_s)),
+            f"{frole}-{os.getpid()}", role=frole,
+            lease_epoch=lease.epoch if lease is not None else 0,
+            push_interval_s=args.fleet_push_interval
+            if args.fleet_push_interval is not None else 1.0).start()
+        print(f"serve: fleet member {fleet_pub.member!r} pushing to "
+              f"{args.fleet_join} every {fleet_pub.push_interval_s}s",
+              file=sys.stderr)
     resume_sup = None
     follower = None
     if args.standby:
@@ -340,6 +359,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             for sig, handler in prev.items():
                 signal.signal(sig, handler)
             journal.close()
+            if fleet_pub is not None:
+                fleet_pub.close()  # orderly BYE: the fleet sees "left"
             print(json.dumps({"standby": follower.stats(),
                               "stopped": True}))
             return 0
@@ -354,6 +375,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         n_ticks_eff = max(0, args.ticks - base)
         resume_sup = follower.resume_suppression
         lease.start_heartbeat()
+        if fleet_pub is not None:
+            # the promotion IS a fleet event: same member, new role, the
+            # successor lease epoch — failover_soak asserts this exact
+            # role_changed sequence against the lease/journal truth
+            fleet_pub.set_role("leader", lease_epoch=lease.epoch)
         print(f"serve: standby PROMOTED to leader at tick {base} "
               f"(lease epoch {lease.epoch}, detected in "
               f"{follower.promote_detect_s:.3f}s; {n_ticks_eff} ticks "
@@ -417,7 +443,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     if args.trace_out or args.postmortem_dir or args.obs_port is not None:
         from rtap_tpu.obs import TraceRecorder
 
-        trace = TraceRecorder(capacity=args.trace_ring)
+        # real process identity on the timeline: fleet_trace.py stitches
+        # multi-process traces by pid and labels tracks by this name
+        trace = TraceRecorder(
+            capacity=args.trace_ring,
+            process_name=fleet_pub.member if fleet_pub is not None
+            else f"rtap-serve-{os.getpid()}")
     if args.postmortem_dir:
         from rtap_tpu.obs import FlightRecorder
 
@@ -492,9 +523,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # restart continuity (ISSUE 6 satellite): the run epoch persists
     # beside the incident stream and the gauge survives into every
     # snapshot, so a supervised child's counter resets are attributable
-    from rtap_tpu.obs import bump_run_epoch
+    from rtap_tpu.obs import bump_run_epoch, set_build_info
 
-    bump_run_epoch(args.alerts)
+    run_epoch = bump_run_epoch(args.alerts)
+    # always-on identity gauge (ISSUE 19 satellite): every snapshot,
+    # scrape, and fleet push says who this process is — a serve reaching
+    # this point serves as the leader (a standby already promoted above)
+    set_build_info(role="leader", shard=0, run_epoch=run_epoch, config=cfg)
+    if fleet_pub is not None:
+        fleet_pub.set_role("leader", run_epoch=run_epoch)
+        fleet_pub.attach(health=health, latency=latency, slo=slo_tracker,
+                         correlator=correlator, trace=trace)
     if latency is not None:
         # first-class lag gauges (ISSUE 11): polled once per tick into
         # rtap_obs_latency_lag{lag=...} — replication-ack lag while a
@@ -505,13 +544,24 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         if correlator is not None:
             latency.lag_providers["incident_close_s"] = \
                 lambda _t, ts: correlator.oldest_open_age_s(ts)
+    # fleet observability plane, aggregator side (ISSUE 19): the merged
+    # one-pane-of-glass views ride the obs HTTP server (/fleet/*), so
+    # --fleet-listen requires --obs-port (enforced in main())
+    fleet_agg = None
+    if args.fleet_listen is not None:
+        from rtap_tpu.fleet import FleetAggregator
+
+        fleet_agg = FleetAggregator(port=args.fleet_listen).start()
+        print(f"serve: fleet aggregator on "
+              f"{fleet_agg.host}:{fleet_agg.port} (merged views at the "
+              "obs server's GET /fleet/* routes)", file=sys.stderr)
     obs_server = None
     if args.obs_port is not None:
         obs_server = ExpositionServer(
             port=args.obs_port, trace=trace,
             flight=flight, health=health,
             correlator=correlator, latency=latency, slo=slo_tracker,
-            predict=predictor,
+            predict=predictor, fleet=fleet_agg,
             healthz_stale_after_s=max(30.0, 10 * args.cadence)).start()
         ohost, oport = obs_server.address
         print(f"serve: obs telemetry on http://{ohost}:{oport}/metrics",
@@ -562,7 +612,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                               correlator=correlator,
                               latency=latency,
                               slo=slo_tracker,
-                              predictor=predictor)
+                              predictor=predictor,
+                              fleet=fleet_pub)
         except BaseException as e:  # noqa: BLE001 — dump, then re-raise
             # crash black-box: an exception escaping serve dumps a
             # postmortem bundle BEFORE the traceback, so a dead soak
@@ -602,8 +653,16 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         if journal is not None:
             journal.tee = None
             journal.close()
+        if fleet_pub is not None:
+            # joined push-thread exit with a best-effort BYE; an abrupt
+            # death instead goes stale and the aggregator marks it DOWN
+            fleet_pub.close()
         if obs_server is not None:
             obs_server.close()
+        if fleet_agg is not None:
+            # after the obs server: no /fleet/* route may race a closed
+            # aggregator
+            fleet_agg.close()
         if args.trace_out and trace is not None:
             # Perfetto-loadable Chrome trace JSON, atomically (tmp +
             # replace): written even on an error path — the timeline of
@@ -1061,6 +1120,25 @@ def main(argv: list[str] | None = None) -> int:
                    help="append one JSONL telemetry snapshot line to this "
                         "file on exit (default: $RTAP_OBS_SNAPSHOT if set "
                         "— the no-network hw-session surface)")
+    p.add_argument("--fleet-join", default=None, metavar="HOST:PORT",
+                   help="join the fleet observability plane: push this "
+                        "process's full telemetry (registry snapshot, "
+                        "health rollup, latency sketch states, SLO "
+                        "windows, open-incident digest) to the fleet "
+                        "aggregator at HOST:PORT once per "
+                        "--fleet-push-interval, off the tick path "
+                        "(docs/FLEET.md)")
+    p.add_argument("--fleet-listen", type=int, default=None, metavar="PORT",
+                   help="host the fleet aggregator: accept member pushes "
+                        "on localhost PORT (0 = ephemeral) and serve the "
+                        "merged one-pane-of-glass views on the obs "
+                        "server's GET /fleet/* routes (requires "
+                        "--obs-port; docs/FLEET.md)")
+    p.add_argument("--fleet-push-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="fleet telemetry push cadence (default 1.0; needs "
+                        "--fleet-join). The member declares 3 missed "
+                        "pushes as its DOWN staleness horizon")
     p.add_argument("--trace-out", default=None,
                    help="write the per-tick host span timeline as Chrome "
                         "trace-event JSON to this file on exit (load it in "
@@ -1494,6 +1572,37 @@ def main(argv: list[str] | None = None) -> int:
               "add --standby (the leader side uses --replicate-to)",
               file=sys.stderr)
         return 2
+    if getattr(args, "fleet_join", None):
+        fhost, fsep, fport_s = args.fleet_join.rpartition(":")
+        try:
+            fport = int(fport_s)
+        except ValueError:
+            fport = -1
+        if not fsep or not (0 < fport < 65536):
+            print(f"serve: bad --fleet-join {args.fleet_join!r} — expected "
+                  "HOST:PORT (the fleet aggregator's listen address; an "
+                  "empty HOST means 127.0.0.1)", file=sys.stderr)
+            return 2
+    if getattr(args, "fleet_listen", None) is not None:
+        if not (0 <= args.fleet_listen < 65536):
+            print("serve: --fleet-listen must be a TCP port "
+                  "(0 = ephemeral)", file=sys.stderr)
+            return 2
+        if getattr(args, "obs_port", None) is None:
+            print("serve: --fleet-listen serves the merged fleet views "
+                  "on the obs HTTP server's /fleet/* routes; add "
+                  "--obs-port", file=sys.stderr)
+            return 2
+    if getattr(args, "fleet_push_interval", None) is not None:
+        if not getattr(args, "fleet_join", None):
+            print("serve: --fleet-push-interval paces the fleet "
+                  "telemetry push; add --fleet-join HOST:PORT",
+                  file=sys.stderr)
+            return 2
+        if args.fleet_push_interval <= 0:
+            print("serve: --fleet-push-interval must be > 0",
+                  file=sys.stderr)
+            return 2
     if getattr(args, "freeze", False) and getattr(args, "auto_register", False):
         print("serve: --freeze with --auto-register would claim fresh "
               "models that can never learn — a lazily registered stream "
@@ -1531,7 +1640,24 @@ def main(argv: list[str] | None = None) -> int:
             log=lambda m: print(m, file=sys.stderr))
         print(f"serve: supervising {' '.join(child_cmd[3:])} "
               f"(restart budget {args.supervise_restarts})", file=sys.stderr)
-        return sup.run()
+        sup_pub = None
+        if getattr(args, "fleet_join", None):
+            # the supervisor is a fleet member too: its restart-budget
+            # counters and liveness ride the same plane as its child
+            # (which inherits --fleet-join and registers separately)
+            from rtap_tpu.fleet import FleetPublisher
+
+            shost, _ssep, sport_s = args.fleet_join.rpartition(":")
+            sup_pub = FleetPublisher(
+                (shost or "127.0.0.1", int(sport_s)),
+                f"supervisor-{os.getpid()}", role="supervisor",
+                push_interval_s=args.fleet_push_interval
+                if args.fleet_push_interval is not None else 1.0).start()
+        try:
+            return sup.run()
+        finally:
+            if sup_pub is not None:
+                sup_pub.close()
     if getattr(args, "backend", None) == "tpu":
         # fail in 120s on a wedged tunnel instead of hanging the operator's
         # terminal, and reuse compiled programs across service restarts

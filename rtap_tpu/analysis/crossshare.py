@@ -65,7 +65,8 @@ RULES = {
 #: scope for construction sites; the shared class itself may live
 #: anywhere under rtap_tpu/
 SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
-         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/__main__.py")
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/fleet/",
+         "rtap_tpu/__main__.py")
 
 
 class _AttrScan(ast.NodeVisitor):

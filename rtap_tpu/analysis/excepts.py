@@ -44,7 +44,7 @@ RULES = {
 }
 
 SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
-         "rtap_tpu/ingest/", "rtap_tpu/correlate/")
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/fleet/")
 
 #: teardown calls whose failure has no narratable outcome
 _CLEANUP_CALLS = frozenset({

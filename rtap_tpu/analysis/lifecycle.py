@@ -54,7 +54,7 @@ RULES = {
 }
 
 SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
-         "rtap_tpu/ingest/", "rtap_tpu/correlate/")
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/fleet/")
 
 #: resource kind -> (constructor dotted-name suffixes, release method
 #: names, human name)

@@ -60,7 +60,8 @@ RULES = {
 
 #: whole serve stack + the CLI wiring that constructs it
 SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
-         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/__main__.py")
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/fleet/",
+         "rtap_tpu/__main__.py")
 
 
 @dataclass(frozen=True)

@@ -44,7 +44,7 @@ RULES = {
 
 STRICT_DIRS = ("rtap_tpu/service/", "rtap_tpu/obs/",
                "rtap_tpu/resilience/", "rtap_tpu/ingest/",
-               "rtap_tpu/correlate/")
+               "rtap_tpu/correlate/", "rtap_tpu/fleet/")
 
 #: coverage pin: serve-path instrumentation modules that MUST live under
 #: a strict dir. Extend with every new serve-path module.
@@ -53,6 +53,8 @@ MUST_BE_STRICT = (
     "rtap_tpu/obs/slo.py",
     "rtap_tpu/obs/metrics.py",
     "rtap_tpu/service/loop.py",
+    "rtap_tpu/fleet/member.py",
+    "rtap_tpu/fleet/aggregator.py",
 )
 
 

@@ -54,7 +54,7 @@ RULES = {
 
 #: the serve stack (same scope as the strict print gate)
 SCOPE = ("rtap_tpu/service/", "rtap_tpu/obs/", "rtap_tpu/resilience/",
-         "rtap_tpu/ingest/", "rtap_tpu/correlate/")
+         "rtap_tpu/ingest/", "rtap_tpu/correlate/", "rtap_tpu/fleet/")
 
 #: attribute-method calls that mutate their receiver in place
 MUTATORS = frozenset({

@@ -1,0 +1,55 @@
+"""rtap_tpu.fleet — the fleet observability plane (ISSUE 19).
+
+One pane of glass over every rtap process. Members (leader, hot
+standby, per-shard serves, supervisors) each run a
+:class:`FleetPublisher` that pushes full telemetry — registry snapshot,
+health rollup, lossless latency sketch states, SLO window counts,
+open-incident digest — over an RJ-framed CRC'd record stream
+(fleet/protocol.py, the journal/replication framing discipline with a
+version-skew-skipping type band). A :class:`FleetAggregator` folds the
+pushes into a member table with staleness-driven DOWN marking and an
+ordered membership/role event log, and merges: counters sum, gauges
+label per member, quantile sketches merge losslessly (fleet p99 is the
+p99 of pooled observations, never max-of-member-p99s), and the fleet
+SLO verdict is re-derived from pooled window counts against the merged
+sketch. :func:`stitch_traces` splices per-process Chrome traces onto
+one Perfetto timeline using the registration clock-alignment handshake.
+
+Serve wires this with ``--fleet-join HOST:PORT`` (become a member) and
+``--fleet-listen PORT`` (host the aggregator; the ``/fleet/*`` routes
+ride the obs HTTP server). docs/FLEET.md is the runbook.
+"""
+
+from rtap_tpu.fleet.aggregator import (
+    FleetAggregator,
+    merge_metrics,
+    merge_sketches,
+    merge_slo,
+)
+from rtap_tpu.fleet.member import FleetPublisher
+from rtap_tpu.fleet.protocol import (
+    FLEET_BYE,
+    FLEET_HELLO,
+    FLEET_SNAP,
+    FLEET_V,
+    FleetWalker,
+    pack_fleet,
+    unpack_payload,
+)
+from rtap_tpu.fleet.stitch import stitch_traces
+
+__all__ = [
+    "FLEET_BYE",
+    "FLEET_HELLO",
+    "FLEET_SNAP",
+    "FLEET_V",
+    "FleetAggregator",
+    "FleetPublisher",
+    "FleetWalker",
+    "merge_metrics",
+    "merge_sketches",
+    "merge_slo",
+    "pack_fleet",
+    "stitch_traces",
+    "unpack_payload",
+]

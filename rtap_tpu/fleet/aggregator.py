@@ -1,0 +1,629 @@
+"""Fleet aggregator: the one-pane-of-glass collector + merge core.
+
+One named thread (``rtap-fleet-agg``) owns a listening socket and a
+``selectors`` loop over every member connection: accept, walk the
+RJ-framed fleet records (protocol.py), fold HELLO/SNAP/BYE into a
+per-member state table, and sweep staleness — a member that misses its
+declared ``down_after_s`` of pushes is marked DOWN (and flips back UP on
+its next push), with every transition appended to a bounded event log.
+That ordered log IS the fleet plane's observed story: failover_soak
+asserts "leader DOWN -> standby role_changed to leader at epoch+1"
+against the lease-derived truth.
+
+The merge core answers fleet-level questions from member pushes:
+
+- **counters sum** across members (same name+labels = one fleet total);
+  **gauges label per member** (a gauge has no cross-process sum — fleet
+  drill-down wants "which member", so each row gains a ``member``
+  label);
+- **latency sketches merge losslessly** (QuantileSketch.from_state +
+  merge over identical bucket geometry), so the fleet p99 is THE p99 of
+  the pooled observations, never max-of-member-p99s;
+- **SLO burn is re-derived from summed window counts** over the merged
+  sketch — one fleet verdict for a meshed soak, same clamped
+  multi-window thresholds as the per-member tracker.
+
+Reads (the ``/fleet/*`` HTTP routes, the soak harness) take the state
+lock briefly and merge on the caller's thread — the collector thread
+never blocks on a slow reader.
+"""
+
+from __future__ import annotations
+
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+
+from rtap_tpu.fleet.protocol import (
+    FLEET_BYE,
+    FLEET_HELLO,
+    FLEET_SNAP,
+    FleetWalker,
+    unpack_payload,
+)
+from rtap_tpu.obs.latency import QuantileSketch
+from rtap_tpu.obs.metrics import TelemetryRegistry, get_registry
+
+__all__ = ["FleetAggregator", "merge_metrics", "merge_sketches",
+           "merge_slo"]
+
+
+# ------------------------------------------------------------ merge core
+def merge_metrics(snaps: dict[str, dict]) -> dict:
+    """Merge member registry snapshots: counters sum into fleet totals;
+    gauges (and histogram rows) keep per-member identity via an added
+    ``member`` label (there is no honest cross-process sum for a gauge
+    reading or a bucket layout the members may disagree on)."""
+    sums: dict[tuple, dict] = {}
+    labeled: list[dict] = []
+    for member in sorted(snaps):
+        for row in (snaps[member].get("metrics") or {}).get("metrics", []):
+            if row.get("type") == "counter":
+                key = (row["name"],
+                       tuple(sorted((row.get("labels") or {}).items())))
+                slot = sums.get(key)
+                if slot is None:
+                    slot = sums[key] = {
+                        "name": row["name"], "type": "counter",
+                        **({"labels": dict(row["labels"])}
+                           if row.get("labels") else {}),
+                        "value": 0, "members": 0}
+                slot["value"] += row.get("value", 0)
+                slot["members"] += 1
+            else:
+                labeled.append({
+                    **row,
+                    "labels": {**(row.get("labels") or {}),
+                               "member": member}})
+    return {"counters": [sums[k] for k in sorted(sums)],
+            "gauges": labeled}
+
+
+def merge_sketches(states: list[dict]) -> QuantileSketch | None:
+    """Rebuild + merge lossless sketch states; None when empty. Raises
+    ValueError on geometry mismatch (the caller decides whether to skip
+    the member or fail the merge — a fleet quantile silently missing a
+    member would be the max-of-p99s lie with extra steps)."""
+    merged: QuantileSketch | None = None
+    for st in states:
+        sk = QuantileSketch.from_state(st)
+        merged = sk if merged is None else merged.merge(sk)
+    return merged
+
+
+def _burn(bad: int, total: int, budget: float) -> float:
+    return (bad / total / budget) if total else 0.0
+
+
+def merge_slo(snaps: dict[str, dict]) -> dict:
+    """One fleet SLO verdict from summed member window counts + merged
+    sketches. Members are pooled per (stage, target, quantile) spec;
+    mismatched window lengths are surfaced as conflicts, not pooled
+    (a 60-tick and a 600-tick "fast" window do not average)."""
+    pooled: dict[tuple, dict] = {}
+    conflicts: list[dict] = []
+    for member in sorted(snaps):
+        for ent in snaps[member].get("slo") or []:
+            key = (ent["stage"], ent["target_s"], ent["quantile"])
+            slot = pooled.get(key)
+            if slot is None:
+                slot = pooled[key] = {
+                    "stage": ent["stage"], "target_s": ent["target_s"],
+                    "quantile": ent["quantile"],
+                    "fast_window_ticks": ent["fast_window_ticks"],
+                    "slow_window_ticks": ent["slow_window_ticks"],
+                    "fast_bad": 0, "fast_total": 0,
+                    "slow_bad": 0, "slow_total": 0,
+                    "cum_bad": 0, "cum_total": 0,
+                    "burn_events": 0, "members": []}
+            if (ent["fast_window_ticks"] != slot["fast_window_ticks"]
+                    or ent["slow_window_ticks"]
+                    != slot["slow_window_ticks"]):
+                conflicts.append({"member": member, "stage": ent["stage"],
+                                  "why": "window length mismatch"})
+                continue
+            for k in ("fast_bad", "fast_total", "slow_bad", "slow_total",
+                      "cum_bad", "cum_total", "burn_events"):
+                slot[k] += ent[k]
+            slot["members"].append(member)
+    # merged sketches give the fleet observed quantile per stage
+    merged_q: dict[str, QuantileSketch] = {}
+    sketch_conflicts: list[str] = []
+    for member in sorted(snaps):
+        sketches = (snaps[member].get("latency") or {}).get("sketches", {})
+        for stage, st in sketches.items():
+            try:
+                sk = QuantileSketch.from_state(st)
+                if stage in merged_q:
+                    merged_q[stage].merge(sk)
+                else:
+                    merged_q[stage] = sk
+            except (ValueError, KeyError, TypeError):
+                sketch_conflicts.append(f"{member}:{stage}")
+    slos = []
+    for key in sorted(pooled):
+        s = pooled[key]
+        budget = 1.0 - s["quantile"]
+        bad_frac = (s["cum_bad"] / s["cum_total"]) if s["cum_total"] \
+            else 0.0
+        sk = merged_q.get(s["stage"])
+        observed = sk.quantile(s["quantile"], "total") \
+            if sk is not None else None
+        # the per-member tracker's clamped multi-window thresholds
+        # (obs/slo.py on_tick), applied to the POOLED counts
+        fast_thr = min(14.0, 0.9 / budget)
+        slow_thr = min(6.0, 0.5 / budget)
+        fast = _burn(s["fast_bad"], s["fast_total"], budget)
+        slow = _burn(s["slow_bad"], s["slow_total"], budget)
+        slos.append({
+            "slo": f"{s['stage']}@p{round(s['quantile'] * 100, 4):g}",
+            "stage": s["stage"],
+            "target_s": s["target_s"],
+            "quantile": s["quantile"],
+            "met": (bad_frac <= budget) if s["cum_total"] else None,
+            "samples": s["cum_total"], "bad": s["cum_bad"],
+            "bad_frac": round(bad_frac, 6),
+            "budget_frac": round(budget, 6),
+            "budget_remaining": round(
+                1.0 - bad_frac / budget if s["cum_total"] else 1.0, 4),
+            "observed_quantile_s": round(observed, 6)
+            if observed is not None else None,
+            "burn_fast": round(fast, 4), "burn_slow": round(slow, 4),
+            "burning": fast >= fast_thr and slow >= slow_thr,
+            "burn_events": s["burn_events"],
+            "members": s["members"],
+        })
+    out = {"met": all(v["met"] is not False for v in slos),
+           "slos": slos}
+    if conflicts:
+        out["window_conflicts"] = conflicts
+    if sketch_conflicts:
+        out["sketch_conflicts"] = sketch_conflicts
+    return out
+
+
+# ------------------------------------------------------------- collector
+class _Member:
+    __slots__ = ("name", "hello", "snap", "seq", "snapshots", "last_seen",
+                 "last_unix", "state", "clock_offset_s", "down_after_s")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.hello: dict = {}
+        self.snap: dict = {}
+        self.seq = 0
+        self.snapshots = 0
+        self.last_seen = time.monotonic()
+        self.last_unix = time.time()
+        self.state = "up"
+        self.clock_offset_s = 0.0
+        self.down_after_s = 5.0
+
+
+class _Conn:
+    __slots__ = ("sock", "walker", "member")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.walker = FleetWalker()
+        self.member: str | None = None
+
+
+class FleetAggregator:
+    """The fleet plane's collector: bind, start(), read merged views.
+
+    ``port=0`` binds an ephemeral localhost port (``.port`` after
+    construction — the harness/CLI hands it to members). All public
+    ``fleet_*``/``members_view``/``events_view`` readers are
+    thread-safe; ``close()`` wakes and joins the collector thread and
+    closes every socket deterministically.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1", *,
+                 registry: TelemetryRegistry | None = None,
+                 default_down_after_s: float = 5.0,
+                 sweep_interval_s: float = 0.2,
+                 max_events: int = 2048):
+        if sweep_interval_s <= 0:
+            raise ValueError(
+                f"sweep_interval_s must be > 0; got {sweep_interval_s}")
+        self.default_down_after_s = float(default_down_after_s)
+        #: staleness-check granularity: DOWN detection lags a member's
+        #: declared horizon by at most this much (soaks with sub-second
+        #: takeover windows tighten it; it is also the idle select
+        #: timeout, so don't set it to a busy-poll value)
+        self.sweep_interval_s = float(sweep_interval_s)
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(port)))
+        self._lsock.listen(64)
+        self._lsock.setblocking(False)
+        self.host, self.port = self._lsock.getsockname()[:2]
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._lsock, selectors.EVENT_READ, "accept")
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._lock = threading.Lock()  # members/events: collector
+        self._members: dict[str, _Member] = {}  # writes, route reads
+        self._events: deque = deque(maxlen=int(max_events))
+        self._conns: dict[int, _Conn] = {}  # collector-thread-only
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        reg = registry if registry is not None else get_registry()
+        self._obs_up = reg.gauge(
+            "rtap_obs_fleet_members",
+            "fleet members by liveness state (push within the member's "
+            "declared staleness horizon = up)", state="up")
+        self._obs_down = reg.gauge(
+            "rtap_obs_fleet_members",
+            "fleet members by liveness state (push within the member's "
+            "declared staleness horizon = up)", state="down")
+        self._obs_snaps = reg.counter(
+            "rtap_obs_fleet_snapshots_total",
+            "FLEET_SNAP telemetry pushes folded into the fleet state")
+        self._obs_skew = reg.counter(
+            "rtap_obs_fleet_frames_skipped_total",
+            "well-framed fleet records skipped for version skew "
+            "(unknown in-band type or future payload version)")
+        self._obs_garbage = reg.counter(
+            "rtap_obs_fleet_garbage_bytes_total",
+            "bytes resynced past on member streams (torn writes, bad "
+            "CRC) — the walker recovered at the next record boundary")
+        self._obs_downs = reg.counter(
+            "rtap_obs_fleet_member_down_total",
+            "UP->DOWN staleness transitions observed by the aggregator")
+
+    # ------------------------------------------------------------ events --
+    def _event(self, kind: str, member: str, **fields) -> None:
+        # lock held by caller
+        self._events.append({"t_unix": time.time(), "event": kind,
+                             "member": member, **fields})
+
+    def _fold_hello(self, conn: _Conn, p: dict) -> None:
+        name = str(p.get("member", ""))
+        if not name:
+            return
+        conn.member = name
+        now_unix = time.time()
+        with self._lock:
+            m = self._members.get(name)
+            fresh = m is None
+            if fresh:
+                m = self._members[name] = _Member(name)
+            m.hello = p
+            m.last_seen = time.monotonic()
+            m.last_unix = now_unix
+            m.down_after_s = float(
+                p.get("down_after_s", self.default_down_after_s))
+            clock = p.get("clock") or {}
+            if "unix" in clock:
+                # alignment handshake: this member's wall clock vs ours
+                # at registration (transit delay rides inside it; good
+                # to ~one RTT, plenty for trace splicing)
+                m.clock_offset_s = now_unix - float(clock["unix"])
+            came_back = m.state != "up"
+            m.state = "up"
+            self._event("rejoined" if (came_back and not fresh)
+                        else "joined", name,
+                        role=p.get("role"), shard=p.get("shard"),
+                        lease_epoch=p.get("lease_epoch"),
+                        run_epoch=p.get("run_epoch"), pid=p.get("pid"))
+
+    def _fold_snap(self, conn: _Conn, p: dict) -> None:
+        name = str(p.get("member", "")) or conn.member
+        if not name:
+            return
+        self._obs_snaps.inc()
+        with self._lock:
+            m = self._members.get(name)
+            if m is None:
+                # HELLO lost to skew: admit the member from its push
+                m = self._members[name] = _Member(name)
+                m.down_after_s = self.default_down_after_s
+                self._event("joined", name, role=p.get("role"),
+                            shard=p.get("shard"),
+                            lease_epoch=p.get("lease_epoch"),
+                            run_epoch=p.get("run_epoch"))
+            old_role = m.snap.get("role") or m.hello.get("role")
+            old_epoch = m.snap.get("lease_epoch",
+                                   m.hello.get("lease_epoch"))
+            m.snap = p
+            m.seq = int(p.get("seq", m.seq))
+            m.snapshots += 1
+            m.last_seen = time.monotonic()
+            m.last_unix = time.time()
+            if m.state != "up":
+                m.state = "up"
+                self._event("up", name, role=p.get("role"))
+            if old_role is not None and p.get("role") != old_role:
+                self._event("role_changed", name, role=p.get("role"),
+                            old_role=old_role,
+                            lease_epoch=p.get("lease_epoch"),
+                            old_lease_epoch=old_epoch)
+
+    def _fold_bye(self, conn: _Conn, p: dict) -> None:
+        name = str(p.get("member", "")) or conn.member
+        if not name:
+            return
+        with self._lock:
+            m = self._members.get(name)
+            if m is not None and m.state != "left":
+                m.state = "left"
+                self._event("left", name)
+
+    # --------------------------------------------------------- collector --
+    def _sweep(self) -> None:
+        now = time.monotonic()
+        up = down = 0
+        with self._lock:
+            for m in self._members.values():
+                if m.state == "up" and now - m.last_seen > m.down_after_s:
+                    m.state = "down"
+                    self._obs_downs.inc()
+                    self._event(
+                        "down", m.name,
+                        role=m.snap.get("role") or m.hello.get("role"),
+                        last_push_age_s=round(now - m.last_seen, 3))
+                if m.state == "up":
+                    up += 1
+                elif m.state == "down":
+                    down += 1
+        self._obs_up.set(up)
+        self._obs_down.set(down)
+
+    def _drop_conn(self, conn: _Conn) -> None:
+        # every conn in _conns is selector-registered (invariant of
+        # _service's accept arm) and dropped at most once
+        self._sel.unregister(conn.sock)
+        self._conns.pop(conn.sock.fileno(), None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _service(self, key) -> None:
+        if key.data == "accept":
+            try:
+                sock, _addr = self._lsock.accept()
+            except OSError:
+                return
+            sock.setblocking(False)
+            conn = _Conn(sock)
+            self._conns[sock.fileno()] = conn
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+            return
+        if key.data == "wake":
+            try:
+                self._wake_r.recv(4096)
+            except OSError:
+                return  # teardown raced the wake byte; loop re-checks
+            return
+        conn: _Conn = key.data
+        try:
+            data = conn.sock.recv(1 << 16)
+        except BlockingIOError:
+            return
+        except OSError:
+            self._drop_conn(conn)
+            return
+        if not data:
+            # connection closed without BYE: staleness (not the close)
+            # decides DOWN — a member may reconnect within its horizon
+            self._drop_conn(conn)
+            return
+        skew_before = conn.walker.skew_skipped
+        garbage_before = conn.walker.garbage_bytes
+        for typ, payload in conn.walker.feed(data):
+            p = unpack_payload(payload)
+            if p is None:
+                self._obs_skew.inc()
+                continue
+            if typ == FLEET_HELLO:
+                self._fold_hello(conn, p)
+            elif typ == FLEET_SNAP:
+                self._fold_snap(conn, p)
+            elif typ == FLEET_BYE:
+                self._fold_bye(conn, p)
+        if conn.walker.skew_skipped > skew_before:
+            self._obs_skew.inc(conn.walker.skew_skipped - skew_before)
+        if conn.walker.garbage_bytes > garbage_before:
+            self._obs_garbage.inc(
+                conn.walker.garbage_bytes - garbage_before)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            for key, _mask in self._sel.select(
+                    timeout=self.sweep_interval_s):
+                self._service(key)
+            self._sweep()
+        for conn in list(self._conns.values()):
+            self._drop_conn(conn)
+        self._sel.close()
+
+    def _close_sockets(self) -> None:
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        try:
+            self._wake_r.close()
+        except OSError:
+            pass
+        try:
+            self._wake_w.close()
+        except OSError:
+            pass
+
+    # -------------------------------------------------------- lifecycle --
+    def start(self) -> "FleetAggregator":
+        self._thread = threading.Thread(
+            target=self._run, name="rtap-fleet-agg", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # the wake byte cuts the final select() short; close() is
+            # single-owner (the wake pair outlives the collector), so
+            # the send cannot race its own close
+            self._wake_w.send(b"x")
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._sel.close()  # idempotent (the collector closed its own)
+        self._close_sockets()
+
+    def wait_members(self, n: int, timeout_s: float = 10.0,
+                     state: str = "up") -> bool:
+        """Block until >= n members are in ``state`` (harness helper)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if sum(1 for m in self._members.values()
+                       if m.state == state) >= n:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    # ------------------------------------------------------------- reads --
+    def _snaps(self) -> dict[str, dict]:
+        with self._lock:
+            return {name: m.snap for name, m in self._members.items()
+                    if m.snap}
+
+    def members_view(self) -> list[dict]:
+        """Per-member roster: identity, liveness, clock alignment —
+        the ``GET /fleet/members`` body and fleet_trace.py's input."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for name in sorted(self._members):
+                m = self._members[name]
+                src = m.snap or m.hello
+                out.append({
+                    "member": name,
+                    "state": m.state,
+                    "role": src.get("role"),
+                    "shard": src.get("shard"),
+                    "pid": m.hello.get("pid"),
+                    "run_epoch": src.get("run_epoch"),
+                    "lease_epoch": src.get("lease_epoch"),
+                    "tick": m.snap.get("tick"),
+                    "seq": m.seq,
+                    "snapshots": m.snapshots,
+                    "last_push_age_s": round(now - m.last_seen, 3),
+                    "down_after_s": m.down_after_s,
+                    "clock_offset_s": round(m.clock_offset_s, 6),
+                    "trace": m.hello.get("trace"),
+                })
+        return out
+
+    def events_view(self) -> list[dict]:
+        """The ordered membership/role event log (joined, up, down,
+        role_changed, left) — the fleet plane's observed sequence."""
+        with self._lock:
+            return list(self._events)
+
+    def fleet_metrics(self) -> dict:
+        """``GET /fleet/metrics``: counters summed fleet-wide, gauges
+        labeled per member, plus the roster."""
+        return {"ts": time.time(), **merge_metrics(self._snaps()),
+                "members": self.members_view()}
+
+    def fleet_latency(self) -> dict:
+        """``GET /fleet/latency``: per-stage quantiles from MERGED
+        sketches (pooled counts), plus per-member tick progress."""
+        snaps = self._snaps()
+        stages: dict[str, QuantileSketch] = {}
+        conflicts: list[str] = []
+        per_member = {}
+        for member in sorted(snaps):
+            lat = snaps[member].get("latency") or {}
+            per_member[member] = {"ticks": lat.get("ticks", 0),
+                                  "detect_samples":
+                                      lat.get("detect_samples", 0)}
+            for stage, st in (lat.get("sketches") or {}).items():
+                try:
+                    sk = QuantileSketch.from_state(st)
+                    if stage in stages:
+                        stages[stage].merge(sk)
+                    else:
+                        stages[stage] = sk
+                except (ValueError, KeyError, TypeError):
+                    conflicts.append(f"{member}:{stage}")
+        out = {
+            "ts": time.time(),
+            "stages": {name: {"window": sk.summary("window"),
+                              "total": sk.summary("total")}
+                       for name, sk in sorted(stages.items())},
+            "members": per_member,
+        }
+        if conflicts:
+            out["sketch_conflicts"] = conflicts
+        return out
+
+    def fleet_slo(self) -> dict:
+        """``GET /fleet/slo``: ONE fleet verdict from pooled window
+        counts + merged sketches (never max-of-member-verdicts)."""
+        return {"ts": time.time(), **merge_slo(self._snaps())}
+
+    def fleet_health(self) -> dict:
+        """``GET /fleet/health``: member health rollups side by side +
+        a worst-of fleet verdict (health verdicts don't sum; a fleet is
+        as healthy as its sickest member)."""
+        snaps = self._snaps()
+        per = {}
+        worst = "ok"
+        groups = 0
+        for member in sorted(snaps):
+            h = snaps[member].get("health")
+            if not h:
+                continue
+            fleet_block = h.get("fleet", {})
+            per[member] = fleet_block
+            groups += int(fleet_block.get("groups", 0) or 0)
+            if fleet_block.get("verdict") not in (None, "ok"):
+                worst = fleet_block.get("verdict")
+        return {"ts": time.time(), "verdict": worst if per else None,
+                "groups_total": groups, "members": per}
+
+    def fleet_incidents(self) -> dict:
+        """``GET /fleet/incidents``: open-window digests per member +
+        fleet totals (ROADMAP item 1's cross-shard aggregation rail)."""
+        snaps = self._snaps()
+        per = {}
+        open_total = emitted_total = 0
+        for member in sorted(snaps):
+            inc = snaps[member].get("incidents")
+            if inc is None:
+                continue
+            per[member] = inc
+            open_total += len(inc.get("open_windows") or {})
+            emitted_total += int(inc.get("incidents_emitted", 0))
+        return {"ts": time.time(), "open_windows_total": open_total,
+                "incidents_emitted_total": emitted_total,
+                "members": per}
+
+    def member_snaps(self) -> dict[str, dict]:
+        """Latest raw FLEET_SNAP per member — the unmerged evidence
+        (per-member counters for reconciliation, exact SLO windows)."""
+        return self._snaps()
+
+    def snapshot(self) -> dict:
+        """Everything at once — the soak-artifact / fleet_report form.
+        ``snaps`` carries the raw per-member pushes so the merged views
+        stay auditable offline."""
+        return {
+            "ts": time.time(),
+            "members": self.members_view(),
+            "events": self.events_view(),
+            "metrics": merge_metrics(self._snaps()),
+            "latency": self.fleet_latency(),
+            "slo": self.fleet_slo(),
+            "health": self.fleet_health(),
+            "incidents": self.fleet_incidents(),
+            "snaps": self._snaps(),
+        }

@@ -1,0 +1,251 @@
+"""Fleet member publisher: one thread pushing this process's telemetry.
+
+Every rtap process that joins the fleet plane (``serve --fleet-join
+HOST:PORT``, the soak children, a supervisor) runs one
+:class:`FleetPublisher`: a single named background thread that dials the
+aggregator, introduces itself with a ``FLEET_HELLO`` (identity + clock
+anchors), then pushes a full ``FLEET_SNAP`` every ``push_interval_s`` —
+registry snapshot, health rollup, lossless latency sketch states, SLO
+window counts, open-incident digest. Push is strictly OFF the tick
+path: the serve loop at most stores its tick number for the snapshot to
+carry (``note_tick``), and a dead/slow aggregator costs the member a
+counted failed send per interval, never a blocked tick
+(obs/selfbench.measure_fleet gates the snapshot-build cost <= 1% of the
+tick budget like every other obs surface).
+
+Role is mutable under a lock (``set_role``): a standby that promotes
+mid-connection announces leader/epoch on its next push — the aggregator
+sees the promotion as a role change on the SAME member, which is exactly
+the sequence failover_soak asserts against the lease-derived truth.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from rtap_tpu.fleet.protocol import (
+    FLEET_BYE,
+    FLEET_HELLO,
+    FLEET_SNAP,
+    pack_fleet,
+)
+from rtap_tpu.obs.metrics import TelemetryRegistry, get_registry
+
+__all__ = ["FleetPublisher"]
+
+
+class FleetPublisher:
+    """Periodic full-telemetry push to a fleet aggregator.
+
+    ``registry``/``health``/``latency``/``slo``/``correlator``/``trace``
+    are the process's armed trackers (None = that block is simply absent
+    from the push — the aggregator merges what exists, the serve
+    flag-gating discipline). ``member`` must be unique fleet-wide (serve
+    uses role+pid); duplicate names supersede by latest HELLO.
+    """
+
+    def __init__(self, addr: tuple[str, int], member: str, *,
+                 role: str = "leader", shard: int = 0,
+                 run_epoch: int = 0, lease_epoch: int = 0,
+                 push_interval_s: float = 1.0,
+                 registry: TelemetryRegistry | None = None,
+                 health=None, latency=None, slo=None, correlator=None,
+                 trace=None, connect_timeout_s: float = 2.0):
+        if push_interval_s <= 0:
+            raise ValueError(
+                f"push_interval_s must be > 0; got {push_interval_s}")
+        self.addr = (str(addr[0]), int(addr[1]))
+        self.member = str(member)
+        self.push_interval_s = float(push_interval_s)
+        #: staleness horizon the member DECLARES at HELLO: miss three
+        #: consecutive pushes and the aggregator marks you DOWN
+        self.down_after_s = 3.0 * self.push_interval_s
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.registry = registry if registry is not None else get_registry()
+        self.health = health
+        self.latency = latency
+        self.slo = slo
+        self.correlator = correlator
+        self.trace = trace
+        self._lock = threading.Lock()  # role/epochs/tick: loop thread
+        self._role = str(role)         # writes, push thread reads
+        self._shard = int(shard)
+        self._run_epoch = int(run_epoch)
+        self._lease_epoch = int(lease_epoch)
+        self._tick = -1
+        self._tick_base = 0
+        self._seq = 0
+        self._sock: socket.socket | None = None  # push-thread-only
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._obs_pushes = self.registry.counter(
+            "rtap_obs_fleet_pushes_total",
+            "FLEET_SNAP records this member delivered to its aggregator")
+        self._obs_push_failures = self.registry.counter(
+            "rtap_obs_fleet_push_failures_total",
+            "fleet pushes that failed to send (dial refused, peer gone "
+            "mid-write); the member reconnects on the next interval")
+
+    # ------------------------------------------------------------ state --
+    def set_role(self, role: str, lease_epoch: int | None = None,
+                 run_epoch: int | None = None) -> None:
+        """Announce a role change (standby promotion) on the next push."""
+        with self._lock:
+            self._role = str(role)
+            if lease_epoch is not None:
+                self._lease_epoch = int(lease_epoch)
+            if run_epoch is not None:
+                self._run_epoch = int(run_epoch)
+
+    def set_tick_base(self, base: int) -> None:
+        """Anchor ``note_tick``'s loop-local tick onto the GLOBAL tick
+        axis: a resumed or promoted member reports journal-global
+        progress, so the fleet's per-member tick column is comparable
+        across restarts."""
+        with self._lock:
+            self._tick_base = int(base)
+
+    def note_tick(self, tick: int) -> None:
+        """Record loop progress for the next snapshot (loop thread; one
+        guarded int store — the only fleet cost on the tick path)."""
+        with self._lock:
+            self._tick = self._tick_base + int(tick)
+
+    def attach(self, *, health=None, latency=None, slo=None,
+               correlator=None, trace=None) -> None:
+        """Wire trackers constructed after the publisher started.
+
+        A standby serve joins the fleet BEFORE its follow loop (so the
+        aggregator sees the whole standby phase), but its obs trackers
+        only exist after promotion — attach them here; the next push
+        carries them. None leaves a tracker unchanged."""
+        with self._lock:
+            if health is not None:
+                self.health = health
+            if latency is not None:
+                self.latency = latency
+            if slo is not None:
+                self.slo = slo
+            if correlator is not None:
+                self.correlator = correlator
+            if trace is not None:
+                self.trace = trace
+
+    # ------------------------------------------------------------- push --
+    def _hello(self) -> dict:
+        with self._lock:
+            ident = {"role": self._role, "shard": self._shard,
+                     "run_epoch": self._run_epoch,
+                     "lease_epoch": self._lease_epoch}
+            trace = self.trace
+        h = {"member": self.member, **ident, "pid": os.getpid(),
+             "process_name": f"{self.member}",
+             "push_interval_s": self.push_interval_s,
+             "down_after_s": self.down_after_s,
+             # the clock-alignment handshake: the aggregator pins this
+             # member's (wall, perf) pair against its own wall clock so
+             # fleet_trace.py can splice trace timelines
+             "clock": {"unix": time.time(),
+                       "perf": time.perf_counter()}}
+        if trace is not None:
+            h["trace"] = {"epoch_unix": trace.epoch_unix,
+                          "epoch_perf": trace.epoch_perf}
+        return h
+
+    def _snap(self) -> dict:
+        with self._lock:
+            self._seq += 1
+            snap = {"member": self.member, "seq": self._seq,
+                    "role": self._role, "shard": self._shard,
+                    "run_epoch": self._run_epoch,
+                    "lease_epoch": self._lease_epoch,
+                    "tick": self._tick}
+            health, latency = self.health, self.latency
+            slo, correlator = self.slo, self.correlator
+        snap["t_unix"] = time.time()
+        snap["metrics"] = self.registry.snapshot()
+        if health is not None:
+            snap["health"] = health.snapshot()
+        if latency is not None:
+            snap["latency"] = {
+                "ticks": latency.ticks,
+                "detect_samples": latency.detect_samples,
+                "sketches": latency.sketch_states(),
+                "waterfall": latency.last_waterfall,
+                "lags": dict(latency.last_lags),
+            }
+        if slo is not None:
+            snap["slo"] = slo.fleet_state()
+        if correlator is not None:
+            c = correlator.snapshot()
+            snap["incidents"] = {
+                "open_windows": c.get("open_windows", {}),
+                "incidents_emitted": c.get("incidents_emitted", 0),
+                "recent": list(c.get("incidents", []))[-5:],
+            }
+        return snap
+
+    def _send(self, frame: bytes) -> bool:
+        """Deliver one frame, dialing if needed; False = counted miss."""
+        try:
+            if self._sock is None:
+                s = socket.create_connection(
+                    self.addr, timeout=self.connect_timeout_s)
+                s.settimeout(self.connect_timeout_s)
+                s.sendall(pack_fleet(FLEET_HELLO, self._hello()))
+                self._sock = s
+            self._sock.sendall(frame)
+            return True
+        except OSError:
+            self._obs_push_failures.inc()
+            self._teardown_sock()
+            return False
+
+    def _teardown_sock(self) -> None:
+        s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass  # already torn down by the peer
+
+    def _run(self) -> None:
+        # first push immediately: registration must not wait an interval
+        # (failover_soak's takeover windows are a few pushes long)
+        while True:
+            if self._send(pack_fleet(FLEET_SNAP, self._snap())):
+                self._obs_pushes.inc()
+            if self._stop.wait(self.push_interval_s):
+                break
+        # final flush: the closing member's last state (completed tick,
+        # final counters) must reach the plane before the BYE — merged
+        # fleet counters are reconciled against this push
+        if self._send(pack_fleet(FLEET_SNAP, self._snap())):
+            self._obs_pushes.inc()
+        if self._sock is not None:
+            try:
+                self._sock.sendall(
+                    pack_fleet(FLEET_BYE, {"member": self.member}))
+            except OSError:
+                self._obs_push_failures.inc()  # departure is best-effort
+        self._teardown_sock()
+
+    # -------------------------------------------------------- lifecycle --
+    def start(self) -> "FleetPublisher":
+        """Start the push thread (idempotent: a member whose role was
+        resolved through the standby path may already be pushing)."""
+        if self._thread is None and not self._stop.is_set():
+            self._thread = threading.Thread(
+                target=self._run, name="rtap-fleet-push", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the push thread deterministically (joined, BYE sent)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -1,0 +1,140 @@
+"""Fleet push protocol: RJ-framed member records (ISSUE 19).
+
+The fleet plane rides the SAME length-framed CRC'd record discipline as
+the tick journal and the replication wire (``RJ`` magic, ``<2sBI``
+header, crc32 over type+len+payload — rtap_tpu/resilience/journal.py is
+the framing's home), with its own type band so a fleet stream can never
+be confused with (or corrupted into) a journal/replication stream:
+
+========  ===========  ==================================================
+type      name         payload (JSON, versioned)
+========  ===========  ==================================================
+32        FLEET_HELLO  member identity + clock-alignment anchors, sent
+                       once per connection: member name, role
+                       (leader/standby/shard-N/supervisor), shard id,
+                       run epoch, lease epoch, pid, process_name, the
+                       declared push interval, and a
+                       ``(time.time, perf_counter)`` clock pair the
+                       aggregator uses to align this member's trace
+                       timeline with the fleet's.
+33        FLEET_SNAP   one full telemetry push: registry snapshot,
+                       health rollup, lossless latency sketch states,
+                       SLO window counts, open-incident digest, and the
+                       member's current role/epochs (promotions surface
+                       here without a reconnect).
+34        FLEET_BYE    orderly departure (the aggregator marks LEFT
+                       instead of waiting out the DOWN staleness).
+35..47    (reserved)   future fleet records. A well-framed record in
+                       this band with a type this build does not know is
+                       SKIPPED and counted (``skew_skipped``) — version
+                       skew between members and aggregator must degrade
+                       to missing fields, never to a desynced stream.
+========  ===========  ==================================================
+
+Payloads are JSON objects carrying ``"v": FLEET_V``; a payload whose
+``v`` is newer than this build is likewise skipped and counted. Torn
+tails wait for more bytes; bad magic / out-of-band type / bad CRC
+resyncs to the next magic and counts garbage — the
+:class:`FleetWalker` is the replication ``WireWalker`` discipline with
+the skew-skipping band added.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+
+from rtap_tpu.resilience.journal import _CRC, _HEADER, _MAGIC, _MAX_PAYLOAD
+
+__all__ = ["FLEET_HELLO", "FLEET_SNAP", "FLEET_BYE", "FLEET_V",
+           "FleetWalker", "pack_fleet", "unpack_payload"]
+
+#: fleet payload schema version (bump on incompatible payload changes;
+#: readers skip payloads from the future instead of guessing)
+FLEET_V = 1
+
+FLEET_HELLO = 32
+FLEET_SNAP = 33
+FLEET_BYE = 34
+
+#: the whole reserved fleet band: well-framed records here are at worst
+#: skipped, never treated as garbage
+_FLEET_BAND = range(32, 48)
+_KNOWN_TYPES = (FLEET_HELLO, FLEET_SNAP, FLEET_BYE)
+
+
+def pack_fleet(typ: int, obj: dict) -> bytes:
+    """Frame one fleet record: JSON payload in RJ framing. The payload
+    always carries the protocol version (writers cannot forget it)."""
+    if typ not in _FLEET_BAND:
+        raise ValueError(f"type {typ} outside the fleet band "
+                         f"[{_FLEET_BAND.start}, {_FLEET_BAND.stop})")
+    payload = json.dumps({"v": FLEET_V, **obj},
+                         separators=(",", ":")).encode()
+    head = _HEADER.pack(_MAGIC, typ, len(payload))
+    return head + payload + _CRC.pack(zlib.crc32(head[2:] + payload))
+
+
+def unpack_payload(payload: bytes) -> dict | None:
+    """Decode one record's JSON payload; None for undecodable or
+    future-versioned payloads (the caller counts the skip)."""
+    try:
+        obj = json.loads(payload)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(obj, dict) or int(obj.get("v", 0)) > FLEET_V:
+        return None
+    return obj
+
+
+class FleetWalker:
+    """Incremental fleet-record stream walker: feed() recv chunks, get
+    ``(typ, payload_bytes)`` records out. Torn tails wait; bad
+    magic/CRC/out-of-band type resyncs to the next magic (counted in
+    ``garbage_bytes``/``bad_crc``); well-framed in-band records of an
+    unknown type are dropped whole and counted in ``skew_skipped``."""
+
+    def __init__(self):
+        self._buf = bytearray()
+        self.records = 0
+        self.garbage_bytes = 0
+        self.bad_crc = 0
+        self.skew_skipped = 0
+
+    def feed(self, data: bytes) -> list[tuple[int, bytes]]:
+        self._buf += data
+        buf = bytes(self._buf)
+        n = len(buf)
+        out: list[tuple[int, bytes]] = []
+        off = 0
+        while off + _HEADER.size + _CRC.size <= n:
+            magic, typ, ln = _HEADER.unpack_from(buf, off)
+            if magic != _MAGIC or typ not in _FLEET_BAND \
+                    or ln > _MAX_PAYLOAD:
+                nxt = buf.find(_MAGIC, off + 1)
+                skip_to = nxt if nxt != -1 else max(off + 1, n - 1)
+                self.garbage_bytes += skip_to - off
+                off = skip_to
+                continue
+            end = off + _HEADER.size + ln + _CRC.size
+            if end > n:
+                break  # torn tail: wait for more bytes
+            payload = buf[off + _HEADER.size:end - _CRC.size]
+            (crc,) = _CRC.unpack_from(buf, end - _CRC.size)
+            if crc != zlib.crc32(buf[off + 2:off + _HEADER.size] + payload):
+                self.bad_crc += 1
+                nxt = buf.find(_MAGIC, off + 1)
+                skip_to = nxt if nxt != -1 else max(off + 1, n - 1)
+                self.garbage_bytes += skip_to - off
+                off = skip_to
+                continue
+            if typ not in _KNOWN_TYPES:
+                # CRC held: a future record, not corruption — skip WHOLE
+                self.skew_skipped += 1
+                off = end
+                continue
+            out.append((typ, payload))
+            off = end
+        del self._buf[:off]
+        self.records += len(out)
+        return out

@@ -1,0 +1,91 @@
+"""Trace stitching: many per-process Chrome traces, one Perfetto timeline.
+
+Each rtap process exports its own Chrome trace (obs/trace.py) with
+timestamps in microseconds since ITS OWN recorder epoch and real
+pid/process_name metadata. Stitching rebases every trace onto one fleet
+timeline:
+
+- the fleet time origin is the EARLIEST recorder epoch among the input
+  traces (``otherData.epoch_unix``), so a leader's final ticks and its
+  standby's promotion spans land in causal order on one axis;
+- each trace's events shift by ``(epoch_unix - origin) * 1e6`` µs, plus
+  that member's registration clock offset when the caller provides the
+  aggregator's member roster (the HELLO clock-alignment handshake —
+  corrects wall-clock disagreement between hosts, which the per-process
+  epochs alone cannot see);
+- pids colliding across traces (a restarted process re-using a pid, or
+  two hosts) are remapped so every input keeps a distinct Perfetto
+  process track, with its ``process_name`` metadata preserved.
+
+``scripts/fleet_trace.py`` is the CLI over this; the function is pure so
+the soak harness and tests splice in-process.
+"""
+
+from __future__ import annotations
+
+__all__ = ["stitch_traces"]
+
+
+def stitch_traces(traces: list[dict],
+                  members: list[dict] | None = None) -> dict:
+    """Splice Chrome trace docs onto one timeline.
+
+    ``traces``: ``chrome_trace()`` outputs (each with ``otherData``
+    anchors). ``members``: optional aggregator roster rows
+    (``members_view()``) whose ``clock_offset_s`` is applied to the
+    matching trace (matched by pid). Returns one Chrome trace doc.
+    """
+    docs = [t for t in traces if t.get("traceEvents")]
+    if not docs:
+        return {"traceEvents": [], "otherData": {"stitched_from": 0}}
+    offsets_by_pid: dict[int, float] = {}
+    for m in members or []:
+        if m.get("pid") is not None and m.get("clock_offset_s") is not None:
+            offsets_by_pid[int(m["pid"])] = float(m["clock_offset_s"])
+
+    def _epoch(doc: dict) -> float:
+        other = doc.get("otherData") or {}
+        pid = other.get("pid")
+        off = offsets_by_pid.get(int(pid)) if pid is not None else None
+        # the member's wall clock, corrected onto the aggregator's:
+        # epoch_unix + offset is when this recorder started in FLEET time
+        return float(other.get("epoch_unix", 0.0)) + (off or 0.0)
+
+    origin = min(_epoch(d) for d in docs)
+    events: list[dict] = []
+    used_pids: set[int] = set()
+    processes: list[dict] = []
+    for doc in docs:
+        other = doc.get("otherData") or {}
+        pid = int(other.get("pid", 0) or 0)
+        shift_us = round((_epoch(doc) - origin) * 1e6, 3)
+        out_pid = pid
+        while out_pid in used_pids:
+            out_pid += 1_000_000  # keep colliding processes distinct
+        used_pids.add(out_pid)
+        processes.append({
+            "pid": pid, "stitched_pid": out_pid,
+            "process_name": other.get("process_name"),
+            "epoch_unix": other.get("epoch_unix"),
+            "shift_us": shift_us,
+        })
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = out_pid
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + shift_us, 3)
+            events.append(ev)
+    # metadata events (ph == "M") must precede their process's spans for
+    # Perfetto to label tracks; a stable sort on ts keeps them first at
+    # equal timestamps because they carry no ts shift of their own
+    events.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               float(e.get("ts", 0.0))))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "stitched_from": len(docs),
+            "origin_epoch_unix": origin,
+            "processes": processes,
+        },
+    }

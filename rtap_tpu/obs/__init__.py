@@ -33,7 +33,7 @@ from rtap_tpu.obs.metrics import (
     log_buckets,
 )
 from rtap_tpu.obs.flight import FlightRecorder, validate_bundle
-from rtap_tpu.obs.health import HealthTracker, bump_run_epoch
+from rtap_tpu.obs.health import HealthTracker, bump_run_epoch, set_build_info
 from rtap_tpu.obs.latency import LatencyTracker, QuantileSketch
 from rtap_tpu.obs.slo import SloSpec, SloTracker, parse_slo
 from rtap_tpu.obs.trace import TraceRecorder
@@ -60,6 +60,7 @@ __all__ = [
     "parse_slo",
     "read_last_snapshot",
     "render_prometheus",
+    "set_build_info",
     "summarize_snapshot",
     "validate_bundle",
     "write_snapshot",

@@ -319,6 +319,32 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        elif path.startswith("/fleet/"):
+            # the fleet aggregator's merged views (ISSUE 19,
+            # rtap_tpu/fleet/): counters summed across members, gauges
+            # labeled per member, quantiles from MERGED sketches, one
+            # fleet SLO verdict, member roster + incident rollup —
+            # point-in-time diagnostic reads, same contract as /health
+            ag = getattr(self.server, "fleet", None)
+            if ag is None:
+                self.send_error(404, "fleet aggregation not enabled "
+                                     "(serve --fleet-listen PORT)")
+                return
+            route = {
+                "/fleet/metrics": ag.fleet_metrics,
+                "/fleet/health": ag.fleet_health,
+                "/fleet/latency": ag.fleet_latency,
+                "/fleet/slo": ag.fleet_slo,
+                "/fleet/incidents": ag.fleet_incidents,
+                "/fleet/members": ag.members_view,
+                "/fleet/events": ag.events_view,
+                "/fleet/snapshot": ag.snapshot,
+            }.get(path)
+            if route is None:
+                self.send_error(404)
+                return
+            body = (json.dumps(route()) + "\n").encode()
+            ctype = "application/json"
         elif path == "/postmortem":
             # on-demand flight-recorder dump; returns the bundle path (or
             # null when throttled). GET because it is an operator poke on
@@ -370,6 +396,9 @@ class ExpositionServer:
     SLOs' live burn rates and verdict, and with a ``predict`` tracker
     (rtap_tpu/predict/), ``/predict`` serves the divergence
     trajectories, alarmed streams, and open predicted-blast windows.
+    With a ``fleet`` aggregator (rtap_tpu/fleet/), the ``/fleet/*``
+    routes serve the merged cross-process views — metrics, health,
+    latency, slo, incidents, members, events, snapshot.
     ``/healthz`` is always routed:
     a liveness probe returning 200 while the loop ticked within
     ``healthz_stale_after_s`` seconds, 503 otherwise
@@ -379,7 +408,7 @@ class ExpositionServer:
     def __init__(self, registry: TelemetryRegistry | None = None,
                  host: str = "127.0.0.1", port: int = 0,
                  trace=None, flight=None, health=None, correlator=None,
-                 latency=None, slo=None, predict=None,
+                 latency=None, slo=None, predict=None, fleet=None,
                  healthz_stale_after_s: float = 30.0):
         self.registry = registry or get_registry()
         self._server = _Server((host, port), _Handler)
@@ -391,6 +420,7 @@ class ExpositionServer:
         self._server.latency = latency
         self._server.slo = slo
         self._server.predict = predict
+        self._server.fleet = fleet
         self._server.healthz_stale_after_s = float(healthz_stale_after_s)
         self.address = self._server.server_address  # (host, bound port)
         self._thread = threading.Thread(

@@ -110,6 +110,11 @@ class QuantileSketch:
             raise ValueError(f"need 0 < lo < hi; got lo={lo}, hi={hi}")
         if per_decade < 1:
             raise ValueError(f"per_decade must be >= 1; got {per_decade}")
+        # geometry kept verbatim so state()/from_state() round-trips
+        # rebuild bit-identical edge arrays (merge requires identity)
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.per_decade = int(per_decade)
         self.edges = _edges(lo, hi, per_decade)
         self._edges_list = list(self.edges)
         self._edges_arr = np.asarray(self.edges)  # searchsorted target
@@ -227,6 +232,81 @@ class QuantileSketch:
             out["max_s"] = round(
                 max((sh.max for sh in shards), default=0.0), 6)
         return out
+
+    # ------------------------------------------------- fleet merge core --
+    def state(self) -> dict:
+        """Lossless wire form (the fleet push payload, ISSUE 19): bucket
+        geometry + the shard-summed count arrays for every scope. A
+        sketch rebuilt by :meth:`from_state` answers every quantile/count
+        query identically to this one — the counts ARE the sketch."""
+        cur = np.zeros(self._n, np.int64)
+        prev = np.zeros(self._n, np.int64)
+        total = np.zeros(self._n, np.int64)
+        sum_s = 0.0
+        max_s = 0.0
+        for s in self._shard_list():
+            cur += s.cur
+            prev += s.prev
+            total += s.total
+            sum_s += s.sum
+            if s.max > max_s:
+                max_s = s.max
+        return {"v": 1, "lo": self.lo, "hi": self.hi,
+                "per_decade": self.per_decade,
+                "cur": cur.tolist(), "prev": prev.tolist(),
+                "total": total.tolist(),
+                "sum": sum_s, "max": max_s, "rolls": self.rolls}
+
+    @classmethod
+    def from_state(cls, wire: dict) -> "QuantileSketch":
+        """Rebuild a sketch from :meth:`state` output (a plain-JSON wire
+        payload, not a model state tree). Raises ValueError on
+        geometry/count-length mismatch (a corrupt or skewed payload
+        must never fold silently into a fleet quantile)."""
+        sk = cls(lo=float(wire["lo"]), hi=float(wire["hi"]),
+                 per_decade=int(wire["per_decade"]))
+        cur = np.asarray(wire["cur"], np.int64)
+        prev = np.asarray(wire["prev"], np.int64)
+        total = np.asarray(wire["total"], np.int64)
+        if not (cur.shape == prev.shape == total.shape == (sk._n,)):
+            raise ValueError(
+                f"sketch state count arrays have wrong length "
+                f"(want {sk._n}, got {cur.shape}/{prev.shape}/"
+                f"{total.shape})")
+        shard = sk._shards.setdefault(threading.get_ident(),
+                                      _SketchShard(sk._n))
+        shard.cur[:] = cur
+        shard.prev[:] = prev
+        shard.total[:] = total
+        shard.sum = float(wire.get("sum", 0.0))
+        shard.max = float(wire.get("max", 0.0))
+        sk.rolls = int(wire.get("rolls", 0))
+        return sk
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Fold ``other``'s counts into this sketch, losslessly, scope by
+        scope (cur+cur, prev+prev, total+total, sum/max folded). Only
+        sketches over IDENTICAL bucket edges merge — fleet p99s must come
+        from summed counts over one geometry, never from resampling
+        (which would silently re-introduce the max-of-p99s lie this
+        exists to kill). Returns self for chaining."""
+        if other.edges != self.edges:
+            raise ValueError(
+                f"cannot merge sketches with different bucket edges "
+                f"(lo/hi/per_decade {self.lo}/{self.hi}/{self.per_decade}"
+                f" vs {other.lo}/{other.hi}/{other.per_decade})")
+        shard = self._shards.get(threading.get_ident())
+        if shard is None:
+            shard = self._shards.setdefault(
+                threading.get_ident(), _SketchShard(self._n))
+        for s in other._shard_list():
+            shard.cur += s.cur
+            shard.prev += s.prev
+            shard.total += s.total
+            shard.sum += s.sum
+            if s.max > shard.max:
+                shard.max = s.max
+        return self
 
 
 class LatencyTracker:
@@ -395,6 +475,12 @@ class LatencyTracker:
             "waterfall": self.last_waterfall,
             "lags": dict(self.last_lags),
         }
+
+    def sketch_states(self) -> dict:
+        """Per-stage lossless sketch states (the fleet push payload) —
+        the aggregator rebuilds and merges these so fleet quantiles are
+        computed from pooled counts, not from per-member quantiles."""
+        return {name: sk.state() for name, sk in self.sketches.items()}
 
     def stats(self) -> dict:
         """End-of-run block for the loop's stats dict (and the soak

@@ -17,10 +17,12 @@ from rtap_tpu.obs.metrics import TelemetryRegistry
 
 __all__ = ["measure", "measure_trace", "measure_journal", "measure_health",
            "measure_correlate", "measure_latency", "measure_predict",
+           "measure_fleet",
            "GATE_MEASURES", "GATE_BUDGET_FRAC",
            "OPS_PER_TICK", "TRACE_SPANS_PER_TICK",
            "HEALTH_FOLDS_PER_TICK", "CORRELATE_ALERTS_PER_TICK",
-           "LATENCY_OBSERVES_PER_TICK", "PREDICT_FOLDS_PER_TICK"]
+           "LATENCY_OBSERVES_PER_TICK", "PREDICT_FOLDS_PER_TICK",
+           "FLEET_PUSHES_PER_TICK"]
 
 #: instrument operations a serve tick costs at the production shape (six
 #: phase observes + tick latency observe + ticks/scored/alert counters +
@@ -51,6 +53,12 @@ LATENCY_OBSERVES_PER_TICK = 32
 #: multi-group shape (ISSUE 16): one per collected chunk per group, 16
 #: groups — the same shape as the health folds they ride beside
 PREDICT_FOLDS_PER_TICK = 16
+
+#: fleet snapshot builds a serve tick is budgeted for (ISSUE 19): the
+#: soak children push every cadence/2 (two full snapshot builds per
+#: tick); production serve defaults to one push per second against a
+#: 1 s cadence — the gate budgets the denser soak shape
+FLEET_PUSHES_PER_TICK = 2
 
 
 def _time_op(fn, n: int) -> float:
@@ -395,6 +403,66 @@ def measure_predict(n: int = 2000, cadence_s: float = 1.0,
     }
 
 
+def measure_fleet(n: int = 2000, cadence_s: float = 1.0,
+                  n_pushes: int = FLEET_PUSHES_PER_TICK) -> dict:
+    """Fleet-publisher cost (ISSUE 19), same protocol as :func:`measure`:
+    per-op nanoseconds of ``note_tick`` (the ONLY fleet operation on the
+    tick path — one guarded int store) and of the full snapshot build +
+    wire pack the push thread pays per interval (registry snapshot,
+    lossless sketch states, SLO window counts — GIL time the loop thread
+    contends with even though the send itself is off-path), projected to
+    a tick at the soak push density (``push_interval = cadence/2`` ->
+    two snapshot builds per tick). The publisher is never started: the
+    measurement is the build+pack cost, not socket I/O. Registered in
+    :data:`GATE_MEASURES`, so ``bench.py --obs-bench`` gates it <= 1% of
+    the tick budget alongside every other obs instrument."""
+    from rtap_tpu.fleet.member import FleetPublisher
+    from rtap_tpu.fleet.protocol import FLEET_SNAP, pack_fleet
+    from rtap_tpu.obs.latency import LatencyTracker
+    from rtap_tpu.obs.slo import SloTracker, parse_slo
+
+    reg = TelemetryRegistry()
+    # a realistic push payload: a serving registry plus armed latency/
+    # SLO trackers with FULL sketch windows (state() walks every bucket
+    # array — empty sketches would understate the steady-state cost)
+    reg.counter("rtap_obs_ticks_total").inc(1000)
+    reg.counter("rtap_obs_scored_total").inc(64_000)
+    reg.gauge("rtap_obs_streams_active").set(1024.0)
+    tracker = LatencyTracker(window_ticks=120, cadence_s=cadence_s,
+                             registry=reg)
+    slo = SloTracker([parse_slo("tick=1s@p99")], cadence_s=cadence_s,
+                     registry=reg, quantile_source=tracker.quantile)
+    tracker.slo = slo
+    phases = {p: 0.001 for p in ("source", "membership", "dispatch",
+                                 "collect", "emit", "checkpoint")}
+    for t in range(120):
+        tracker.record_tick(t, 1_700_000_000 + t, phases, 0.01)
+        slo.on_tick(t)
+    pub = FleetPublisher(("127.0.0.1", 1), "selfbench", registry=reg,
+                         latency=tracker, slo=slo,
+                         push_interval_s=max(0.001, cadence_s / 2))
+    pub.note_tick(0)  # warm the lock path out of the measurement
+    note_s = _time_op(lambda: pub.note_tick(1), 50_000)
+
+    frame_bytes = [0]
+
+    def _push():
+        frame_bytes[0] = len(pack_fleet(FLEET_SNAP, pub._snap()))
+
+    _push()  # warm the registry/sketch snapshot paths
+    snap_s = _time_op(_push, n)
+    per_tick_s = note_s + n_pushes * snap_s
+    return {
+        "fleet_note_tick_ns": round(note_s * 1e9, 1),
+        "fleet_snap_pack_us": round(snap_s * 1e6, 2),
+        "snap_frame_bytes": frame_bytes[0],
+        "pushes_per_tick": n_pushes,
+        "per_tick_overhead_us": round(per_tick_s * 1e6, 2),
+        "per_tick_overhead_frac": per_tick_s / cadence_s,
+        "cadence_s": cadence_s,
+    }
+
+
 #: THE obs-bench gate registry (ISSUE 11 satellite): every self-
 #: benchmarked instrument surface, each gated <= ``budget_frac`` of the
 #: tick budget by ``bench.py --obs-bench`` and the tier-1 overhead
@@ -409,6 +477,7 @@ GATE_MEASURES: tuple = (
     ("obs_correlate_overhead", measure_correlate),
     ("obs_latency_overhead", measure_latency),
     ("obs_predict_overhead", measure_predict),
+    ("obs_fleet_overhead", measure_fleet),
 )
 
 #: the shared acceptance bar: each surface's projected per-tick cost

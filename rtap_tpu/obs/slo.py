@@ -383,3 +383,36 @@ class SloTracker:
             "slow_burn_threshold": self.slow_burn,
             **self.verdict(),
         }
+
+    def _window_sums(self, st: _SloState, window: int) -> tuple[int, int]:
+        n = min(st.filled, window)
+        if n == 0:
+            return 0, 0
+        sel = (st.idx - 1 - np.arange(n)) % self.slow_window
+        return int(st.bad_ring[sel].sum()), int(st.total_ring[sel].sum())
+
+    def fleet_state(self) -> list[dict]:
+        """Per-SLO mergeable counts (the fleet push payload, ISSUE 19):
+        raw bad/total sums for the fast/slow windows and the run, NOT
+        fractions — the aggregator re-derives fleet burn rates from
+        summed counts, the same anti-max-of-p99s discipline the merged
+        sketches follow. Window lengths ride along so the aggregator can
+        refuse to pool incomparable windows."""
+        out = []
+        for st in self._states.values():
+            fast_bad, fast_total = self._window_sums(st, self.fast_window)
+            slow_bad, slow_total = self._window_sums(st, self.slow_window)
+            out.append({
+                "stage": st.spec.name,
+                "target_s": st.spec.target_s,
+                "quantile": st.spec.quantile,
+                "fast_window_ticks": self.fast_window,
+                "slow_window_ticks": self.slow_window,
+                "fast_bad": fast_bad, "fast_total": fast_total,
+                "slow_bad": slow_bad, "slow_total": slow_total,
+                "cum_bad": int(st.cum_bad),
+                "cum_total": int(st.cum_total),
+                "burning": st.burning,
+                "burn_events": st.burn_events,
+            })
+        return out

@@ -38,6 +38,7 @@ through the name channel.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 
@@ -82,12 +83,16 @@ class TraceRecorder:
     """
 
     def __init__(self, capacity: int = 65536, max_names: int = 1024,
-                 max_arg_bytes: int = 256):
+                 max_arg_bytes: int = 256,
+                 process_name: str | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1; got {capacity}")
         self.capacity = int(capacity)
         self.max_names = int(max_names)
         self.max_arg_bytes = int(max_arg_bytes)
+        #: Perfetto process label (fleet stitching keys member traces by
+        #: it); settable after construction — serve learns its role late
+        self.process_name = process_name
         # perf_counter is the span clock (monotonic, sub-us); the unix
         # anchor lets a reader align the trace with alert-line timestamps
         self.epoch_perf = time.perf_counter()
@@ -223,11 +228,19 @@ class TraceRecorder:
         Track layout: tid 0 is the loop thread (phase spans + tick spans
         + untargeted instants); each group `g` gets tid ``g + 1`` for its
         dispatch/collect child spans and group-targeted instants.
-        Timestamps are microseconds since the recorder epoch.
+        Timestamps are microseconds since the recorder epoch. ``pid`` is
+        the REAL process id and a ``process_name`` metadata event labels
+        the track — two traces from a leader/standby pair drop onto one
+        Perfetto timeline as distinct processes (the otherData epoch
+        anchors are what scripts/fleet_trace.py aligns clocks with).
         """
         recs = self.records(last_ticks=last_ticks)
+        pid = os.getpid()
         events: list[dict] = [{
-            "ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": self.process_name or f"rtap-{pid}"},
+        }, {
+            "ph": "M", "pid": pid, "tid": 0, "name": "thread_name",
             "args": {"name": "serve loop"},
         }]
         seen_groups: set[int] = set()
@@ -237,7 +250,8 @@ class TraceRecorder:
             if g >= 0 and g not in seen_groups:
                 seen_groups.add(g)
                 events.append({
-                    "ph": "M", "pid": 1, "tid": tid, "name": "thread_name",
+                    "ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_name",
                     "args": {"name": f"group{g}"},
                 })
             args: dict = {"tick": r["tick"]}
@@ -251,7 +265,7 @@ class TraceRecorder:
             ev = {
                 "name": r["name"],
                 "cat": "phase" if g < 0 else "group",
-                "pid": 1,
+                "pid": pid,
                 "tid": tid,
                 "ts": round(r["t0"] * 1e6, 3),
                 "args": args,
@@ -268,7 +282,10 @@ class TraceRecorder:
             "traceEvents": events,
             "displayTimeUnit": "ms",
             "otherData": {
+                "pid": pid,
+                "process_name": self.process_name or f"rtap-{pid}",
                 "epoch_unix": self.epoch_unix,
+                "epoch_perf": self.epoch_perf,
                 "total_records": self.total,
                 "dropped_records": self.dropped,
             },

@@ -331,6 +331,7 @@ def live_loop(
     latency=None,
     slo=None,
     predictor=None,
+    fleet=None,
 ) -> dict:
     """Paced live scoring: each tick, poll `source(tick) -> (values [G], ts)`,
     score the group(s), emit alerts; sleep off any time left in the cadence
@@ -1965,6 +1966,10 @@ def live_loop(
                     elapsed, poll_wall=lat_poll_wall, source=source)
                 if slo is not None:
                     slo.on_tick(k)
+            if fleet is not None:
+                # one guarded int store; the fleet pushes themselves run
+                # on the publisher's own thread, never on the tick path
+                fleet.note_tick(k)
             if flight is not None:
                 flight.record_tick(
                     k, elapsed,

@@ -98,6 +98,21 @@ def run_child(args) -> int:
         # the proc_exit that killed the previous incarnation)
         chaos = ChaosEngine(ChaosSpec.from_file(args.spec).shifted(base))
 
+    fleet_pub = None
+    if args.fleet_port:
+        # fleet observability plane (ISSUE 19): every incarnation of the
+        # supervised child is the SAME fleet member ("serve") — a SIGKILL
+        # shows up as staleness DOWN (no BYE), the restart as a rejoin
+        # carrying the new resume base as its run_epoch. The parent reads
+        # restart evidence through the plane, not per-child artifacts.
+        from rtap_tpu.fleet import FleetPublisher
+
+        fleet_pub = FleetPublisher(
+            ("127.0.0.1", args.fleet_port), "serve", role="leader",
+            run_epoch=base,
+            push_interval_s=max(0.02, args.cadence / 2)).start()
+        fleet_pub.set_tick_base(base)
+
     def seeded_row(k: int):
         g = base + k  # the feed depends only on the GLOBAL tick
         rng = np.random.Generator(np.random.Philox(key=(args.seed, g)))
@@ -140,12 +155,17 @@ def run_child(args) -> int:
         from rtap_tpu.obs.slo import tick_slo_pair
 
         latency, slo = tick_slo_pair(args.cadence, args.slo)
+        if fleet_pub is not None:
+            fleet_pub.attach(latency=latency, slo=slo)
     stats = live_loop(
         source, reg, n_ticks=n_eff, cadence_s=args.cadence,
         alert_path=os.path.join(w, "alerts.jsonl"),
         checkpoint_dir=ckdir, checkpoint_every=args.checkpoint_every,
-        journal=journal, chaos=chaos, latency=latency, slo=slo)
+        journal=journal, chaos=chaos, latency=latency, slo=slo,
+        fleet=fleet_pub)
     journal.close()
+    if fleet_pub is not None:
+        fleet_pub.close()  # final-state flush + orderly BYE
     line = {"base": base, "ran": stats["ticks"],
             "alerts": stats["alerts"],
             "scored": stats["scored"],
@@ -160,7 +180,8 @@ def run_child(args) -> int:
 
 
 # --------------------------------------------------------------- parent
-def child_cmd(args, workdir: str, spec: str | None) -> list[str]:
+def child_cmd(args, workdir: str, spec: str | None,
+              fleet_port: int = 0) -> list[str]:
     cmd = [sys.executable, os.path.abspath(__file__), "--child",
            "--workdir", workdir, "--seed", str(args.seed),
            "--ticks", str(args.ticks), "--streams", str(args.streams),
@@ -177,6 +198,8 @@ def child_cmd(args, workdir: str, spec: str | None) -> list[str]:
         cmd.append("--binary-ingest")
     if spec:
         cmd += ["--spec", spec]
+    if fleet_port:
+        cmd += ["--fleet-port", str(fleet_port)]
     return cmd
 
 
@@ -309,6 +332,100 @@ def parse_alert_stream(path: str) -> dict:
             "garbage": garbage}
 
 
+def _member_counter(snap: dict, name: str):
+    for row in (snap.get("metrics") or {}).get("metrics", []):
+        if row.get("name") == name and row.get("type") == "counter":
+            return row.get("value", 0)
+    return None
+
+
+def fleet_verdict(agg, args, stats_path: str,
+                  failures: list[str]) -> dict:
+    """Judge the FLEET-OBSERVED restart story (ISSUE 19): every SIGKILL
+    must appear on the plane as the member going DOWN by staleness (a
+    kill-9'd process sends no BYE) then REJOINING when the supervisor's
+    replacement re-HELLOs under the same name; the budget's completion
+    and the completing incarnation's alert accounting must be readable
+    through the plane alone."""
+    events = agg.events_view()
+    members = agg.members_view()
+    snap = agg.member_snaps().get("serve") or {}
+    serve_ev = [e for e in events if e["member"] == "serve"]
+    rejoins = [e for e in serve_ev if e["event"] == "rejoined"]
+    # classify each staleness DOWN by what follows it: the next liveness
+    # event is "rejoined" for a real death (the replacement re-HELLOs)
+    # but "up" for a stall flap — a checkpoint/compile stall that held
+    # the push thread past the tight soak-cadence staleness horizon.
+    # Flaps are honest evidence of stalls, not deaths.
+    death_downs = flaps = 0
+    for i, e in enumerate(serve_ev):
+        if e["event"] != "down":
+            continue
+        nxt = next((x["event"] for x in serve_ev[i + 1:]
+                    if x["event"] in ("up", "rejoined", "left")), None)
+        if nxt == "rejoined":
+            death_downs += 1
+        elif nxt == "up":
+            flaps += 1
+    if len(rejoins) != args.kills:
+        failures.append(
+            f"fleet plane saw {len(rejoins)} rejoin(s), expected one "
+            f"per restart ({args.kills})")
+    if death_downs != args.kills:
+        failures.append(
+            f"fleet plane saw {death_downs} death DOWN(s) (staleness "
+            f"DOWN answered by a rejoin), scheduled {args.kills} "
+            f"kill(s)")
+    # each restart resumes FORWARD: the rejoin HELLOs carry the new
+    # incarnation's resume base as run_epoch, which must be monotonic
+    bases = [e.get("run_epoch") or 0 for e in rejoins]
+    if bases != sorted(bases):
+        failures.append(
+            f"fleet-observed restart resume bases went backwards: "
+            f"{bases}")
+    final_tick = max((m.get("tick") if m.get("tick") is not None else -1)
+                     for m in members) if members else -1
+    if final_tick != args.ticks - 1:
+        failures.append(
+            f"fleet plane never observed the budget completing "
+            f"(last member tick {final_tick}, want {args.ticks - 1})")
+    # the completing incarnation's stats line counts every crossing it
+    # SCORED; on the plane those split into emitted lines plus
+    # resume-suppressed already-delivered ids — the sum closes the books
+    reconciled = None
+    last_line = None
+    if os.path.isfile(stats_path):
+        with open(stats_path) as f:
+            for line in f:
+                last_line = json.loads(line)
+    if last_line is not None and snap:
+        emitted = _member_counter(snap, "rtap_obs_alerts_total")
+        suppressed = _member_counter(
+            snap, "rtap_obs_alerts_suppressed_total") or 0
+        reconciled = {"fleet_emitted": emitted,
+                      "fleet_suppressed": suppressed,
+                      "stats": last_line.get("alerts")}
+        if emitted is not None and \
+                emitted + suppressed != last_line.get("alerts"):
+            failures.append(
+                f"fleet-pushed emitted+suppressed {emitted}+{suppressed}"
+                f" != the completing child's stats-line crossing count "
+                f"{last_line.get('alerts')}")
+    return {
+        "members": [{k: m.get(k) for k in ("member", "state", "role",
+                                           "run_epoch", "tick",
+                                           "snapshots")}
+                    for m in members],
+        "death_downs": death_downs,
+        "stall_flaps": flaps,
+        "rejoins": len(rejoins),
+        "restart_bases": bases,
+        "final_tick": final_tick,
+        "counters_reconciled": reconciled,
+        "events_total": len(events),
+    }
+
+
 def verify(args, ref_dir: str, crash_dir: str, sup, observed_kills: list,
            failures: list[str]) -> dict:
     ref_alerts = parse_alert_stream(os.path.join(ref_dir, "alerts.jsonl"))
@@ -431,12 +548,20 @@ def main() -> int:
                          "detect SLOs don't apply here, docs/SLO.md). "
                          "'off' disables")
     ap.add_argument("--restart-backoff", type=float, default=0.05)
+    ap.add_argument("--fleet", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run an in-process fleet aggregator and judge "
+                         "the restart story through the fleet plane too "
+                         "(staleness DOWN per kill, rejoin per restart, "
+                         "merged counters reconcile — docs/FLEET.md)")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--out", default=None, help="report JSON path")
     # child-mode flags
     ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--spec", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--stats-out", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--fleet-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.child:
         return run_child(args)
@@ -468,9 +593,21 @@ def main() -> int:
                      for i in range(args.kills))
     log(f"kill schedule (ticks): {targets}")
 
-    # 3. supervised crashy run
+    # 3. supervised crashy run (the parent's aggregator watches it
+    # through the fleet plane: kills land as staleness DOWNs, restarts
+    # as rejoins — the reference run stays off the plane so the fleet
+    # story is the crash run's alone)
+    agg = None
+    if args.fleet:
+        from rtap_tpu.fleet import FleetAggregator
+
+        agg = FleetAggregator(
+            port=0,
+            sweep_interval_s=max(0.02, min(0.2, args.cadence))).start()
+        log(f"fleet aggregator on :{agg.port}")
     sup = Supervisor(
-        child_cmd(args, crash_dir, None),
+        child_cmd(args, crash_dir, None,
+                  fleet_port=agg.port if agg is not None else 0),
         restart_budget=args.kills + 2,
         backoff_base_s=args.restart_backoff,
         backoff_max_s=max(1.0, args.restart_backoff * 4),
@@ -492,6 +629,13 @@ def main() -> int:
 
     # 4. verdict
     report_body = verify(args, ref_dir, crash_dir, sup, observed, failures)
+    if agg is not None:
+        report_body["fleetobs"] = fleet_verdict(
+            agg, args, os.path.join(crash_dir, "stats.jsonl"), failures)
+        with open(os.path.join(crash_dir, "fleet_snapshot.json"),
+                  "w") as f:
+            json.dump(agg.snapshot(), f, indent=2)
+        agg.close()
     report = {
         "seed": args.seed,
         "kills_scheduled": targets,

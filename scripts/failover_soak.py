@@ -115,6 +115,18 @@ def run_child(args) -> int:
     lease = None
     resume_sup = None
     promote_info = None
+    fleet_pub = None
+    if not args.ref and args.fleet_port:
+        # fleet observability plane (ISSUE 19): this child is a member;
+        # the parent's in-process aggregator reads the verdict evidence
+        # (DOWN -> role_changed sequence, merged counters/SLO) through
+        # the plane instead of scraping per-child artifacts. Push faster
+        # than the takeover window so event ORDER is evidence.
+        from rtap_tpu.fleet import FleetPublisher
+
+        fleet_pub = FleetPublisher(
+            ("127.0.0.1", args.fleet_port), args.name, role="standby",
+            push_interval_s=max(0.02, args.cadence / 2))
     if not args.ref:
         lease = Lease(os.path.join(w, "lease"), owner=args.name,
                       timeout_s=args.lease_timeout)
@@ -127,6 +139,8 @@ def run_child(args) -> int:
         # second leader — it FOLLOWS, and earns leadership only through
         # the promotion path (which fences the other side properly)
         if args.follow or fresh_other or not lease.try_acquire():
+            if fleet_pub is not None:
+                fleet_pub.start()  # the standby phase is on the plane too
             follower = StandbyFollower(
                 reg, journal, lease=lease, port=args.listen,
                 alert_path=alerts, checkpoint_dir=ckdir,
@@ -135,6 +149,8 @@ def run_child(args) -> int:
             outcome = follower.run()
             if outcome == "stopped":
                 journal.close()
+                if fleet_pub is not None:
+                    fleet_pub.close()  # orderly BYE: "left", not DOWN
                 return 0
             resume_sup = follower.resume_suppression
             promote_info = {
@@ -148,9 +164,17 @@ def run_child(args) -> int:
         # leadership liveness = PROCESS alive: the heartbeat thread
         # keeps the lease fresh through multi-second checkpoint rounds
         lease.start_heartbeat()
+        if fleet_pub is not None:
+            # promotion (or immediate leadership): same member, new
+            # role, the lease epoch the parent checks against truth.
+            # start() is idempotent — the standby path already pushes.
+            fleet_pub.set_role("leader", lease_epoch=lease.epoch)
+            fleet_pub.start()
 
     base = max(journal.next_tick, peek_resume_ticks(ckdir))
     n_eff = max(0, args.ticks - base)
+    if fleet_pub is not None:
+        fleet_pub.set_tick_base(base)  # report journal-GLOBAL progress
 
     sender = None
     if not args.ref:
@@ -179,18 +203,22 @@ def run_child(args) -> int:
         if sender is not None:
             latency.lag_providers["repl_ack_ticks"] = \
                 lambda _t, _ts: sender.ack_lag_ticks()
+        if fleet_pub is not None:
+            fleet_pub.attach(latency=latency, slo=slo)
     stats = live_loop(
         source, reg, n_ticks=n_eff, cadence_s=args.cadence,
         alert_path=alerts, checkpoint_dir=ckdir,
         checkpoint_every=args.checkpoint_every, journal=journal,
         lease=lease, stop_event=stop, resume_suppression=resume_sup,
-        latency=latency, slo=slo)
+        latency=latency, slo=slo, fleet=fleet_pub)
     if sender is not None:
         sender.close()
         journal.tee = None
     if lease is not None:
         lease.stop_heartbeat()
     journal.close()
+    if fleet_pub is not None:
+        fleet_pub.close()  # final-state flush + orderly BYE
     line = {"name": "ref" if args.ref else args.name, "base": base,
             "ran": stats["ticks"], "alerts": stats["alerts"],
             "fenced": bool(stats.get("fenced")),
@@ -243,6 +271,8 @@ def child_cmd(args, workdir: str, name: str | None = None,
                 "--peer", str(peer)]
         if follow:
             cmd.append("--follow")
+        if getattr(args, "fleet_port", 0):
+            cmd += ["--fleet-port", str(args.fleet_port)]
     return cmd
 
 
@@ -261,6 +291,137 @@ def _wait(cond, timeout_s: float, poll_s: float = 0.02) -> bool:
             return True
         time.sleep(poll_s)
     return False
+
+
+def _member_counter(snap: dict, name: str):
+    for row in (snap.get("metrics") or {}).get("metrics", []):
+        if row.get("name") == name and row.get("type") == "counter":
+            return row.get("value", 0)
+    return None
+
+
+def fleet_verdict(agg, args, observed: list, fence_report,
+                  promotions: list, stats_lines: list,
+                  failures: list[str]) -> dict:
+    """Judge the FLEET-OBSERVED story against the lease/journal truth
+    (ISSUE 19): every takeover must appear on the plane as the old
+    leader going DOWN (staleness — a SIGKILLed process sends no BYE)
+    followed by a ``role_changed`` to leader on the successor; the
+    fleet-observed promotion epochs must equal the alert stream's
+    ``standby_promoted`` epochs; the budget's completion and the
+    completing leader's alert count must be visible through merged
+    fleet state alone."""
+    members = agg.members_view()
+    events = agg.events_view()
+    snaps = agg.member_snaps()
+    fl_slo = agg.fleet_slo()
+    checks: list[dict] = []
+
+    # the observed failover sequence, one anchor per scheduled takeover:
+    # DOWN(gone) then role_changed-to-leader(successor), in event order
+    seq = [e for e in events
+           if e["event"] == "down"
+           or (e["event"] == "role_changed" and e.get("role") == "leader")]
+    anchors = [(k["killed"], k["new_leader"], "kill") for k in observed]
+    if fence_report:
+        anchors.append((fence_report["paused"],
+                        fence_report["new_leader"], "fence"))
+    cursor = 0
+    for gone, succ, kind in anchors:
+        j = next((i for i in range(cursor, len(seq))
+                  if seq[i]["event"] == "down"
+                  and seq[i]["member"] == gone), None)
+        if j is None:
+            failures.append(f"fleet plane never marked the {kind}ed "
+                            f"leader {gone} DOWN")
+            checks.append({"kind": kind, "down": gone, "promoted": succ,
+                           "ok": False, "why": "no DOWN event"})
+            continue
+        r = next((i for i in range(j + 1, len(seq))
+                  if seq[i]["event"] == "role_changed"
+                  and seq[i]["member"] == succ), None)
+        if r is None:
+            failures.append(
+                f"fleet plane saw {gone} DOWN but no role_changed to "
+                f"leader on {succ} after it ({kind} round)")
+            checks.append({"kind": kind, "down": gone, "promoted": succ,
+                           "ok": False, "why": "no role_changed after"})
+            continue
+        checks.append({
+            "kind": kind, "down": gone, "promoted": succ, "ok": True,
+            "down_t_unix": seq[j]["t_unix"],
+            "promoted_t_unix": seq[r]["t_unix"],
+            "lease_epoch": seq[r].get("lease_epoch"),
+            "old_lease_epoch": seq[r].get("old_lease_epoch")})
+        cursor = r + 1
+
+    # epoch truth: every promotion the alert stream recorded must have
+    # been observed on the plane at the SAME lease epoch (and vice
+    # versa — the fleet sees unscheduled jitter promotions too)
+    fleet_epochs = sorted(e.get("lease_epoch") or 0 for e in seq
+                          if e["event"] == "role_changed")
+    truth_epochs = sorted(p.get("epoch") or 0 for p in promotions)
+    if fleet_epochs != truth_epochs:
+        failures.append(
+            f"fleet-observed promotion epochs {fleet_epochs} != "
+            f"lease/journal truth {truth_epochs}")
+
+    # budget completion is visible through the plane: the final-flush
+    # push of the completing leader carries the last GLOBAL tick
+    final_tick = max((m.get("tick") if m.get("tick") is not None else -1)
+                     for m in members) if members else -1
+    if final_tick != args.ticks - 1:
+        failures.append(
+            f"fleet plane never observed the budget completing "
+            f"(last member tick {final_tick}, want {args.ticks - 1})")
+
+    # merged counters reconcile: a stats line's "alerts" is every
+    # crossing the member SCORED; on the plane those split into emitted
+    # lines (rtap_obs_alerts_total) plus resume-suppressed
+    # already-delivered ids (rtap_obs_alerts_suppressed_total) — the
+    # sum must close the books (the per-child artifact is now
+    # corroboration, not source)
+    reconciled = {}
+    for line in stats_lines:
+        nm = line.get("name")
+        if nm not in snaps or line.get("fenced"):
+            continue  # a fenced zombie's counters are fence-dropped
+        emitted = _member_counter(snaps[nm], "rtap_obs_alerts_total")
+        suppressed = _member_counter(
+            snaps[nm], "rtap_obs_alerts_suppressed_total") or 0
+        reconciled[nm] = {"fleet_emitted": emitted,
+                          "fleet_suppressed": suppressed,
+                          "stats": line.get("alerts")}
+        if emitted is not None and \
+                emitted + suppressed != line.get("alerts"):
+            failures.append(
+                f"member {nm}: fleet-pushed emitted+suppressed "
+                f"{emitted}+{suppressed} != its stats-line crossing "
+                f"count {line.get('alerts')}")
+
+    # fleet SLO comes from MERGED sketches (never max-of-member-p99s)
+    if args.slo != "off":
+        slos = fl_slo.get("slos") or []
+        if not slos:
+            failures.append("fleet plane carries no merged SLO verdict "
+                            "despite armed SLOs")
+        elif any(v.get("observed_quantile_s") is None
+                 for v in slos if v.get("samples")):
+            failures.append("fleet SLO verdict lacks a merged-sketch "
+                            "observed quantile")
+
+    return {
+        "members": [{k: m.get(k) for k in ("member", "state", "role",
+                                           "lease_epoch", "tick",
+                                           "snapshots")}
+                    for m in members],
+        "sequence": checks,
+        "promotion_epochs": fleet_epochs,
+        "final_tick": final_tick,
+        "counters_reconciled": reconciled,
+        "events_total": len(events),
+        "slo": fl_slo,
+    }
 
 
 def main() -> int:
@@ -301,6 +462,15 @@ def main() -> int:
                     action=argparse.BooleanOptionalAction, default=True,
                     help="add a SIGSTOP/SIGCONT round proving a paused "
                          "old leader is fenced out of the alert sink")
+    ap.add_argument("--fleet",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="host a fleet aggregator in the parent and make "
+                         "every HA child a fleet member: the takeover "
+                         "verdict (leader DOWN -> standby promoted at "
+                         "the successor epoch), merged counters, and "
+                         "the fleet SLO are then read through the fleet "
+                         "plane and judged against the lease/journal "
+                         "truth (docs/FLEET.md)")
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--out", default=None, help="report JSON path")
     # child-mode flags
@@ -310,6 +480,8 @@ def main() -> int:
     ap.add_argument("--name", default="A", help=argparse.SUPPRESS)
     ap.add_argument("--listen", type=int, default=0, help=argparse.SUPPRESS)
     ap.add_argument("--peer", type=int, default=0, help=argparse.SUPPRESS)
+    ap.add_argument("--fleet-port", type=int, default=0,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--stats-out", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
     if args.lease_timeout is None:
@@ -341,7 +513,19 @@ def main() -> int:
         log(f"FATAL: reference run failed rc={rc}")
         return INFRA_FAILED_EXIT
 
-    # 2. the HA pair: A first (acquires the lease), then B (standby)
+    # 2. the HA pair: A first (acquires the lease), then B (standby).
+    # The parent hosts the fleet aggregator IN-PROCESS (Python API, no
+    # HTTP hop): verdict evidence arrives through the plane.
+    agg = None
+    if args.fleet:
+        from rtap_tpu.fleet import FleetAggregator
+
+        agg = FleetAggregator(
+            port=0,
+            sweep_interval_s=max(0.02, min(0.2, args.cadence))).start()
+        args.fleet_port = agg.port
+        log(f"fleet aggregator on :{agg.port} (sweep "
+            f"{agg.sweep_interval_s}s)")
     ports = dict(zip("AB", _free_ports(2)))
     lease_path = os.path.join(ha_dir, "lease")
 
@@ -589,6 +773,18 @@ def main() -> int:
         (s.get("slo") for s in reversed(fenced_lines) if s.get("slo")),
         None)
 
+    # the fleet plane's verdict (ISSUE 19): the aggregator's observed
+    # story judged against the lease/journal truth above, and the whole
+    # merged state preserved as an artifact (scripts/fleet_report.py
+    # pretty-prints it; tests replay assertions against it)
+    fleetobs = None
+    if agg is not None:
+        fleetobs = fleet_verdict(agg, args, observed, fence_report,
+                                 promotions, fenced_lines, failures)
+        with open(os.path.join(ha_dir, "fleet_snapshot.json"), "w") as f:
+            json.dump(agg.snapshot(), f, indent=2)
+        agg.close()
+
     report = {
         "seed": args.seed,
         "kills_scheduled": targets,
@@ -613,6 +809,7 @@ def main() -> int:
         "unscheduled_fences": unscheduled_fences,
         "fenced_exits": fenced_stats,
         "slo_verdict": slo_verdict,
+        "fleetobs": fleetobs,
         "wall_s": round(time.monotonic() - t_all, 1),
         "verified": not failures,
         "failures": failures,

@@ -1,0 +1,225 @@
+"""Render a fleet observability report: member roster, merged rollups.
+
+One renderer for every surface the fleet plane exports (ISSUE 19):
+
+- ``--url BASE``      — a live obs server with an aggregator attached:
+  GET ``BASE/fleet/snapshot`` (serve --fleet-listen PORT --obs-port);
+- ``--snapshot FILE`` — a fleet snapshot JSON as written by the soak
+  harnesses (``ha/fleet_snapshot.json``, ``crash/fleet_snapshot.json``)
+  or saved from ``GET /fleet/snapshot``;
+- ``--report FILE``   — a soak report JSON whose ``fleetobs`` block
+  (failover_soak / crash_soak) becomes the report body: the
+  fleet-observed takeover/restart story next to its reconciliation.
+
+Prints ONE JSON line to stdout (the artifact contract shared with the
+benches) and a human-readable member table + fleet rollup to stderr.
+``--out FILE`` also writes the report as indented JSON (the
+committed-artifact form).
+
+Usage:
+  python scripts/fleet_report.py --url http://127.0.0.1:9100
+  python scripts/fleet_report.py --snapshot /tmp/soak/ha/fleet_snapshot.json
+  python scripts/fleet_report.py --report reports/fleetobs_r19.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _from_url(base: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(
+            base.rstrip("/") + "/fleet/snapshot", timeout=10) as r:
+        snap = json.loads(r.read())
+    return {"source": base, "fleet": snap}
+
+
+def _from_snapshot(path: str) -> dict:
+    with open(path) as f:
+        snap = json.load(f)
+    if "members" not in snap:
+        raise SystemExit(f"{path} is not a fleet snapshot (no members "
+                         "roster) — expected agg.snapshot() JSON")
+    return {"source": os.path.abspath(path), "fleet": snap}
+
+
+def _from_report(path: str) -> dict:
+    with open(path) as f:
+        rep = json.load(f)
+    fo = rep.get("fleetobs")
+    if not fo:
+        raise SystemExit(
+            f"{path} carries no fleetobs block — was the soak run with "
+            "the fleet plane enabled (--fleet)?")
+    return {"source": os.path.abspath(path), "fleetobs": fo,
+            "verified": rep.get("verified"),
+            "failures": rep.get("failures", [])}
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    v = float(v)
+    return f"{v * 1e3:.2f}ms" if v < 1.0 else f"{v:.3f}s"
+
+
+def _member_rows(members: list[dict]) -> list[str]:
+    lines = [f"Members ({len(members)}):",
+             f"  {'member':<16} {'state':<6} {'role':<10} "
+             f"{'epoch':>5} {'tick':>8} {'pushes':>7} {'age':>8}"]
+    for m in members:
+        age = m.get("last_push_age_s")
+        lines.append(
+            f"  {str(m.get('member')):<16} {str(m.get('state')):<6} "
+            f"{str(m.get('role')):<10} "
+            f"{m.get('lease_epoch') if m.get('lease_epoch') is not None else '-':>5} "
+            f"{m.get('tick') if m.get('tick') is not None else '-':>8} "
+            f"{m.get('snapshots', 0):>7} "
+            f"{_fmt_s(age) if age is not None else '-':>8}")
+    return lines
+
+
+def _rollup_rows(snap: dict) -> list[str]:
+    """The fleet rollup: summed counters, merged SLO, merged latency,
+    worst-of health, incident totals (docs/FLEET.md merge semantics)."""
+    lines: list[str] = []
+    counters = (snap.get("metrics") or {}).get("counters") or []
+    if counters:
+        lines.append("Fleet counters (summed across members):")
+        for c in counters:
+            lbl = ",".join(f"{k}={v}"
+                           for k, v in sorted((c.get("labels")
+                                               or {}).items()))
+            name = c["name"] + (f"{{{lbl}}}" if lbl else "")
+            lines.append(f"  {name:<52} {c['value']:>12g} "
+                         f"({c['members']} member(s))")
+    slo = snap.get("slo")
+    if slo and slo.get("slos"):
+        lines.append(
+            f"Fleet SLO verdict (merged sketches): "
+            f"{'MET' if slo.get('met') else 'MISSED'}")
+        for v in slo["slos"]:
+            status = ("n/a" if v["met"] is None
+                      else "met" if v["met"] else "MISS")
+            lines.append(
+                f"  {v['slo']:<22} {status:<4} "
+                f"observed {_fmt_s(v.get('observed_quantile_s')):>10} "
+                f"bad {v['bad']}/{v['samples']} "
+                f"members {','.join(v.get('members', []))}")
+    lat = snap.get("latency")
+    if lat and lat.get("stages"):
+        lines.append("Fleet stage quantiles (merged sketches):")
+        for name, sk in sorted(lat["stages"].items()):
+            q = sk.get("total") or {}
+            lines.append(
+                f"  {name:<10} p50 {_fmt_s(q.get('p50')):>10} "
+                f"p95 {_fmt_s(q.get('p95')):>10} "
+                f"p99 {_fmt_s(q.get('p99')):>10} n={q.get('count', 0)}")
+    health = snap.get("health")
+    if health and health.get("verdict") is not None:
+        lines.append(f"Fleet health (worst-of): {health['verdict']} "
+                     f"({health.get('groups_total', 0)} group(s) across "
+                     f"{len(health.get('members') or {})} member(s))")
+    inc = snap.get("incidents")
+    if inc and inc.get("members"):
+        lines.append(
+            f"Incidents: {inc.get('open_windows_total', 0)} open "
+            f"window(s), {inc.get('incidents_emitted_total', 0)} "
+            f"emitted fleet-wide")
+    events = snap.get("events") or []
+    if events:
+        lines.append(f"Last events ({len(events)} total):")
+        for e in events[-8:]:
+            extra = ""
+            if e["event"] == "role_changed":
+                extra = (f" {e.get('old_role')}->{e.get('role')} "
+                         f"epoch {e.get('lease_epoch')}")
+            elif e["event"] == "down":
+                extra = f" after {_fmt_s(e.get('last_push_age_s'))}"
+            lines.append(f"  {e['event']:<12} {e['member']}{extra}")
+    return lines
+
+
+def _fleetobs_rows(rep: dict) -> list[str]:
+    """A soak's fleet-observed story: the takeover/restart sequence the
+    plane saw, judged against the lease/journal truth."""
+    fo = rep["fleetobs"]
+    lines = []
+    if rep.get("verified") is not None:
+        lines.append(f"Soak verdict: "
+                     f"{'VERIFIED' if rep['verified'] else 'FAILED'}")
+        for msg in rep.get("failures", []):
+            lines.append(f"  FAIL: {msg}")
+    lines.extend(_member_rows(fo.get("members") or []))
+    for c in fo.get("sequence") or []:
+        status = "ok" if c.get("ok") else f"FAIL ({c.get('why')})"
+        lines.append(f"  {c['kind']:<6} {c['down']} DOWN -> "
+                     f"{c['promoted']} promoted "
+                     f"(epoch {c.get('lease_epoch')}): {status}")
+    if "death_downs" in fo:
+        lines.append(f"  restarts: {fo.get('rejoins')} rejoin(s), "
+                     f"{fo['death_downs']} death DOWN(s), "
+                     f"{fo.get('stall_flaps', 0)} stall flap(s), "
+                     f"resume bases {fo.get('restart_bases')}")
+    if fo.get("promotion_epochs"):
+        lines.append(f"  promotion epochs (fleet-observed): "
+                     f"{fo['promotion_epochs']}")
+    lines.append(f"  final tick through the plane: {fo.get('final_tick')}")
+    rec = fo.get("counters_reconciled")
+    if rec:
+        lines.append(f"  counters reconciled: {json.dumps(rec)}")
+    return lines
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="live obs server base URL "
+                                   "(GET /fleet/snapshot)")
+    src.add_argument("--snapshot", help="fleet snapshot JSON (soak "
+                                        "artifact or saved route body)")
+    src.add_argument("--report", help="soak report JSON with a fleetobs "
+                                      "block")
+    ap.add_argument("--out", default=None,
+                    help="also write the report as indented JSON "
+                         "(the committed-artifact form)")
+    args = ap.parse_args()
+
+    if args.url:
+        rep = _from_url(args.url)
+    elif args.snapshot:
+        rep = _from_snapshot(args.snapshot)
+    else:
+        rep = _from_report(args.report)
+
+    if "fleet" in rep:
+        lines = _member_rows(rep["fleet"].get("members") or [])
+        lines += _rollup_rows(rep["fleet"])
+    else:
+        lines = _fleetobs_rows(rep)
+    for line in lines:
+        print(line, file=sys.stderr)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=2)
+    print(json.dumps(rep))
+    fl = rep.get("fleet") or {}
+    down = [m for m in (fl.get("members") or [])
+            if m.get("state") == "down"]
+    if rep.get("verified") is False or down:
+        return 4
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Splice per-process Chrome traces onto one fleet Perfetto timeline.
+
+The CLI over ``rtap_tpu.fleet.stitch_traces`` (ISSUE 19): feed it the
+trace JSONs the processes exported (``GET /trace`` bodies, soak
+artifacts) and it rebases every one onto the earliest recorder epoch —
+a killed leader's final ticks and its standby's promotion spans land in
+causal order on ONE timeline, each on its own named process track.
+
+``--members`` takes a fleet snapshot JSON (``ha/fleet_snapshot.json``,
+``GET /fleet/snapshot``) or a bare ``/fleet/members`` roster; the
+registration clock offsets in it correct wall-clock disagreement
+between hosts (the HELLO clock-alignment handshake) — without it the
+stitch trusts each process's own wall clock.
+
+Usage:
+  python scripts/fleet_trace.py leader.trace.json standby.trace.json \
+      --members /tmp/soak/ha/fleet_snapshot.json -o fleet.trace.json
+  # then load fleet.trace.json in https://ui.perfetto.dev
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rtap_tpu.fleet import stitch_traces  # noqa: E402
+
+
+def _load_members(path: str) -> list[dict]:
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):  # a bare /fleet/members body
+        return doc
+    return doc.get("members") or []
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("traces", nargs="+", metavar="TRACE_JSON",
+                    help="per-process Chrome trace files (GET /trace "
+                         "bodies; obs/trace.py chrome_trace() docs)")
+    ap.add_argument("--members", default=None,
+                    help="fleet snapshot or /fleet/members JSON whose "
+                         "clock_offset_s corrects each trace (matched "
+                         "by pid)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the stitched trace here (default: "
+                         "stdout)")
+    args = ap.parse_args()
+
+    docs = []
+    for path in args.traces:
+        with open(path) as f:
+            doc = json.load(f)
+        if "traceEvents" not in doc:
+            raise SystemExit(f"{path} is not a Chrome trace "
+                             "(no traceEvents)")
+        docs.append(doc)
+    members = _load_members(args.members) if args.members else None
+    stitched = stitch_traces(docs, members=members)
+    other = stitched["otherData"]
+    print(f"[fleet-trace] stitched {other.get('stitched_from', 0)} "
+          f"trace(s), {len(stitched['traceEvents'])} events",
+          file=sys.stderr)
+    for p in other.get("processes", []):
+        print(f"[fleet-trace]   {p.get('process_name')} pid "
+              f"{p.get('pid')} -> track {p.get('stitched_pid')} "
+              f"(+{p.get('shift_us', 0)}us)", file=sys.stderr)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(stitched) + "\n")
+    else:
+        print(json.dumps(stitched))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

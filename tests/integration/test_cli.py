@@ -240,3 +240,26 @@ def test_nab_command_missing_corpus_fails_loudly(tmp_path):
     p = run_cli("nab", "--corpus", str(tmp_path / "nowhere"))
     assert p.returncode == 2
     assert "combined_windows.json" in p.stderr
+
+
+def test_serve_fleet_flag_usage_errors():
+    """The --fleet-* gates fire BEFORE backend init (exit 2 + message),
+    the same contract as every other serve flag (ISSUE 19)."""
+    p = run_cli("serve", "--streams", "a", "--fleet-join", "nocolon")
+    assert p.returncode == 2
+    assert "bad --fleet-join" in p.stderr
+    p = run_cli("serve", "--streams", "a", "--fleet-join", "host:99999")
+    assert p.returncode == 2
+    assert "bad --fleet-join" in p.stderr
+    # the aggregator's merged views ride the obs server: no --obs-port,
+    # no /fleet/* routes to serve them on
+    p = run_cli("serve", "--streams", "a", "--fleet-listen", "0")
+    assert p.returncode == 2
+    assert "--obs-port" in p.stderr
+    p = run_cli("serve", "--streams", "a", "--fleet-push-interval", "0.5")
+    assert p.returncode == 2
+    assert "--fleet-join" in p.stderr
+    p = run_cli("serve", "--streams", "a",
+                "--fleet-join", ":9999", "--fleet-push-interval", "0")
+    assert p.returncode == 2
+    assert "must be > 0" in p.stderr

@@ -1,0 +1,171 @@
+"""Fleet observability plane end-to-end (ISSUE 19, rtap_tpu/fleet/).
+
+In-process members against a real aggregator over real sockets:
+
+- registration (HELLO) + periodic SNAP pushes land in the member table;
+- a standby's ``set_role`` surfaces as a ``role_changed`` event — the
+  exact sequence failover_soak judges against the lease truth;
+- abrupt death (socket gone, no BYE) is marked DOWN by staleness, and
+  a same-name re-HELLO is a ``rejoined``; an orderly close is ``left``;
+- merged views: counters sum across members, fleet SLO pools window
+  counts over merged sketches;
+- the ``/fleet/*`` routes ride the obs HTTP server, 404ing with a hint
+  when no aggregator is attached.
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtap_tpu.fleet import (
+    FLEET_HELLO,
+    FLEET_SNAP,
+    FleetAggregator,
+    FleetPublisher,
+    pack_fleet,
+)
+from rtap_tpu.obs.expo import ExpositionServer
+from rtap_tpu.obs.metrics import TelemetryRegistry
+from rtap_tpu.obs.slo import tick_slo_pair
+
+pytestmark = pytest.mark.quick
+
+
+def _wait(cond, timeout_s=8.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _pub(agg, name, registry, role="leader", **kw):
+    return FleetPublisher(("127.0.0.1", agg.port), name, role=role,
+                          push_interval_s=0.05, registry=registry, **kw)
+
+
+def test_members_promotion_and_merged_views():
+    agg = FleetAggregator(port=0, sweep_interval_s=0.02)
+    agg.start()
+    try:
+        ra, rb = TelemetryRegistry(), TelemetryRegistry()
+        ra.counter("rtap_obs_scored_total", "h").inc(40)
+        rb.counter("rtap_obs_scored_total", "h").inc(2)
+        lat_a, slo_a = tick_slo_pair(0.05, None)
+        lat_b, slo_b = tick_slo_pair(0.05, None)
+        rng = np.random.default_rng(0)
+        for _ in range(60):  # A fast, B slow: merged p99 must see B
+            lat_a.sketches["tick"].observe(0.001)
+            lat_b.sketches["tick"].observe(float(rng.uniform(0.2, 0.4)))
+        a = _pub(agg, "A", ra, latency=lat_a, slo=slo_a).start()
+        b = _pub(agg, "B", rb, role="standby", latency=lat_b,
+                 slo=slo_b).start()
+        assert agg.wait_members(2)
+        a.note_tick(7)
+        roster = {m["member"]: m for m in agg.members_view()}
+        assert roster["A"]["role"] == "leader"
+        assert roster["B"]["role"] == "standby"
+        assert roster["A"]["pid"] is not None
+        assert _wait(lambda: {m["member"]: m for m in agg.members_view()}
+                     ["A"]["tick"] == 7)
+
+        # counters SUM across members; gauges label per member
+        fm = agg.fleet_metrics()
+        scored = next(c for c in fm["counters"]
+                      if c["name"] == "rtap_obs_scored_total")
+        assert scored["value"] == 42 and scored["members"] == 2
+
+        # fleet latency/SLO from MERGED sketches: B's slow mode decides
+        # the fleet p99 even though A pushed far more samples
+        fl = agg.fleet_latency()
+        assert fl["stages"]["tick"]["total"]["count"] == 120
+        assert fl["stages"]["tick"]["total"]["p99"] >= 0.2
+
+        # promotion: same member, new role -> role_changed with epochs
+        b.set_role("leader", lease_epoch=2)
+        assert _wait(lambda: any(
+            e["event"] == "role_changed" and e["member"] == "B"
+            for e in agg.events_view()))
+        ev = next(e for e in agg.events_view()
+                  if e["event"] == "role_changed")
+        assert ev["role"] == "leader" and ev["old_role"] == "standby"
+        assert ev["lease_epoch"] == 2
+
+        # orderly close = LEFT (BYE), never DOWN
+        b.close()
+        assert _wait(lambda: {m["member"]: m["state"]
+                              for m in agg.members_view()}["B"] == "left")
+        a.close()
+    finally:
+        agg.close()
+
+
+def test_staleness_down_then_rejoin():
+    """A kill-9'd member sends no BYE: its silence crosses the declared
+    staleness horizon -> DOWN; the supervisor's replacement re-HELLOs
+    the same name -> rejoined. This is crash_soak's restart evidence."""
+    agg = FleetAggregator(port=0, sweep_interval_s=0.02)
+    agg.start()
+    try:
+        def raw_hello(sock):
+            sock.sendall(pack_fleet(FLEET_HELLO, {
+                "member": "M", "role": "leader", "down_after_s": 0.15,
+                "clock": {"unix": time.time()}}))
+            sock.sendall(pack_fleet(FLEET_SNAP,
+                                    {"member": "M", "seq": 1, "tick": 3}))
+
+        s = socket.create_connection(("127.0.0.1", agg.port), timeout=5)
+        raw_hello(s)
+        assert agg.wait_members(1)
+        s.close()  # abrupt: no BYE — only staleness may declare DOWN
+        assert _wait(lambda: {m["member"]: m["state"]
+                              for m in agg.members_view()}["M"] == "down")
+        assert any(e["event"] == "down" and e["member"] == "M"
+                   for e in agg.events_view())
+        s2 = socket.create_connection(("127.0.0.1", agg.port), timeout=5)
+        raw_hello(s2)
+        assert _wait(lambda: any(e["event"] == "rejoined"
+                                 and e["member"] == "M"
+                                 for e in agg.events_view()))
+        s2.close()
+    finally:
+        agg.close()
+
+
+def test_fleet_routes_on_obs_server():
+    agg = FleetAggregator(port=0, sweep_interval_s=0.05)
+    agg.start()
+    reg = TelemetryRegistry()
+    reg.counter("rtap_obs_ticks_total", "h").inc(5)
+    pub = _pub(agg, "solo", reg).start()
+    try:
+        assert agg.wait_members(1)
+        with ExpositionServer(registry=reg, fleet=agg) as srv:
+            host, port = srv.address
+            base = f"http://{host}:{port}"
+            members = json.loads(urllib.request.urlopen(
+                base + "/fleet/members", timeout=10).read())
+            assert members[0]["member"] == "solo"
+            snap = json.loads(urllib.request.urlopen(
+                base + "/fleet/snapshot", timeout=10).read())
+            assert "solo" in snap["snaps"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/fleet/nope", timeout=10)
+            assert ei.value.code == 404
+        # an aggregator-less obs server 404s with the enabling flag
+        with ExpositionServer(registry=reg) as srv2:
+            host, port = srv2.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/fleet/members", timeout=10)
+            assert ei.value.code == 404
+            assert "fleet-listen" in ei.value.reason
+    finally:
+        pub.close()
+        agg.close()
