@@ -53,6 +53,39 @@ def _sized_cluster(args: argparse.Namespace):
 
 
 def _cmd_serve(args: argparse.Namespace) -> int:
+    # control plane (ISSUE 20, docs/RESILIENCE.md "Control plane"): owns
+    # one fencing lease per shard + membership + the shard map, epochs
+    # journaled write-ahead. Started before any service import so a
+    # --control-only process never touches the accelerator stack.
+    control_plane = None
+    if args.control_listen is not None:
+        from rtap_tpu.fleet.control import ControlPlane
+
+        try:
+            control_plane = ControlPlane(
+                args.control_journal, port=args.control_listen,
+                lease_timeout_s=args.lease_timeout).start()
+        except (OSError, ValueError) as e:
+            print(f"serve: control plane failed to start: {e}",
+                  file=sys.stderr)
+            return 2
+        chost, cport = control_plane.address
+        print(f"serve: control plane on {chost}:{cport} (journal "
+              f"{args.control_journal}, {control_plane.recovered_shards} "
+              "shard lease(s) recovered)", file=sys.stderr)
+        if args.control_only:
+            import signal
+            import threading
+
+            cstop = threading.Event()
+            for _sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(_sig, lambda *_: cstop.set())
+            cstop.wait()
+            stats = control_plane.stats()
+            control_plane.close()
+            print(json.dumps({"control": stats, "stopped": True}))
+            return 0
+
     from rtap_tpu.config import nab_preset
     from rtap_tpu.service.loop import live_loop
     from rtap_tpu.service.registry import StreamGroupRegistry
@@ -63,10 +96,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # shard-resource gate): one serve process = one mesh shard, and its
     # journal dir, checkpoint claims, lease file, and alert sink (plus
     # the .corr/.epoch sidecars derived from it downstream) must be
-    # distinct per shard. Today's single-shard serve is shard 0 —
-    # shard_scoped_path returns every path byte-identical — and
-    # ROADMAP-1's mesh launcher lands its shard index here.
-    serve_shard = 0
+    # distinct per shard. --shard is the index ROADMAP-1's mesh launcher
+    # (and the control-plane shard map) lands here; the single-shard
+    # default is shard 0, where shard_scoped_path returns every path
+    # byte-identical.
+    serve_shard = int(getattr(args, "shard", 0) or 0)
     for _attr in ("journal_dir", "checkpoint_dir", "lease_file", "alerts"):
         if getattr(args, _attr, None):
             setattr(args, _attr,
@@ -263,6 +297,27 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             # heartbeat keeps the lease fresh through multi-second
             # synchronous work (checkpoint rounds)
             lease.start_heartbeat()
+    elif args.control_join:
+        # same FencingLease surface, control-plane backend (ISSUE 20):
+        # the loop, alert fence, follower and heartbeat cannot tell the
+        # two apart — only the acquire/degrade semantics differ
+        from rtap_tpu.fleet.control import ControlLease, parse_control_addr
+
+        lease = ControlLease(
+            parse_control_addr(args.control_join),
+            owner=f"{os.uname().nodename}:{os.getpid()}",
+            shard=serve_shard, timeout_s=args.lease_timeout,
+            degraded_grace_s=args.control_grace)
+        lease.hello("standby" if args.standby else "leader")
+        if not args.standby:
+            if not lease.try_acquire():
+                print(f"serve: control plane {args.control_join} refused "
+                      f"the shard {serve_shard} lease (held by "
+                      f"{lease.holder()!r}, in its restart grace, or "
+                      "unreachable) — start with --standby, or wait out "
+                      "the lease timeout", file=sys.stderr)
+                return 2
+            lease.start_heartbeat()
     # (--columns + non-cluster presets rejected in main() before backend init)
     if args.preset == "nab":
         cfg = nab_preset()
@@ -319,6 +374,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         prev[sig] = signal.signal(sig, _on_signal)
+    if lease is not None and hasattr(lease, "on_drain"):
+        # a control-plane drain mark becomes an orderly between-tick
+        # exit: same path as SIGTERM, so final state is saved and the
+        # stats line printed — the rolling-upgrade primitive
+        lease.on_drain = stop.set
     # fleet observability plane, member side (ISSUE 19, rtap_tpu/fleet/,
     # docs/FLEET.md): started BEFORE the standby block so the aggregator
     # watches the whole standby phase — the follow loop, the promotion
@@ -352,7 +412,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             learn=not args.freeze, cadence_s=args.cadence,
             stop_event=stop)
         print(f"serve: standby following on port "
-              f"{args.replicate_listen} (lease {args.lease_file}, "
+              f"{args.replicate_listen} (lease "
+              f"{args.lease_file or f'control:{args.control_join}'}, "
               f"timeout {args.lease_timeout}s)", file=sys.stderr)
         outcome = follower.run()
         if outcome == "stopped":
@@ -529,7 +590,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     # always-on identity gauge (ISSUE 19 satellite): every snapshot,
     # scrape, and fleet push says who this process is — a serve reaching
     # this point serves as the leader (a standby already promoted above)
-    set_build_info(role="leader", shard=0, run_epoch=run_epoch, config=cfg)
+    set_build_info(role="leader", shard=serve_shard, run_epoch=run_epoch,
+                   config=cfg)
     if fleet_pub is not None:
         fleet_pub.set_role("leader", run_epoch=run_epoch)
         fleet_pub.attach(health=health, latency=latency, slo=slo_tracker,
@@ -634,6 +696,19 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 source.announce_leader(hint)
                 print(f"serve: pushed MAP re-point to new leader {hint}",
                       file=sys.stderr)
+        if lease is not None and hasattr(lease, "degraded"):
+            stats["control_lease"] = lease.stats()
+        if lease is not None and getattr(lease, "draining", False):
+            # drained by the control plane: an ORDERLY handoff — release
+            # the lease (epoch floor retained server-side) so the standby
+            # promotes immediately instead of waiting out staleness
+            lease.stop_heartbeat()
+            rel = getattr(lease, "release", None)
+            if rel is not None:
+                rel()
+            stats["drained"] = True
+            print(f"serve: shard {serve_shard} drained — lease released, "
+                  "the standby takes over", file=sys.stderr)
     finally:
         if jax_tracing:
             try:
@@ -655,14 +730,21 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             journal.close()
         if fleet_pub is not None:
             # joined push-thread exit with a best-effort BYE; an abrupt
-            # death instead goes stale and the aggregator marks it DOWN
-            fleet_pub.close()
+            # death instead goes stale and the aggregator marks it DOWN.
+            # A drain exit says so in the BYE — fleet_report must not
+            # read a rolling upgrade as an outage.
+            fleet_pub.close(
+                reason="drain" if (lease is not None
+                                   and getattr(lease, "draining", False))
+                else None)
         if obs_server is not None:
             obs_server.close()
         if fleet_agg is not None:
             # after the obs server: no /fleet/* route may race a closed
             # aggregator
             fleet_agg.close()
+        if control_plane is not None:
+            control_plane.close()
         if args.trace_out and trace is not None:
             # Perfetto-loadable Chrome trace JSON, atomically (tmp +
             # replace): written even on an error path — the timeline of
@@ -852,7 +934,7 @@ def main(argv: list[str] | None = None) -> int:
     sub = ap.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("serve", help="live scoring loop fed by TCP push or HTTP poll")
-    p.add_argument("--streams", required=True,
+    p.add_argument("--streams", default=None,
                    help="comma-separated stream ids to register, or "
                         "@/path/to/file with one id per line (argv has a "
                         "~128 KB single-argument limit; fleets above a few "
@@ -990,6 +1072,47 @@ def main(argv: list[str] | None = None) -> int:
                         "promote; detection ~= 1.5x timeout, so keep "
                         "the timeout <= ~5 cadences for a 10-tick "
                         "takeover budget)")
+    p.add_argument("--shard", type=int, default=0,
+                   help="this serve's mesh shard index: scopes the "
+                        "journal/checkpoint/lease/alert paths per shard "
+                        "(the ISSUE 15 shard-resource gate) and names "
+                        "the control-plane lease this process claims "
+                        "under --control-join")
+    p.add_argument("--control-listen", type=int, default=None,
+                   metavar="PORT",
+                   help="host the fleet CONTROL PLANE on localhost PORT "
+                        "(0 = ephemeral): one fencing lease per shard, "
+                        "membership/claims, and the shard map. Needs "
+                        "--control-journal — every epoch grant is "
+                        "journaled write-ahead (RJ framing, fsync before "
+                        "the reply), so a kill-9'd control plane "
+                        "restarts with epochs strictly monotonic "
+                        "(docs/RESILIENCE.md control plane)")
+    p.add_argument("--control-journal", default=None, metavar="DIR",
+                   help="the control plane's write-ahead journal dir — "
+                        "the epoch-durability root for --control-listen")
+    p.add_argument("--control-only", action="store_true",
+                   help="run ONLY the control plane (no data plane): "
+                        "serve leases/membership until SIGTERM, then "
+                        "print a stats line — the process "
+                        "scripts/fleet_chaos.py kills and restarts. "
+                        "Needs --control-listen")
+    p.add_argument("--control-join", default=None, metavar="HOST:PORT",
+                   help="hold this shard's lease THROUGH the control "
+                        "plane at HOST:PORT instead of a --lease-file: "
+                        "acquire/heartbeat/fence over control RPCs. An "
+                        "unreachable plane degrades — the loop keeps "
+                        "ticking on the cached lease for a bounded, "
+                        "counted window (--control-grace), then "
+                        "self-fences; a standby never promotes on "
+                        "control-plane silence (docs/RESILIENCE.md)")
+    p.add_argument("--control-grace", type=float, default=None,
+                   metavar="SECONDS",
+                   help="the bounded cached-lease window under "
+                        "--control-join (default max(10x lease timeout, "
+                        "30s)): a control plane unreachable past this "
+                        "self-fences the holder — fail-safe, never "
+                        "split-brain")
     p.add_argument("--learn-every", type=int, default=1,
                    help="learning cadence: learn every k-th tick once the "
                         "likelihood learning_period has passed (SCALING.md "
@@ -1494,13 +1617,15 @@ def main(argv: list[str] | None = None) -> int:
         print("serve: --replicate-to ships the write-ahead journal — add "
               "--journal-dir", file=sys.stderr)
         return 2
-    if getattr(args, "replicate_to", None) and not getattr(args, "lease_file", None) \
+    if getattr(args, "replicate_to", None) \
+            and not getattr(args, "lease_file", None) \
+            and not getattr(args, "control_join", None) \
             and not getattr(args, "standby", False):
-        print("serve: --replicate-to needs --lease-file — a leader "
-              "without the lease cannot be fenced, and its standby "
-              "(which requires the lease) would find it absent and "
-              "promote immediately: two live leaders on one alert sink",
-              file=sys.stderr)
+        print("serve: --replicate-to needs --lease-file (or "
+              "--control-join) — a leader without the lease cannot be "
+              "fenced, and its standby (which requires the lease) would "
+              "find it absent and promote immediately: two live leaders "
+              "on one alert sink", file=sys.stderr)
         return 2
     if getattr(args, "replicate_to", None) \
             and not getattr(args, "checkpoint_dir", None):
@@ -1515,7 +1640,9 @@ def main(argv: list[str] | None = None) -> int:
             ("--replicate-listen", args.replicate_listen is not None),
             ("--journal-dir", bool(args.journal_dir)),
             ("--checkpoint-dir", bool(args.checkpoint_dir)),
-            ("--lease-file", bool(args.lease_file)),
+            ("--lease-file or --control-join",
+             bool(args.lease_file) or bool(getattr(args, "control_join",
+                                                   None))),
         ) if not v]
         if missing:
             print(f"serve: --standby needs {', '.join(missing)} (the "
@@ -1603,6 +1730,65 @@ def main(argv: list[str] | None = None) -> int:
             print("serve: --fleet-push-interval must be > 0",
                   file=sys.stderr)
             return 2
+    if getattr(args, "control_listen", None) is not None:
+        if not (0 <= args.control_listen < 65536):
+            print("serve: --control-listen must be a TCP port "
+                  "(0 = ephemeral)", file=sys.stderr)
+            return 2
+        if not getattr(args, "control_journal", None):
+            print("serve: --control-listen needs --control-journal — the "
+                  "write-ahead epoch journal is what keeps fencing "
+                  "monotonic across a control-plane crash",
+                  file=sys.stderr)
+            return 2
+    if getattr(args, "control_journal", None) \
+            and getattr(args, "control_listen", None) is None:
+        print("serve: --control-journal is the control plane's journal "
+              "dir; add --control-listen PORT", file=sys.stderr)
+        return 2
+    if getattr(args, "control_only", False) \
+            and getattr(args, "control_listen", None) is None:
+        print("serve: --control-only runs just the control plane; add "
+              "--control-listen PORT (and --control-journal)",
+              file=sys.stderr)
+        return 2
+    if args.command == "serve" and args.streams is None \
+            and not getattr(args, "control_only", False):
+        # --streams is only optional for the pure control-plane process
+        # (it scores nothing); every data-plane serve must name its fleet
+        print("serve: --streams is required (only --control-only runs "
+              "without a stream fleet)", file=sys.stderr)
+        return 2
+    if getattr(args, "control_join", None):
+        if getattr(args, "lease_file", None):
+            print("serve: --control-join and --lease-file are exclusive "
+                  "— one lease authority per process (under a control "
+                  "plane, IT owns the shard lease)", file=sys.stderr)
+            return 2
+        chost, csep, cport_s = args.control_join.rpartition(":")
+        try:
+            cport = int(cport_s)
+        except ValueError:
+            cport = -1
+        if not csep or not (0 < cport < 65536):
+            print(f"serve: bad --control-join {args.control_join!r} — "
+                  "expected HOST:PORT (the control plane's listen "
+                  "address; an empty HOST means 127.0.0.1)",
+                  file=sys.stderr)
+            return 2
+    if getattr(args, "control_grace", None) is not None:
+        if not getattr(args, "control_join", None):
+            print("serve: --control-grace bounds the cached-lease window "
+                  "under --control-join; add --control-join HOST:PORT",
+                  file=sys.stderr)
+            return 2
+        if args.control_grace <= 0:
+            print("serve: --control-grace must be > 0", file=sys.stderr)
+            return 2
+    if getattr(args, "shard", 0) < 0:
+        print("serve: --shard must be >= 0 (the mesh shard index)",
+              file=sys.stderr)
+        return 2
     if getattr(args, "freeze", False) and getattr(args, "auto_register", False):
         print("serve: --freeze with --auto-register would claim fresh "
               "models that can never learn — a lazily registered stream "

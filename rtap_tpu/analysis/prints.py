@@ -55,6 +55,7 @@ MUST_BE_STRICT = (
     "rtap_tpu/service/loop.py",
     "rtap_tpu/fleet/member.py",
     "rtap_tpu/fleet/aggregator.py",
+    "rtap_tpu/fleet/control.py",
 )
 
 

@@ -18,6 +18,11 @@ one Perfetto timeline using the registration clock-alignment handshake.
 Serve wires this with ``--fleet-join HOST:PORT`` (become a member) and
 ``--fleet-listen PORT`` (host the aggregator; the ``/fleet/*`` routes
 ride the obs HTTP server). docs/FLEET.md is the runbook.
+
+ISSUE 20 adds the CONTROL plane to the same wire band (fleet/control.py):
+a :class:`ControlPlane` process owns shard leases / membership / the
+shard map behind ``serve --control-listen``, and data planes hold their
+fencing epoch through a :class:`ControlLease` (``--control-join``).
 """
 
 from rtap_tpu.fleet.aggregator import (
@@ -25,6 +30,14 @@ from rtap_tpu.fleet.aggregator import (
     merge_metrics,
     merge_sketches,
     merge_slo,
+)
+from rtap_tpu.fleet.control import (
+    ControlLease,
+    ControlPlane,
+    control_drain,
+    control_read,
+    parse_control_addr,
+    read_control_journal,
 )
 from rtap_tpu.fleet.member import FleetPublisher
 from rtap_tpu.fleet.protocol import (
@@ -43,13 +56,19 @@ __all__ = [
     "FLEET_HELLO",
     "FLEET_SNAP",
     "FLEET_V",
+    "ControlLease",
+    "ControlPlane",
     "FleetAggregator",
     "FleetPublisher",
     "FleetWalker",
+    "control_drain",
+    "control_read",
     "merge_metrics",
     "merge_sketches",
     "merge_slo",
     "pack_fleet",
+    "parse_control_addr",
+    "read_control_journal",
     "stitch_traces",
     "unpack_payload",
 ]

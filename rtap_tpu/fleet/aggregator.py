@@ -187,7 +187,8 @@ def merge_slo(snaps: dict[str, dict]) -> dict:
 # ------------------------------------------------------------- collector
 class _Member:
     __slots__ = ("name", "hello", "snap", "seq", "snapshots", "last_seen",
-                 "last_unix", "state", "clock_offset_s", "down_after_s")
+                 "last_unix", "state", "clock_offset_s", "down_after_s",
+                 "left_reason")
 
     def __init__(self, name: str):
         self.name = name
@@ -200,6 +201,7 @@ class _Member:
         self.state = "up"
         self.clock_offset_s = 0.0
         self.down_after_s = 5.0
+        self.left_reason: str | None = None
 
 
 class _Conn:
@@ -293,7 +295,13 @@ class FleetAggregator:
             fresh = m is None
             if fresh:
                 m = self._members[name] = _Member(name)
+            # supervised-restart lineage (ISSUE 20 satellite): a rejoin
+            # whose restarts_total ADVANCED past the previous hello's is
+            # the supervisor respawning the same member — an expected
+            # recovery, not an operator-page cold return
+            prev_restarts = m.hello.get("restarts_total")
             m.hello = p
+            m.left_reason = None
             m.last_seen = time.monotonic()
             m.last_unix = now_unix
             m.down_after_s = float(
@@ -306,11 +314,25 @@ class FleetAggregator:
                 m.clock_offset_s = now_unix - float(clock["unix"])
             came_back = m.state != "up"
             m.state = "up"
-            self._event("rejoined" if (came_back and not fresh)
-                        else "joined", name,
+            extra: dict = {}
+            if came_back and not fresh:
+                kind = "rejoined"
+                restarts = p.get("restarts_total")
+                extra["supervised"] = bool(
+                    restarts is not None
+                    and (prev_restarts is None
+                         or int(restarts) > int(prev_restarts)))
+                if restarts is not None:
+                    extra["restarts_total"] = restarts
+                if p.get("last_death_rc") is not None:
+                    extra["last_death_rc"] = p.get("last_death_rc")
+            else:
+                kind = "joined"
+            self._event(kind, name,
                         role=p.get("role"), shard=p.get("shard"),
                         lease_epoch=p.get("lease_epoch"),
-                        run_epoch=p.get("run_epoch"), pid=p.get("pid"))
+                        run_epoch=p.get("run_epoch"), pid=p.get("pid"),
+                        **extra)
 
     def _fold_snap(self, conn: _Conn, p: dict) -> None:
         name = str(p.get("member", "")) or conn.member
@@ -352,7 +374,12 @@ class FleetAggregator:
             m = self._members.get(name)
             if m is not None and m.state != "left":
                 m.state = "left"
-                self._event("left", name)
+                reason = p.get("reason")
+                m.left_reason = str(reason) if reason else None
+                if m.left_reason:
+                    self._event("left", name, reason=m.left_reason)
+                else:
+                    self._event("left", name)
 
     # --------------------------------------------------------- collector --
     def _sweep(self) -> None:
@@ -518,6 +545,11 @@ class FleetAggregator:
                     "down_after_s": m.down_after_s,
                     "clock_offset_s": round(m.clock_offset_s, 6),
                     "trace": m.hello.get("trace"),
+                    "restarts_total": src.get(
+                        "restarts_total", m.hello.get("restarts_total")),
+                    "last_death_rc": src.get(
+                        "last_death_rc", m.hello.get("last_death_rc")),
+                    "left_reason": m.left_reason,
                 })
         return out
 

@@ -1,0 +1,715 @@
+"""Control plane: one lease per shard, journaled fencing epochs.
+
+ISSUE 20 splits the serve monolith: a small :class:`ControlPlane`
+process owns the LEASES (one fencing epoch per shard — the
+generalization of the file :class:`~rtap_tpu.resilience.replicate.Lease`
+to N shards), the MEMBERSHIP/claims roster, and the SHARD MAP; data
+planes join it with ``serve --control-join HOST:PORT`` and talk to it
+through :class:`ControlLease`, a drop-in
+:class:`~rtap_tpu.resilience.replicate.FencingLease` backend — the tick
+loop, alert fence, standby follower and heartbeat thread cannot tell
+the two apart.
+
+Durability: every fencing DECISION (grant / release / drain) is
+journaled write-ahead through the same RJ record framing as the tick
+journal — appended and fsynced BEFORE the grant reply leaves the
+socket. A kill-9'd control plane restarts from that journal with every
+shard's max granted epoch as the bump floor, so it can never hand out
+an epoch <= one it already granted (never re-inverting a fence), and a
+restart GRACE window (one lease timeout) refuses takeover grants for a
+recovered shard until its surviving holder had a fair chance to
+re-heartbeat.
+
+Availability: a data plane whose control plane is unreachable keeps
+ticking on its CACHED lease for a bounded, counted window
+(``degraded_grace_s``): ``still_mine()`` answers from cache,
+``try_acquire`` refuses (a standby never promotes on silence — the
+control plane being down is not evidence the leader is), and the loop
+counts every degraded tick (``rtap_obs_control_degraded_ticks_total``)
+and emits ``control_plane_lost`` / ``control_plane_regained`` events.
+Past the window the holder self-fences — fail-safe, never split-brain.
+
+Wire: the control RPCs live in the fleet band (types 35..44, one
+short-lived connection per RPC — connect, one request, one reply,
+close), so a control stream degrades exactly like a fleet stream: torn
+tails wait, garbage resyncs, unknown in-band types skip whole.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from collections import deque
+
+from rtap_tpu.fleet.protocol import FleetWalker, pack_fleet, unpack_payload
+from rtap_tpu.obs.metrics import TelemetryRegistry, get_registry
+from rtap_tpu.resilience.replicate import FencingLease
+
+__all__ = ["CTRL_ACQUIRE", "CTRL_DRAIN", "CTRL_GRANT",
+           "CTRL_HB", "CTRL_HELLO", "CTRL_JREC", "CTRL_MAP", "CTRL_READ",
+           "CTRL_RELEASE", "CTRL_STATE", "ControlLease", "ControlPlane",
+           "control_drain", "control_read", "control_rpc",
+           "parse_control_addr", "read_control_journal"]
+
+# ---- the control slice of the fleet band (docs/FLEET.md wire table) ----
+CTRL_HELLO = 35    # member -> plane: register {member, role, shard, pid}
+CTRL_ACQUIRE = 36  # member -> plane: claim a shard lease
+CTRL_GRANT = 37    # plane -> member: acquire verdict {ok, epoch, cur}
+CTRL_HB = 38       # member -> plane: holder heartbeat {shard,owner,epoch}
+CTRL_STATE = 39    # plane -> member: one shard's lease entry + drain flag
+CTRL_READ = 40     # member -> plane: read one shard (shard < 0: the map)
+CTRL_RELEASE = 41  # member -> plane: orderly handoff (the drain exit)
+CTRL_DRAIN = 42    # admin -> plane: mark a shard draining
+CTRL_MAP = 43      # plane -> member: full shard map + membership roster
+#: journal-only record kind (never leaves the process): one JSON control
+#: decision, appended write-ahead
+CTRL_JREC = 44
+
+_REQUEST_TYPES = (CTRL_HELLO, CTRL_ACQUIRE, CTRL_HB, CTRL_READ,
+                  CTRL_RELEASE, CTRL_DRAIN)
+_REPLY_TYPES = (CTRL_GRANT, CTRL_STATE, CTRL_MAP)
+
+
+def _journal_path(journal_dir: str) -> str:
+    return os.path.join(str(journal_dir), "control.journal")
+
+
+def read_control_journal(journal_dir: str) -> list[dict]:
+    """Replay the control journal: every well-framed ``CTRL_JREC``
+    payload in append order. The walker discipline makes recovery
+    torn-tail tolerant — a record half-written at the kill instant is
+    skipped, never mis-parsed (and was never acted on: the reply only
+    goes out after fsync)."""
+    out: list[dict] = []
+    try:
+        with open(_journal_path(journal_dir), "rb") as f:
+            data = f.read()
+    except OSError:
+        return out
+    walker = FleetWalker(known=(CTRL_JREC,))
+    for _typ, payload in walker.feed(data):
+        obj = unpack_payload(payload)
+        if obj is not None:
+            out.append(obj)
+    return out
+
+
+# ------------------------------------------------------------- the plane
+class ControlPlane:
+    """The lease/membership/shard-map owner (one per deployment).
+
+    State per shard: ``{epoch, owner, ts_mono, timeout_s, meta,
+    draining}``. Epoch grants are journaled write-ahead (fsync before
+    reply); heartbeats only re-stamp ``ts_mono`` and are NOT journaled —
+    a restart recovers epochs exactly and freshness conservatively
+    (unknown age + boot grace, see :meth:`_handle_acquire`)."""
+
+    def __init__(self, journal_dir: str, *, port: int = 0,
+                 host: str = "127.0.0.1", lease_timeout_s: float = 5.0,
+                 registry: TelemetryRegistry | None = None):
+        if not journal_dir:
+            raise ValueError("control plane needs a journal dir (the "
+                             "epoch-durability root)")
+        if lease_timeout_s <= 0:
+            raise ValueError(
+                f"lease_timeout_s must be > 0; got {lease_timeout_s}")
+        self.journal_dir = str(journal_dir)
+        os.makedirs(self.journal_dir, exist_ok=True)
+        self.host, self.port = str(host), int(port)
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.address: tuple[str, int] | None = None
+        self._lock = threading.Lock()
+        #: shard -> live lease entry
+        self._leases: dict[int, dict] = {}
+        #: shard -> max epoch ever journaled (the grant floor; never
+        #: regresses, even across release)
+        self._granted: dict[int, int] = {}
+        #: member name -> last HELLO payload (+ seen timestamp)
+        self._members: dict[str, dict] = {}
+        self._sock: socket.socket | None = None
+        self._stop = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+        self._conn_threads: set = set()
+        reg = registry if registry is not None else get_registry()
+        self._obs_requests = reg.counter(
+            "rtap_obs_control_requests_total",
+            "control-plane RPCs served (acquire/heartbeat/read/release/"
+            "drain/hello)")
+        self._obs_grants = reg.counter(
+            "rtap_obs_control_grants_total",
+            "shard lease epochs granted (each one journaled write-ahead "
+            "before the reply)")
+        self.recovered_shards = 0
+        self._jf = None
+        self._recover()
+        #: restart grace anchor: takeover acquires for a recovered shard
+        #: whose holder has not re-heartbeat are denied until one full
+        #: lease timeout past boot
+        self._boot = time.monotonic()
+
+    # ------------------------------------------------------- durability --
+    def _recover(self) -> None:
+        for rec in read_control_journal(self.journal_dir):
+            try:
+                shard = int(rec.get("shard", -1))
+            except (TypeError, ValueError):
+                continue
+            if shard < 0:
+                continue
+            kind = rec.get("kind")
+            if kind == "grant":
+                try:
+                    epoch = int(rec.get("epoch", 0))
+                except (TypeError, ValueError):
+                    continue
+                self._granted[shard] = max(self._granted.get(shard, 0),
+                                           epoch)
+                self._leases[shard] = {
+                    "epoch": epoch, "owner": rec.get("owner"),
+                    "ts_mono": None,  # freshness unknown after restart
+                    "timeout_s": float(rec.get("timeout_s")
+                                       or self.lease_timeout_s),
+                    "meta": {}, "draining": False}
+            elif kind == "drain":
+                entry = self._leases.get(shard)
+                if entry is not None:
+                    entry["draining"] = True
+            elif kind == "release":
+                entry = self._leases.get(shard)
+                if entry is not None and entry.get("owner") \
+                        == rec.get("owner"):
+                    entry["owner"] = None
+                    entry["ts_mono"] = None
+                    entry["draining"] = False
+        self.recovered_shards = len(self._granted)
+        self._jf = open(_journal_path(self.journal_dir), "ab")
+
+    def _journal(self, kind: str, shard: int, *, epoch: int | None = None,
+                 owner: str | None = None,
+                 timeout_s: float | None = None) -> None:
+        rec: dict = {"kind": kind, "shard": int(shard), "ts": time.time()}
+        if epoch is not None:
+            rec["epoch"] = int(epoch)
+        if owner is not None:
+            rec["owner"] = str(owner)
+        if timeout_s is not None:
+            rec["timeout_s"] = float(timeout_s)
+        self._jf.write(pack_fleet(CTRL_JREC, rec))
+        self._jf.flush()
+        # write-ahead is the whole durability story: the grant the
+        # client is about to act on must survive our kill-9, or a
+        # restarted plane could re-grant the same epoch and invert
+        # the fence
+        os.fsync(self._jf.fileno())
+
+    # -------------------------------------------------------- lease math --
+    def _entry_stale(self, entry: dict) -> bool:
+        if entry.get("owner") is None:
+            return True
+        ts = entry.get("ts_mono")
+        if ts is None:
+            return True  # recovered, never re-heartbeat: no freshness
+        return time.monotonic() - ts > float(
+            entry.get("timeout_s") or self.lease_timeout_s)
+
+    def _view(self, shard: int, entry: dict | None) -> dict | None:
+        """The client-facing entry: age measured on OUR monotonic clock
+        (members may disagree on wall time), plus a derived wall ``ts``
+        so file-lease consumers (stale logs, reports) keep working."""
+        if entry is None:
+            return None
+        ts = entry.get("ts_mono")
+        age = (time.monotonic() - ts) if ts is not None else None
+        return {"shard": int(shard), "epoch": int(entry["epoch"]),
+                "owner": entry.get("owner"), "age_s": age,
+                "ts": (time.time() - age) if age is not None else 0.0,
+                "draining": bool(entry.get("draining")),
+                "meta": dict(entry.get("meta") or {})}
+
+    def _shard_map(self) -> dict:
+        shards = {str(s): self._view(s, e)
+                  for s, e in sorted(self._leases.items())}
+        return {"shards": shards,
+                "members": {name: dict(info)
+                            for name, info in self._members.items()}}
+
+    # --------------------------------------------------------- handlers --
+    def _handle(self, typ: int, p: dict) -> tuple[int, dict]:
+        with self._lock:
+            self._obs_requests.inc()
+            if typ == CTRL_ACQUIRE:
+                return self._handle_acquire(p)
+            if typ == CTRL_HB:
+                return self._handle_hb(p)
+            if typ == CTRL_READ:
+                shard = int(p.get("shard", 0))
+                if shard < 0:
+                    return CTRL_MAP, self._shard_map()
+                entry = self._leases.get(shard)
+                return CTRL_STATE, {
+                    "shard": shard, "cur": self._view(shard, entry),
+                    "draining": bool(entry and entry.get("draining"))}
+            if typ == CTRL_RELEASE:
+                return self._handle_release(p)
+            if typ == CTRL_DRAIN:
+                shard = int(p.get("shard", 0))
+                entry = self._leases.get(shard)
+                if entry is not None and not entry.get("draining"):
+                    self._journal("drain", shard)
+                    entry["draining"] = True
+                return CTRL_STATE, {
+                    "shard": shard, "cur": self._view(shard, entry),
+                    "draining": bool(entry and entry.get("draining"))}
+            if typ == CTRL_HELLO:
+                name = str(p.get("member") or "")
+                if name:
+                    self._members[name] = {
+                        "member": name, "role": p.get("role"),
+                        "shard": p.get("shard"), "pid": p.get("pid"),
+                        "seen_ts": time.time()}
+                return CTRL_MAP, self._shard_map()
+            # unreachable: the walker only emits _REQUEST_TYPES
+            return CTRL_STATE, {"shard": -1, "cur": None}
+
+    def _handle_acquire(self, p: dict) -> tuple[int, dict]:
+        shard = int(p.get("shard", 0))
+        owner = str(p.get("owner") or "")
+        timeout_s = float(p.get("timeout_s") or self.lease_timeout_s)
+        entry = self._leases.get(shard)
+        now = time.monotonic()
+        if entry is not None and entry.get("owner") == owner \
+                and not self._entry_stale(entry):
+            # re-acquire by the live holder: same epoch, fresh stamp
+            entry["ts_mono"] = now
+            entry["timeout_s"] = timeout_s
+            if p.get("meta"):
+                entry["meta"] = dict(p["meta"])
+            return CTRL_GRANT, {"ok": True, "shard": shard,
+                                "epoch": int(entry["epoch"]),
+                                "cur": self._view(shard, entry)}
+        if entry is not None and not self._entry_stale(entry):
+            return CTRL_GRANT, {"ok": False, "why": "held",
+                                "shard": shard,
+                                "cur": self._view(shard, entry)}
+        if entry is not None and entry.get("owner") is not None \
+                and entry.get("owner") != owner \
+                and entry.get("ts_mono") is None \
+                and now - self._boot < float(
+                    entry.get("timeout_s") or self.lease_timeout_s):
+            # restart grace: this shard's holder was granted before our
+            # crash and has not re-heartbeat yet — denying the takeover
+            # for one lease timeout keeps a control-plane restart from
+            # disruptively fencing every healthy leader at once
+            return CTRL_GRANT, {"ok": False, "why": "boot_grace",
+                                "shard": shard,
+                                "cur": self._view(shard, entry)}
+        epoch = max(self._granted.get(shard, 0),
+                    int(entry["epoch"]) if entry else 0) + 1
+        self._journal("grant", shard, epoch=epoch, owner=owner,
+                      timeout_s=timeout_s)
+        self._granted[shard] = epoch
+        self._leases[shard] = {"epoch": epoch, "owner": owner,
+                               "ts_mono": now, "timeout_s": timeout_s,
+                               "meta": dict(p.get("meta") or {}),
+                               "draining": False}
+        self._obs_grants.inc()
+        return CTRL_GRANT, {"ok": True, "shard": shard, "epoch": epoch,
+                            "cur": self._view(shard, self._leases[shard])}
+
+    def _handle_hb(self, p: dict) -> tuple[int, dict]:
+        shard = int(p.get("shard", 0))
+        owner = str(p.get("owner") or "")
+        try:
+            epoch = int(p.get("epoch", 0))
+        except (TypeError, ValueError):
+            epoch = 0
+        entry = self._leases.get(shard)
+        if entry is not None and entry.get("owner") == owner \
+                and int(entry["epoch"]) == epoch:
+            # the holder (possibly surviving our restart: ts_mono None
+            # re-stamps here, which is what ends its boot-grace limbo)
+            entry["ts_mono"] = time.monotonic()
+            if p.get("meta"):
+                entry["meta"] = dict(p["meta"])
+        # any mismatch (epoch advanced, owner changed) just reflects the
+        # current entry back — the client's _lost() does the fencing
+        return CTRL_STATE, {
+            "shard": shard, "cur": self._view(shard, entry),
+            "draining": bool(entry and entry.get("draining"))}
+
+    def _handle_release(self, p: dict) -> tuple[int, dict]:
+        shard = int(p.get("shard", 0))
+        owner = str(p.get("owner") or "")
+        entry = self._leases.get(shard)
+        if entry is not None and entry.get("owner") == owner:
+            self._journal("release", shard, owner=owner)
+            entry["owner"] = None
+            entry["ts_mono"] = None
+            entry["draining"] = False
+        return CTRL_STATE, {"shard": shard,
+                            "cur": self._view(shard, entry),
+                            "draining": False}
+
+    # ------------------------------------------------------------ server --
+    def start(self) -> "ControlPlane":
+        if self._accept_thread is not None:
+            return self
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(32)
+        self._sock = s
+        self.address = s.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rtap-control-accept",
+            daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # listener closed: shutdown
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="rtap-control-conn", daemon=True)
+            self._conn_threads.add(t)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        walker = FleetWalker(known=_REQUEST_TYPES)
+        try:
+            conn.settimeout(5.0)
+            while not self._stop.is_set():
+                try:
+                    data = conn.recv(65536)
+                except OSError:
+                    return
+                if not data:
+                    return
+                for typ, payload in walker.feed(data):
+                    p = unpack_payload(payload)
+                    if p is None:
+                        continue  # future-versioned request: skip whole
+                    rtyp, reply = self._handle(typ, p)
+                    try:
+                        conn.sendall(pack_fleet(rtyp, reply))
+                    except OSError:
+                        return  # client gone mid-reply: its retry's job
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass  # already torn down by the peer
+            self._conn_threads.discard(threading.current_thread())
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass  # already closed
+            self._sock = None
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+            self._accept_thread = None
+        for t in list(self._conn_threads):
+            t.join(timeout=1.0)
+        if self._jf is not None:
+            self._jf.close()
+            self._jf = None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"address": list(self.address) if self.address else None,
+                    "journal_dir": self.journal_dir,
+                    "recovered_shards": self.recovered_shards,
+                    "shards": {str(s): self._view(s, e)
+                               for s, e in sorted(self._leases.items())},
+                    "members": sorted(self._members)}
+
+
+# -------------------------------------------------------------- one RPC
+def control_rpc(addr: tuple[str, int], typ: int, obj: dict, *,
+                timeout_s: float = 2.0) -> dict | None:
+    """One control RPC: connect, one request, one reply, close. None on
+    any transport failure (the caller decides whether that degrades or
+    fences — see :class:`ControlLease`)."""
+    try:
+        with socket.create_connection(
+                (str(addr[0]), int(addr[1])), timeout=timeout_s) as s:
+            s.settimeout(timeout_s)
+            s.sendall(pack_fleet(typ, obj))
+            walker = FleetWalker(known=_REPLY_TYPES)
+            while True:
+                data = s.recv(65536)
+                if not data:
+                    return None  # peer closed mid-reply
+                records = walker.feed(data)
+                if records:
+                    return unpack_payload(records[0][1])
+    except OSError:
+        return None
+
+
+def control_read(addr: tuple[str, int], shard: int = -1, *,
+                 timeout_s: float = 2.0) -> dict | None:
+    """Read one shard's lease entry (or, with ``shard < 0``, the whole
+    shard map + membership roster) — the drill/report probe."""
+    return control_rpc(addr, CTRL_READ, {"shard": int(shard)},
+                       timeout_s=timeout_s)
+
+
+def control_drain(addr: tuple[str, int], shard: int, *,
+                  timeout_s: float = 2.0) -> dict | None:
+    """Mark a shard draining: the holder's next heartbeat reply carries
+    the flag, it exits orderly and releases, and its standby takes over
+    without waiting out staleness (the rolling-upgrade primitive)."""
+    return control_rpc(addr, CTRL_DRAIN, {"shard": int(shard)},
+                       timeout_s=timeout_s)
+
+
+# ------------------------------------------------------------ the lease
+class ControlLease(FencingLease):
+    """A shard lease held THROUGH the control plane: the drop-in
+    :class:`FencingLease` backend for ``serve --control-join``.
+
+    Degradation contract (the tentpole property): every RPC failure
+    flips ``degraded`` and queues a ``control_plane_lost`` event;
+    while degraded, :meth:`still_mine` keeps answering True from the
+    cached grant (the loop keeps ticking, counted per tick),
+    :meth:`try_acquire` returns False (a standby NEVER promotes on
+    control-plane silence), and :meth:`is_stale` returns False (same
+    reason). The window is bounded: unreachable past
+    ``degraded_grace_s`` the holder self-fences — an operator gets a
+    stalled-alerts page, never a split brain."""
+
+    def __init__(self, addr: tuple[str, int], owner: str, *,
+                 shard: int = 0, timeout_s: float = 5.0,
+                 meta: dict | None = None,
+                 degraded_grace_s: float | None = None,
+                 connect_timeout_s: float = 1.0,
+                 registry: TelemetryRegistry | None = None):
+        super().__init__(owner, timeout_s=timeout_s, meta=meta)
+        self.addr = (str(addr[0]), int(addr[1]))
+        self.shard = int(shard)
+        if self.shard < 0:
+            raise ValueError(f"shard must be >= 0; got {shard}")
+        #: bounded cached-lease window: unreachable control plane past
+        #: this long self-fences the holder (fail-safe beats available)
+        self.degraded_grace_s = (float(degraded_grace_s)
+                                 if degraded_grace_s is not None
+                                 else max(10.0 * self.timeout_s, 30.0))
+        if self.degraded_grace_s <= 0:
+            raise ValueError(f"degraded_grace_s must be > 0; got "
+                             f"{degraded_grace_s}")
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.draining = False
+        #: wired by serve to the loop's stop event: a drain mark becomes
+        #: an orderly exit at the next tick boundary
+        self.on_drain = None
+        self.degraded = False
+        self._degraded_since: float | None = None
+        self._net_lock = threading.Lock()
+        self._cache: dict | None = None
+        self.shard_map: dict | None = None
+        self._events: deque = deque(maxlen=64)
+        reg = registry if registry is not None else get_registry()
+        self._obs_rpc_failures = reg.counter(
+            "rtap_obs_control_rpc_failures_total",
+            "control-plane RPCs that failed in transport (dial refused, "
+            "timeout, torn reply); each one extends/starts a degraded "
+            "window")
+        self._obs_connected = reg.gauge(
+            "rtap_obs_control_connected",
+            "1 while the last control-plane RPC succeeded, 0 while "
+            "degraded (serving on the cached lease)")
+        self._obs_connected.set(0)
+
+    # ---------------------------------------------------------- transport --
+    def _rpc(self, typ: int, obj: dict) -> dict | None:
+        p = control_rpc(self.addr, typ,
+                        {"shard": self.shard, **obj},
+                        timeout_s=self.connect_timeout_s)
+        with self._net_lock:
+            if p is None:
+                self._obs_rpc_failures.inc()
+                self._obs_connected.set(0)
+                if not self.degraded:
+                    self.degraded = True
+                    self._degraded_since = time.monotonic()
+                    self._events.append(("control_plane_lost", {
+                        "shard": self.shard,
+                        "grace_s": self.degraded_grace_s}))
+            else:
+                self._obs_connected.set(1)
+                if self.degraded:
+                    outage = time.monotonic() - (self._degraded_since
+                                                 or time.monotonic())
+                    self.degraded = False
+                    self._degraded_since = None
+                    self._events.append(("control_plane_regained", {
+                        "shard": self.shard,
+                        "outage_s": round(outage, 3)}))
+        return p
+
+    def pop_events(self) -> list[tuple[str, dict]]:
+        """Drain queued lease-lifecycle events (the loop re-emits them
+        through ``_res_event`` so they land in counters/trace/alerts)."""
+        out: list[tuple[str, dict]] = []
+        while True:
+            try:
+                out.append(self._events.popleft())
+            except IndexError:
+                return out
+
+    # ------------------------------------------------------ lease surface --
+    def read(self) -> dict | None:
+        p = self._rpc(CTRL_READ, {})
+        if p is None:
+            return self._cache  # the bounded-window cache
+        cur = p.get("cur")
+        self._cache = cur
+        return cur
+
+    def _stale(self, cur: dict) -> bool:
+        # staleness is judged on the control plane's OWN clock (age_s),
+        # never on cross-host wall time; a released or freshness-unknown
+        # entry is stale (that is what lets a drained shard's standby
+        # promote without waiting out a timeout)
+        if cur.get("owner") is None:
+            return True
+        age = cur.get("age_s")
+        if age is None:
+            return True
+        return float(age) > self.timeout_s
+
+    def is_stale(self) -> bool:
+        p = self._rpc(CTRL_READ, {})
+        if p is None:
+            # an unreachable control plane is NOT evidence the leader is
+            # gone — the standby keeps following (no false promotion)
+            return False
+        cur = p.get("cur")
+        self._cache = cur if cur is not None else self._cache
+        return cur is None or self._stale(cur)
+
+    def try_acquire(self) -> bool:
+        if self.fenced:
+            return False
+        p = None
+        for _attempt in range(3):  # startup race vs the plane's bind
+            p = self._rpc(CTRL_ACQUIRE, {
+                "owner": self.owner, "timeout_s": self.timeout_s,
+                "meta": self.meta})
+            if p is not None:
+                break
+            time.sleep(0.1)
+        if p is None or not p.get("ok"):
+            if p is not None:
+                self._cache = p.get("cur") or self._cache
+            return False
+        self.epoch = int(p.get("epoch", 0))
+        self._cache = p.get("cur")
+        self.draining = False
+        return True
+
+    def refresh(self) -> bool:
+        with self._lock:
+            if self.fenced:
+                return False
+            p = self._rpc(CTRL_HB, {"owner": self.owner,
+                                    "epoch": self.epoch,
+                                    "meta": self.meta})
+            if p is None:
+                since = self._degraded_since
+                if since is not None and \
+                        time.monotonic() - since > self.degraded_grace_s:
+                    # the bounded window closed: fail safe. From here
+                    # the loop's fence check exits with FENCED_RC.
+                    self.fenced = True
+                    self._events.append(("control_grace_exhausted", {
+                        "shard": self.shard,
+                        "grace_s": self.degraded_grace_s}))
+                    return False
+                # inside the window: keep serving on the cached grant
+                self._last_probe = time.monotonic()
+                return True
+            cur = p.get("cur")
+            self._cache = cur
+            if self._lost(cur):
+                self.fenced = True
+                return False
+            if (bool(p.get("draining"))
+                    or bool((cur or {}).get("draining"))) \
+                    and not self.draining:
+                self.draining = True
+                self._events.append(("shard_draining",
+                                     {"shard": self.shard}))
+                cb = self.on_drain
+                if cb is not None:
+                    cb()
+            self.refreshes += 1
+            self._last_probe = time.monotonic()
+            return True
+
+    def still_mine(self) -> bool:
+        if self.fenced:
+            return False
+        if time.monotonic() - self._last_probe < self._probe_interval:
+            return True
+        # refresh() does the probe bookkeeping (and the degraded-window
+        # math) under self._lock — one implementation for the heartbeat
+        # thread and the alert fence
+        return self.refresh()
+
+    def release(self) -> None:
+        """Orderly handoff (the drain exit): give the shard back so the
+        standby promotes immediately instead of waiting out staleness.
+        Best-effort — an unreachable plane just falls back to the
+        staleness path."""
+        self._rpc(CTRL_RELEASE, {"owner": self.owner, "epoch": self.epoch})
+
+    def hello(self, role: str) -> dict | None:
+        """Register on the membership roster; caches the returned shard
+        map snapshot (the claims/topology view the plane owns)."""
+        p = self._rpc(CTRL_HELLO, {"member": self.owner, "role": str(role),
+                                   "pid": os.getpid()})
+        if p is not None:
+            self.shard_map = {"shards": p.get("shards") or {},
+                              "members": p.get("members") or {}}
+        return p
+
+    def holder_meta(self) -> dict:
+        cur = self.read() or {}
+        # flatten like the file lease (meta keys at top level) so the
+        # serve split-brain hint and soak forensics read both the same
+        return {**(cur.get("meta") or {}),
+                **{k: v for k, v in cur.items() if k != "meta"}}
+
+    def stats(self) -> dict:
+        return {"shard": self.shard, "epoch": self.epoch,
+                "owner": self.owner, "fenced": self.fenced,
+                "degraded": self.degraded, "draining": self.draining,
+                "refreshes": self.refreshes,
+                "grace_s": self.degraded_grace_s}
+
+
+def parse_control_addr(spec: str) -> tuple[str, int]:
+    """``HOST:PORT`` (empty HOST = 127.0.0.1) -> (host, port). Raises
+    ValueError with an operator-facing message on malformed input."""
+    host, sep, port_s = str(spec).rpartition(":")
+    if not sep:
+        raise ValueError(f"control address must be HOST:PORT; got {spec!r}")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(
+            f"control address port must be an integer; got {port_s!r}")
+    if not 0 < port < 65536:
+        raise ValueError(f"control address port out of range: {port}")
+    return (host or "127.0.0.1", port)

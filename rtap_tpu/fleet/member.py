@@ -78,6 +78,22 @@ class FleetPublisher:
         self._tick = -1
         self._tick_base = 0
         self._seq = 0
+        self._bye_reason: str | None = None
+        # supervised-restart lineage (ISSUE 20 satellite): the
+        # supervisor exports its death accounting into each respawned
+        # child's environment; the child's HELLO/SNAP carry it so the
+        # aggregator can tell a supervised-restart rejoin from a cold
+        # one. Absent env (unsupervised process) = fields omitted.
+        try:
+            self._restarts_total = int(
+                os.environ.get("RTAP_SUPERVISED_RESTARTS", ""))
+        except ValueError:
+            self._restarts_total = None
+        try:
+            self._last_death_rc = int(
+                os.environ.get("RTAP_SUPERVISED_LAST_RC", ""))
+        except ValueError:
+            self._last_death_rc = None
         self._sock: socket.socket | None = None  # push-thread-only
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -153,6 +169,10 @@ class FleetPublisher:
         if trace is not None:
             h["trace"] = {"epoch_unix": trace.epoch_unix,
                           "epoch_perf": trace.epoch_perf}
+        if self._restarts_total is not None:
+            h["restarts_total"] = self._restarts_total
+        if self._last_death_rc is not None:
+            h["last_death_rc"] = self._last_death_rc
         return h
 
     def _snap(self) -> dict:
@@ -166,6 +186,10 @@ class FleetPublisher:
             health, latency = self.health, self.latency
             slo, correlator = self.slo, self.correlator
         snap["t_unix"] = time.time()
+        if self._restarts_total is not None:
+            snap["restarts_total"] = self._restarts_total
+        if self._last_death_rc is not None:
+            snap["last_death_rc"] = self._last_death_rc
         snap["metrics"] = self.registry.snapshot()
         if health is not None:
             snap["health"] = health.snapshot()
@@ -226,9 +250,14 @@ class FleetPublisher:
         if self._send(pack_fleet(FLEET_SNAP, self._snap())):
             self._obs_pushes.inc()
         if self._sock is not None:
+            bye: dict = {"member": self.member}
+            if self._bye_reason:
+                # a reasoned departure (drain = rolling upgrade) is an
+                # OPERATION, not an outage — the aggregator and
+                # fleet_report judge it differently
+                bye["reason"] = self._bye_reason
             try:
-                self._sock.sendall(
-                    pack_fleet(FLEET_BYE, {"member": self.member}))
+                self._sock.sendall(pack_fleet(FLEET_BYE, bye))
             except OSError:
                 self._obs_push_failures.inc()  # departure is best-effort
         self._teardown_sock()
@@ -243,8 +272,13 @@ class FleetPublisher:
             self._thread.start()
         return self
 
-    def close(self) -> None:
-        """Stop the push thread deterministically (joined, BYE sent)."""
+    def close(self, reason: str | None = None) -> None:
+        """Stop the push thread deterministically (joined, BYE sent).
+        ``reason`` rides the BYE payload — ``"drain"`` marks the orderly
+        rolling-upgrade departure the exit contracts must not count as
+        DOWN."""
+        if reason:
+            self._bye_reason = str(reason)
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)

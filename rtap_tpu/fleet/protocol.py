@@ -24,7 +24,13 @@ type      name         payload (JSON, versioned)
                        here without a reconnect).
 34        FLEET_BYE    orderly departure (the aggregator marks LEFT
                        instead of waiting out the DOWN staleness).
-35..47    (reserved)   future fleet records. A well-framed record in
+35..44    CTRL_*       the control-plane slice of the band (ISSUE 20):
+                       lease acquire/heartbeat/read/release/drain RPCs,
+                       their GRANT/STATE/MAP replies, and the
+                       control plane's write-ahead journal record —
+                       rtap_tpu/fleet/control.py owns the definitions;
+                       the fleet-push walker skips them as skew.
+45..47    (reserved)   future fleet records. A well-framed record in
                        this band with a type this build does not know is
                        SKIPPED and counted (``skew_skipped``) — version
                        skew between members and aggregator must degrade
@@ -92,9 +98,15 @@ class FleetWalker:
     ``(typ, payload_bytes)`` records out. Torn tails wait; bad
     magic/CRC/out-of-band type resyncs to the next magic (counted in
     ``garbage_bytes``/``bad_crc``); well-framed in-band records of an
-    unknown type are dropped whole and counted in ``skew_skipped``."""
+    unknown type are dropped whole and counted in ``skew_skipped``.
 
-    def __init__(self):
+    ``known`` selects which in-band types this consumer emits (default:
+    the fleet push records) — the control plane (fleet/control.py) rides
+    the same walker over its own slice of the band, so both streams
+    share one degradation discipline."""
+
+    def __init__(self, known: tuple = _KNOWN_TYPES):
+        self._known = tuple(known)
         self._buf = bytearray()
         self.records = 0
         self.garbage_bytes = 0
@@ -128,7 +140,7 @@ class FleetWalker:
                 self.garbage_bytes += skip_to - off
                 off = skip_to
                 continue
-            if typ not in _KNOWN_TYPES:
+            if typ not in self._known:
                 # CRC held: a future record, not corruption — skip WHOLE
                 self.skew_skipped += 1
                 off = end

@@ -94,8 +94,9 @@ from rtap_tpu.resilience.journal import (
     iter_raw_records,
 )
 
-__all__ = ["FENCED_RC", "Lease", "ReplicationSender", "StandbyFollower",
-           "WIRE_HELLO", "WIRE_ACK", "WIRE_SNAP", "WireWalker", "pack_wire"]
+__all__ = ["FENCED_RC", "FencingLease", "Lease", "ReplicationSender",
+           "StandbyFollower", "WIRE_HELLO", "WIRE_ACK", "WIRE_SNAP",
+           "WireWalker", "pack_wire"]
 
 #: serve's exit code when a leader discovers it has been fenced out by a
 #: promoted standby (distinct from crashes, budget exhaustion, and the
@@ -171,69 +172,47 @@ class WireWalker:
 
 
 # ---------------------------------------------------------------- lease
-class Lease:
-    """File-based leadership lease with a monotonic fencing epoch.
+class FencingLease:
+    """The fencing-epoch state machine every lease backend shares: the
+    sticky ``fenced`` flag, the loss/staleness predicates, the cached
+    :meth:`still_mine` probe, the heartbeat thread, and the
+    meta-rebinding discipline. Backends provide the storage — the file
+    :class:`Lease` below, the control-plane
+    ``rtap_tpu.fleet.control.ControlLease`` — by implementing
+    :meth:`read`, :meth:`try_acquire` and :meth:`refresh`; everything
+    that makes fencing CORRECT (once fenced always fenced, epoch
+    comparison, probe caching) lives here exactly once."""
 
-    The holder rewrites ``{epoch, owner, ts, meta...}`` every refresh;
-    a process whose refresh (or :meth:`still_mine` probe) finds the
-    epoch advanced — or the owner changed at its own epoch — is FENCED
-    for good (sticky: once fenced, always fenced). Acquiring a stale or
-    absent lease BUMPS the epoch, which is what fences the previous
-    holder. Single-standby topology: the acquire path is
-    read-check-replace, not a distributed lock (docs/RESILIENCE.md
-    names the deployment constraint)."""
-
-    def __init__(self, path: str | Path, owner: str,
-                 timeout_s: float = 5.0, meta: dict | None = None):
+    def __init__(self, owner: str, timeout_s: float = 5.0,
+                 meta: dict | None = None):
         if timeout_s <= 0:
             raise ValueError(f"lease timeout_s must be > 0; got {timeout_s}")
-        self.path = Path(path)
         self.owner = str(owner)
         self.timeout_s = float(timeout_s)
         self.meta = dict(meta or {})
         self.epoch = 0
-        #: highest epoch ever observed in the file — the acquire bump
-        #: floor. Without it, one unreadable read (transient shared-fs
-        #: fault, deleted file) at promotion would restart epochs at 1,
-        #: INVERTING the fence: the old leader at epoch N>1 keeps
-        #: serving and the new one fences itself.
-        self._seen_epoch = 0
         self.fenced = False
         self.refreshes = 0
-        # still_mine() is called per alert batch: cache the disk probe
-        # to at most one read per min(0.2, timeout/4) seconds
+        # still_mine() is called per alert batch: cache the backend
+        # probe to at most one read per min(0.2, timeout/4) seconds
         self._probe_interval = min(0.2, self.timeout_s / 4.0)
         self._last_probe = 0.0
         self._lock = threading.Lock()
-        # the seen-epoch floor gets its OWN lock: read() runs both
-        # inside self._lock (refresh/still_mine) and without it
-        # (is_stale, holder — the follower's stale probe), so reusing
-        # self._lock here would deadlock the locked callers
-        self._seen_lock = threading.Lock()
         self._hb_stop: threading.Event | None = None
         self._hb_thread: threading.Thread | None = None
 
+    # ---- backend surface (subclasses implement) -----------------------
     def read(self) -> dict | None:
-        try:
-            cur = json.loads(self.path.read_text())
-        except (OSError, ValueError):
-            return None
-        try:
-            seen = int(cur.get("epoch", 0))
-        except (TypeError, ValueError):
-            # a malformed epoch field cannot advance the floor; the
-            # entry itself still serves the caller's staleness logic
-            return cur
-        # the floor update is a read-modify-write shared between the
-        # heartbeat thread (refresh -> read) and unlocked main-side
-        # probes (is_stale/holder): unguarded, an interleaving could
-        # REGRESS the floor (T2 loads the old floor, T1 stores a higher
-        # one, T2 stores the stale max) — and a regressed floor at
-        # promotion re-inverts the fence the floor exists to prevent
-        with self._seen_lock:
-            self._seen_epoch = max(self._seen_epoch, seen)
-        return cur
+        """Current lease entry (``{epoch, owner, ts, ...}``) or None."""
+        raise NotImplementedError
 
+    def try_acquire(self) -> bool:
+        raise NotImplementedError
+
+    def refresh(self) -> bool:
+        raise NotImplementedError
+
+    # ---- shared fencing logic -----------------------------------------
     def _stale(self, cur: dict) -> bool:
         return time.time() - float(cur.get("ts", 0)) > self.timeout_s
 
@@ -243,33 +222,6 @@ class Lease:
         cur = self.read()
         return cur is None or self._stale(cur)
 
-    def _write(self) -> None:
-        tmp = self.path.with_name(self.path.name + f".tmp-{os.getpid()}")
-        tmp.write_text(json.dumps({"epoch": self.epoch, "owner": self.owner,
-                                   "ts": time.time(), **self.meta}))
-        os.replace(tmp, self.path)
-
-    def try_acquire(self) -> bool:
-        """Claim leadership: succeeds when the lease is absent, stale,
-        or already ours. A fresh claim bumps the epoch past the previous
-        holder's — the fence."""
-        if self.fenced:
-            return False
-        cur = self.read()
-        if cur is not None and cur.get("owner") != self.owner \
-                and not self._stale(cur):
-            return False
-        if cur is not None and cur.get("owner") == self.owner:
-            self.epoch = max(self.epoch, int(cur.get("epoch", 0)))
-        else:
-            self.epoch = max(int(cur.get("epoch", 0) if cur else 0),
-                             self._seen_epoch, self.epoch) + 1
-        try:
-            self._write()
-        except OSError:
-            return False
-        return True
-
     def _lost(self, cur: dict | None) -> bool:
         if cur is None:
             return False  # unreadable/missing: not evidence of a taker
@@ -278,28 +230,7 @@ class Lease:
         return int(cur.get("epoch", 0)) == self.epoch \
             and cur.get("owner") != self.owner
 
-    def refresh(self) -> bool:
-        """Re-stamp ts, or discover the fence. Returns False exactly
-        when fenced. Thread-safe: the tick loop's fence check and the
-        heartbeat thread share it."""
-        with self._lock:
-            if self.fenced:
-                return False
-            if self._lost(self.read()):
-                self.fenced = True
-                return False
-            try:
-                self._write()
-            except OSError:  # rtap: allow[except-silent] — an
-                # unwritable lease is an infrastructure fault, not a
-                # fence; keep serving (the standby will promote on
-                # staleness and THEN we fence — the safe order)
-                pass
-            self.refreshes += 1
-            self._last_probe = time.monotonic()
-            return True
-
-    def start_heartbeat(self) -> "Lease":
+    def start_heartbeat(self) -> "FencingLease":
         """Refresh from a daemon thread at timeout/3 so liveness means
         PROCESS alive, not tick-loop fast: a leader mid-checkpoint (a
         multi-second synchronous save on a slow host) must not go stale
@@ -333,7 +264,7 @@ class Lease:
     def set_meta(self, **kv) -> None:
         """Update lease metadata AFTER the heartbeat is running. Rebinds
         ``self.meta`` to a fresh dict (never mutates in place): the
-        heartbeat thread's ``_write`` unpacks ``**self.meta`` without a
+        heartbeat thread's write path unpacks ``**self.meta`` without a
         lock, and an in-place insert mid-iteration would raise and
         silently kill the thread — leaving lease freshness to the tick
         loop alone, the exact gap the heartbeat exists to cover."""
@@ -362,6 +293,104 @@ class Lease:
 
     def holder_meta(self) -> dict:
         return self.read() or {}
+
+
+class Lease(FencingLease):
+    """File-based leadership lease with a monotonic fencing epoch.
+
+    The holder rewrites ``{epoch, owner, ts, meta...}`` every refresh;
+    a process whose refresh (or :meth:`still_mine` probe) finds the
+    epoch advanced — or the owner changed at its own epoch — is FENCED
+    for good (sticky: once fenced, always fenced). Acquiring a stale or
+    absent lease BUMPS the epoch, which is what fences the previous
+    holder. Single-standby topology: the acquire path is
+    read-check-replace, not a distributed lock (docs/RESILIENCE.md
+    names the deployment constraint)."""
+
+    def __init__(self, path: str | Path, owner: str,
+                 timeout_s: float = 5.0, meta: dict | None = None):
+        super().__init__(owner, timeout_s=timeout_s, meta=meta)
+        self.path = Path(path)
+        #: highest epoch ever observed in the file — the acquire bump
+        #: floor. Without it, one unreadable read (transient shared-fs
+        #: fault, deleted file) at promotion would restart epochs at 1,
+        #: INVERTING the fence: the old leader at epoch N>1 keeps
+        #: serving and the new one fences itself.
+        self._seen_epoch = 0
+        # the seen-epoch floor gets its OWN lock: read() runs both
+        # inside self._lock (refresh/still_mine) and without it
+        # (is_stale, holder — the follower's stale probe), so reusing
+        # self._lock here would deadlock the locked callers
+        self._seen_lock = threading.Lock()
+
+    def read(self) -> dict | None:
+        try:
+            cur = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            return None
+        try:
+            seen = int(cur.get("epoch", 0))
+        except (TypeError, ValueError):
+            # a malformed epoch field cannot advance the floor; the
+            # entry itself still serves the caller's staleness logic
+            return cur
+        # the floor update is a read-modify-write shared between the
+        # heartbeat thread (refresh -> read) and unlocked main-side
+        # probes (is_stale/holder): unguarded, an interleaving could
+        # REGRESS the floor (T2 loads the old floor, T1 stores a higher
+        # one, T2 stores the stale max) — and a regressed floor at
+        # promotion re-inverts the fence the floor exists to prevent
+        with self._seen_lock:
+            self._seen_epoch = max(self._seen_epoch, seen)
+        return cur
+
+    def _write(self) -> None:
+        tmp = self.path.with_name(self.path.name + f".tmp-{os.getpid()}")
+        tmp.write_text(json.dumps({"epoch": self.epoch, "owner": self.owner,
+                                   "ts": time.time(), **self.meta}))
+        os.replace(tmp, self.path)
+
+    def try_acquire(self) -> bool:
+        """Claim leadership: succeeds when the lease is absent, stale,
+        or already ours. A fresh claim bumps the epoch past the previous
+        holder's — the fence."""
+        if self.fenced:
+            return False
+        cur = self.read()
+        if cur is not None and cur.get("owner") != self.owner \
+                and not self._stale(cur):
+            return False
+        if cur is not None and cur.get("owner") == self.owner:
+            self.epoch = max(self.epoch, int(cur.get("epoch", 0)))
+        else:
+            self.epoch = max(int(cur.get("epoch", 0) if cur else 0),
+                             self._seen_epoch, self.epoch) + 1
+        try:
+            self._write()
+        except OSError:
+            return False
+        return True
+
+    def refresh(self) -> bool:
+        """Re-stamp ts, or discover the fence. Returns False exactly
+        when fenced. Thread-safe: the tick loop's fence check and the
+        heartbeat thread share it."""
+        with self._lock:
+            if self.fenced:
+                return False
+            if self._lost(self.read()):
+                self.fenced = True
+                return False
+            try:
+                self._write()
+            except OSError:  # rtap: allow[except-silent] — an
+                # unwritable lease is an infrastructure fault, not a
+                # fence; keep serving (the standby will promote on
+                # staleness and THEN we fence — the safe order)
+                pass
+            self.refreshes += 1
+            self._last_probe = time.monotonic()
+            return True
 
 
 # --------------------------------------------------------------- sender

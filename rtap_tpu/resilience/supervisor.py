@@ -211,7 +211,18 @@ class Supervisor:
         try:
             while True:
                 t0 = time.monotonic()
-                self.child = subprocess.Popen(self.cmd, env=self.env)
+                # restart-lineage handoff (ISSUE 20 satellite): each
+                # incarnation learns how many predecessors died and how
+                # the last one went, and its FleetPublisher carries both
+                # on the plane — a supervised-restart rejoin is then
+                # distinguishable from a cold rejoin at the aggregator
+                env = dict(self.env if self.env is not None
+                           else os.environ)
+                env["RTAP_SUPERVISED_RESTARTS"] = str(self.deaths)
+                if self.death_rcs:
+                    env["RTAP_SUPERVISED_LAST_RC"] = \
+                        str(self.death_rcs[-1])
+                self.child = subprocess.Popen(self.cmd, env=env)
                 rc = self._wait()
                 uptime = time.monotonic() - t0
                 if self._stop.is_set():

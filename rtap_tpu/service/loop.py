@@ -921,6 +921,17 @@ def live_loop(
         "stream groups currently quarantined (dispatch/collect fault "
         "isolation)")
     obs_groups_quarantined.set(0)
+    # control-plane degradation accounting: only armed when the lease is
+    # control-plane-backed (ControlLease exposes ``degraded``); a file
+    # lease never counts here
+    obs_control_degraded = None
+    control_degraded_ticks = 0
+    if lease is not None and hasattr(lease, "degraded"):
+        obs_control_degraded = obs.counter(
+            "rtap_obs_control_degraded_ticks_total",
+            "ticks served on the cached control-plane lease while the "
+            "plane was unreachable (bounded by the degraded grace "
+            "window; >0 after an outage proves no tick stalled)")
     obs_source_errors = obs.counter(
         "rtap_obs_source_errors_total",
         "source callables that RAISED (vs. returning NaN); the tick "
@@ -1521,6 +1532,21 @@ def live_loop(
             # an evicted service must not lose since-last-checkpoint learning
             if stop_event is not None and stop_event.is_set():
                 break
+            if lease is not None:
+                # lease-lifecycle events queued by the backend (control
+                # plane lost/regained, drain marks) land in the same
+                # counters/trace/alert-stream pipe as every other
+                # resilience event — the loop stays backend-agnostic
+                pop = getattr(lease, "pop_events", None)
+                if pop is not None:
+                    for ev_kind, ev_fields in pop():
+                        _res_event(ev_kind, k, **ev_fields)
+                if obs_control_degraded is not None \
+                        and getattr(lease, "degraded", False):
+                    # the cached-lease path, exercised: this tick runs
+                    # without a reachable control plane
+                    obs_control_degraded.inc()
+                    control_degraded_ticks += 1
             if lease is not None and not lease.still_mine():
                 # fenced: a standby promoted past our epoch while this
                 # process was paused/partitioned. Stop scoring AND stop
@@ -1528,6 +1554,12 @@ def live_loop(
                 # new leader owns the stream; our unsaved ticks are its
                 # journal's to replay, not ours to double-deliver.
                 fenced = True
+                pop = getattr(lease, "pop_events", None)
+                if pop is not None:
+                    # the probe that discovered the fence may have queued
+                    # its own story (grace exhausted): flush it first
+                    for ev_kind, ev_fields in pop():
+                        _res_event(ev_kind, k, **ev_fields)
                 _res_event("leader_fenced", k,
                            epoch=int(getattr(lease, "epoch", -1)),
                            holder=str(lease.holder() or ""))
@@ -2060,6 +2092,8 @@ def live_loop(
         # (the whole point is that a fenced leader appends NOTHING)
         extra["fenced"] = True
         extra["fenced_line_drops"] = writer.fenced_drops
+    if obs_control_degraded is not None:
+        extra["control_degraded_ticks"] = control_degraded_ticks
     if ticks_run > 0:
         extra["phase_ms_per_tick"] = {
             k: round(v / ticks_run * 1e3, 2) for k, v in phase_s.items()}

@@ -47,6 +47,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+from scripts.fleet_verdict import (  # noqa: E402
+    classify_downs,
+    final_tick_check,
+    reconcile_alert_counters,
+)
 
 VERIFY_FAILED_EXIT = 5
 INFRA_FAILED_EXIT = 3
@@ -332,13 +337,6 @@ def parse_alert_stream(path: str) -> dict:
             "garbage": garbage}
 
 
-def _member_counter(snap: dict, name: str):
-    for row in (snap.get("metrics") or {}).get("metrics", []):
-        if row.get("name") == name and row.get("type") == "counter":
-            return row.get("value", 0)
-    return None
-
-
 def fleet_verdict(agg, args, stats_path: str,
                   failures: list[str]) -> dict:
     """Judge the FLEET-OBSERVED restart story (ISSUE 19): every SIGKILL
@@ -346,27 +344,15 @@ def fleet_verdict(agg, args, stats_path: str,
     kill-9'd process sends no BYE) then REJOINING when the supervisor's
     replacement re-HELLOs under the same name; the budget's completion
     and the completing incarnation's alert accounting must be readable
-    through the plane alone."""
+    through the plane alone. The individual checks live in
+    scripts/fleet_verdict.py, shared with failover_soak and
+    fleet_chaos."""
     events = agg.events_view()
     members = agg.members_view()
     snap = agg.member_snaps().get("serve") or {}
     serve_ev = [e for e in events if e["member"] == "serve"]
     rejoins = [e for e in serve_ev if e["event"] == "rejoined"]
-    # classify each staleness DOWN by what follows it: the next liveness
-    # event is "rejoined" for a real death (the replacement re-HELLOs)
-    # but "up" for a stall flap — a checkpoint/compile stall that held
-    # the push thread past the tight soak-cadence staleness horizon.
-    # Flaps are honest evidence of stalls, not deaths.
-    death_downs = flaps = 0
-    for i, e in enumerate(serve_ev):
-        if e["event"] != "down":
-            continue
-        nxt = next((x["event"] for x in serve_ev[i + 1:]
-                    if x["event"] in ("up", "rejoined", "left")), None)
-        if nxt == "rejoined":
-            death_downs += 1
-        elif nxt == "up":
-            flaps += 1
+    death_downs, flaps = classify_downs(serve_ev)
     if len(rejoins) != args.kills:
         failures.append(
             f"fleet plane saw {len(rejoins)} rejoin(s), expected one "
@@ -383,12 +369,7 @@ def fleet_verdict(agg, args, stats_path: str,
         failures.append(
             f"fleet-observed restart resume bases went backwards: "
             f"{bases}")
-    final_tick = max((m.get("tick") if m.get("tick") is not None else -1)
-                     for m in members) if members else -1
-    if final_tick != args.ticks - 1:
-        failures.append(
-            f"fleet plane never observed the budget completing "
-            f"(last member tick {final_tick}, want {args.ticks - 1})")
+    final_tick = final_tick_check(members, args.ticks - 1, failures)
     # the completing incarnation's stats line counts every crossing it
     # SCORED; on the plane those split into emitted lines plus
     # resume-suppressed already-delivered ids — the sum closes the books
@@ -399,18 +380,9 @@ def fleet_verdict(agg, args, stats_path: str,
             for line in f:
                 last_line = json.loads(line)
     if last_line is not None and snap:
-        emitted = _member_counter(snap, "rtap_obs_alerts_total")
-        suppressed = _member_counter(
-            snap, "rtap_obs_alerts_suppressed_total") or 0
-        reconciled = {"fleet_emitted": emitted,
-                      "fleet_suppressed": suppressed,
-                      "stats": last_line.get("alerts")}
-        if emitted is not None and \
-                emitted + suppressed != last_line.get("alerts"):
-            failures.append(
-                f"fleet-pushed emitted+suppressed {emitted}+{suppressed}"
-                f" != the completing child's stats-line crossing count "
-                f"{last_line.get('alerts')}")
+        reconciled = reconcile_alert_counters(
+            snap, last_line.get("alerts"), "the completing child",
+            failures)
     return {
         "members": [{k: m.get(k) for k in ("member", "state", "role",
                                            "run_epoch", "tick",

@@ -52,6 +52,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from rtap_tpu.utils.platform import maybe_force_cpu  # noqa: E402
+from scripts.fleet_verdict import (  # noqa: E402
+    final_tick_check,
+    promotion_epoch_truth,
+    reconcile_alert_counters,
+    takeover_sequence,
+)
 
 VERIFY_FAILED_EXIT = 5
 INFRA_FAILED_EXIT = 3
@@ -293,13 +299,6 @@ def _wait(cond, timeout_s: float, poll_s: float = 0.02) -> bool:
     return False
 
 
-def _member_counter(snap: dict, name: str):
-    for row in (snap.get("metrics") or {}).get("metrics", []):
-        if row.get("name") == name and row.get("type") == "counter":
-            return row.get("value", 0)
-    return None
-
-
 def fleet_verdict(agg, args, observed: list, fence_report,
                   promotions: list, stats_lines: list,
                   failures: list[str]) -> dict:
@@ -310,94 +309,29 @@ def fleet_verdict(agg, args, observed: list, fence_report,
     fleet-observed promotion epochs must equal the alert stream's
     ``standby_promoted`` epochs; the budget's completion and the
     completing leader's alert count must be visible through merged
-    fleet state alone."""
+    fleet state alone. The individual checks live in
+    scripts/fleet_verdict.py, shared with crash_soak and fleet_chaos."""
     members = agg.members_view()
     events = agg.events_view()
     snaps = agg.member_snaps()
     fl_slo = agg.fleet_slo()
-    checks: list[dict] = []
 
-    # the observed failover sequence, one anchor per scheduled takeover:
-    # DOWN(gone) then role_changed-to-leader(successor), in event order
-    seq = [e for e in events
-           if e["event"] == "down"
-           or (e["event"] == "role_changed" and e.get("role") == "leader")]
+    # the observed failover sequence, one anchor per scheduled takeover
     anchors = [(k["killed"], k["new_leader"], "kill") for k in observed]
     if fence_report:
         anchors.append((fence_report["paused"],
                         fence_report["new_leader"], "fence"))
-    cursor = 0
-    for gone, succ, kind in anchors:
-        j = next((i for i in range(cursor, len(seq))
-                  if seq[i]["event"] == "down"
-                  and seq[i]["member"] == gone), None)
-        if j is None:
-            failures.append(f"fleet plane never marked the {kind}ed "
-                            f"leader {gone} DOWN")
-            checks.append({"kind": kind, "down": gone, "promoted": succ,
-                           "ok": False, "why": "no DOWN event"})
-            continue
-        r = next((i for i in range(j + 1, len(seq))
-                  if seq[i]["event"] == "role_changed"
-                  and seq[i]["member"] == succ), None)
-        if r is None:
-            failures.append(
-                f"fleet plane saw {gone} DOWN but no role_changed to "
-                f"leader on {succ} after it ({kind} round)")
-            checks.append({"kind": kind, "down": gone, "promoted": succ,
-                           "ok": False, "why": "no role_changed after"})
-            continue
-        checks.append({
-            "kind": kind, "down": gone, "promoted": succ, "ok": True,
-            "down_t_unix": seq[j]["t_unix"],
-            "promoted_t_unix": seq[r]["t_unix"],
-            "lease_epoch": seq[r].get("lease_epoch"),
-            "old_lease_epoch": seq[r].get("old_lease_epoch")})
-        cursor = r + 1
+    checks = takeover_sequence(events, anchors, failures)
+    fleet_epochs = promotion_epoch_truth(events, promotions, failures)
+    final_tick = final_tick_check(members, args.ticks - 1, failures)
 
-    # epoch truth: every promotion the alert stream recorded must have
-    # been observed on the plane at the SAME lease epoch (and vice
-    # versa — the fleet sees unscheduled jitter promotions too)
-    fleet_epochs = sorted(e.get("lease_epoch") or 0 for e in seq
-                          if e["event"] == "role_changed")
-    truth_epochs = sorted(p.get("epoch") or 0 for p in promotions)
-    if fleet_epochs != truth_epochs:
-        failures.append(
-            f"fleet-observed promotion epochs {fleet_epochs} != "
-            f"lease/journal truth {truth_epochs}")
-
-    # budget completion is visible through the plane: the final-flush
-    # push of the completing leader carries the last GLOBAL tick
-    final_tick = max((m.get("tick") if m.get("tick") is not None else -1)
-                     for m in members) if members else -1
-    if final_tick != args.ticks - 1:
-        failures.append(
-            f"fleet plane never observed the budget completing "
-            f"(last member tick {final_tick}, want {args.ticks - 1})")
-
-    # merged counters reconcile: a stats line's "alerts" is every
-    # crossing the member SCORED; on the plane those split into emitted
-    # lines (rtap_obs_alerts_total) plus resume-suppressed
-    # already-delivered ids (rtap_obs_alerts_suppressed_total) — the
-    # sum must close the books (the per-child artifact is now
-    # corroboration, not source)
     reconciled = {}
     for line in stats_lines:
         nm = line.get("name")
         if nm not in snaps or line.get("fenced"):
             continue  # a fenced zombie's counters are fence-dropped
-        emitted = _member_counter(snaps[nm], "rtap_obs_alerts_total")
-        suppressed = _member_counter(
-            snaps[nm], "rtap_obs_alerts_suppressed_total") or 0
-        reconciled[nm] = {"fleet_emitted": emitted,
-                          "fleet_suppressed": suppressed,
-                          "stats": line.get("alerts")}
-        if emitted is not None and \
-                emitted + suppressed != line.get("alerts"):
-            failures.append(
-                f"member {nm}: fleet-pushed emitted+suppressed "
-                f"{emitted}+{suppressed} != its stats-line crossing "
-                f"count {line.get('alerts')}")
+        reconciled[nm] = reconcile_alert_counters(
+            snaps[nm], line.get("alerts"), f"member {nm}", failures)
 
     # fleet SLO comes from MERGED sketches (never max-of-member-p99s)
     if args.slo != "off":

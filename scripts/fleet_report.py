@@ -77,8 +77,13 @@ def _member_rows(members: list[dict]) -> list[str]:
              f"{'epoch':>5} {'tick':>8} {'pushes':>7} {'age':>8}"]
     for m in members:
         age = m.get("last_push_age_s")
+        state = str(m.get("state"))
+        if m.get("left_reason"):
+            # a reasoned departure (drain) is an operation, not an
+            # outage — show it inline so the roster reads correctly
+            state = f"{state}({m['left_reason']})"
         lines.append(
-            f"  {str(m.get('member')):<16} {str(m.get('state')):<6} "
+            f"  {str(m.get('member')):<16} {state:<6} "
             f"{str(m.get('role')):<10} "
             f"{m.get('lease_epoch') if m.get('lease_epoch') is not None else '-':>5} "
             f"{m.get('tick') if m.get('tick') is not None else '-':>8} "
@@ -144,6 +149,13 @@ def _rollup_rows(snap: dict) -> list[str]:
                          f"epoch {e.get('lease_epoch')}")
             elif e["event"] == "down":
                 extra = f" after {_fmt_s(e.get('last_push_age_s'))}"
+            elif e["event"] == "left" and e.get("reason"):
+                extra = f" reason={e['reason']}"
+            elif e["event"] == "rejoined" and "supervised" in e:
+                extra = (" supervised-restart"
+                         if e["supervised"] else " cold")
+                if e.get("restarts_total") is not None:
+                    extra += f" restarts={e['restarts_total']}"
             lines.append(f"  {e['event']:<12} {e['member']}{extra}")
     return lines
 
@@ -191,7 +203,14 @@ def main() -> int:
     ap.add_argument("--out", default=None,
                     help="also write the report as indented JSON "
                          "(the committed-artifact form)")
+    ap.add_argument("--expect-down", type=int, default=0, metavar="N",
+                    help="tolerate up to N members in state=down before "
+                         "exiting 4 — for reports captured mid-drill "
+                         "where a planned outage is still in flight "
+                         "(default 0: any DOWN member is a failure)")
     args = ap.parse_args()
+    if args.expect_down < 0:
+        ap.error("--expect-down must be >= 0")
 
     if args.url:
         rep = _from_url(args.url)
@@ -214,9 +233,13 @@ def main() -> int:
             json.dump(rep, f, indent=2)
     print(json.dumps(rep))
     fl = rep.get("fleet") or {}
+    # exit contract: DOWN means an UNPLANNED outage. A member that left
+    # with reason=drain (rolling upgrade) is an operation — never a
+    # failure — and --expect-down N tolerates in-flight planned kills.
     down = [m for m in (fl.get("members") or [])
-            if m.get("state") == "down"]
-    if rep.get("verified") is False or down:
+            if m.get("state") == "down"
+            and m.get("left_reason") != "drain"]
+    if rep.get("verified") is False or len(down) > args.expect_down:
         return 4
     return 0
 

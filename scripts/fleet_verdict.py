@@ -1,0 +1,151 @@
+"""Shared fleet-plane verdict helpers for the soak/chaos harnesses.
+
+failover_soak.py, crash_soak.py and fleet_chaos.py all close their runs
+the same way: read the soak's story back THROUGH the fleet plane
+(aggregator events/members/snaps) and judge it against the lease/journal
+ground truth. The individual checks — the DOWN→role_changed takeover
+anchor walk, the promotion-epoch truth comparison, the death-DOWN vs
+stall-flap classifier, the budget-completion tick, the
+emitted+suppressed alert reconciliation — were duplicated across the
+harnesses (ISSUE 20 satellite); this module is the one copy. Each
+helper appends human-readable messages to the caller's ``failures``
+list and returns the machine-readable block for the report JSON, so a
+harness's ``fleet_verdict`` is a thin composition.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "member_counter",
+    "takeover_sequence",
+    "promotion_epoch_truth",
+    "final_tick_check",
+    "reconcile_alert_counters",
+    "classify_downs",
+]
+
+
+def member_counter(snap: dict, name: str):
+    """A counter's value out of one member's pushed registry snapshot
+    (None = the member never pushed that counter)."""
+    for row in (snap.get("metrics") or {}).get("metrics", []):
+        if row.get("name") == name and row.get("type") == "counter":
+            return row.get("value", 0)
+    return None
+
+
+def takeover_sequence(events: list[dict], anchors: list[tuple],
+                      failures: list[str]) -> list[dict]:
+    """Walk the fleet event log against the scheduled takeovers.
+
+    ``anchors`` is ``[(gone, successor, kind), ...]`` in schedule order;
+    each must appear on the plane as the old leader going DOWN
+    (staleness — a SIGKILLed process sends no BYE) followed by a
+    ``role_changed`` to leader on the successor, with a cursor so the
+    sequence is ordered, not just present. Returns one check dict per
+    anchor."""
+    seq = [e for e in events
+           if e["event"] == "down"
+           or (e["event"] == "role_changed" and e.get("role") == "leader")]
+    checks: list[dict] = []
+    cursor = 0
+    for gone, succ, kind in anchors:
+        j = next((i for i in range(cursor, len(seq))
+                  if seq[i]["event"] == "down"
+                  and seq[i]["member"] == gone), None)
+        if j is None:
+            failures.append(f"fleet plane never marked the {kind}ed "
+                            f"leader {gone} DOWN")
+            checks.append({"kind": kind, "down": gone, "promoted": succ,
+                           "ok": False, "why": "no DOWN event"})
+            continue
+        r = next((i for i in range(j + 1, len(seq))
+                  if seq[i]["event"] == "role_changed"
+                  and seq[i]["member"] == succ), None)
+        if r is None:
+            failures.append(
+                f"fleet plane saw {gone} DOWN but no role_changed to "
+                f"leader on {succ} after it ({kind} round)")
+            checks.append({"kind": kind, "down": gone, "promoted": succ,
+                           "ok": False, "why": "no role_changed after"})
+            continue
+        checks.append({
+            "kind": kind, "down": gone, "promoted": succ, "ok": True,
+            "down_t_unix": seq[j]["t_unix"],
+            "promoted_t_unix": seq[r]["t_unix"],
+            "lease_epoch": seq[r].get("lease_epoch"),
+            "old_lease_epoch": seq[r].get("old_lease_epoch")})
+        cursor = r + 1
+    return checks
+
+
+def promotion_epoch_truth(events: list[dict], promotions: list[dict],
+                          failures: list[str]) -> list[int]:
+    """Every promotion the alert stream (lease/journal truth) recorded
+    must have been observed on the plane at the SAME lease epoch, and
+    vice versa — the fleet sees unscheduled jitter promotions too.
+    Returns the sorted fleet-observed epochs."""
+    fleet_epochs = sorted(e.get("lease_epoch") or 0 for e in events
+                          if e["event"] == "role_changed"
+                          and e.get("role") == "leader")
+    truth_epochs = sorted(p.get("epoch") or 0 for p in promotions)
+    if fleet_epochs != truth_epochs:
+        failures.append(
+            f"fleet-observed promotion epochs {fleet_epochs} != "
+            f"lease/journal truth {truth_epochs}")
+    return fleet_epochs
+
+
+def final_tick_check(members: list[dict], want_last_tick: int,
+                     failures: list[str]) -> int:
+    """Budget completion must be visible through the plane alone: the
+    final-flush push of the completing leader carries the last GLOBAL
+    tick. Returns the max fleet-observed tick."""
+    final_tick = max((m.get("tick") if m.get("tick") is not None else -1)
+                     for m in members) if members else -1
+    if final_tick != want_last_tick:
+        failures.append(
+            f"fleet plane never observed the budget completing "
+            f"(last member tick {final_tick}, want {want_last_tick})")
+    return final_tick
+
+
+def reconcile_alert_counters(snap: dict, stats_alerts, who: str,
+                             failures: list[str]) -> dict:
+    """Close the alert books through the plane: a stats line's
+    ``alerts`` is every crossing the member SCORED; on the plane those
+    split into emitted lines (rtap_obs_alerts_total) plus
+    resume-suppressed already-delivered ids
+    (rtap_obs_alerts_suppressed_total) — the sum must equal it (the
+    per-child artifact is corroboration, not source)."""
+    emitted = member_counter(snap, "rtap_obs_alerts_total")
+    suppressed = member_counter(
+        snap, "rtap_obs_alerts_suppressed_total") or 0
+    out = {"fleet_emitted": emitted, "fleet_suppressed": suppressed,
+           "stats": stats_alerts}
+    if emitted is not None and emitted + suppressed != stats_alerts:
+        failures.append(
+            f"{who}: fleet-pushed emitted+suppressed "
+            f"{emitted}+{suppressed} != its stats-line crossing "
+            f"count {stats_alerts}")
+    return out
+
+
+def classify_downs(member_events: list[dict]) -> tuple[int, int]:
+    """Classify one member's staleness DOWNs by what follows each: the
+    next liveness event is ``rejoined`` for a real death (the
+    supervisor's replacement re-HELLOs) but ``up`` for a stall flap —
+    a checkpoint/compile stall that held the push thread past a tight
+    soak-cadence staleness horizon. Flaps are honest evidence of
+    stalls, not deaths. Returns ``(death_downs, stall_flaps)``."""
+    death_downs = flaps = 0
+    for i, e in enumerate(member_events):
+        if e["event"] != "down":
+            continue
+        nxt = next((x["event"] for x in member_events[i + 1:]
+                    if x["event"] in ("up", "rejoined", "left")), None)
+        if nxt == "rejoined":
+            death_downs += 1
+        elif nxt == "up":
+            flaps += 1
+    return death_downs, flaps

@@ -14,7 +14,10 @@ In-process members against a real aggregator over real sockets:
 """
 
 import json
+import os
 import socket
+import subprocess
+import sys
 import time
 import urllib.error
 import urllib.request
@@ -23,6 +26,7 @@ import numpy as np
 import pytest
 
 from rtap_tpu.fleet import (
+    FLEET_BYE,
     FLEET_HELLO,
     FLEET_SNAP,
     FleetAggregator,
@@ -136,6 +140,90 @@ def test_staleness_down_then_rejoin():
         s2.close()
     finally:
         agg.close()
+
+
+def test_supervised_rejoin_and_drain_reason():
+    """ISSUE 20 satellites: a rejoin whose restarts_total ADVANCED is
+    the supervisor respawning the member (supervised=true, death rc
+    attached); an unchanged counter is a cold return (supervised=false);
+    and a BYE carrying reason=drain lands in the left event AND the
+    roster row — the evidence fleet_report's exit contract reads."""
+    agg = FleetAggregator(port=0, sweep_interval_s=0.02)
+    agg.start()
+    try:
+        def hello(extra):
+            s = socket.create_connection(("127.0.0.1", agg.port),
+                                         timeout=5)
+            s.sendall(pack_fleet(FLEET_HELLO, {
+                "member": "M2", "role": "leader", "down_after_s": 0.15,
+                "clock": {"unix": time.time()}, **extra}))
+            return s
+
+        def rejoins():
+            return [e for e in agg.events_view()
+                    if e["event"] == "rejoined" and e["member"] == "M2"]
+
+        s = hello({"restarts_total": 0})
+        assert agg.wait_members(1)
+        s.close()  # kill-9: silence, then staleness declares DOWN
+        assert _wait(lambda: {m["member"]: m["state"]
+                              for m in agg.members_view()}["M2"] == "down")
+
+        # supervisor respawn: counter advanced 0 -> 1, death rc rides
+        s2 = hello({"restarts_total": 1, "last_death_rc": -9})
+        assert _wait(lambda: len(rejoins()) == 1)
+        ev = rejoins()[0]
+        assert ev["supervised"] is True
+        assert ev["restarts_total"] == 1 and ev["last_death_rc"] == -9
+        # roster carries the lineage fields for fleet_report's table
+        row = {m["member"]: m for m in agg.members_view()}["M2"]
+        assert row["restarts_total"] == 1 and row["last_death_rc"] == -9
+        s2.close()
+        assert _wait(lambda: {m["member"]: m["state"]
+                              for m in agg.members_view()}["M2"] == "down")
+
+        # cold return: same counter -> NOT a supervised recovery
+        s3 = hello({"restarts_total": 1})
+        assert _wait(lambda: len(rejoins()) == 2)
+        assert rejoins()[1]["supervised"] is False
+
+        # orderly drain: reason rides the BYE into event + roster row
+        s3.sendall(pack_fleet(FLEET_BYE, {"member": "M2",
+                                          "reason": "drain"}))
+        assert _wait(lambda: any(
+            e["event"] == "left" and e["member"] == "M2"
+            and e.get("reason") == "drain" for e in agg.events_view()))
+        row = {m["member"]: m for m in agg.members_view()}["M2"]
+        assert row["state"] == "left" and row["left_reason"] == "drain"
+        s3.close()
+    finally:
+        agg.close()
+
+
+def test_fleet_report_drain_and_expect_down_exits(tmp_path):
+    """scripts/fleet_report.py exit contract (ISSUE 20 satellite): DOWN
+    means an UNPLANNED outage — a drain departure never trips exit 4,
+    and --expect-down N tolerates in-flight planned kills."""
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    def run(members, *extra):
+        snap = tmp_path / "snap.json"
+        snap.write_text(json.dumps({"members": members}))
+        return subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts",
+                                          "fleet_report.py"),
+             "--snapshot", str(snap), *extra],
+            cwd=repo, capture_output=True, text=True, timeout=120)
+
+    drained = {"member": "A", "state": "down", "left_reason": "drain"}
+    dead = {"member": "B", "state": "down", "left_reason": None}
+    assert run([drained]).returncode == 0
+    assert run([dead]).returncode == 4
+    assert run([dead], "--expect-down", "1").returncode == 0
+    assert run([drained, dead], "--expect-down", "1").returncode == 0
+    p = run([dead], "--expect-down", "-1")
+    assert p.returncode == 2 and "--expect-down" in p.stderr
 
 
 def test_fleet_routes_on_obs_server():

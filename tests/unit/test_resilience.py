@@ -435,7 +435,11 @@ def test_http_poll_breaker_short_circuits_dead_endpoint():
 
     t0 = time.perf_counter()
     v, ts = src(2)  # short-circuited: NaN immediately, no connect wait
-    assert time.perf_counter() - t0 < 0.05
+    # well under the 0.2 s connect timeout proves no dial was attempted;
+    # the old 0.05 s bound flaked when suite load preempted the host
+    # mid-call (pin semantics, not speed) — the counters below are the
+    # real short-circuit proof
+    assert time.perf_counter() - t0 < 0.15
     assert np.isnan(v).all() and ts > 0
     assert src.polls_short_circuited == 1
     assert src.poll_failures == 2  # no attempt, no new failure

@@ -7,24 +7,34 @@ MUST_BE_STRICT coverage pin, and the race/purity/exception/flag-docs
 passes. The gate is zero unsuppressed findings against the committed
 analysis_baseline.json.
 
-Also gated here (ISSUE 12 CI satellite): the analyzer's wall-time
-budget — it must never become the slow part of the static gate on the
-1-core tier-1 host — and the --json artifact contract soaks/hw_session
+Also gated here (ISSUE 12 CI satellite): the analyzer's CPU budget —
+it must never become the slow part of the static gate on the 1-core
+tier-1 host — and the --json artifact contract soaks/hw_session
 archive."""
 
 import glob
 import json
 import os
+import resource
 import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-#: the analyzer's wall budget on the 1-core tier-1 host (ISSUE 12: the
+#: the analyzer's CPU budget on the 1-core tier-1 host (ISSUE 12: the
 #: static gate must stay fast; measured ~1.6 s — the 10 s ceiling is
-#: headroom, not a target)
+#: headroom, not a target). Budgets here are CHILD CPU SECONDS, not
+#: wall time: wall budgets flaked whenever a concurrent process stole
+#: the host mid-run (a 5 s analysis read as 13+ s under suite load) —
+#: CPU time pins the analyzer's WORK, which is what the budget is
+#: about, and is immune to preemption (the paced-loop deflake pattern:
+#: pin semantics, not speed).
 ANALYZER_BUDGET_S = 10.0
+
+
+def _child_cpu_s():
+    r = resource.getrusage(resource.RUSAGE_CHILDREN)
+    return r.ru_utime + r.ru_stime
 
 
 def _run():
@@ -120,17 +130,18 @@ def test_analyzer_budget_and_json_artifact():
     one parseable JSON artifact line on stdout (the soak/hw_session
     archival surface), reporting ok=true with zero findings against the
     committed baseline."""
-    t0 = time.perf_counter()
+    cpu0 = _child_cpu_s()
     proc = subprocess.run(
         [sys.executable, "-m", "rtap_tpu.analysis", "--json",
          "--no-cache"],
         cwd=REPO, capture_output=True, text=True, timeout=120,
     )
-    elapsed = time.perf_counter() - t0
+    cpu = _child_cpu_s() - cpu0
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert elapsed < ANALYZER_BUDGET_S, (
-        f"analyzer took {elapsed:.1f}s (> {ANALYZER_BUDGET_S}s budget) — "
-        "it must never become the slow part of the static gate")
+    assert cpu < ANALYZER_BUDGET_S, (
+        f"analyzer burned {cpu:.1f} CPU s (> {ANALYZER_BUDGET_S}s "
+        "budget) — it must never become the slow part of the static "
+        "gate")
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     assert len(lines) == 1, f"--json must emit ONE stdout line, got: {lines}"
     art = json.loads(lines[0])["analysis"]
@@ -157,12 +168,17 @@ def test_analyzer_budget_and_json_artifact():
 
 
 def _analysis_json(*extra_args):
+    """Run the analyzer; returns (proc, artifact, child CPU seconds).
+    CPU seconds — not the artifact's wall-clock elapsed_s — feed the
+    budget assertions (see ANALYZER_BUDGET_S: pin work, not speed)."""
+    cpu0 = _child_cpu_s()
     proc = subprocess.run(
         [sys.executable, "-m", "rtap_tpu.analysis", "--json", *extra_args],
         cwd=REPO, capture_output=True, text=True, timeout=120,
     )
+    cpu = _child_cpu_s() - cpu0
     art = json.loads(proc.stdout.splitlines()[-1])["analysis"]
-    return proc, art
+    return proc, art, cpu
 
 
 def test_findings_cache_cold_vs_hit_identical_and_subsecond(tmp_path):
@@ -171,12 +187,12 @@ def test_findings_cache_cold_vs_hit_identical_and_subsecond(tmp_path):
     minus timing/cache-mode), and the hit must be sub-second — the
     whole point of hashing instead of re-parsing ~100 files."""
     cache = str(tmp_path / "lint_cache.json")
-    _p1, art1 = _analysis_json("--cache-path", cache)
-    _p2, art2 = _analysis_json("--cache-path", cache)
+    _p1, art1, _cpu1 = _analysis_json("--cache-path", cache)
+    _p2, art2, cpu2 = _analysis_json("--cache-path", cache)
     assert art1["cache"] == "cold"
     assert art2["cache"] == "hit"
-    assert art2["elapsed_s"] < 1.0, (
-        f"cache hit took {art2['elapsed_s']}s — the incremental path "
+    assert cpu2 < 1.0, (
+        f"cache hit burned {cpu2:.2f} CPU s — the incremental path "
         "must stay sub-second")
     for volatile in ("elapsed_s", "cache"):
         art1.pop(volatile), art2.pop(volatile)
@@ -197,7 +213,7 @@ def test_findings_cache_invalidated_by_file_edit(tmp_path):
     with open(victim, "w") as f:
         f.write('import sys\nprint("x", file=sys.stderr)\n')
     try:
-        proc, art = _analysis_json("--cache-path", cache)
+        proc, art, _cpu = _analysis_json("--cache-path", cache)
     finally:
         _cleanup(victim, subdir)
     assert proc.returncode != 0
@@ -206,7 +222,7 @@ def test_findings_cache_invalidated_by_file_edit(tmp_path):
                for f in art["findings"])
     # ... and reverting the edit re-runs again (file-set hash): the
     # next run is live and green, not a stale red replay
-    proc3, art3 = _analysis_json("--cache-path", cache)
+    proc3, art3, _cpu3 = _analysis_json("--cache-path", cache)
     assert proc3.returncode == 0 and art3["cache"] == "warm"
     # EDITING an existing file (content change, same file set) must
     # also re-run — the per-file content hash, not the path list, is
@@ -217,7 +233,7 @@ def test_findings_cache_invalidated_by_file_edit(tmp_path):
     with open(target, "a", encoding="utf-8") as f:
         f.write("\n# cache-invalidation canary (comment only)\n")
     try:
-        _proc4, art4 = _analysis_json("--cache-path", cache)
+        _proc4, art4, _cpu4 = _analysis_json("--cache-path", cache)
     finally:
         with open(target, "w", encoding="utf-8") as f:
             f.write(original)
@@ -238,8 +254,8 @@ def test_findings_cache_warm_equals_cold_and_meets_budget(tmp_path):
     with open(target, "a", encoding="utf-8") as f:
         f.write("\n# warm-budget canary (comment only)\n")
     try:
-        _p, warm = _analysis_json("--cache-path", cache)
-        _p2, cold = _analysis_json("--no-cache")
+        _p, warm, warm_cpu = _analysis_json("--cache-path", cache)
+        _p2, cold, _cold_cpu = _analysis_json("--no-cache")
     finally:
         with open(target, "w", encoding="utf-8") as f:
             f.write(original)
@@ -248,8 +264,8 @@ def test_findings_cache_warm_equals_cold_and_meets_budget(tmp_path):
     # mesh model + two new program passes (partition-contract,
     # scaling-math) add ~0.4 s of per-warm-run work that per-file
     # partitioning cannot elide (their inputs are cross-file by nature)
-    assert warm["elapsed_s"] < 3.0, (
-        f"warm run took {warm['elapsed_s']}s — per-file pass reuse "
+    assert warm_cpu < 3.0, (
+        f"warm run burned {warm_cpu:.2f} CPU s — per-file pass reuse "
         "must keep incremental runs fast")
     for volatile in ("elapsed_s", "cache"):
         warm.pop(volatile), cold.pop(volatile)
